@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Metric: tokens/sec/chip for GPT-2-125M causal-LM training (ZeRO-1, bf16,
+fused jitted train step) on the available device(s). ``vs_baseline`` compares
+against an estimated NCCL/A100 DeepSpeed throughput for the same model
+(A100 bf16 peak 312 TFLOPs at ~40% MFU → ~167k tokens/s for a 125M-param model;
+see BASELINE.md — the reference publishes no directly comparable table).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "")
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+    n_chips = jax.device_count()
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", 8))
+    seq_len = int(os.environ.get("BENCH_SEQ", 1024))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    model = os.environ.get("BENCH_MODEL", "gpt2_125m")
+
+    spec = dst.causal_lm_spec(model, remat="none")
+    config = {
+        "train_batch_size": batch_per_chip * n_chips,
+        "train_micro_batch_size_per_gpu": batch_per_chip,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    data = synthetic_lm_data(batch_per_chip * n_chips, seq_len,
+                             spec_vocab(spec), seed=0)
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = engine.train_batch(data)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = steps * batch_per_chip * n_chips * seq_len
+    tokens_per_sec_chip = tokens / dt / n_chips
+    baseline = 167_000.0  # est. A100 DeepSpeed tokens/s/GPU for 125M @ 40% MFU
+    print(json.dumps({
+        "metric": "tokens/sec/chip gpt2_125m zero1 bf16",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec_chip / baseline, 3),
+    }))
+
+
+def spec_vocab(spec):
+    from deepspeed_tpu.models.transformer import PRESETS
+
+    return PRESETS[os.environ.get("BENCH_MODEL", "gpt2_125m")].vocab_size
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedTPUAccelerator
+from deepspeed_tpu.accelerator.real_accelerator import (
+    SUPPORTED_ACCELERATOR_LIST,
+    get_accelerator,
+    is_current_accelerator_supported,
+    set_accelerator,
+)
+
+__all__ = [
+    "DeepSpeedTPUAccelerator",
+    "SUPPORTED_ACCELERATOR_LIST",
+    "get_accelerator",
+    "is_current_accelerator_supported",
+    "set_accelerator",
+]

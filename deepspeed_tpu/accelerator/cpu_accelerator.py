@@ -1,0 +1,59 @@
+"""CPU accelerator — the no-cluster test/portability escape hatch.
+
+Parity role: the reference's ``cpu_accelerator.py`` + gloo path is how its unit
+suite runs without GPUs (SURVEY.md §4). Here the same role is played by JAX's host
+platform, typically forced to N virtual devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedTPUAccelerator
+
+
+class CPU_Accelerator(DeepSpeedTPUAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "jax_ici"
+
+    def is_synchronized_device(self) -> bool:
+        return True
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        return "cpu" if device_index is None else f"cpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        import jax
+
+        return jax.local_devices(backend="cpu")[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        try:
+            import psutil
+
+            vm = psutil.virtual_memory()
+            return {"bytes_in_use": vm.used, "peak_bytes_in_use": vm.used,
+                    "bytes_limit": vm.total}
+        except Exception:
+            return {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder"
+
+    def is_available(self) -> bool:
+        return True
+
+    def is_bf16_supported(self) -> bool:
+        return True

@@ -1,0 +1,66 @@
+"""Concrete TPU accelerator (parity: reference ``accelerator/cuda_accelerator.py``)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedTPUAccelerator
+
+
+class TPU_Accelerator(DeepSpeedTPUAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "jax_ici"
+
+    def is_synchronized_device(self) -> bool:
+        # XLA executes a single ordered program per device; no user-visible streams.
+        return True
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        import jax
+
+        return jax.local_devices()[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        import jax
+
+        devs = jax.local_devices()
+        dev = devs[device_index or 0]
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        return {
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+        }
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder"
+
+    def is_available(self) -> bool:
+        import jax
+
+        try:
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def is_triton_supported(self) -> bool:
+        return False

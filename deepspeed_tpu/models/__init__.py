@@ -1,0 +1,10 @@
+from deepspeed_tpu.models.api import ModelSpec, causal_lm_spec
+from deepspeed_tpu.models.transformer import (
+    PRESETS,
+    TransformerConfig,
+    causal_lm_loss,
+    forward,
+    get_model_config,
+    init_params,
+    param_logical_axes,
+)

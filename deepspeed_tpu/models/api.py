@@ -1,0 +1,74 @@
+"""ModelSpec — the contract between user models and the engine.
+
+The reference wraps ``nn.Module`` objects (``runtime/engine.py:235``); this
+framework is functional, so a model is a triple of pure functions plus sharding
+metadata. Adapters exist for the built-in transformer zoo (here) and flax modules
+(``models/flax_adapter.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import transformer as T
+
+PyTree = Any
+Batch = Union[jax.Array, Dict[str, jax.Array]]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    init_fn: Callable[[jax.Array], PyTree]            # rng → fp32 params
+    loss_fn: Callable[[PyTree, Batch], jax.Array]     # (compute params, batch) → scalar
+    axes_fn: Callable[[], PyTree]                     # → logical-axes tree
+    apply_fn: Optional[Callable[[PyTree, Batch], Any]] = None  # → model outputs
+    name: str = "model"
+    num_params: Optional[int] = None
+
+
+def _tokens_of(batch: Batch) -> jax.Array:
+    if isinstance(batch, dict):
+        return batch["tokens"]
+    return batch
+
+
+def _mask_of(batch: Batch):
+    if isinstance(batch, dict):
+        return batch.get("loss_mask")
+    return None
+
+
+def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
+                   attention_fn=None, activation_constraint=None,
+                   **overrides) -> ModelSpec:
+    """Build a ModelSpec for a causal-LM transformer preset or config."""
+    if isinstance(cfg, str):
+        name = cfg
+        cfg = T.get_model_config(cfg, **overrides)
+    else:
+        name = "transformer"
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+
+    def loss_fn(params, batch):
+        logits = T.forward(params, _tokens_of(batch), cfg,
+                           attention_fn=attention_fn,
+                           activation_constraint=activation_constraint)
+        return T.causal_lm_loss(logits, _tokens_of(batch), _mask_of(batch))
+
+    def apply_fn(params, batch):
+        return T.forward(params, _tokens_of(batch), cfg,
+                         attention_fn=attention_fn,
+                         activation_constraint=activation_constraint)
+
+    return ModelSpec(
+        init_fn=lambda rng: T.init_params(cfg, rng),
+        loss_fn=loss_fn,
+        apply_fn=apply_fn,
+        axes_fn=lambda: T.param_logical_axes(cfg),
+        name=name,
+        num_params=cfg.num_params(),
+    )

@@ -1,0 +1,371 @@
+"""Torch-free transformer model zoo (GPT-2 and Llama families).
+
+Role: the reference ships no model zoo for training (users bring HF/Megatron
+models; its test fixtures are ``tests/unit/simple_model.py``), but its inference
+engine has per-model implementations (``inference/v2/model_implementations/``).
+This framework is torch-free, so the model zoo is first-class: functional JAX
+models designed for the compiler —
+
+* **scan over layers**: per-layer params are stacked on a leading 'layers' dim and
+  the forward is a ``lax.scan`` → O(1) compile time in depth, natural hook for
+  pipeline sharding and per-layer remat;
+* **logical sharding axes**: every param carries a tuple of logical axis names
+  (`("layers", "embed", "heads")`) consumed by ``parallel/partitioning.py`` — the
+  AutoTP analog;
+* **pluggable attention**: the attention callable can be swapped for the Pallas
+  flash kernel, Ulysses all-to-all attention, or ring attention without touching
+  the model.
+
+Numerics: matmuls in the compute dtype (bf16 by default) with fp32 softmax and
+fp32 layernorm/rmsnorm accumulation — MXU-friendly per the TPU guide.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+AttentionFn = Callable[..., jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # None → MHA; < num_heads → GQA
+    ffn_hidden_size: Optional[int] = None
+    max_seq_len: int = 1024
+    pos_emb: str = "learned"            # learned | rope | none
+    norm: str = "layernorm"             # layernorm | rmsnorm
+    activation: str = "gelu"            # gelu | swiglu
+    use_bias: bool = True
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    init_std: float = 0.02
+    dtype: str = "bfloat16"             # compute dtype
+    remat: str = "none"                 # none | full | dots_saveable
+    causal: bool = True                 # False → bidirectional encoder (BERT)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        if self.activation == "swiglu":
+            # Llama sizing: 2/3 * 4H rounded to multiple of 256
+            raw = int(8 * self.hidden_size / 3)
+            return 256 * ((raw + 255) // 256)
+        return 4 * self.hidden_size
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def num_params(self) -> int:
+        h, f, v, l = self.hidden_size, self.ffn_size, self.vocab_size, self.num_layers
+        kv = self.kv_heads * self.head_dim
+        per_layer = h * h + 2 * h * kv + h * h  # q, k, v, o
+        per_layer += (3 if self.activation == "swiglu" else 2) * h * f
+        per_layer += 2 * h  # norms
+        total = l * per_layer + v * h + 2 * h
+        if not self.tie_embeddings:
+            total += v * h
+        if self.pos_emb == "learned":
+            total += self.max_seq_len * h
+        return total
+
+
+# --------------------------------------------------------------------------- #
+# init
+# --------------------------------------------------------------------------- #
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
+    """fp32 master parameters. Output projections scaled by 1/sqrt(2L) (GPT-2)."""
+    h, f, L = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+    qdim = cfg.num_heads * cfg.head_dim
+    kvdim = cfg.kv_heads * cfg.head_dim
+    std = cfg.init_std
+    out_std = std / math.sqrt(2 * L)
+    keys = jax.random.split(rng, 16)
+
+    def norm_init(shape):
+        p = {"scale": jnp.ones(shape, jnp.float32)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros(shape, jnp.float32)
+        return p
+
+    def dense(key, shape, s):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    block = {
+        "ln1": norm_init((L, h)),
+        "ln2": norm_init((L, h)),
+        "wq": dense(keys[0], (L, h, qdim), std),
+        "wk": dense(keys[1], (L, h, kvdim), std),
+        "wv": dense(keys[2], (L, h, kvdim), std),
+        "wo": dense(keys[3], (L, qdim, h), out_std),
+        "w_up": dense(keys[4], (L, h, f), std),
+        "w_down": dense(keys[5], (L, f, h), out_std),
+    }
+    if cfg.activation == "swiglu":
+        block["w_gate"] = dense(keys[6], (L, h, f), std)
+    if cfg.use_bias:
+        block["bq"] = jnp.zeros((L, qdim), jnp.float32)
+        block["bk"] = jnp.zeros((L, kvdim), jnp.float32)
+        block["bv"] = jnp.zeros((L, kvdim), jnp.float32)
+        block["bo"] = jnp.zeros((L, h), jnp.float32)
+        block["b_up"] = jnp.zeros((L, f), jnp.float32)
+        block["b_down"] = jnp.zeros((L, h), jnp.float32)
+
+    params = {
+        "tok_emb": dense(keys[7], (cfg.vocab_size, h), std),
+        "blocks": block,
+        "final_norm": norm_init((h,)),
+    }
+    if cfg.pos_emb == "learned":
+        params["pos_emb"] = dense(keys[8], (cfg.max_seq_len, h), std)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[9], (h, cfg.vocab_size), std)
+    return params
+
+
+def param_logical_axes(cfg: TransformerConfig) -> PyTree:
+    """Logical axis names per parameter dim (consumed by the sharding policy)."""
+    def norm_axes(prefix):
+        p = {"scale": prefix + ("embed",)}
+        if cfg.norm == "layernorm":
+            p["bias"] = prefix + ("embed",)
+        return p
+
+    lyr = ("layers",)
+    block = {
+        "ln1": norm_axes(lyr),
+        "ln2": norm_axes(lyr),
+        "wq": lyr + ("embed", "heads"),
+        "wk": lyr + ("embed", "kv_heads"),
+        "wv": lyr + ("embed", "kv_heads"),
+        "wo": lyr + ("heads", "embed"),
+        "w_up": lyr + ("embed", "mlp"),
+        "w_down": lyr + ("mlp", "embed"),
+    }
+    if cfg.activation == "swiglu":
+        block["w_gate"] = lyr + ("embed", "mlp")
+    if cfg.use_bias:
+        block.update({
+            "bq": lyr + ("heads",), "bk": lyr + ("kv_heads",), "bv": lyr + ("kv_heads",),
+            "bo": lyr + ("embed",), "b_up": lyr + ("mlp",), "b_down": lyr + ("embed",),
+        })
+    axes = {
+        "tok_emb": ("vocab", "embed"),
+        "blocks": block,
+        "final_norm": norm_axes(()),
+    }
+    if cfg.pos_emb == "learned":
+        axes["pos_emb"] = ("seq", "embed")
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------------- #
+# building blocks
+# --------------------------------------------------------------------------- #
+
+def _norm(x: jax.Array, p: Dict[str, jax.Array], kind: str, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * lax.rsqrt(var + eps) * p["scale"]
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        out = (x32 - mean) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return out.astype(dtype)
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)          # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, N, D]; rotates pairs (interleaved halves convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = True,
+                          segment_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Reference (XLA-fused) attention. q:[B,S,N,D] k,v:[B,S,K,D]. fp32 softmax."""
+    B, S, N, D = q.shape
+    K = k.shape[2]
+    if K != N:
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    if segment_mask is not None:
+        scores = jnp.where(segment_mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
+                   cos: Optional[jax.Array], sin: Optional[jax.Array],
+                   attention_fn: AttentionFn) -> jax.Array:
+    """One transformer block; lp holds this layer's (unstacked) params."""
+    B, S, H = x.shape
+    dt = cfg.compute_dtype
+
+    def proj(name, inp, shape):
+        w = lp[f"w{name}"].astype(dt)
+        out = inp @ w
+        if cfg.use_bias:
+            out = out + lp[f"b{name}"].astype(dt)
+        return out.reshape(shape)
+
+    h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+    q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
+    k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
+    v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
+    if cfg.pos_emb == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    attn = attention_fn(q, k, v, causal=cfg.causal)
+    attn = attn.reshape(B, S, cfg.num_heads * cfg.head_dim)
+    attn_out = attn @ lp["wo"].astype(dt)
+    if cfg.use_bias:
+        attn_out = attn_out + lp["bo"].astype(dt)
+    x = x + attn_out
+
+    h = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+    up = h @ lp["w_up"].astype(dt)
+    if cfg.use_bias:
+        up = up + lp["b_up"].astype(dt)
+    if cfg.activation == "swiglu":
+        gate = h @ lp["w_gate"].astype(dt)
+        act = jax.nn.silu(gate) * up
+    else:
+        act = jax.nn.gelu(up, approximate=True)
+    down = act @ lp["w_down"].astype(dt)
+    if cfg.use_bias:
+        down = down + lp["b_down"].astype(dt)
+    return x + down
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+            attention_fn: Optional[AttentionFn] = None,
+            activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
+            ) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] in fp32."""
+    attention_fn = attention_fn or dot_product_attention
+    constrain = activation_constraint or (lambda x: x)
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+
+    x = params["tok_emb"].astype(dt)[tokens]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_emb"].astype(dt)[:S][None]
+    x = constrain(x)
+
+    cos = sin = None
+    if cfg.pos_emb == "rope":
+        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, layer_params):
+        y = _block_forward(carry, layer_params, cfg, cos, sin, attention_fn)
+        return constrain(y), None
+
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots_saveable":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_saveable)
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
+                   loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross entropy; stable log-softmax in fp32."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+# --------------------------------------------------------------------------- #
+# presets (names mirror the driver's milestone configs, BASELINE.md)
+# --------------------------------------------------------------------------- #
+
+PRESETS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                              num_heads=4, max_seq_len=128),
+    "tiny_llama": TransformerConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                                    num_heads=4, num_kv_heads=2, max_seq_len=128,
+                                    pos_emb="rope", norm="rmsnorm",
+                                    activation="swiglu", use_bias=False,
+                                    tie_embeddings=False),
+    "gpt2_125m": TransformerConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                                   num_heads=12, max_seq_len=1024),
+    "gpt2_350m": TransformerConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                                   num_heads=16, max_seq_len=1024),
+    "gpt2_1p5b": TransformerConfig(vocab_size=50304, hidden_size=1600, num_layers=48,
+                                   num_heads=25, max_seq_len=1024),
+    "bert_large": TransformerConfig(vocab_size=30528, hidden_size=1024, num_layers=24,
+                                    num_heads=16, max_seq_len=512, causal=False),
+    "llama2_7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                                   num_heads=32, ffn_hidden_size=11008,
+                                   max_seq_len=4096, pos_emb="rope", norm="rmsnorm",
+                                   activation="swiglu", use_bias=False,
+                                   tie_embeddings=False),
+    "llama2_13b": TransformerConfig(vocab_size=32000, hidden_size=5120, num_layers=40,
+                                    num_heads=40, ffn_hidden_size=13824,
+                                    max_seq_len=4096, pos_emb="rope", norm="rmsnorm",
+                                    activation="swiglu", use_bias=False,
+                                    tie_embeddings=False),
+}
+
+
+def get_model_config(name: str, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown model preset {name!r}; available: {sorted(PRESETS)}")
+    return dataclasses.replace(PRESETS[name], **overrides)

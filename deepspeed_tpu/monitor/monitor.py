@@ -1,0 +1,117 @@
+"""Monitoring fan-out: TensorBoard / CSV / W&B.
+
+Parity: reference ``monitor/monitor.py:30`` (``MonitorMaster`` fanning out to
+``TensorBoardMonitor``, ``WandbMonitor``, ``csvMonitor``). Events are
+``(tag, value, step)`` triples written from process 0 only (SPMD: every host has
+identical values; writing once is the rank-0 gating analog).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class csvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = getattr(config, "output_path", "") or "./csv_monitor"
+        self.job_name = getattr(config, "job_name", "job")
+        self._files = {}
+        if self.enabled and jax.process_index() == 0:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled or jax.process_index() != 0:
+            return
+        for tag, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(getattr(config, "output_path", "") or "./runs",
+                                    getattr(config, "job_name", "job"))
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception as e:  # tensorboard optional
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.writer is None:
+            return
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.run = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+
+                self.run = wandb.init(
+                    project=getattr(config, "project", None) or "deepspeed_tpu",
+                    group=getattr(config, "group", None),
+                    name=getattr(config, "job_name", None))
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.run is None:
+            return
+        import wandb
+
+        for tag, value, step in events:
+            wandb.log({tag: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled backends (reference ``monitor/monitor.py:30``)."""
+
+    def __init__(self, ds_config):
+        self.backends: List[Monitor] = []
+        for backend_cls, cfg in (
+            (TensorBoardMonitor, ds_config.tensorboard),
+            (csvMonitor, ds_config.csv_monitor),
+            (WandbMonitor, ds_config.wandb),
+        ):
+            if getattr(cfg, "enabled", False):
+                self.backends.append(backend_cls(cfg))
+        self.enabled = any(b.enabled for b in self.backends)
+
+    def write_events(self, events: List[Event]) -> None:
+        for b in self.backends:
+            if b.enabled:
+                b.write_events(events)

@@ -1,0 +1,336 @@
+"""Functional optimizers — the ops/adam, ops/lion, ops/lamb, ops/adagrad family.
+
+Parity: reference ``ops/adam/fused_adam.py:18`` (FusedAdam, csrc/adam CUDA
+multi-tensor kernels), ``ops/lion``, ``ops/lamb``, ``ops/adagrad``,
+``zero/muon/muon_optimizer.py:14`` (Muon with aux Adam). On TPU "fusion" is XLA's
+job: each update below is a pure jnp expression over the (sharded) state pytree
+which XLA fuses into a handful of elementwise kernels per shard — the multi-tensor
+apply machinery is unnecessary. A Pallas fused path exists for the hottest case
+(see ``deepspeed_tpu/ops/pallas/fused_adam.py``).
+
+State layout mirrors the param pytree per-moment ({"exp_avg": tree, ...}) so the
+ZeRO sharding policy (``parallel/partitioning.py``) derives optimizer-state
+shardings directly from param shardings — the stage-1 partitioning analog.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+PyTree = Any
+
+
+def _tmap(fn, *trees, **kwargs):
+    return jax.tree.map(fn, *trees, **kwargs)
+
+
+@dataclasses.dataclass
+class TPUOptimizer:
+    """Base: subclasses define per-leaf math; state mirrors params per moment."""
+
+    lr: float = 1e-3
+    weight_decay: float = 0.0
+
+    # names of per-leaf moment buffers, e.g. ("exp_avg", "exp_avg_sq")
+    moment_names: Tuple[str, ...] = ()
+
+    def init(self, params: PyTree) -> Dict[str, Any]:
+        state = {name: _tmap(jnp.zeros_like, params) for name in self.moment_names}
+        state["step"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def update(self, grads: PyTree, state: Dict[str, Any], params: PyTree,
+               lr: Optional[jax.Array] = None) -> Tuple[PyTree, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def state_moment_trees(self, state: Dict[str, Any]):
+        return {k: state[k] for k in self.moment_names}
+
+
+@dataclasses.dataclass
+class FusedAdam(TPUOptimizer):
+    """Adam/AdamW (reference ``ops/adam/fused_adam.py``; ``adam_w_mode`` semantics)."""
+
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    adam_w_mode: bool = True
+    bias_correction: bool = True
+    moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq")
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state["step"] + 1
+        sf = step.astype(jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** sf
+            bc2 = 1.0 - b2 ** sf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(p, g, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + self.weight_decay * p32
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * jnp.square(g)
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.adam_w_mode and self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            return (p32 - lr * upd).astype(p.dtype), m, v
+
+        out = _tmap(leaf, params, grads, state["exp_avg"], state["exp_avg_sq"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = _tmap(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"exp_avg": new_m, "exp_avg_sq": new_v, "step": step}
+
+
+@dataclasses.dataclass
+class Lion(TPUOptimizer):
+    """Lion (reference ``ops/lion``/``csrc/lion``): sign of interpolated momentum."""
+
+    betas: Tuple[float, float] = (0.9, 0.99)
+    moment_names: Tuple[str, ...] = ("exp_avg",)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        def leaf(p, g, m):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            upd = jnp.sign(b1 * m + (1.0 - b1) * g)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            m_new = b2 * m + (1.0 - b2) * g
+            return (p32 - lr * upd).astype(p.dtype), m_new
+
+        out = _tmap(leaf, params, grads, state["exp_avg"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"exp_avg": new_m, "step": state["step"] + 1}
+
+
+@dataclasses.dataclass
+class FusedLamb(TPUOptimizer):
+    """LAMB (reference ``ops/lamb``): Adam direction × trust ratio per layer."""
+
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-6
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq")
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state["step"] + 1
+        sf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** sf
+        bc2 = 1.0 - b2 ** sf
+
+        def leaf(p, g, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * jnp.square(g)
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(upd)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff), 1.0)
+            return (p32 - lr * trust * upd).astype(p.dtype), m, v
+
+        out = _tmap(leaf, params, grads, state["exp_avg"], state["exp_avg_sq"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = _tmap(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"exp_avg": new_m, "exp_avg_sq": new_v, "step": step}
+
+
+@dataclasses.dataclass
+class FusedAdagrad(TPUOptimizer):
+    """Adagrad (reference ``ops/adagrad``/``csrc/adagrad``)."""
+
+    eps: float = 1e-10
+    moment_names: Tuple[str, ...] = ("sum_sq",)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, s):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p32
+            s = s + jnp.square(g)
+            return (p32 - lr * g / (jnp.sqrt(s) + self.eps)).astype(p.dtype), s
+
+        out = _tmap(leaf, params, grads, state["sum_sq"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_s = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"sum_sq": new_s, "step": state["step"] + 1}
+
+
+@dataclasses.dataclass
+class SGD(TPUOptimizer):
+    momentum: float = 0.0
+    nesterov: bool = False
+    moment_names: Tuple[str, ...] = ("momentum_buf",)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, buf):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p32
+            buf = self.momentum * buf + g
+            d = (g + self.momentum * buf) if self.nesterov else \
+                (buf if self.momentum else g)
+            return (p32 - lr * d).astype(p.dtype), buf
+
+        out = _tmap(leaf, params, grads, state["momentum_buf"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_buf = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"momentum_buf": new_buf, "step": state["step"] + 1}
+
+
+def _newton_schulz_orthogonalize(g: jax.Array, steps: int = 5, eps: float = 1e-7) -> jax.Array:
+    """Quintic Newton-Schulz iteration approximating the orthogonal factor of g.
+
+    The Muon core (reference ``zero/muon/muon_optimizer.py``); runs on the MXU in
+    bfloat16 — matmul-dominated by design.
+    """
+    a, b, c = 3.4445, -4.7750, 2.0315
+    transpose = g.shape[0] > g.shape[1]
+    x = g.astype(jnp.bfloat16)
+    if transpose:
+        x = x.T
+    x = x / (jnp.linalg.norm(x.astype(jnp.float32)).astype(jnp.bfloat16) + eps)
+
+    def body(_, x):
+        xxt = x @ x.T
+        return a * x + (b * xxt + c * (xxt @ xxt)) @ x
+
+    x = jax.lax.fori_loop(0, steps, body, x)
+    if transpose:
+        x = x.T
+    return x.astype(jnp.float32)
+
+
+@dataclasses.dataclass
+class Muon(TPUOptimizer):
+    """Muon with aux Adam for non-matrix params (reference
+    ``zero/muon/muon_optimizer.py:14``: linear-layer weight matrices take the
+    orthogonalized-momentum path; embeddings/heads/norms/biases take Adam — the
+    reference flags params explicitly at ``__init__.py:84-90``).
+
+    Routing here is by parameter name + rank: leaves whose path mentions
+    emb/head/norm/bias/scale, or with rank < 2, take Adam. Rank-2 matrices and
+    rank-3 *stacked* layer matrices (scan-over-layers layout ``(L, m, n)``) take
+    Muon — the stacked case is vmapped over the leading layer dim."""
+
+    momentum: float = 0.95
+    ns_steps: int = 5
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq")
+
+    _ADAM_NAME_HINTS = ("emb", "head", "norm", "bias", "scale", "ln")
+
+    def _use_muon(self, path: str, p) -> bool:
+        name = path.lower()
+        if any(h in name for h in self._ADAM_NAME_HINTS):
+            return False
+        return p.ndim in (2, 3) and min(p.shape[-2:]) >= 16
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state["step"] + 1
+        sf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** sf
+        bc2 = 1.0 - b2 ** sf
+
+        def leaf(path, p, g, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self._use_muon(jax.tree_util.keystr(path), p):
+                buf = self.momentum * m + g
+                ns = _newton_schulz_orthogonalize
+                ortho = (jax.vmap(lambda x: ns(x, self.ns_steps))(buf)
+                         if p.ndim == 3 else ns(buf, self.ns_steps))
+                scale = jnp.sqrt(jnp.float32(max(1.0, p.shape[-2] / p.shape[-1])))
+                upd = ortho * scale
+                if self.weight_decay:
+                    upd = upd + self.weight_decay * p32
+                return (p32 - lr * upd).astype(p.dtype), buf, v
+            m2 = b1 * m + (1.0 - b1) * g
+            v2 = b2 * v + (1.0 - b2) * jnp.square(g)
+            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            return (p32 - lr * upd).astype(p.dtype), m2, v2
+
+        out = jax.tree_util.tree_map_with_path(
+            leaf, params, grads, state["exp_avg"], state["exp_avg_sq"])
+        new_params = _tmap(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = _tmap(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = _tmap(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"exp_avg": new_m, "exp_avg_sq": new_v, "step": step}
+
+
+_OPTIMIZERS = {
+    "adam": FusedAdam,
+    "adamw": FusedAdam,
+    "fusedadam": FusedAdam,
+    "lion": Lion,
+    "fusedlion": Lion,
+    "lamb": FusedLamb,
+    "fusedlamb": FusedLamb,
+    "adagrad": FusedAdagrad,
+    "sgd": SGD,
+    "muon": Muon,
+}
+
+# 1-bit optimizers compress the *communication*; on TPU grads ride ICI and the
+# quantized-collective path (ops/pallas/quantization) plays that role. Map the
+# optimizer math to its base.
+_ONEBIT_ALIASES = {
+    "onebitadam": "adam", "zerooneadam": "adam", "onebitlamb": "lamb",
+}
+
+
+def get_optimizer(name: str, params: Dict[str, Any]) -> TPUOptimizer:
+    key = name.lower().replace("_", "")
+    if key in _ONEBIT_ALIASES:
+        logger.warning(
+            f"optimizer {name!r}: 1-bit communication compression is handled by the "
+            "quantized-collective path on TPU; using base optimizer math")
+        key = _ONEBIT_ALIASES[key]
+    if key not in _OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {name!r}; supported: {sorted(_OPTIMIZERS)}")
+    cls = _OPTIMIZERS[key]
+    kwargs = dict(params)
+    if "betas" in kwargs:
+        kwargs["betas"] = tuple(kwargs["betas"])
+    kwargs.pop("torch_adam", None)
+    kwargs.pop("adam_w_mode", None) if cls is not FusedAdam else None
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(kwargs) - field_names
+    for k in unknown:
+        logger.warning(f"optimizer param {k!r} not supported by {cls.__name__} — ignored")
+        kwargs.pop(k)
+    return cls(**kwargs)

@@ -1,0 +1,58 @@
+"""Typed-config plumbing.
+
+Parity: reference ``runtime/config_utils.py`` (``DeepSpeedConfigModel`` pydantic
+base). Implemented as dataclasses with a strict ``from_dict`` that reports unknown
+keys — same user-facing behavior (typo detection, defaults, nesting) without the
+pydantic dependency.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Type, TypeVar, get_args, get_origin, get_type_hints
+
+from deepspeed_tpu.utils.logging import logger
+
+T = TypeVar("T")
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def config_from_dict(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
+    """Build dataclass ``cls`` from a JSON dict, recursing into nested configs."""
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise DeepSpeedConfigError(f"config section {path or cls.__name__} must be a "
+                                   f"dict, got {type(data).__name__}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    hints = get_type_hints(cls)
+    kwargs: Dict[str, Any] = {}
+    for key, value in data.items():
+        if key not in fields:
+            logger.warning(f"unknown config key {path + key!r} — ignored")
+            continue
+        ftype = hints.get(key, fields[key].type)
+        origin = get_origin(ftype)
+        if origin is None and dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            kwargs[key] = config_from_dict(ftype, value, path=f"{path}{key}.")
+        elif origin is not None and type(None) in get_args(ftype):
+            inner = [a for a in get_args(ftype) if a is not type(None)]
+            if len(inner) == 1 and dataclasses.is_dataclass(inner[0]) and isinstance(value, dict):
+                kwargs[key] = config_from_dict(inner[0], value, path=f"{path}{key}.")
+            else:
+                kwargs[key] = value
+        else:
+            kwargs[key] = value
+    try:
+        obj = cls(**kwargs)
+    except TypeError as e:
+        raise DeepSpeedConfigError(f"invalid config section {path or cls.__name__}: {e}")
+    if hasattr(obj, "validate"):
+        obj.validate()
+    return obj
+
+
+def config_to_dict(obj) -> Dict[str, Any]:
+    return dataclasses.asdict(obj)

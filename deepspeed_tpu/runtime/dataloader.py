@@ -1,0 +1,89 @@
+"""Deterministic sharded data loading for SPMD training.
+
+Parity: reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader``,
+``RepeatingLoader``). SPMD twist: a batch is ONE global ``jax.Array`` sharded
+over the mesh, not per-rank tensors — each host feeds its addressable shard via
+``jax.make_array_from_process_local_data``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+PyTree = Any
+
+
+class RepeatingLoader:
+    """Wraps a re-iterable, restarting it when exhausted (reference analog).
+
+    Generators cannot be restarted — ``iter()`` on an exhausted generator returns
+    the same exhausted object — so they are rejected with a clear error rather
+    than silently raising StopIteration mid-epoch.
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+        if self.data_iter is loader:
+            raise TypeError(
+                "RepeatingLoader needs a re-iterable source (list, DataLoader, ...); "
+                "got a one-shot iterator/generator. Make the source infinite instead "
+                "(e.g. synthetic_lm_data(num_batches=None)) or pass a sequence.")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedTPUDataLoader:
+    """Yields global sharded batches from a host-local numpy source.
+
+    ``source`` yields numpy pytrees with a leading *global* batch dim (single
+    process) or the process-local slice (multi-host) — ``make_array_from_
+    process_local_data`` assembles the global array either way.
+    """
+
+    def __init__(self, source, batch_sharding: NamedSharding,
+                 drop_last: bool = True):
+        self.source = source
+        self.batch_sharding = batch_sharding
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[PyTree]:
+        for host_batch in self.source:
+            yield shard_host_batch(host_batch, self.batch_sharding)
+
+    def __len__(self):
+        return len(self.source)
+
+
+def shard_host_batch(host_batch: PyTree, sharding: NamedSharding) -> PyTree:
+    def put(x):
+        x = np.asarray(x)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(put, host_batch)
+
+
+def synthetic_lm_data(batch_size: int, seq_len: int, vocab_size: int,
+                      seed: int = 0, num_batches: Optional[int] = None,
+                      dtype=np.int32):
+    """Deterministic synthetic token stream (the ``random_dataloader`` fixture
+    analog, reference ``tests/unit/simple_model.py:275``)."""
+    rng = np.random.default_rng(seed)
+    i = 0
+    while num_batches is None or i < num_batches:
+        yield {"tokens": rng.integers(0, vocab_size, (batch_size, seq_len), dtype=dtype)}
+        i += 1

@@ -1,0 +1,107 @@
+"""Static + dynamic loss scaling for fp16 training.
+
+Parity: reference ``runtime/fp16/loss_scaler.py`` (``LossScaler`` /
+``DynamicLossScaler``). TPU-native: the scaler state is a pytree carried inside
+the jitted train step; overflow detection is a global ``isfinite`` reduction on
+the (sharded) gradients, and the skip-update branch is a ``lax.cond`` — the same
+semantics as the reference's ``_overflow_check_and_loss_scale_update``
+(``stage3.py:2552``) without host round-trips.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LossScaleState:
+    scale: jax.Array          # f32 scalar
+    good_steps: jax.Array     # i32 scalar: consecutive overflow-free steps
+    hysteresis: jax.Array     # i32 scalar: remaining tolerated overflows
+
+    @staticmethod
+    def create(initial_scale: float, hysteresis: int = 2) -> "LossScaleState":
+        return LossScaleState(
+            scale=jnp.asarray(initial_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        )
+
+
+@dataclasses.dataclass
+class DynamicLossScaler:
+    """Config + pure update rules (state lives in the train step)."""
+
+    initial_scale: float = 2.0 ** 16
+    scale_factor: float = 2.0
+    scale_window: int = 1000
+    min_scale: float = 1.0
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    dynamic: bool = True
+
+    @staticmethod
+    def from_config(fp16_config) -> "DynamicLossScaler":
+        if not fp16_config.dynamic_loss_scale:
+            return DynamicLossScaler(initial_scale=fp16_config.loss_scale, dynamic=False)
+        return DynamicLossScaler(
+            initial_scale=2.0 ** fp16_config.initial_scale_power,
+            scale_window=fp16_config.loss_scale_window,
+            min_scale=fp16_config.min_loss_scale,
+            hysteresis=fp16_config.hysteresis,
+            consecutive_hysteresis=fp16_config.consecutive_hysteresis,
+        )
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState.create(self.initial_scale, self.hysteresis)
+
+    def has_overflow(self, grads: Any) -> jax.Array:
+        leaves = jax.tree.leaves(grads)
+        finite = jnp.asarray(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return jnp.logical_not(finite)
+
+    def update(self, state: LossScaleState, overflow: jax.Array) -> LossScaleState:
+        if not self.dynamic:
+            return state
+
+        def on_overflow(s: LossScaleState) -> LossScaleState:
+            hyst = s.hysteresis - 1
+            new_scale = jnp.where(
+                hyst <= 0,
+                jnp.maximum(s.scale / self.scale_factor, self.min_scale),
+                s.scale)
+            return LossScaleState(scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
+                                  hysteresis=jnp.maximum(hyst, 1))
+
+        def on_good(s: LossScaleState) -> LossScaleState:
+            good = s.good_steps + 1
+            grow = (good % self.scale_window) == 0
+            new_scale = jnp.where(grow, s.scale * self.scale_factor, s.scale)
+            hyst = jnp.asarray(self.hysteresis, jnp.int32) if self.consecutive_hysteresis \
+                else s.hysteresis
+            return LossScaleState(scale=new_scale, good_steps=good, hysteresis=hyst)
+
+        return jax.lax.cond(overflow, on_overflow, on_good, state)
+
+
+def global_grad_norm(grads: Any, axes=None) -> jax.Array:
+    """L2 norm over the full (possibly sharded) gradient pytree. Under pjit the
+    partial sums are combined by XLA; under shard_map pass reduction ``axes``."""
+    leaves = jax.tree.leaves(grads)
+    total = jnp.zeros((), jnp.float32)
+    for g in leaves:
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    if axes:
+        total = jax.lax.psum(total, axes)
+    return jnp.sqrt(total)
+
+
+def clip_by_global_norm(grads: Any, max_norm: float, norm: jax.Array) -> Any:
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype), grads)

@@ -1,0 +1,176 @@
+"""LR schedules: LRRangeTest / OneCycle / WarmupLR / WarmupDecayLR / WarmupCosineLR.
+
+Parity: reference ``runtime/lr_schedules.py:277-784``. Implemented as pure
+``step → lr`` functions (jit-compatible: they accept traced step values), wrapped
+in stateful classes exposing the reference's ``step()`` / ``get_last_lr()`` /
+``state_dict()`` API for user code.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+VALID_SCHEDULES = ["LRRangeTest", "OneCycle", "WarmupLR", "WarmupDecayLR",
+                   "WarmupCosineLR"]
+
+
+class LRSchedule:
+    """Base: holds base lr; subclasses implement lr_at(step) with jnp math."""
+
+    def __init__(self, base_lr: float):
+        self.base_lr = base_lr
+        self.last_batch_iteration = -1
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    # --- torch-like stateful API (reference behavior) ---
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        return [float(self.lr_at(jnp.maximum(0, self.last_batch_iteration)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class WarmupLR(LRSchedule):
+    """Linear warmup 0→base then constant (reference :672)."""
+
+    def __init__(self, base_lr: float, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 warmup_type: str = "log", **_):
+        super().__init__(warmup_max_lr)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        self.warmup_type = warmup_type
+
+    def _warmup_frac(self, step):
+        frac = jnp.clip(step.astype(jnp.float32) / self.warmup_num_steps, 0.0, 1.0)
+        if self.warmup_type == "log":
+            frac = jnp.log1p(frac * (math.e - 1.0))
+        return frac
+
+    def lr_at(self, step):
+        step = jnp.asarray(step)
+        return self.min_lr + (self.max_lr - self.min_lr) * self._warmup_frac(step)
+
+
+class WarmupDecayLR(WarmupLR):
+    """Warmup then linear decay to 0 at total_num_steps (reference :738)."""
+
+    def __init__(self, base_lr: float, total_num_steps: int = 10000, **kwargs):
+        super().__init__(base_lr, **kwargs)
+        self.total_num_steps = max(total_num_steps, self.warmup_num_steps)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step)
+        warm = super().lr_at(step)
+        decay = jnp.clip(
+            (self.total_num_steps - step.astype(jnp.float32))
+            / max(1, self.total_num_steps - self.warmup_num_steps), 0.0, 1.0)
+        return jnp.where(step < self.warmup_num_steps, warm, self.max_lr * decay)
+
+
+class WarmupCosineLR(LRSchedule):
+    """Linear warmup then cosine decay (reference :784)."""
+
+    def __init__(self, base_lr: float, total_num_steps: int = 10000,
+                 warmup_min_ratio: float = 0.0, warmup_num_steps: int = 1000,
+                 cos_min_ratio: float = 0.0001, **_):
+        super().__init__(base_lr)
+        self.total_num_steps = total_num_steps
+        self.warmup_min_ratio = warmup_min_ratio
+        self.warmup_num_steps = max(1, warmup_num_steps)
+        self.cos_min_ratio = cos_min_ratio
+
+    def lr_at(self, step):
+        step = jnp.asarray(step).astype(jnp.float32)
+        warm_ratio = self.warmup_min_ratio + (1 - self.warmup_min_ratio) * jnp.clip(
+            step / self.warmup_num_steps, 0.0, 1.0)
+        progress = jnp.clip((step - self.warmup_num_steps)
+                            / max(1, self.total_num_steps - self.warmup_num_steps), 0.0, 1.0)
+        cos_ratio = self.cos_min_ratio + (1 - self.cos_min_ratio) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress))
+        ratio = jnp.where(step < self.warmup_num_steps, warm_ratio, cos_ratio)
+        return self.base_lr * ratio
+
+
+class LRRangeTest(LRSchedule):
+    """LR range sweep for tuning (reference :277)."""
+
+    def __init__(self, base_lr: float, lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False, **_):
+        super().__init__(lr_range_test_min_lr)
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_at(self, step):
+        step = jnp.asarray(step).astype(jnp.float32)
+        count = step / self.step_size
+        if self.staircase:
+            count = jnp.floor(count)
+        return self.min_lr * (1 + self.step_rate * count)
+
+
+class OneCycle(LRSchedule):
+    """1-cycle policy (reference :391): lr up then down then decay."""
+
+    def __init__(self, base_lr: float, cycle_min_lr: float = 0.0, cycle_max_lr: float = 0.001,
+                 decay_lr_rate: float = 0.0, cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 decay_step_size: int = 0, **_):
+        super().__init__(cycle_max_lr)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first = cycle_first_step_size
+        self.second = cycle_second_step_size or cycle_first_step_size
+        self.decay_step_size = max(decay_step_size, 1)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step).astype(jnp.float32)
+        up = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * jnp.clip(
+            step / self.first, 0.0, 1.0)
+        down_progress = jnp.clip((step - self.first) / self.second, 0.0, 1.0)
+        down = self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * down_progress
+        end_cycle = self.first + self.second
+        decay_steps = jnp.maximum(0.0, step - end_cycle) / self.decay_step_size
+        decayed = self.cycle_min_lr / (1.0 + self.decay_lr_rate * decay_steps)
+        lr = jnp.where(step <= self.first, up,
+                       jnp.where(step <= end_cycle, down, decayed))
+        return lr
+
+
+_SCHEDULES = {
+    "WarmupLR": WarmupLR,
+    "WarmupDecayLR": WarmupDecayLR,
+    "WarmupCosineLR": WarmupCosineLR,
+    "LRRangeTest": LRRangeTest,
+    "OneCycle": OneCycle,
+}
+
+
+def get_lr_schedule(name: Optional[str], params: Dict[str, Any],
+                    base_lr: float) -> Optional[LRSchedule]:
+    if name is None:
+        return None
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown scheduler {name!r}; supported: {sorted(_SCHEDULES)}")
+    return _SCHEDULES[name](base_lr, **params)

@@ -1,0 +1,126 @@
+"""Per-collective size/latency/bandwidth statistics.
+
+Parity: reference ``deepspeed/utils/comms_logging.py`` (``CommsLogger`` with
+msg-size buckets, ``log_summary``). On TPU, collectives issued inside a traced
+program have no host-visible per-op latency; for those we record op counts and
+message sizes at trace time (exact, from static shapes) and estimate algorithmic
+bandwidth only for eagerly-executed (host-level) collectives where wall time is
+measurable. In-depth per-collective device timing comes from ``jax.profiler``.
+"""
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frame_depth: int = 3) -> str:
+    import sys
+
+    try:
+        return sys._getframe(frame_depth).f_code.co_name
+    except Exception:
+        return "unknown"
+
+
+def convert_size(size_bytes: float) -> str:
+    if size_bytes <= 0:
+        return "0B"
+    units = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = min(int(math.floor(math.log(size_bytes, 1024))), len(units) - 1)
+    return f"{round(size_bytes / 1024 ** i, 2)} {units[i]}"
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> Dict[str, float]:
+    """Algorithmic + bus bandwidth, matching the reference's formulas
+    (``comms_logging.py`` ``calc_bw_log``): allreduce busbw scales by 2(n-1)/n,
+    all_gather/reduce_scatter by (n-1)/n."""
+    duration_s = max(duration_s, 1e-9)
+    n = max(n, 1)
+    tput = size_bytes / duration_s
+    if comm_op in ("all_reduce", "inference_all_reduce", "all_reduce_coalesced"):
+        busbw = tput * (2 * (n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor", "all_to_all", "all_to_all_single"):
+        busbw = tput * ((n - 1) / n)
+    else:
+        busbw = tput
+    return {"tput_GBps": tput / 1e9, "busbw_GBps": busbw / 1e9}
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False, prof_all: bool = True,
+                 prof_ops: Optional[List[str]] = None, debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # comms_dict[op_name][msg_size] = [count, [latencies], [tputs], [busbws]]
+        self.comms_dict: Dict[str, Dict[int, list]] = defaultdict(dict)
+        self.traced_counts: Dict[str, int] = defaultdict(int)
+        self.traced_bytes: Dict[str, int] = defaultdict(int)
+
+    def configure(self, comms_config) -> None:
+        self.enabled = comms_config.enabled
+        self.verbose = comms_config.verbose
+        self.prof_all = comms_config.prof_all
+        self.prof_ops = comms_config.prof_ops
+        self.debug = comms_config.debug
+
+    def _should_log(self, record_name: str) -> bool:
+        return self.enabled and (self.prof_all or record_name in self.prof_ops)
+
+    def append_traced(self, raw_name: str, record_name: str, size_bytes: int) -> None:
+        """Record a collective issued during tracing (no wall-time available)."""
+        if not self._should_log(record_name):
+            return
+        self.traced_counts[record_name] += 1
+        self.traced_bytes[record_name] += size_bytes
+
+    def append(self, raw_name: str, record_name: str, latency_s: float, size_bytes: int,
+               group_size: int) -> None:
+        if not self._should_log(record_name):
+            return
+        bw = calc_bw_log(raw_name, size_bytes, latency_s, group_size)
+        per_size = self.comms_dict[record_name].setdefault(size_bytes, [0, [], [], []])
+        per_size[0] += 1
+        per_size[1].append(latency_s * 1000.0)
+        per_size[2].append(bw["tput_GBps"])
+        per_size[3].append(bw["busbw_GBps"])
+        if self.verbose:
+            log_dist(
+                f"comm op: {record_name} | time(ms): {latency_s * 1e3:.2f} | "
+                f"msg size: {convert_size(size_bytes)} | algbw (Gbps): "
+                f"{bw['tput_GBps'] * 8:.2f} | busbw (Gbps): {bw['busbw_GBps'] * 8:.2f}"
+            )
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        lines = ["Comm. Op\tMessage Size\tCount\tTotal Latency(ms)\tAvg Latency(ms)"
+                 "\ttput_avg (Gbps)\tbusbw_avg (Gbps)"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(op_name)
+            for size_bytes, (count, lats, tputs, busbws) in sorted(sizes.items()):
+                total = sum(lats)
+                avg = total / max(count, 1)
+                avg_tput = 8 * sum(tputs) / max(len(tputs), 1)
+                avg_busbw = 8 * sum(busbws) / max(len(busbws), 1)
+                lines.append(
+                    f"\t\t\t{convert_size(size_bytes)}\t{count}\t{total:.2f}\t{avg:.2f}"
+                    f"\t{avg_tput:.2f}\t{avg_busbw:.2f}")
+        if self.traced_counts:
+            lines.append("traced (in-jit) collectives: op\tcount\ttotal bytes")
+            for op_name in sorted(self.traced_counts):
+                lines.append(
+                    f"\t{op_name}\t{self.traced_counts[op_name]}"
+                    f"\t{convert_size(self.traced_bytes[op_name])}")
+        summary = "\n".join(lines)
+        log_dist(summary, ranks=[0])
+        return summary
+
+    def reset(self) -> None:
+        self.comms_dict.clear()
+        self.traced_counts.clear()
+        self.traced_bytes.clear()

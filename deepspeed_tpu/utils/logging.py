@@ -1,0 +1,77 @@
+"""Rank-aware logging for SPMD JAX programs.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (rank-aware
+``logger`` + ``log_dist(ranks=[...])``), re-thought for SPMD: under JAX every host
+runs the same program, so "rank" gating is by ``jax.process_index()`` rather than
+an env-derived RANK.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+            )
+        )
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax.distributed not initialized / no backend yet
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: process 0).
+
+    ``ranks=[-1]`` logs on every process. Mirrors the reference API
+    (``deepspeed/utils/logging.py`` ``log_dist``).
+    """
+    ranks = ranks if ranks is not None else [0]
+    me = _process_index()
+    if -1 in ranks or me in ranks:
+        logger.log(level, f"[proc {me}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_once_impl(message)
+
+
+@functools.lru_cache(None)
+def _warn_once_impl(message: str) -> None:
+    logger.warning(message)
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)

@@ -1,0 +1,174 @@
+"""Wall-clock timers with device-synchronization fences.
+
+Parity: reference ``deepspeed/utils/timer.py`` (``SynchronizedWallClockTimer``,
+``ThroughputTimer``). On TPU there are no user-visible streams/events, so
+synchronization is a ``jax.block_until_ready`` fence on a trivial device value
+(``accelerator.synchronize``) before reading the host clock — the
+``is_synchronized_device`` escape hatch the reference keeps for exactly this
+class of device (``accelerator/abstract_accelerator.py:19``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync() -> None:
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    get_accelerator().synchronize()
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._record: List[float] = []
+
+    def start(self, sync: bool = True) -> None:
+        if self.started:
+            return
+        if sync:
+            _sync()
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, record: bool = True, sync: bool = True) -> None:
+        if not self.started:
+            return
+        if sync:
+            _sync()
+        delta = time.perf_counter() - self._start_time
+        self._elapsed += delta
+        if record:
+            self._record.append(delta)
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        out = self._elapsed
+        if self.started:
+            out += time.perf_counter() - self._start_time
+        if reset:
+            self._elapsed = 0.0
+        return out
+
+    def mean(self) -> float:
+        if not self._record:
+            return 0.0
+        return sum(self._record) / len(self._record)
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry; each timer fences the device before reading the clock."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        stats = get_accelerator().memory_stats()
+        ib = stats.get("bytes_in_use", 0)
+        pk = stats.get("peak_bytes_in_use", 0)
+        return f"mem: in_use={ib / 2**30:.2f}GB peak={pk / 2**30:.2f}GB"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks=None) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}ms")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        log_dist(msg, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        assert normalizer > 0.0
+        return {
+            n: self.timers[n].mean() * 1000.0 / normalizer
+            for n in names
+            if n in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Tracks samples/sec and TFLOPS across steps (reference ``utils/timer.py`` analog)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: Optional[int] = None,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
+        self.initialized = False
+        self.global_step_count = 0
+        self.local_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self) -> None:
+        self.local_step_count = 0
+
+    def start(self) -> None:
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync()
+            self._start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.local_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self._start_time and self.global_step_count > self.start_step:
+            _sync()
+            duration = time.perf_counter() - self._start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count} "
+                    f"samples/sec={self.avg_samples_per_sec():.2f} "
+                    f"ms/step={self.step_elapsed_time / self.steps_per_output * 1000:.1f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count <= self.start_step or self.total_elapsed_time == 0.0:
+            return 0.0
+        steps = self.global_step_count - self.start_step
+        return self.batch_size / (self.total_elapsed_time / steps)

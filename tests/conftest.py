@@ -1,0 +1,41 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+This is the "distributed-without-a-cluster" harness (reference
+``tests/unit/common.py`` ``DistributedExec``; SURVEY.md §4) — multi-chip behavior
+is exercised on host-platform virtual devices with REAL XLA collectives.
+
+Note: a sitecustomize may register a TPU PJRT plugin and import jax before this
+file runs, so we both set the env vars AND update jax.config directly.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_sessionstart(session):
+    n = len(jax.devices())
+    assert n >= 8, (
+        f"tests need >=8 virtual CPU devices, got {n}. XLA_FLAGS must be set "
+        "before the first jax backend use")
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    yield
+    # Each test may build its own mesh; reset globals between tests.
+    from deepspeed_tpu.comm import comm as comm_mod
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    comm_mod._initialized = False
+    comm_mod.comms_logger.reset()
+    comm_mod.comms_logger.enabled = False

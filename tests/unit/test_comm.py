@@ -1,0 +1,112 @@
+"""Collective API tests on the virtual 8-device mesh (reference
+``tests/unit/comm/test_dist.py`` analog)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.mesh import MeshConfig
+
+
+@pytest.fixture
+def mesh8():
+    dist.init_distributed(mesh_config=MeshConfig(data=8))
+    return dist.get_mesh()
+
+
+def test_world_size(mesh8):
+    assert dist.get_world_size() == 8
+    assert dist.get_world_size("data") == 8
+    assert dist.get_world_size("tensor") == 1
+
+
+def test_all_reduce_traced(mesh8):
+    def f(x):
+        return dist.all_reduce(x, op=dist.ReduceOp.SUM, group="data")
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    shmapped = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    out = jax.jit(shmapped)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+def test_all_reduce_ops(mesh8):
+    x = jnp.arange(1.0, 9.0).reshape(8, 1)
+
+    def run(op):
+        f = jax.shard_map(lambda v: dist.all_reduce(v, op=op, group="data"),
+                          mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+        return np.asarray(jax.jit(f)(x))[0, 0]
+
+    assert run(dist.ReduceOp.MAX) == 8.0
+    assert run(dist.ReduceOp.MIN) == 1.0
+    np.testing.assert_allclose(run(dist.ReduceOp.AVG), 4.5)
+
+
+def test_all_gather_traced(mesh8):
+    x = jnp.arange(8.0).reshape(8, 1)
+    f = jax.shard_map(lambda v: dist.all_gather(v, group="data", gather_axis=0),
+                      mesh=mesh8, in_specs=P("data"), out_specs=P(),
+                      check_vma=False)
+    # all_gather inside shard_map returns the full array on every shard
+    out = jax.jit(f)(x)
+    assert out.shape == (8, 1)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.arange(8.0))
+
+
+def test_reduce_scatter_traced(mesh8):
+    # ZeRO-style: every rank holds the full gradient; psum-scatter leaves each
+    # rank with its reduced shard.
+    x = jnp.ones((8, 16))
+    f = jax.shard_map(lambda v: dist.reduce_scatter(v, group="data", scatter_axis=0),
+                      mesh=mesh8, in_specs=P(), out_specs=P("data"))
+    out = jax.jit(f)(x)
+    assert out.shape == (8, 16)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 16), 8.0))
+
+
+def test_all_to_all_traced(mesh8):
+    # classic Ulysses-style shard transpose
+    x = jnp.arange(64.0).reshape(8, 8)
+    f = jax.shard_map(
+        lambda v: dist.all_to_all_single(v, group="data", split_axis=1, concat_axis=0),
+        mesh=mesh8, in_specs=P("data", None), out_specs=P(None, "data"))
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(64.0).reshape(8, 8))
+
+
+def test_broadcast_traced(mesh8):
+    x = jnp.arange(8.0).reshape(8, 1)
+    f = jax.shard_map(lambda v: dist.broadcast(v, src=3, group="data"),
+                      mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(8, 3.0))
+
+
+def test_permute_ring(mesh8):
+    x = jnp.arange(8.0).reshape(8, 1)
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    f = jax.shard_map(lambda v: dist.permute(v, perm, group="data"),
+                      mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    out = np.asarray(jax.jit(f)(x)).ravel()
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_comms_logger_traced_counts(mesh8):
+    dist.configure(enabled=True)
+    x = jnp.ones((8, 4))
+    f = jax.shard_map(lambda v: dist.all_reduce(v, group="data"),
+                      mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    jax.jit(f)(x)
+    assert dist.comms_logger.traced_counts.get("all_reduce", 0) >= 1
+    summary = dist.log_summary()
+    assert "all_reduce" in summary
+
+
+def test_mesh_shape_validation():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolve(8)
+    sizes = MeshConfig(data=-1, tensor=2).resolve(8)
+    assert sizes["data"] == 4 and sizes["tensor"] == 2

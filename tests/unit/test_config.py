@@ -1,0 +1,88 @@
+"""Config parsing + batch triad resolution (reference ``runtime/config.py`` tests)."""
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfigError, load_config
+
+
+def test_basic_parse():
+    cfg = load_config({
+        "train_batch_size": 32,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+    })
+    assert cfg.optimizer.type == "adam"
+    assert cfg.fp16.enabled
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.precision_dtype == "float16"
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_batch_triad():
+    cfg = load_config({"train_batch_size": 32})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+    cfg = load_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+    cfg = load_config({"train_micro_batch_size_per_gpu": 2,
+                       "gradient_accumulation_steps": 3})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triad_mismatch():
+    cfg = load_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 3,
+                       "gradient_accumulation_steps": 2})
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_both_precisions_rejected():
+    cfg = load_config({"train_batch_size": 8, "fp16": {"enabled": True},
+                       "bf16": {"enabled": True}})
+    with pytest.raises(DeepSpeedConfigError):
+        _ = cfg.precision_dtype
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        load_config({"zero_optimization": {"stage": 5}})
+
+
+def test_ignored_cuda_sections():
+    cfg = load_config({"train_batch_size": 8, "amp": {"enabled": True},
+                       "aio": {"block_size": 1048576}})
+    assert cfg.train_batch_size == 8
+
+
+def test_reference_style_config():
+    """A real DeepSpeed JSON config should parse unchanged."""
+    cfg = load_config({
+        "train_batch_size": 16,
+        "steps_per_print": 2000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.8, 0.999],
+                                                 "eps": 1e-8, "weight_decay": 3e-7}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                                 "warmup_num_steps": 1000}},
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "bf16": {"enabled": True},
+        "wall_clock_breakdown": False,
+        "zero_optimization": {
+            "stage": 3,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+        },
+    })
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.scheduler.type == "WarmupLR"

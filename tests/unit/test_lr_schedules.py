@@ -1,0 +1,64 @@
+"""LR schedule shapes (reference tests/unit/runtime/test_lr_schedulers.py analog)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupCosineLR,
+    WarmupDecayLR,
+    WarmupLR,
+    get_lr_schedule,
+)
+
+
+def test_warmup_lr():
+    s = WarmupLR(1e-3, warmup_min_lr=0.0, warmup_max_lr=1e-3, warmup_num_steps=100,
+                 warmup_type="linear")
+    assert float(s.lr_at(0)) == 0.0
+    assert abs(float(s.lr_at(50)) - 5e-4) < 1e-9
+    assert abs(float(s.lr_at(100)) - 1e-3) < 1e-9
+    assert abs(float(s.lr_at(1000)) - 1e-3) < 1e-9
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(1e-3, total_num_steps=200, warmup_max_lr=1e-3,
+                      warmup_num_steps=100, warmup_type="linear")
+    assert abs(float(s.lr_at(100)) - 1e-3) < 1e-8
+    assert float(s.lr_at(200)) < 1e-8
+    mid = float(s.lr_at(150))
+    assert 4e-4 < mid < 6e-4
+
+
+def test_warmup_cosine():
+    s = WarmupCosineLR(1e-3, total_num_steps=200, warmup_num_steps=50)
+    assert float(s.lr_at(50)) == pytest.approx(1e-3, rel=1e-3)
+    assert float(s.lr_at(200)) == pytest.approx(1e-3 * 0.0001, rel=1e-2)
+
+
+def test_one_cycle():
+    s = OneCycle(1e-3, cycle_min_lr=1e-5, cycle_max_lr=1e-3,
+                 cycle_first_step_size=100)
+    assert float(s.lr_at(0)) == pytest.approx(1e-5, rel=1e-5)
+    assert float(s.lr_at(100)) == pytest.approx(1e-3, rel=1e-5)
+    assert float(s.lr_at(200)) == pytest.approx(1e-5, rel=1e-3)
+
+
+def test_range_test():
+    s = LRRangeTest(1e-3, lr_range_test_min_lr=1e-4, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(1e-4)
+    assert float(s.lr_at(10)) == pytest.approx(2e-4)
+
+
+def test_factory_and_stateful_api():
+    s = get_lr_schedule("WarmupLR", {"warmup_num_steps": 10}, base_lr=1e-3)
+    s.step()
+    s.step()
+    assert s.last_batch_iteration == 1
+    sd = s.state_dict()
+    s2 = get_lr_schedule("WarmupLR", {"warmup_num_steps": 10}, base_lr=1e-3)
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == 1
+    with pytest.raises(ValueError):
+        get_lr_schedule("Bogus", {}, 1e-3)

@@ -1,0 +1,168 @@
+"""Optimizer numerics vs torch reference (reference ``tests/unit/ops/adam`` style:
+kernel output compared against the framework-native implementation)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizer import (
+    FusedAdam,
+    FusedAdagrad,
+    FusedLamb,
+    Lion,
+    Muon,
+    SGD,
+    get_optimizer,
+)
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(32, 16)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(16,)), jnp.float32),
+    }
+
+
+def _grads(seed=1):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(32, 16)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(16,)), jnp.float32),
+    }
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    params = _tree()
+    grads = _grads()
+    opt = FusedAdam(lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+    state = opt.init(params)
+
+    tparams = {k: torch.nn.Parameter(torch.tensor(np.asarray(v))) for k, v in params.items()}
+    topt = torch.optim.AdamW(list(tparams.values()), lr=1e-2, betas=(0.9, 0.999),
+                             eps=1e-8, weight_decay=0.01)
+    new_params, state = params, state
+    for step in range(3):
+        new_params, state = opt.update(grads, state, new_params)
+        for k, p in tparams.items():
+            p.grad = torch.tensor(np.asarray(grads[k]))
+        topt.step()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_params[k]),
+                                   tparams[k].detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_adam_no_wd_matches_torch_adam():
+    torch = pytest.importorskip("torch")
+    params = _tree()
+    grads = _grads()
+    opt = FusedAdam(lr=3e-3, adam_w_mode=False, weight_decay=0.1)
+    state = opt.init(params)
+    tparams = {k: torch.nn.Parameter(torch.tensor(np.asarray(v))) for k, v in params.items()}
+    topt = torch.optim.Adam(list(tparams.values()), lr=3e-3, weight_decay=0.1)
+    new_params = params
+    for _ in range(2):
+        new_params, state = opt.update(grads, state, new_params)
+        for k, p in tparams.items():
+            p.grad = torch.tensor(np.asarray(grads[k]))
+        topt.step()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_params[k]),
+                                   tparams[k].detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_momentum():
+    params = _tree()
+    grads = _grads()
+    opt = SGD(lr=0.1, momentum=0.9)
+    state = opt.init(params)
+    p1, state = opt.update(grads, state, params)
+    # first step: buf = g → p1 = p - 0.1 g
+    np.testing.assert_allclose(np.asarray(p1["w"]),
+                               np.asarray(params["w"]) - 0.1 * np.asarray(grads["w"]),
+                               rtol=1e-6)
+
+
+def test_lion_sign_update():
+    params = _tree()
+    grads = _grads()
+    opt = Lion(lr=1e-3, betas=(0.9, 0.99))
+    state = opt.init(params)
+    p1, _ = opt.update(grads, state, params)
+    expected = np.asarray(params["w"]) - 1e-3 * np.sign(0.1 * np.asarray(grads["w"]))
+    np.testing.assert_allclose(np.asarray(p1["w"]), expected, rtol=1e-5, atol=1e-7)
+
+
+def test_lamb_trust_ratio_bounds():
+    params = _tree()
+    grads = _grads()
+    opt = FusedLamb(lr=1e-2)
+    state = opt.init(params)
+    p1, _ = opt.update(grads, state, params)
+    assert np.all(np.isfinite(np.asarray(p1["w"])))
+
+
+def test_adagrad():
+    params = _tree()
+    grads = _grads()
+    opt = FusedAdagrad(lr=1e-2)
+    state = opt.init(params)
+    p1, state2 = opt.update(grads, state, params)
+    expected = np.asarray(params["w"]) - 1e-2 * np.asarray(grads["w"]) / (
+        np.abs(np.asarray(grads["w"])) + 1e-10)
+    np.testing.assert_allclose(np.asarray(p1["w"]), expected, rtol=1e-5)
+
+
+def test_muon_orthogonalizes():
+    params = {"w": jnp.eye(32) * 2.0, "emb": jnp.ones((8,))}
+    grads = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(32, 32)),
+                              jnp.float32), "emb": jnp.ones((8,))}
+    opt = Muon(lr=1e-2)
+    state = opt.init(params)
+    p1, _ = opt.update(grads, state, params)
+    assert np.all(np.isfinite(np.asarray(p1["w"])))
+    assert p1["emb"].shape == (8,)
+
+
+def test_factory():
+    opt = get_optimizer("Adam", {"lr": 1e-4, "betas": [0.9, 0.95]})
+    assert isinstance(opt, FusedAdam) and opt.lr == 1e-4
+    opt = get_optimizer("OneBitAdam", {"lr": 1e-4})
+    assert isinstance(opt, FusedAdam)
+    with pytest.raises(ValueError):
+        get_optimizer("nope", {})
+
+
+def test_update_is_jittable():
+    params = _tree()
+    grads = _grads()
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    new_params, new_state = jax.jit(opt.update)(grads, state, params)
+    assert new_state["step"] == 1
+
+
+def test_muon_routing_stacked_layers():
+    """Stacked (L, m, n) layer weights must take the Muon path; embeddings Adam."""
+    opt = Muon(lr=1e-2)
+    assert opt._use_muon("['blocks']['wq']", jnp.zeros((2, 64, 64)))
+    assert opt._use_muon("['blocks']['w_up']", jnp.zeros((2, 64, 256)))
+    assert not opt._use_muon("['tok_emb']", jnp.zeros((512, 64)))
+    assert not opt._use_muon("['blocks']['ln1']['scale']", jnp.zeros((2, 64)))
+    assert not opt._use_muon("['lm_head']", jnp.zeros((64, 512)))
+    # full update on a model-shaped tree stays finite
+    params = {"tok_emb": jnp.ones((32, 16)), "blocks": {"wq": jnp.ones((2, 16, 16))}}
+    grads = jax.tree.map(jnp.ones_like, params)
+    state = opt.init(params)
+    p1, _ = opt.update(grads, state, params)
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree.leaves(p1))
+
+
+def test_repeating_loader_rejects_generators():
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    with pytest.raises(TypeError):
+        RepeatingLoader(x for x in range(3))
+    loader = RepeatingLoader([1, 2])
+    assert [next(loader) for _ in range(5)] == [1, 2, 1, 2, 1]

@@ -41,10 +41,27 @@ def _mask_of(batch: Batch):
     return None
 
 
+def resolve_attention(attention: Optional[str]):
+    """Named attention impls: 'xla' (default fused reference) or 'flash'
+    (Pallas kernel, ops/pallas/flash_attention.py)."""
+    if attention in (None, "xla", "default"):
+        return None
+    if attention == "flash":
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention
+    raise ValueError(f"unknown attention impl {attention!r}")
+
+
 def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    attention_fn=None, activation_constraint=None,
+                   attention: Optional[str] = None,
                    **overrides) -> ModelSpec:
     """Build a ModelSpec for a causal-LM transformer preset or config."""
+    if attention_fn is not None and attention is not None:
+        raise ValueError("pass either attention_fn or attention=, not both")
+    if attention_fn is None:
+        attention_fn = resolve_attention(attention)
     if isinstance(cfg, str):
         name = cfg
         cfg = T.get_model_config(cfg, **overrides)

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the framework's native-kernel layer.
+
+Plays the role of the reference's ``csrc/`` CUDA tree (SURVEY.md §2.5): instead
+of nvcc-compiled extensions dispatched by op builders, kernels here are Pallas
+programs compiled by Mosaic for TPU, with ``interpret=True`` as the CPU
+fallback (the analog of the reference's CPU op builders).
+"""
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

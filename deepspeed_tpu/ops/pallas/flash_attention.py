@@ -1,0 +1,435 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(``csrc/transformer/`` softmax/transform kernels behind
+``DeepSpeedTransformerLayer``, ``ops/transformer/transformer.py:296``, and the
+triton flash path ``ops/transformer/inference/triton/attention.py``). Online
+(blockwise) softmax never materializes the [S, S] score matrix in HBM:
+
+* forward: grid (batch*q_heads, q_blocks, kv_blocks); kv innermost so the
+  running max/denominator/accumulator live in VMEM scratch across kv steps;
+* backward: two kernels (dq; dk+dv) recomputing probabilities from the saved
+  logsumexp — the standard flash-attention-2 decomposition;
+* GQA: kv tensors stay at [batch*kv_heads, S, D]; the q-head → kv-head
+  mapping happens in the BlockSpec index maps (no ``jnp.repeat`` in HBM, and
+  VJP residuals hold the small kv tensors);
+* causal masking skips fully-masked kv blocks (upper-triangular block tiles
+  are never computed);
+* CPU fallback = ``interpret=True`` (the role the reference's CPU op builders
+  play for its CUDA ops).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds of jax as well
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(shape, dtype)  # pragma: no cover
+
+
+def _compiler_params():
+    if pltpu is not None and not _use_interpret():
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return None
+
+
+def _block_mask(q_start, kv_start, shape, causal, kv_len, q_len=None):
+    row = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    col = kv_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = col < kv_len
+    if q_len is not None:
+        mask = jnp.logical_and(mask, row < q_len)
+    if causal:
+        mask = jnp.logical_and(mask, col <= row)
+    return mask
+
+
+# --------------------------------------------------------------------------- #
+# forward kernel
+# --------------------------------------------------------------------------- #
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, kv_len: int,
+                block_q: int, block_kv: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks strictly above the diagonal; always skip blocks
+    # fully beyond the (unpadded) kv length
+    q_start = i * block_q
+    kv_start = j * block_kv
+    run = kv_start < kv_len
+    if causal:
+        run = jnp.logical_and(run, kv_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bkv]
+        s = jnp.where(_block_mask(q_start, kv_start, s.shape, causal, kv_len),
+                      s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                            # [bq, 1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                            # [bq, bkv]
+        alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, d]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:, 0:1] = m_new
+        l_ref[:, 0:1] = l_new
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, 0:1] + jnp.log(l_safe)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, lse)
+
+
+def _fwd(q, k, v, *, scale, causal, kv_len, rep, block_q, block_kv, interpret):
+    BN, S_pad, D = q.shape
+    BK, Skv_pad, _ = k.shape
+    n_q = S_pad // block_q
+    n_kv = Skv_pad // block_kv
+    kv_of = _kv_index(rep)
+
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, kv_len=kv_len,
+            block_q=block_q, block_kv=block_kv),
+        grid=(BN, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (kv_of(b), j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (kv_of(b), j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, S_pad, D), q.dtype),
+            # per-row logsumexp; trailing dim 1 == array dim keeps the TPU
+            # tiling rules happy without lane-broadcasting into HBM
+            jax.ShapeDtypeStruct((BN, S_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_q, D), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+            _vmem((block_q, 128), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------- #
+# backward kernels
+# --------------------------------------------------------------------------- #
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale: float, causal: bool, kv_len: int,
+                   block_q: int, block_kv: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = i * block_q
+    kv_start = j * block_kv
+    run = kv_start < kv_len
+    if causal:
+        run = jnp.logical_and(run, kv_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                   # [bq, 1]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _block_mask(q_start, kv_start, s.shape, causal, kv_len)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # [bq, bkv]
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bkv]
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, causal: bool, kv_len: int, q_len: int,
+                    n_q: int, block_q: int, block_kv: int):
+    j = pl.program_id(1)       # kv block (outer)
+    inner = pl.program_id(2)   # (q-head-in-group, q block) flattened (inner)
+    n_inner = pl.num_programs(2)
+    i = inner % n_q
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = i * block_q
+    kv_start = j * block_kv
+    run = jnp.logical_and(kv_start < kv_len, q_start < q_len)
+    if causal:
+        run = jnp.logical_and(run, kv_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bq, bkv]
+        mask = _block_mask(q_start, kv_start, s.shape, causal, kv_len, q_len)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bkv, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bkv]
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(inner == n_inner - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _kv_index(rep: int):
+    """Map a q-batch grid index (batch*q_heads) to the kv-batch index
+    (batch*kv_heads) for GQA: consecutive groups of ``rep`` q-heads share one
+    kv head. With rep == 1 this is the identity."""
+    if rep == 1:
+        return lambda b: b
+
+    def kv_of(b):
+        # b = batch * N + h; N = K * rep  →  kv = batch * K + h // rep
+        return b // rep
+
+    return kv_of
+
+
+def _bwd(scale, causal, kv_len, q_len, rep, block_q, block_kv,
+         residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    interpret = _use_interpret()
+    BN, S_pad, D = q.shape
+    BK, Skv_pad, _ = k.shape
+    n_q = S_pad // block_q
+    n_kv = Skv_pad // block_kv
+    kv_of = _kv_index(rep)
+
+    # delta_r = rowsum(dO * O) — cheap elementwise, let XLA fuse it
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [BN, S_pad, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, block_q=block_q, block_kv=block_kv),
+        grid=(BN, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (kv_of(b), j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (kv_of(b), j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BN, S_pad, D), q.dtype),
+        scratch_shapes=[_vmem((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid batch dim is the KV batch; the inner dim flattens
+    # (q-head-in-group × q-block) so the accumulator sums the whole GQA group
+    def q_of(b, inner):
+        return b * rep + inner // n_q
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, q_len=q_len, n_q=n_q,
+                          block_q=block_q, block_kv=block_kv),
+        grid=(BK, n_kv, rep * n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, t: (q_of(b, t), t % n_q, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, t: (q_of(b, t), t % n_q, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, t: (q_of(b, t), t % n_q, 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, t: (q_of(b, t), t % n_q, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, t: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BK, Skv_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((BK, Skv_pad, D), v.dtype),
+        ],
+        scratch_shapes=[
+            _vmem((block_kv, D), jnp.float32),
+            _vmem((block_kv, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# public entry — custom VJP over the padded [B*heads, S, D] layout
+# --------------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, kv_len, q_len, rep, block_q, block_kv):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal, kv_len=kv_len, rep=rep,
+                block_q=block_q, block_kv=block_kv, interpret=_use_interpret())
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, kv_len, q_len, rep, block_q, block_kv):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, kv_len=kv_len, rep=rep,
+                  block_q=block_q, block_kv=block_kv,
+                  interpret=_use_interpret())
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, kv_len, q_len, rep, block_q, block_kv,
+               residuals, g):
+    return _bwd(scale, causal, kv_len, q_len, rep, block_q, block_kv,
+                residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    segment_mask: Optional[jax.Array] = None,
+                    block_q: int = 128, block_kv: int = 128) -> jax.Array:
+    """Drop-in for ``models.transformer.dot_product_attention``.
+
+    q: [B, S, N, D]; k, v: [B, S, K, D] (K divides N → GQA via kernel index
+    maps, no repetition in HBM). Arbitrary masks fall back to the XLA
+    reference implementation (the Pallas kernel handles causal/full only).
+    """
+    if segment_mask is not None:
+        from deepspeed_tpu.models.transformer import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal,
+                                     segment_mask=segment_mask)
+
+    B, S, N, D = q.shape
+    K = k.shape[2]
+    if N % K != 0:
+        raise ValueError(f"q heads {N} not divisible by kv heads {K}")
+    rep = N // K
+    Skv = k.shape[1]
+    block_q = min(block_q, _round_pow2(S))
+    block_kv = min(block_kv, _round_pow2(Skv))
+
+    # [B, S, H, D] → [B*H, S, D]
+    def to_bn(x):
+        b, s, n, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+    qb = _pad_seq(to_bn(q), block_q)
+    kb = _pad_seq(to_bn(k), block_kv)
+    vb = _pad_seq(to_bn(v), block_kv)
+
+    scale = 1.0 / math.sqrt(D)
+    o = _flash(qb, kb, vb, scale, causal, Skv, S, rep, block_q, block_kv)
+    o = o[:, :S]
+    return o.reshape(B, N, S, D).transpose(0, 2, 1, 3)
+
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+def _round_pow2(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p

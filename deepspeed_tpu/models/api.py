@@ -42,22 +42,50 @@ def _mask_of(batch: Batch):
 
 
 def resolve_attention(attention: Optional[str]):
-    """Named attention impls: 'xla' (default fused reference) or 'flash'
-    (Pallas kernel, ops/pallas/flash_attention.py)."""
+    """Named attention impls:
+
+    * 'xla' (default) — XLA-fused reference attention
+    * 'flash' — Pallas kernel (ops/pallas/flash_attention.py)
+    * 'ulysses' / 'ulysses_flash' — all-to-all SP around xla/flash inner attention
+    * 'ring' — KV-ring context parallelism over the 'seq' axis
+    * 'chunked' — FPDT-style query-chunked attention (memory-capped)
+    """
     if attention in (None, "xla", "default"):
         return None
     if attention == "flash":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention
+    if attention == "ulysses":
+        from deepspeed_tpu.sequence import ulysses_attention
+
+        return ulysses_attention()
+    if attention == "ulysses_flash":
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        from deepspeed_tpu.sequence import ulysses_attention
+
+        return ulysses_attention(inner=flash_attention)
+    if attention == "ring":
+        from deepspeed_tpu.sequence import ring_attention
+
+        return ring_attention()
+    if attention == "chunked":
+        from deepspeed_tpu.sequence import chunked_attention
+
+        return chunked_attention
     raise ValueError(f"unknown attention impl {attention!r}")
 
 
 def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    attention_fn=None, activation_constraint=None,
                    attention: Optional[str] = None,
+                   loss_tiles: int = 0,
                    **overrides) -> ModelSpec:
-    """Build a ModelSpec for a causal-LM transformer preset or config."""
+    """Build a ModelSpec for a causal-LM transformer preset or config.
+
+    ``loss_tiles > 1`` computes the LM loss over sequence tiles without
+    materializing full logits (ALST TiledFusedLogitsLoss analog,
+    reference ``runtime/sequence_parallel/ulysses_sp.py:1065``)."""
     if attention_fn is not None and attention is not None:
         raise ValueError("pass either attention_fn or attention=, not both")
     if attention_fn is None:
@@ -71,10 +99,19 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             cfg = dataclasses.replace(cfg, **overrides)
 
     def loss_fn(params, batch):
-        logits = T.forward(params, _tokens_of(batch), cfg,
+        tokens = _tokens_of(batch)
+        if loss_tiles > 1:
+            from deepspeed_tpu.sequence.tiled import tiled_lm_loss
+
+            hidden, head = T.forward_hidden(
+                params, tokens, cfg, attention_fn=attention_fn,
+                activation_constraint=activation_constraint)
+            return tiled_lm_loss(hidden, head, tokens, _mask_of(batch),
+                                 num_tiles=loss_tiles)
+        logits = T.forward(params, tokens, cfg,
                            attention_fn=attention_fn,
                            activation_constraint=activation_constraint)
-        return T.causal_lm_loss(logits, _tokens_of(batch), _mask_of(batch))
+        return T.causal_lm_loss(logits, tokens, _mask_of(batch))
 
     def apply_fn(params, batch):
         return T.forward(params, _tokens_of(batch), cfg,

@@ -283,11 +283,11 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
 # forward
 # --------------------------------------------------------------------------- #
 
-def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
-            attention_fn: Optional[AttentionFn] = None,
-            activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
-            ) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] in fp32."""
+def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+                   attention_fn: Optional[AttentionFn] = None,
+                   activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 → (final hidden [B, S, H], lm head [H, vocab])."""
     attention_fn = attention_fn or dot_product_attention
     constrain = activation_constraint or (lambda x: x)
     dt = cfg.compute_dtype
@@ -315,6 +315,16 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     x, _ = lax.scan(body, x, params["blocks"])
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    return x, head
+
+
+def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+            attention_fn: Optional[AttentionFn] = None,
+            activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
+            ) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] in fp32."""
+    x, head = forward_hidden(params, tokens, cfg, attention_fn,
+                             activation_constraint)
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
     return logits
 

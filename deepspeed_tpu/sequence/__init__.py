@@ -1,0 +1,31 @@
+"""Sequence / context parallelism (reference ``deepspeed/sequence/`` +
+``runtime/sequence_parallel/``; SURVEY.md §5.7).
+
+Long-context mechanisms, all over the 'seq' mesh axis:
+
+* :func:`ulysses_attention` — all-to-all head-scatter attention (Ulysses).
+* :func:`ulysses_attention_shard_map` — explicit-collective variant.
+* :func:`ring_attention` — KV ring over ICI (idiomatic TPU context parallelism;
+  capability not present in the reference, see SURVEY.md §2.3).
+* :func:`chunked_attention` — FPDT-style query chunking.
+* :func:`sequence_tiled_compute` / :func:`tiled_lm_loss` — ALST tiling.
+"""
+from deepspeed_tpu.sequence.ring import ring_attention
+from deepspeed_tpu.sequence.tiled import (
+    chunked_attention,
+    sequence_tiled_compute,
+    tiled_lm_loss,
+)
+from deepspeed_tpu.sequence.ulysses import (
+    ulysses_attention,
+    ulysses_attention_shard_map,
+)
+
+__all__ = [
+    "ring_attention",
+    "chunked_attention",
+    "sequence_tiled_compute",
+    "tiled_lm_loss",
+    "ulysses_attention",
+    "ulysses_attention_shard_map",
+]

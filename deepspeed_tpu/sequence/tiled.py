@@ -1,0 +1,146 @@
+"""Tiled sequence compute — ALST's memory-capping tricks, the XLA way.
+
+Parity: reference ``runtime/sequence_parallel/ulysses_sp.py`` (``TiledMLP``
+:943, ``TiledFusedLogitsLoss`` :1065, ``sequence_tiled_compute`` :720) — for
+arbitrary-length training the sequence dim is processed in tiles so that
+position-wise layers (MLP, logits+loss) never materialize the full [B, S, ...]
+activation. Here each helper is a ``lax.scan`` over sequence tiles with
+``jax.checkpoint`` on the tile body — the backward recomputes one tile at a
+time, giving the same peak-memory cap as the reference's autograd-function
+shards, but fused into the surrounding XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def _split_tiles(x: jax.Array, num_tiles: int, axis: int) -> jax.Array:
+    S = x.shape[axis]
+    if S % num_tiles != 0:
+        raise ValueError(f"seq len {S} not divisible by num_tiles {num_tiles}")
+    tile = S // num_tiles
+    x = jnp.moveaxis(x, axis, 0)
+    return x.reshape((num_tiles, tile) + x.shape[1:])
+
+
+def _merge_tiles(x: jax.Array, axis: int) -> jax.Array:
+    x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+    return jnp.moveaxis(x, 0, axis)
+
+
+def sequence_tiled_compute(fn: Callable[[jax.Array], jax.Array], x: jax.Array,
+                           num_tiles: int, axis: int = 1,
+                           remat: bool = True) -> jax.Array:
+    """Apply a position-wise ``fn`` over sequence tiles (TiledMLP analog).
+
+    ``fn`` must be position-wise along ``axis`` (MLP, norm, elementwise...)."""
+    if num_tiles <= 1:
+        return fn(x)
+    tiles = _split_tiles(x, num_tiles, axis)  # [T, tile, ...] (axis moved to front)
+
+    def body(_, t):
+        # t: [tile, ...]; restore the tile's dims to fn's expected layout
+        return None, jnp.moveaxis(fn(jnp.moveaxis(t, 0, axis)), axis, 0)
+
+    if remat:
+        body = jax.checkpoint(body)
+    _, out = lax.scan(body, None, tiles)      # [T, tile, ...]
+    return _merge_tiles(out, axis)
+
+
+def tiled_lm_loss(hidden: jax.Array, head: jax.Array, tokens: jax.Array,
+                  loss_mask: Optional[jax.Array] = None,
+                  num_tiles: int = 8, remat: bool = True) -> jax.Array:
+    """Next-token CE without materializing [B, S, vocab] logits.
+
+    Parity: ``TiledFusedLogitsLoss`` (``ulysses_sp.py:1065``). hidden: [B,S,H]
+    (pre-head final activations), head: [H,V]. Scans sequence tiles, computing
+    per-tile logits + log-softmax; backward rematerializes one tile at a time.
+    """
+    B, S, H = hidden.shape
+    # shift: predict token t+1 from position t
+    hid = hidden[:, :-1]
+    tgt = tokens[:, 1:]
+    mask = None if loss_mask is None else loss_mask[:, 1:].astype(jnp.float32)
+    Sm = S - 1
+    pad = (-Sm) % num_tiles
+    if pad:
+        hid = jnp.pad(hid, ((0, 0), (0, pad), (0, 0)))
+        tgt = jnp.pad(tgt, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask if mask is not None else jnp.ones((B, Sm), jnp.float32),
+                       ((0, 0), (0, pad)))
+    elif mask is None:
+        mask = jnp.ones((B, Sm), jnp.float32)
+
+    hid_t = _split_tiles(hid, num_tiles, 1)    # [T, tile, B, H]
+    tgt_t = _split_tiles(tgt, num_tiles, 1)    # [T, tile, B]
+    mask_t = _split_tiles(mask, num_tiles, 1)  # [T, tile, B]
+    head32 = head.astype(jnp.float32)
+
+    def tile_body(carry, operand):
+        h, t, mk = operand                     # [tile,B,H], [tile,B], [tile,B]
+        logits = h.astype(jnp.float32) @ head32          # [tile, B, V]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        nll = (logz - picked) * mk
+        loss_sum, count = carry
+        return (loss_sum + jnp.sum(nll), count + jnp.sum(mk)), None
+
+    if remat:
+        tile_body = jax.checkpoint(tile_body)
+    (loss_sum, count), _ = lax.scan(
+        tile_body, (jnp.float32(0.0), jnp.float32(0.0)), (hid_t, tgt_t, mask_t))
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
+def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = True, segment_mask=None,
+                      num_chunks: int = 4, remat: bool = True) -> jax.Array:
+    """FPDT-style query-chunked attention (``sequence/fpdt_layer.py:545`` analog).
+
+    Scans over Q chunks against the full K/V so peak score memory is
+    [B, N, S/chunks, S]; with ``remat`` the backward recomputes per chunk. The
+    reference offloads KV chunks to host; on TPU the scan + remat achieves the
+    memory cap without host traffic (XLA keeps K/V resident in HBM).
+    """
+    import math
+
+    if segment_mask is not None:
+        raise NotImplementedError("segment_mask unsupported in chunked attention")
+    B, S, N, D = q.shape
+    K = k.shape[2]
+    if K != N:
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    if num_chunks <= 1 or S % num_chunks != 0:
+        from deepspeed_tpu.models.transformer import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal)
+    C = S // num_chunks
+    scale = 1.0 / math.sqrt(D)
+    qc = q.reshape(B, num_chunks, C, N, D).transpose(1, 0, 2, 3, 4)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    kv_pos = jnp.arange(S)
+
+    def chunk_body(carry, operand):
+        i, qi = operand                        # qi: [B, C, N, D]
+        scores = jnp.einsum("bcnd,btnd->bnct", qi.astype(jnp.float32), kf) * scale
+        if causal:
+            q_pos = i * C + jnp.arange(C)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnct,btnd->bcnd", probs, vf)
+        return carry, out.astype(q.dtype)
+
+    if remat:
+        chunk_body = jax.checkpoint(chunk_body)
+    _, chunks = lax.scan(chunk_body, None, (jnp.arange(num_chunks), qc))
+    return chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, N, D)

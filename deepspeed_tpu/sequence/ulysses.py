@@ -1,0 +1,133 @@
+"""Ulysses sequence parallelism — all-to-all head-scatter / seq-gather attention.
+
+Parity: reference ``deepspeed/sequence/layer.py`` (``DistributedAttention`` :351,
+``_SeqAllToAll`` :297, ``single_all_to_all`` :241). DeepSpeed-Ulysses shards the
+sequence dim outside attention and swaps to head-sharding around it with two
+all-to-alls, cutting attention comm >10x vs Megatron-SP (SURVEY.md §5.7).
+
+TPU-native design — two interchangeable implementations:
+
+* ``ulysses_attention`` (default): **GSPMD re-sharding**. Activations arrive
+  seq-sharded (``P(dp, 'seq', ...)``); we constrain q/k/v to head-sharded specs
+  (``P(dp, None, 'seq', ...)``) and the output back to seq-sharded. XLA lowers
+  the spec change to exactly the reference's all-to-all pair, scheduled on ICI
+  and overlapped by the latency-hiding scheduler. Composes with any inner
+  attention (XLA fused, Pallas flash) because the inner fn sees global shapes.
+* ``ulysses_attention_shard_map``: **explicit** ``lax.all_to_all`` inside
+  ``shard_map`` — the literal ``_SeqAllToAll`` dataflow, kept for tests and for
+  kernels that must see per-device shapes.
+
+GQA note: when kv_heads < sp, k/v all-to-all cannot split the head dim; the
+explicit variant repeats KV heads up to ``sp`` first (the reference's
+uneven-heads path, ``sequence/layer.py:131``).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+    get_mesh_manager,
+)
+
+
+def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in (DATA_AXIS, EXPERT_AXIS) if mesh.shape.get(a, 1) > 1)
+
+
+def _maybe(axes: Tuple[str, ...]):
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def seq_sharded_spec(mesh: Mesh) -> P:
+    """[B, S, N, D] with S on 'seq' (and heads on 'tensor' if present)."""
+    tp = TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None
+    return P(_maybe(_batch_axes(mesh)), SEQ_AXIS, tp, None)
+
+
+def head_sharded_spec(mesh: Mesh) -> P:
+    """[B, S, N, D] with N on ('tensor','seq') — the inside-attention layout."""
+    heads = tuple(a for a in (TENSOR_AXIS, SEQ_AXIS) if mesh.shape.get(a, 1) > 1)
+    return P(_maybe(_batch_axes(mesh)), None, _maybe(heads), None)
+
+
+def ulysses_attention(inner: Optional[Callable] = None,
+                      mesh: Optional[Mesh] = None) -> Callable:
+    """GSPMD Ulysses: re-shard seq→heads around ``inner`` attention."""
+    from deepspeed_tpu.models.transformer import dot_product_attention
+
+    inner = inner or dot_product_attention
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+             segment_mask=None) -> jax.Array:
+        m = mesh or get_mesh_manager().mesh
+        if m.shape.get(SEQ_AXIS, 1) <= 1:
+            return inner(q, k, v, causal=causal, segment_mask=segment_mask)
+        inside = NamedSharding(m, head_sharded_spec(m))
+        outside = NamedSharding(m, seq_sharded_spec(m))
+        q, k, v = (lax.with_sharding_constraint(x, inside) for x in (q, k, v))
+        o = inner(q, k, v, causal=causal, segment_mask=segment_mask)
+        return lax.with_sharding_constraint(o, outside)
+
+    return attn
+
+
+def _a2a_scatter_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S/sp, N, D] → [B, S, N/sp, D] (reference single_all_to_all :241)."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _a2a_gather_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S, N/sp, D] → [B, S/sp, N, D]."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_shard_map(inner: Optional[Callable] = None,
+                                mesh: Optional[Mesh] = None,
+                                axis_name: str = SEQ_AXIS) -> Callable:
+    """Explicit all-to-all Ulysses inside shard_map (``_SeqAllToAll`` parity)."""
+    from deepspeed_tpu.models.transformer import dot_product_attention
+
+    inner = inner or dot_product_attention
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+             segment_mask=None) -> jax.Array:
+        if segment_mask is not None:
+            raise NotImplementedError("segment_mask not supported in shard_map ulysses")
+        m = mesh or get_mesh_manager().mesh
+        sp = m.shape.get(axis_name, 1)
+        if sp <= 1:
+            return inner(q, k, v, causal=causal)
+        if q.shape[2] % sp != 0:
+            raise ValueError(f"num_heads {q.shape[2]} not divisible by sp={sp}")
+
+        def local(qs, ks, vs):
+            # uneven KV heads (GQA with kv_heads < sp): replicate to sp heads
+            kv = ks.shape[2]
+            if kv % sp != 0:
+                rep = -(-sp // kv)  # ceil
+                ks_, vs_ = (jnp.repeat(t, rep, axis=2) for t in (ks, vs))
+            else:
+                ks_, vs_ = ks, vs
+            qg = _a2a_scatter_heads(qs, axis_name)
+            kg = _a2a_scatter_heads(ks_, axis_name)
+            vg = _a2a_scatter_heads(vs_, axis_name)
+            og = inner(qg, kg, vg, causal=causal)
+            return _a2a_gather_seq(og, axis_name)
+
+        spec = seq_sharded_spec(m)
+        return shard_map(local, mesh=m, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return attn

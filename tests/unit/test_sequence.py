@@ -1,0 +1,198 @@
+"""Sequence-parallelism tests: Ulysses, ring attention, tiled compute.
+
+Model: reference ``tests/unit/sequence_parallelism/test_ulysses.py`` and
+``tests/unit/ulysses_alst/`` — numerics vs full attention on a virtual mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh
+from deepspeed_tpu.models.transformer import dot_product_attention
+from deepspeed_tpu.sequence import (
+    chunked_attention,
+    ring_attention,
+    sequence_tiled_compute,
+    tiled_lm_loss,
+    ulysses_attention,
+    ulysses_attention_shard_map,
+)
+
+
+def _qkv(rng, B=2, S=32, N=4, K=None, D=16, dtype=jnp.float32):
+    K = K or N
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, N, D), dtype)
+    k = jax.random.normal(kk, (B, S, K, D), dtype)
+    v = jax.random.normal(kv, (B, S, K, D), dtype)
+    return q, k, v
+
+
+def _seq_mesh(seq=4, data=2):
+    mm = initialize_mesh(MeshConfig(data=data, seq=seq))
+    return mm.mesh
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gspmd_matches_reference(self, causal):
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        want = dot_product_attention(q, k, v, causal=causal)
+        attn = ulysses_attention(mesh=mesh)
+        with mesh:
+            got = jax.jit(lambda a, b, c: attn(a, b, c, causal=causal))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_shard_map_matches_reference(self, causal):
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+        want = dot_product_attention(q, k, v, causal=causal)
+        attn = ulysses_attention_shard_map(mesh=mesh)
+        with mesh:
+            got = jax.jit(lambda a, b, c: attn(a, b, c, causal=causal))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_shard_map_gqa_uneven_heads(self):
+        # kv_heads=2 < sp=4 exercises the uneven-heads replication path
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(2), N=8, K=2)
+        want = dot_product_attention(q, k, v, causal=True)
+        attn = ulysses_attention_shard_map(mesh=mesh)
+        with mesh:
+            got = jax.jit(lambda a, b, c: attn(a, b, c, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(3))
+        want = dot_product_attention(q, k, v, causal=causal)
+        attn = ring_attention(mesh=mesh)
+        with mesh:
+            got = jax.jit(lambda a, b, c: attn(a, b, c, causal=causal))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa(self):
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(4), N=8, K=2)
+        want = dot_product_attention(q, k, v, causal=True)
+        attn = ring_attention(mesh=mesh)
+        with mesh:
+            got = jax.jit(lambda a, b, c: attn(a, b, c, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_flow(self):
+        mesh = _seq_mesh()
+        q, k, v = _qkv(jax.random.PRNGKey(5))
+        attn = ring_attention(mesh=mesh)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        with mesh:
+            gg = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(gg, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+
+class TestChunkedAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(6))
+        want = dot_product_attention(q, k, v, causal=causal)
+        got = jax.jit(
+            lambda a, b, c: chunked_attention(a, b, c, causal=causal,
+                                              num_chunks=4))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestTiled:
+    def test_tiled_compute_positionwise(self):
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 32, 8))
+        fn = lambda t: jax.nn.gelu(t) * 2.0
+        got = jax.jit(lambda x: sequence_tiled_compute(fn, x, num_tiles=4))(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(fn(x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_tiled_lm_loss_matches_direct(self, with_mask):
+        from deepspeed_tpu.models.transformer import causal_lm_loss
+
+        rng = jax.random.PRNGKey(8)
+        B, S, H, V = 2, 17, 8, 32  # odd S exercises the pad path
+        hidden = jax.random.normal(rng, (B, S, H))
+        head = jax.random.normal(jax.random.PRNGKey(9), (H, V))
+        tokens = jax.random.randint(jax.random.PRNGKey(10), (B, S), 0, V)
+        mask = (jax.random.uniform(jax.random.PRNGKey(11), (B, S)) > 0.3) \
+            .astype(jnp.float32) if with_mask else None
+        logits = hidden @ head
+        want = causal_lm_loss(logits, tokens, mask)
+        got = jax.jit(lambda h, hd, t: tiled_lm_loss(h, hd, t, mask, num_tiles=4))(
+            hidden, head, tokens)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+class TestEndToEndSP:
+    def test_train_with_seq_parallel(self):
+        """Engine trains with mesh seq=2 + ulysses attention; loss decreases."""
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", attention="ulysses",
+                                  max_seq_len=64)
+        config = {
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": 2, "seq": 2, "tensor": 2},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        data = synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512)
+        losses = [float(engine.train_batch(data)) for _ in range(8)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_train_with_ring_attention(self):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", attention="ring",
+                                  max_seq_len=64)
+        config = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 2, "seq": 4},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        data = synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512)
+        losses = [float(engine.train_batch(data)) for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]

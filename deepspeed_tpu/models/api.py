@@ -100,18 +100,22 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
 
     def loss_fn(params, batch):
         tokens = _tokens_of(batch)
+        hidden, head, aux = T.forward_hidden(
+            params, tokens, cfg, attention_fn=attention_fn,
+            activation_constraint=activation_constraint)
         if loss_tiles > 1:
             from deepspeed_tpu.sequence.tiled import tiled_lm_loss
 
-            hidden, head = T.forward_hidden(
-                params, tokens, cfg, attention_fn=attention_fn,
-                activation_constraint=activation_constraint)
-            return tiled_lm_loss(hidden, head, tokens, _mask_of(batch),
+            loss = tiled_lm_loss(hidden, head, tokens, _mask_of(batch),
                                  num_tiles=loss_tiles)
-        logits = T.forward(params, tokens, cfg,
-                           attention_fn=attention_fn,
-                           activation_constraint=activation_constraint)
-        return T.causal_lm_loss(logits, tokens, _mask_of(batch))
+        else:
+            import jax.numpy as _jnp
+
+            logits = hidden.astype(_jnp.float32) @ head.astype(_jnp.float32)
+            loss = T.causal_lm_loss(logits, tokens, _mask_of(batch))
+        if cfg.n_experts > 0:
+            loss = loss + cfg.moe_aux_coef * aux
+        return loss
 
     def apply_fn(params, batch):
         return T.forward(params, _tokens_of(batch), cfg,

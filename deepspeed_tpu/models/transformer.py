@@ -54,6 +54,12 @@ class TransformerConfig:
     dtype: str = "bfloat16"             # compute dtype
     remat: str = "none"                 # none | full | dots_saveable
     causal: bool = True                 # False → bidirectional encoder (BERT)
+    # MoE (reference deepspeed/moe/; 0 experts → dense FFN)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -81,7 +87,11 @@ class TransformerConfig:
         h, f, v, l = self.hidden_size, self.ffn_size, self.vocab_size, self.num_layers
         kv = self.kv_heads * self.head_dim
         per_layer = h * h + 2 * h * kv + h * h  # q, k, v, o
-        per_layer += (3 if self.activation == "swiglu" else 2) * h * f
+        ffn_mats = 3 if self.activation == "swiglu" else 2
+        if self.n_experts > 0:
+            per_layer += self.n_experts * ffn_mats * h * f + h * self.n_experts
+        else:
+            per_layer += ffn_mats * h * f
         per_layer += 2 * h  # norms
         total = l * per_layer + v * h + 2 * h
         if not self.tie_embeddings:
@@ -120,18 +130,28 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
         "wk": dense(keys[1], (L, h, kvdim), std),
         "wv": dense(keys[2], (L, h, kvdim), std),
         "wo": dense(keys[3], (L, qdim, h), out_std),
-        "w_up": dense(keys[4], (L, h, f), std),
-        "w_down": dense(keys[5], (L, f, h), out_std),
     }
-    if cfg.activation == "swiglu":
-        block["w_gate"] = dense(keys[6], (L, h, f), std)
+    E = cfg.n_experts
+    if E > 0:
+        # MoE FFN: per-expert weights (no biases), router gate per layer
+        block["gate_w"] = dense(keys[10], (L, h, E), std)
+        block["w_up"] = dense(keys[4], (L, E, h, f), std)
+        block["w_down"] = dense(keys[5], (L, E, f, h), out_std)
+        if cfg.activation == "swiglu":
+            block["w_gate"] = dense(keys[6], (L, E, h, f), std)
+    else:
+        block["w_up"] = dense(keys[4], (L, h, f), std)
+        block["w_down"] = dense(keys[5], (L, f, h), out_std)
+        if cfg.activation == "swiglu":
+            block["w_gate"] = dense(keys[6], (L, h, f), std)
     if cfg.use_bias:
         block["bq"] = jnp.zeros((L, qdim), jnp.float32)
         block["bk"] = jnp.zeros((L, kvdim), jnp.float32)
         block["bv"] = jnp.zeros((L, kvdim), jnp.float32)
         block["bo"] = jnp.zeros((L, h), jnp.float32)
-        block["b_up"] = jnp.zeros((L, f), jnp.float32)
-        block["b_down"] = jnp.zeros((L, h), jnp.float32)
+        if E == 0:
+            block["b_up"] = jnp.zeros((L, f), jnp.float32)
+            block["b_down"] = jnp.zeros((L, h), jnp.float32)
 
     params = {
         "tok_emb": dense(keys[7], (cfg.vocab_size, h), std),
@@ -161,16 +181,25 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
         "wk": lyr + ("embed", "kv_heads"),
         "wv": lyr + ("embed", "kv_heads"),
         "wo": lyr + ("heads", "embed"),
-        "w_up": lyr + ("embed", "mlp"),
-        "w_down": lyr + ("mlp", "embed"),
     }
-    if cfg.activation == "swiglu":
-        block["w_gate"] = lyr + ("embed", "mlp")
+    if cfg.n_experts > 0:
+        block["gate_w"] = lyr + ("embed", None)
+        block["w_up"] = lyr + ("expert", "embed", "mlp")
+        block["w_down"] = lyr + ("expert", "mlp", "embed")
+        if cfg.activation == "swiglu":
+            block["w_gate"] = lyr + ("expert", "embed", "mlp")
+    else:
+        block["w_up"] = lyr + ("embed", "mlp")
+        block["w_down"] = lyr + ("mlp", "embed")
+        if cfg.activation == "swiglu":
+            block["w_gate"] = lyr + ("embed", "mlp")
     if cfg.use_bias:
         block.update({
             "bq": lyr + ("heads",), "bk": lyr + ("kv_heads",), "bv": lyr + ("kv_heads",),
-            "bo": lyr + ("embed",), "b_up": lyr + ("mlp",), "b_down": lyr + ("embed",),
+            "bo": lyr + ("embed",),
         })
+        if cfg.n_experts == 0:
+            block.update({"b_up": lyr + ("mlp",), "b_down": lyr + ("embed",)})
     axes = {
         "tok_emb": ("vocab", "embed"),
         "blocks": block,
@@ -238,8 +267,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
                    cos: Optional[jax.Array], sin: Optional[jax.Array],
-                   attention_fn: AttentionFn) -> jax.Array:
-    """One transformer block; lp holds this layer's (unstacked) params."""
+                   attention_fn: AttentionFn) -> Tuple[jax.Array, jax.Array]:
+    """One transformer block; lp holds this layer's (unstacked) params.
+    Returns (output, moe aux loss — 0.0 for dense blocks)."""
     B, S, H = x.shape
     dt = cfg.compute_dtype
 
@@ -265,18 +295,28 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     x = x + attn_out
 
     h = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
-    up = h @ lp["w_up"].astype(dt)
-    if cfg.use_bias:
-        up = up + lp["b_up"].astype(dt)
-    if cfg.activation == "swiglu":
-        gate = h @ lp["w_gate"].astype(dt)
-        act = jax.nn.silu(gate) * up
+    aux = jnp.float32(0.0)
+    if cfg.n_experts > 0:
+        from deepspeed_tpu.moe.layer import moe_ffn
+
+        experts = {k_: lp[k_] for k_ in ("w_up", "w_down", "w_gate") if k_ in lp}
+        down, aux = moe_ffn(
+            h, lp["gate_w"], experts, activation=cfg.activation,
+            k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            min_capacity=cfg.moe_min_capacity)
     else:
-        act = jax.nn.gelu(up, approximate=True)
-    down = act @ lp["w_down"].astype(dt)
-    if cfg.use_bias:
-        down = down + lp["b_down"].astype(dt)
-    return x + down
+        up = h @ lp["w_up"].astype(dt)
+        if cfg.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if cfg.activation == "swiglu":
+            gate = h @ lp["w_gate"].astype(dt)
+            act = jax.nn.silu(gate) * up
+        else:
+            act = jax.nn.gelu(up, approximate=True)
+        down = act @ lp["w_down"].astype(dt)
+        if cfg.use_bias:
+            down = down + lp["b_down"].astype(dt)
+    return x + down, aux
 
 
 # --------------------------------------------------------------------------- #
@@ -286,8 +326,9 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
 def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                    attention_fn: Optional[AttentionFn] = None,
                    activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
-                   ) -> Tuple[jax.Array, jax.Array]:
-    """tokens [B, S] int32 → (final hidden [B, S, H], lm head [H, vocab])."""
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens [B, S] int32 → (final hidden [B, S, H], lm head [H, vocab],
+    moe aux loss — summed over layers, 0.0 for dense models)."""
     attention_fn = attention_fn or dot_product_attention
     constrain = activation_constraint or (lambda x: x)
     dt = cfg.compute_dtype
@@ -303,8 +344,8 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, layer_params):
-        y = _block_forward(carry, layer_params, cfg, cos, sin, attention_fn)
-        return constrain(y), None
+        y, aux = _block_forward(carry, layer_params, cfg, cos, sin, attention_fn)
+        return constrain(y), aux
 
     if cfg.remat == "full":
         body = jax.checkpoint(body)
@@ -312,10 +353,10 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_saveable)
 
-    x, _ = lax.scan(body, x, params["blocks"])
+    x, auxes = lax.scan(body, x, params["blocks"])
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
-    return x, head
+    return x, head, jnp.sum(auxes)
 
 
 def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
@@ -323,8 +364,8 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
             activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
             ) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, vocab] in fp32."""
-    x, head = forward_hidden(params, tokens, cfg, attention_fn,
-                             activation_constraint)
+    x, head, _ = forward_hidden(params, tokens, cfg, attention_fn,
+                                activation_constraint)
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
     return logits
 
@@ -372,6 +413,16 @@ PRESETS: Dict[str, TransformerConfig] = {
                                     max_seq_len=4096, pos_emb="rope", norm="rmsnorm",
                                     activation="swiglu", use_bias=False,
                                     tie_embeddings=False),
+    "tiny_moe": TransformerConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                                  num_heads=4, max_seq_len=128, use_bias=False,
+                                  n_experts=4, moe_top_k=2),
+    "mixtral_8x7b": TransformerConfig(vocab_size=32000, hidden_size=4096,
+                                      num_layers=32, num_heads=32, num_kv_heads=8,
+                                      ffn_hidden_size=14336, max_seq_len=4096,
+                                      pos_emb="rope", norm="rmsnorm",
+                                      activation="swiglu", use_bias=False,
+                                      tie_embeddings=False,
+                                      n_experts=8, moe_top_k=2),
 }
 
 

@@ -1,0 +1,29 @@
+"""Mixture-of-experts / expert parallelism (reference ``deepspeed/moe/``).
+
+* :mod:`gating` — top-1/2/k gates with capacity + load-balancing loss
+  (``sharded_moe.py:184,291,375``).
+* :mod:`layer` — dense dispatch/combine einsums; the 'expert' mesh axis plays
+  the role of the reference's expert-parallel process groups
+  (``utils/groups.py:304``), with GSPMD emitting the dispatch all-to-all
+  (``sharded_moe.py:97 _AllToAll``).
+
+Model integration: set ``n_experts > 0`` on a ``TransformerConfig`` (e.g. the
+``tiny_moe`` / ``mixtral_8x7b`` presets).
+"""
+from deepspeed_tpu.moe.gating import (
+    GateOutput,
+    gate_capacity,
+    top1_gating,
+    top2_gating,
+    topk_gating,
+)
+from deepspeed_tpu.moe.layer import moe_ffn
+
+__all__ = [
+    "GateOutput",
+    "gate_capacity",
+    "top1_gating",
+    "top2_gating",
+    "topk_gating",
+    "moe_ffn",
+]

@@ -1,0 +1,109 @@
+"""MoE gating: top-1 / top-2 / top-k with capacity and load-balancing loss.
+
+Parity: reference ``deepspeed/moe/sharded_moe.py`` (``top1gating`` :184,
+``top2gating`` :291, ``topkgating`` :375, ``TopKGate`` :452). The reference
+builds the same GShard-style dense dispatch/combine tensors; here the whole
+gate is a handful of jnp ops with **static capacity** (shape-stable under jit —
+XLA requirement, SURVEY.md §7 "Dynamic shapes").
+
+Conventions (GShard/Switch):
+* capacity C = max(min_capacity, ceil(T * k * capacity_factor / E))
+* choices beyond an expert's capacity are dropped (token falls through the
+  residual connection — same semantics as the reference with drop_tokens=True)
+* aux (load-balancing) loss = E * Σ_e mean_t(gate_prob_e) * mean_t(mask1_e),
+  the Switch/GShard l_aux over the FIRST choice (reference :269).
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GateOutput(NamedTuple):
+    combine: jax.Array    # [T, E, C] fp32 — combine weights
+    dispatch: jax.Array   # [T, E, C] bool — dispatch mask
+    aux_loss: jax.Array   # scalar fp32 — load-balancing loss
+    probs: jax.Array      # [T, E] fp32 — softmax gate probabilities
+    counts: jax.Array     # [E] int32 — tokens routed per expert (pre-capacity)
+
+
+def gate_capacity(num_tokens: int, num_experts: int, k: int,
+                  capacity_factor: float, min_capacity: int = 4) -> int:
+    cap = int(math.ceil(num_tokens * k * capacity_factor / num_experts))
+    return max(min_capacity, cap)
+
+
+def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
+                min_capacity: int = 4,
+                rng: Optional[jax.Array] = None,
+                noise_std: float = 0.0,
+                normalize: bool = True) -> GateOutput:
+    """Generic top-k gate (k=1 → top1gating, k=2 → top2gating semantics)."""
+    T, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    C = gate_capacity(T, E, k, capacity_factor, min_capacity)
+
+    sel_logits = logits
+    if noise_std > 0.0 and rng is not None:
+        # reference top1gating noisy_gate_policy='RSample' analog
+        sel_logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    counts_total = jnp.zeros((E,), jnp.int32)
+    masked = sel_logits
+    gates_list = []
+    masks = []
+    # iterative argmax selection (k is small and static — unrolled)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                    # [T]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [T, E]
+        gates_list.append(jnp.sum(probs * mask, axis=-1))    # [T]
+        masks.append(mask)
+        masked = jnp.where(mask.astype(bool), -jnp.inf, masked)
+
+    # aux loss over first-choice assignment (reference :269)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    # capacity assignment in choice-priority order (1st choices fill first)
+    denom = jnp.zeros((T,), jnp.float32)
+    per_choice = []
+    for i in range(k):
+        mask = masks[i]
+        locations = jnp.cumsum(mask, axis=0) - 1 + counts_total[None, :].astype(jnp.float32)
+        counts_total = counts_total + jnp.sum(mask, axis=0).astype(jnp.int32)
+        keep = (locations < C) & (mask > 0)
+        mask = jnp.where(keep, mask, 0.0)
+        gate_i = gates_list[i] * jnp.sum(mask, axis=-1)      # zero if dropped
+        denom = denom + gate_i
+        per_choice.append((mask, locations, gates_list[i]))
+
+    for mask, locations, gate_raw in per_choice:
+        gate = gate_raw / jnp.maximum(denom, 1e-9) if normalize else gate_raw
+        loc_oh = jax.nn.one_hot(locations.astype(jnp.int32), C, dtype=jnp.float32)
+        combine = combine + gate[:, None, None] * mask[:, :, None] * loc_oh
+
+    dispatch = combine > 0.0
+    counts = jnp.sum(masks[0], axis=0).astype(jnp.int32)
+    return GateOutput(combine, dispatch, aux, probs, counts)
+
+
+def top1_gating(logits: jax.Array, capacity_factor: float = 1.0,
+                min_capacity: int = 4, rng: Optional[jax.Array] = None,
+                noise_std: float = 0.0) -> GateOutput:
+    """Switch-transformer gate (reference ``top1gating`` :184)."""
+    return topk_gating(logits, k=1, capacity_factor=capacity_factor,
+                       min_capacity=min_capacity, rng=rng, noise_std=noise_std,
+                       normalize=False)
+
+
+def top2_gating(logits: jax.Array, capacity_factor: float = 1.0,
+                min_capacity: int = 4) -> GateOutput:
+    """GShard top-2 gate (reference ``top2gating`` :291)."""
+    return topk_gating(logits, k=2, capacity_factor=capacity_factor,
+                       min_capacity=min_capacity, normalize=True)

@@ -1,0 +1,164 @@
+"""MoE tests: gating invariants, dispatch/combine numerics, EP training.
+
+Model: reference ``tests/unit/moe/`` (gating behavior, expert-parallel train).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe import (
+    gate_capacity,
+    moe_ffn,
+    top1_gating,
+    top2_gating,
+    topk_gating,
+)
+
+
+class TestGating:
+    def test_capacity_formula(self):
+        assert gate_capacity(64, 4, 1, 1.0) == 16
+        assert gate_capacity(64, 4, 2, 1.25) == 40
+        assert gate_capacity(8, 8, 1, 1.0, min_capacity=4) == 4
+
+    @pytest.mark.parametrize("k", [1, 2, 3])
+    def test_combine_rows_sum_to_at_most_one(self, k):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (64, 8))
+        out = topk_gating(logits, k=k, capacity_factor=2.0)
+        row_sums = np.asarray(jnp.sum(out.combine, axis=(1, 2)))
+        assert np.all(row_sums <= 1.0 + 1e-5)
+        # with generous capacity nothing is dropped → rows sum to 1 (k>1
+        # normalized) or to the top prob (k=1)
+        if k > 1:
+            np.testing.assert_allclose(row_sums, 1.0, atol=1e-5)
+
+    def test_top1_gate_value_is_top_prob(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (32, 4))
+        out = top1_gating(logits, capacity_factor=4.0)
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        got = np.asarray(jnp.sum(out.combine, axis=(1, 2)))
+        np.testing.assert_allclose(got, probs.max(-1), atol=1e-5)
+
+    def test_dispatch_one_slot_per_choice(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
+        out = top2_gating(logits, capacity_factor=4.0)
+        # each token occupies at most 2 (expert, slot) entries
+        per_token = np.asarray(jnp.sum(out.dispatch, axis=(1, 2)))
+        assert np.all(per_token <= 2)
+        # a capacity slot holds at most one token
+        per_slot = np.asarray(jnp.sum(out.dispatch, axis=0))
+        assert np.all(per_slot <= 1)
+
+    def test_capacity_drops_tokens(self):
+        # all tokens prefer expert 0 → only C survive
+        logits = jnp.tile(jnp.array([[10.0, 0.0, 0.0, 0.0]]), (32, 1))
+        out = top1_gating(logits, capacity_factor=0.5, min_capacity=4)
+        kept = int(jnp.sum(out.dispatch))
+        assert kept == gate_capacity(32, 4, 1, 0.5)
+
+    def test_aux_loss_uniform_vs_skewed(self):
+        # balanced routing → aux ≈ 1; skewed routing → aux > 1
+        T, E = 512, 4
+        rng = jax.random.PRNGKey(3)
+        balanced = jax.random.normal(rng, (T, E)) * 0.01
+        skewed = jnp.concatenate(
+            [jnp.full((T, 1), 5.0), jnp.zeros((T, E - 1))], axis=1)
+        aux_b = float(topk_gating(balanced, k=1).aux_loss)
+        aux_s = float(topk_gating(skewed, k=1).aux_loss)
+        assert abs(aux_b - 1.0) < 0.2
+        assert aux_s > 2.0
+
+
+class TestMoELayer:
+    def test_generous_capacity_matches_dense_mixture(self):
+        """With capacity ≥ T every token is routed; MoE output must equal the
+        explicit prob-weighted mixture of expert FFNs."""
+        B, S, H, F, E = 2, 8, 16, 32, 4
+        rng = jax.random.PRNGKey(4)
+        ks = jax.random.split(rng, 5)
+        x = jax.random.normal(ks[0], (B, S, H))
+        gate_w = jax.random.normal(ks[1], (H, E)) * 0.1
+        experts = {
+            "w_up": jax.random.normal(ks[2], (E, H, F)) * 0.1,
+            "w_down": jax.random.normal(ks[3], (E, F, H)) * 0.1,
+        }
+        y, aux = jax.jit(
+            lambda x: moe_ffn(x, gate_w, experts, k=E,
+                              capacity_factor=float(E * B * S)))(x)
+
+        # explicit mixture: softmax over experts, all experts active (k=E)
+        xt = x.reshape(-1, H)
+        probs = jax.nn.softmax(xt @ gate_w, -1)
+        outs = jnp.einsum("th,ehf->tef", xt, experts["w_up"])
+        outs = jax.nn.gelu(outs, approximate=True)
+        outs = jnp.einsum("tef,efh->teh", outs, experts["w_down"])
+        want = jnp.einsum("te,teh->th", probs, outs).reshape(B, S, H)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_swiglu_experts(self):
+        B, S, H, F, E = 2, 8, 16, 32, 4
+        ks = jax.random.split(jax.random.PRNGKey(5), 5)
+        x = jax.random.normal(ks[0], (B, S, H))
+        gate_w = jax.random.normal(ks[1], (H, E)) * 0.1
+        experts = {
+            "w_up": jax.random.normal(ks[2], (E, H, F)) * 0.1,
+            "w_down": jax.random.normal(ks[3], (E, F, H)) * 0.1,
+            "w_gate": jax.random.normal(ks[4], (E, H, F)) * 0.1,
+        }
+        y, aux = jax.jit(lambda x: moe_ffn(x, gate_w, experts, k=2))(x)
+        assert y.shape == (B, S, H)
+        assert np.isfinite(float(aux))
+
+
+class TestEndToEndEP:
+    def test_train_moe_expert_parallel(self):
+        """tiny_moe trains on a data×expert mesh; loss decreases, experts used."""
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny_moe", dtype="float32", max_seq_len=64)
+        config = {
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": 2, "expert": 4},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        import itertools
+
+        batch = next(synthetic_lm_data(batch_size=16, seq_len=64, vocab_size=512))
+        data = itertools.repeat(batch)  # overfit one batch → reliable decrease
+        losses = [float(engine.train_batch(data)) for _ in range(12)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] - 0.1
+
+    def test_moe_forward_matches_across_mesh_layouts(self):
+        """Same params+batch give the same loss on 1-dev vs expert-sharded mesh."""
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh
+        from deepspeed_tpu.models import transformer as T
+
+        cfg = T.get_model_config("tiny_moe", dtype="float32", max_seq_len=32)
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 512)
+
+        mesh_mod.reset_mesh()
+        loss_single = float(T.causal_lm_loss(
+            T.forward(params, tokens, cfg), tokens))
+
+        mesh_mod.reset_mesh()
+        mm = initialize_mesh(MeshConfig(data=2, expert=4))
+        with mm.mesh:
+            loss_ep = float(jax.jit(
+                lambda p, t: T.causal_lm_loss(T.forward(p, t, cfg), t))(
+                    params, tokens))
+        np.testing.assert_allclose(loss_ep, loss_single, rtol=1e-4)

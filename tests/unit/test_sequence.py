@@ -169,10 +169,13 @@ class TestEndToEndSP:
             "steps_per_print": 10 ** 9,
         }
         engine, *_ = dst.initialize(model=spec, config=config)
-        data = synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512)
+        import itertools
+
+        batch = next(synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512))
+        data = itertools.repeat(batch)
         losses = [float(engine.train_batch(data)) for _ in range(8)]
         assert all(np.isfinite(losses))
-        assert losses[-1] < losses[0]
+        assert losses[-1] < losses[0] - 0.05
 
     def test_train_with_ring_attention(self):
         import deepspeed_tpu as dst
@@ -192,7 +195,10 @@ class TestEndToEndSP:
             "steps_per_print": 10 ** 9,
         }
         engine, *_ = dst.initialize(model=spec, config=config)
-        data = synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512)
+        import itertools
+
+        batch = next(synthetic_lm_data(batch_size=4, seq_len=64, vocab_size=512))
+        data = itertools.repeat(batch)
         losses = [float(engine.train_batch(data)) for _ in range(6)]
         assert all(np.isfinite(losses))
-        assert losses[-1] < losses[0]
+        assert losses[-1] < losses[0] - 0.05

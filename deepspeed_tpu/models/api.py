@@ -98,8 +98,24 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         if overrides:
             cfg = dataclasses.replace(cfg, **overrides)
 
+    def _pipe_stages() -> int:
+        from deepspeed_tpu.comm.mesh import PIPE_AXIS, get_mesh_manager
+
+        try:
+            return get_mesh_manager().mesh.shape.get(PIPE_AXIS, 1)
+        except Exception:
+            return 1
+
     def loss_fn(params, batch):
         tokens = _tokens_of(batch)
+        if _pipe_stages() > 1:
+            loss, aux = T.pipelined_lm_loss(
+                params, tokens, cfg, attention_fn=attention_fn,
+                activation_constraint=activation_constraint,
+                loss_mask=_mask_of(batch))
+            if cfg.n_experts > 0:
+                loss = loss + cfg.moe_aux_coef * aux
+            return loss
         hidden, head, aux = T.forward_hidden(
             params, tokens, cfg, attention_fn=attention_fn,
             activation_constraint=activation_constraint)

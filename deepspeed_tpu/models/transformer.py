@@ -370,6 +370,72 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     return logits
 
 
+def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+                      mesh=None, n_micro: Optional[int] = None,
+                      attention_fn: Optional[AttentionFn] = None,
+                      activation_constraint: Optional[Callable] = None,
+                      loss_mask: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Causal-LM loss with the layer stack pipelined over the 'pipe' mesh axis.
+
+    Embedding runs replicated across stages (cheap); blocks are stage-sharded;
+    final norm + head + loss run on the last stage; returns (loss, moe_aux).
+    See ``parallel/pipeline.py`` (reference ``runtime/pipe/engine.py:337``).
+    """
+    from deepspeed_tpu.comm.mesh import PIPE_AXIS, get_mesh_manager
+    from deepspeed_tpu.parallel.pipeline import microbatch, pipelined_apply
+
+    if mesh is None:
+        mesh = get_mesh_manager().mesh
+    n_stages = mesh.shape[PIPE_AXIS]
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pipe={n_stages}")
+    attention_fn = attention_fn or dot_product_attention
+    constrain = activation_constraint or (lambda x: x)
+    dt = cfg.compute_dtype
+    B, S = tokens.shape
+    M = n_micro or n_stages
+
+    x = params["tok_emb"].astype(dt)[tokens]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_emb"].astype(dt)[:S][None]
+    x = constrain(x)
+
+    cos = sin = None
+    if cfg.pos_emb == "rope":
+        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+
+    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    inputs = {"x": microbatch(x, M), "tokens": microbatch(tokens, M)}
+    if loss_mask is not None:
+        inputs["loss_mask"] = microbatch(loss_mask, M)
+    extra = {"final_norm": params["final_norm"], "head": head}
+    if cos is not None:
+        extra["cos"], extra["sin"] = cos, sin
+
+    def stage_fn(x_in, blocks_l, ex):
+        def body(carry, lp):
+            y, aux = _block_forward(carry, lp, cfg, ex.get("cos"), ex.get("sin"),
+                                    attention_fn)
+            return constrain(y), aux
+
+        if cfg.remat == "full":
+            body = jax.checkpoint(body)
+        elif cfg.remat == "dots_saveable":
+            body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_saveable)
+        y, auxes = lax.scan(body, x_in, blocks_l)
+        return y, jnp.sum(auxes)
+
+    def finalize_fn(y, micro, ex):
+        h = _norm(y, ex["final_norm"], cfg.norm, cfg.norm_eps)
+        logits = h.astype(jnp.float32) @ ex["head"].astype(jnp.float32)
+        return causal_lm_loss(logits, micro["tokens"], micro.get("loss_mask"))
+
+    return pipelined_apply(inputs, params["blocks"], extra, stage_fn,
+                           finalize_fn, mesh)
+
+
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,
                    loss_mask: Optional[jax.Array] = None) -> jax.Array:
     """Next-token cross entropy; stable log-softmax in fp32."""

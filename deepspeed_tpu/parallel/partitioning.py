@@ -29,7 +29,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, EXPERT_AXIS
+from deepspeed_tpu.comm.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+)
 
 # Default logical→mesh rules (Megatron-style TP):
 #   vocab/mlp/heads split over 'tensor'; "expert" over 'expert'; "layers" is the
@@ -88,6 +94,12 @@ class ShardingPolicy:
     zero_stage: int
     tp_rules: Dict[str, Any] = dataclasses.field(default_factory=lambda: dict(DEFAULT_TP_RULES))
     zero_axes: Tuple[str, ...] = ZERO_SHARD_AXES
+
+    def __post_init__(self):
+        # pipeline parallelism: the layer-stack dim is stage-sharded
+        # (reference PipelineModule layer partitioning, runtime/pipe/module.py:86)
+        if self.mesh.shape.get(PIPE_AXIS, 1) > 1:
+            self.tp_rules = dict(self.tp_rules, layers=PIPE_AXIS)
 
     # --- spec trees -------------------------------------------------------- #
     def tp_spec(self, axes_tree: AxesTree) -> Any:

@@ -1,0 +1,119 @@
+"""Pipeline parallelism — microbatch tick schedule over the 'pipe' mesh axis.
+
+Parity: reference ``runtime/pipe/`` — ``PipelineModule``/``LayerSpec``
+(``module.py:86,30``), ``PipelineEngine.train_batch`` (``engine.py:337``),
+``TrainSchedule`` 1F1B instruction stream (``schedule.py:189``) and p2p stage
+transfers (``p2p.py:46,67``).
+
+TPU-native design: the reference interprets a per-rank instruction DSL
+(LoadMicroBatch/ForwardPass/SendActivation/...) in eager Python; here the
+ENTIRE schedule is one ``lax.scan`` over "ticks" inside a ``shard_map`` that is
+manual over 'pipe' only (other mesh axes stay under GSPMD). At tick t, stage s
+computes microbatch ``t - s`` (a diagonal wavefront — GPipe fill/steady/drain),
+then hands its activation to stage s+1 with a single ``lax.ppermute`` neighbor
+hop (ICI-optimal). The backward schedule is not hand-written: JAX autodiff
+reverses the scan and transposes ``ppermute``, yielding the reverse wavefront
+with gradient hops in the opposite direction — the reference's
+``BackwardPass``/``SendGrad``/``RecvGrad`` instructions, derived for free.
+
+Tied weights (e.g. embedding used at stage 0, head at the last stage) are
+passed replicated-over-'pipe'; the vma (varying-manual-axes) machinery inserts
+the cross-stage cotangent psum that the reference implements as
+``ReduceTiedGrads`` (``pipe/engine.py:274``).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import PIPE_AXIS
+
+PyTree = Any
+
+
+def stage_perm(n_stages: int):
+    return [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+
+def _replicated_specs(tree: PyTree) -> PyTree:
+    return jax.tree.map(lambda a: P(*([None] * jnp.ndim(a))), tree)
+
+
+def _stage_sharded_specs(tree: PyTree, axis_name: str) -> PyTree:
+    return jax.tree.map(lambda a: P(axis_name, *([None] * (jnp.ndim(a) - 1))), tree)
+
+
+def pipelined_apply(inputs: Dict[str, jax.Array], blocks: PyTree, extra: PyTree,
+                    stage_fn: Callable, finalize_fn: Callable, mesh: Mesh,
+                    axis_name: str = PIPE_AXIS,
+                    remat_ticks: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Run the pipelined schedule; returns (mean finalize value, mean aux).
+
+    * ``inputs`` — pytree of arrays with leading microbatch dim M; must contain
+      key ``'x'`` (the stage-0 input, e.g. embedded activations [M, b, S, H]).
+      The remaining entries feed ``finalize_fn`` (e.g. targets).
+    * ``blocks`` — layer-stacked params [L, ...]; dim 0 is sharded over 'pipe'
+      (each stage owns L/P contiguous layers).
+    * ``extra`` — params used by every stage or by finalize (norms, head, rope
+      tables); replicated over 'pipe' with autodiff-correct cotangent psum.
+    * ``stage_fn(x, local_blocks, extra) -> (y, aux_scalar)``
+    * ``finalize_fn(y, micro_inputs, extra) -> scalar`` (loss of one microbatch)
+    """
+    n_stages = mesh.shape[axis_name]
+    M = jax.tree.leaves(inputs)[0].shape[0]
+    T = M + n_stages - 1
+
+    def local(inputs_l, blocks_l, extra_l):
+        stage = lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        xm = inputs_l["x"]
+        recv0 = jnp.zeros(xm.shape[1:], xm.dtype)
+
+        def tick(carry, t):
+            recv, loss_sum, aux_sum = carry
+            m_in = t - stage
+            valid_in = (m_in >= 0) & (m_in < M)
+            x_in = jnp.where(is_first, xm[jnp.clip(t, 0, M - 1)], recv)
+            y, aux = stage_fn(x_in, blocks_l, extra_l)
+
+            out_idx = t - (n_stages - 1)
+            valid_out = (out_idx >= 0) & is_last
+            micro = {k: v[jnp.clip(out_idx, 0, M - 1)]
+                     for k, v in inputs_l.items() if k != "x"}
+            loss_m = finalize_fn(y, micro, extra_l)
+            loss_sum = loss_sum + jnp.where(valid_out, loss_m, 0.0)
+            aux_sum = aux_sum + jnp.where(valid_in, aux, 0.0)
+            send = lax.ppermute(y, axis_name, stage_perm(n_stages))
+            return (send, loss_sum, aux_sum), None
+
+        if remat_ticks:
+            tick = jax.checkpoint(tick)
+        # carry becomes pipe-varying after the first tick — mark it up front
+        carry0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis_name,), to="varying"),
+            (recv0, jnp.float32(0.0), jnp.float32(0.0)))
+        (_, loss_sum, aux_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+        loss = lax.psum(loss_sum, axis_name) / M
+        aux = lax.psum(aux_sum, axis_name) / M
+        return loss, aux
+
+    in_specs = (_replicated_specs(inputs),
+                _stage_sharded_specs(blocks, axis_name),
+                _replicated_specs(extra))
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+                   axis_names={axis_name})
+    return fn(inputs, blocks, extra)
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] → [M, B/M, ...]."""
+    B = x.shape[0]
+    if B % n_micro != 0:
+        raise ValueError(f"batch {B} not divisible by pipeline microbatches {n_micro}")
+    return x.reshape((n_micro, B // n_micro) + x.shape[1:])

@@ -61,3 +61,10 @@ def initialize(
 def init_distributed(dist_backend: str = "jax_ici", **kwargs) -> None:
     """Reference ``deepspeed.init_distributed`` analog."""
     comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model, params=None, config=None, **kwargs):
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:328``)."""
+    from deepspeed_tpu.inference.engine import init_inference as _ii
+
+    return _ii(model, params=params, config=config, **kwargs)

@@ -1,0 +1,18 @@
+"""Inference engines (reference L7: ``inference/engine.py`` v1 and
+``inference/v2`` FastGen).
+
+* :class:`InferenceEngine` / :func:`init_inference` — batch generate with one
+  compiled prefill+decode program per shape bucket.
+* :class:`RaggedInferenceEngine` — continuous batching over a slot-structured
+  shared KV cache (put/step/query/flush).
+"""
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.inference.ragged import RaggedInferenceEngine
+from deepspeed_tpu.inference.sampling import sample_logits
+
+__all__ = [
+    "InferenceEngine",
+    "init_inference",
+    "RaggedInferenceEngine",
+    "sample_logits",
+]

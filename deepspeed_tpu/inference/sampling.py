@@ -1,0 +1,37 @@
+"""Token sampling: greedy, temperature, top-k, top-p (nucleus).
+
+Parity: the reference defers sampling to HF ``generate`` (``inference/engine.py:586``)
+and implements top-k/top-p logit processing in FastGen's ragged kernels
+(``inference/v2/kernels/ragged_ops/logits_gather``); here it is a few jnp ops,
+jit-specialized per (temperature, top_k, top_p) config.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits: jax.Array, rng: Optional[jax.Array] = None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """logits [B, V] → token ids [B]. temperature 0 → greedy argmax."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    if rng is None:
+        raise ValueError("sampling with temperature > 0 requires an rng key")
+    logits = logits.astype(jnp.float32) / temperature
+    V = logits.shape[-1]
+    if top_k and top_k < V:
+        kth = jnp.sort(logits, axis=-1)[:, V - top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)          # [B]
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

@@ -295,6 +295,14 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     x = x + attn_out
 
     h = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+    down, aux = _ffn(h, lp, cfg)
+    return x + down, aux
+
+
+def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
+         ) -> Tuple[jax.Array, jax.Array]:
+    """Dense or MoE FFN on normed input; returns (output, aux loss)."""
+    dt = cfg.compute_dtype
     aux = jnp.float32(0.0)
     if cfg.n_experts > 0:
         from deepspeed_tpu.moe.layer import moe_ffn
@@ -316,7 +324,7 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         down = act @ lp["w_down"].astype(dt)
         if cfg.use_bias:
             down = down + lp["b_down"].astype(dt)
-    return x + down, aux
+    return down, aux
 
 
 # --------------------------------------------------------------------------- #
@@ -368,6 +376,110 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                                 activation_constraint)
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
     return logits
+
+
+# --------------------------------------------------------------------------- #
+# KV-cache decode path (inference)
+# --------------------------------------------------------------------------- #
+
+def apply_rope_at(x: jax.Array, cos_table: jax.Array, sin_table: jax.Array,
+                  positions: jax.Array) -> jax.Array:
+    """Rotate x [B, T, N, D] at absolute ``positions`` [B, T]."""
+    d2 = x.shape[-1] // 2
+    cos = cos_table[positions][:, :, None, :].astype(x.dtype)  # [B,T,1,D/2]
+    sin = sin_table[positions][:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
+                  dtype=None) -> Dict[str, jax.Array]:
+    """Layer-stacked KV cache (the blocked-KV analog of the reference's
+    ``inference/v2/ragged/kv_cache.py`` — slot-contiguous, length-masked)."""
+    dt = dtype or cfg.compute_dtype
+    shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def cached_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
+                     positions: jax.Array) -> jax.Array:
+    """q [B,T,N,D] at abs ``positions`` [B,T] against cache [B,M,K,D]; causal
+    mask = cache index <= query position (fp32 softmax)."""
+    B, T, N, D = q.shape
+    M, K = kc.shape[1], kc.shape[2]
+    if K != N:
+        kc = jnp.repeat(kc, N // K, axis=2)
+        vc = jnp.repeat(vc, N // K, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("btnd,bmnd->bntm", q, kc).astype(jnp.float32) * scale
+    mask = jnp.arange(M)[None, None, None, :] <= positions[:, None, :, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bntm,bmnd->btnd", probs, vc)
+
+
+def forward_decode(params: PyTree, tokens: jax.Array,
+                   cache: Dict[str, jax.Array], pos: jax.Array,
+                   cfg: TransformerConfig
+                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Incremental forward: write new tokens' K/V into the cache and attend.
+
+    tokens [B, T] arriving at positions ``pos[b] .. pos[b]+T-1``; pos [B] int32.
+    Works for prefill (T = padded prompt len, pos = 0) and decode (T = 1).
+    Returns (logits [B, T, vocab] fp32, updated cache). Parity: the reference's
+    inference transformer containers (``module_inject/containers``,
+    ``inference/v2/model_implementations``).
+    """
+    B, T = tokens.shape
+    dt = cfg.compute_dtype
+    M = cache["k"].shape[2]
+    positions = pos[:, None] + jnp.arange(T)[None]          # [B, T]
+
+    x = params["tok_emb"].astype(dt)[tokens]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_emb"].astype(dt)[positions]
+
+    cos_t = sin_t = None
+    if cfg.pos_emb == "rope":
+        cos_t, sin_t = rope_table(M, cfg.head_dim, cfg.rope_theta)
+
+    def write(c, new, p):
+        return lax.dynamic_update_slice(c, new, (p, 0, 0))
+
+    def body(x, scans):
+        lp, kc, vc = scans
+        h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+
+        def proj(name, shape):
+            w = lp[f"w{name}"].astype(dt)
+            out = h @ w
+            if cfg.use_bias:
+                out = out + lp[f"b{name}"].astype(dt)
+            return out.reshape(shape)
+
+        q = proj("q", (B, T, cfg.num_heads, cfg.head_dim))
+        k = proj("k", (B, T, cfg.kv_heads, cfg.head_dim))
+        v = proj("v", (B, T, cfg.kv_heads, cfg.head_dim))
+        if cfg.pos_emb == "rope":
+            q = apply_rope_at(q, cos_t, sin_t, positions)
+            k = apply_rope_at(k, cos_t, sin_t, positions)
+        kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos)
+        vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos)
+        attn = cached_attention(q, kc, vc, positions)
+        attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+        attn_out = attn @ lp["wo"].astype(dt)
+        if cfg.use_bias:
+            attn_out = attn_out + lp["bo"].astype(dt)
+        x = x + attn_out
+        h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        down, _ = _ffn(h2, lp, cfg)
+        return x + down, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
 
 
 def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,

@@ -30,14 +30,41 @@ def _tag_dir(root: str, tag: str) -> str:
     return os.path.join(root, tag)
 
 
+_async_ckptr = None
+_async_pending = None
+
+
+def _finalize_async() -> None:
+    """Block until an in-flight async save completes (reference
+    ``DecoupledCheckpointEngine`` drain semantics)."""
+    global _async_pending
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+    _async_pending = None
+
+
 def save_state(save_dir: str, tag: str, state: PyTree,
-               client_state: Optional[Dict] = None, save_latest: bool = True) -> None:
+               client_state: Optional[Dict] = None, save_latest: bool = True,
+               async_save: bool = False) -> None:
+    """``async_save=True`` returns immediately with the write in flight — the
+    reference's decoupled/fast checkpoint engines
+    (``runtime/checkpoint_engine/decoupled_checkpoint_engine.py:78``,
+    ``fast_checkpoint_engine.py:16``); orbax's async checkpointer provides the
+    double-buffered background writer."""
     import orbax.checkpoint as ocp
 
+    global _async_ckptr, _async_pending
     path = os.path.abspath(_tag_dir(save_dir, tag))
     os.makedirs(path, exist_ok=True)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
+    if async_save:
+        _finalize_async()  # at most one save in flight
+        if _async_ckptr is None:
+            _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        _async_ckptr.save(os.path.join(path, "state"), state, force=True)
+        _async_pending = path
+    else:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, "state"), state, force=True)
     if _is_primary():
         with open(os.path.join(path, "client_state.json"), "w") as f:
             json.dump(client_state or {}, f, default=str)
@@ -59,6 +86,7 @@ def load_state(load_dir: str, tag: Optional[str], template_state: PyTree,
     """Restore into the given sharding layout (any mesh topology — UCP behavior)."""
     import orbax.checkpoint as ocp
 
+    _finalize_async()  # a load must observe any in-flight save
     tag = tag or read_latest_tag(load_dir)
     if tag is None:
         raise FileNotFoundError(f"no 'latest' tag file in {load_dir}")

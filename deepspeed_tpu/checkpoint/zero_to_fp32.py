@@ -1,0 +1,64 @@
+"""Offline consolidation of a sharded checkpoint to a single fp32 state dict.
+
+Parity: reference ``deepspeed/utils/zero_to_fp32.py`` (790 LoC reconstructing
+flat ZeRO partitions rank-by-rank) and ``deepspeed/checkpoint/ds_to_universal.py``
+(sharded → topology-free "atom" conversion). Here shards are already stored as
+global arrays (orbax), so consolidation is a replicated restore + export — no
+partition arithmetic. Runs on CPU with no TPU attached.
+
+CLI:
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <checkpoint_dir> <output.npz> [--tag TAG]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+PyTree = Any
+
+
+def get_fp32_state_dict_from_checkpoint(checkpoint_dir: str,
+                                        tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """→ flat {path: fp32 ndarray} of the master weights (reference
+    ``get_fp32_state_dict_from_zero_checkpoint``)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from deepspeed_tpu.checkpoint.engine import read_latest_tag
+
+    tag = tag or read_latest_tag(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' tag file in {checkpoint_dir}")
+    state_path = os.path.abspath(os.path.join(checkpoint_dir, tag, "state"))
+    restored = ocp.PyTreeCheckpointer().restore(state_path)  # numpy, replicated
+    master = restored["master"]
+    flat: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(master)[0]:
+        key = "/".join(
+            p.key if hasattr(p, "key") else str(p.idx) for p in path)
+        flat[key] = np.asarray(leaf, np.float32)
+    return flat
+
+
+def convert_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_path: str,
+                                          tag: Optional[str] = None) -> None:
+    flat = get_fp32_state_dict_from_checkpoint(checkpoint_dir, tag)
+    np.savez(output_path, **flat)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_path")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args()
+    convert_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_path,
+                                          args.tag)
+    print(f"consolidated fp32 state dict written to {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()

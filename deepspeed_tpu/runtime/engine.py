@@ -509,7 +509,8 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
-                        save_latest: bool = True) -> None:
+                        save_latest: bool = True,
+                        async_save: bool = False) -> None:
         from deepspeed_tpu.checkpoint.engine import save_state
 
         tag = tag or f"global_step{self.global_steps}"
@@ -520,8 +521,30 @@ class DeepSpeedTPUEngine:
             "skipped_steps": self.skipped_steps,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
         })
-        save_state(save_dir, tag, self.state, client_state, save_latest=save_latest)
-        log_dist(f"saved checkpoint {save_dir}/{tag}")
+        save_state(save_dir, tag, self.state, client_state,
+                   save_latest=save_latest, async_save=async_save)
+        log_dist(f"saved checkpoint {save_dir}/{tag}"
+                 + (" (async, in flight)" if async_save else ""))
+
+    def save_16bit_model(self, save_dir: str,
+                         save_filename: str = "pytorch_model.npz") -> None:
+        """Gather params and export in the compute dtype (reference
+        ``save_16bit_model`` engine.py:5355 / ``_zero3_consolidated_16bit_state_dict``
+        :5285 — the live-consolidation path)."""
+        import numpy as np_
+
+        os.makedirs(save_dir, exist_ok=True)
+        params = self.get_fp32_params()
+        dtype = np_.dtype(self.precision) if self.precision != "bfloat16" else None
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            key = "/".join(p.key if hasattr(p, "key") else str(p.idx) for p in path)
+            arr = np_.asarray(jax.device_get(leaf))
+            # npz has no bfloat16 — store bf16 as fp16 (same 16-bit budget)
+            flat[key] = arr.astype(dtype or np_.float16)
+        if jax.process_index() == 0:
+            np_.savez(os.path.join(save_dir, save_filename), **flat)
+        log_dist(f"saved 16-bit model to {save_dir}/{save_filename}")
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,

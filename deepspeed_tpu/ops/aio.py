@@ -1,0 +1,126 @@
+"""Python binding for the dstpu_aio C++ async file-I/O library.
+
+Parity: reference ``ops/aio`` / ``csrc/aio/py_ds_aio.cpp`` ``aio_handle``
+(``async_pread``/``async_pwrite``/``wait``) and the op-builder JIT-compile flow
+(``op_builder/builder.py:545 jit_load``) — here the "builder" is one g++
+invocation, cached next to the package (no torch cpp_extension machinery).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "aio", "aio.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_SO_PATH = os.path.join(_BUILD_DIR, "libdstpu_aio.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if (not os.path.exists(_SO_PATH)
+            or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC)):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               _SRC, "-o", _SO_PATH]
+        subprocess.run(cmd, check=True, capture_output=True)
+    return _SO_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build_library())
+            lib.aio_handle_create.restype = ctypes.c_void_p
+            lib.aio_handle_create.argtypes = [ctypes.c_int]
+            lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
+            lib.aio_submit_pwrite.restype = ctypes.c_int
+            lib.aio_submit_pwrite.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_long, ctypes.c_long]
+            lib.aio_submit_pread.restype = ctypes.c_int
+            lib.aio_submit_pread.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_long, ctypes.c_long]
+            lib.aio_wait.restype = ctypes.c_long
+            lib.aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.aio_wait_all.restype = ctypes.c_int
+            lib.aio_wait_all.argtypes = [ctypes.c_void_p]
+            lib.aio_pending.restype = ctypes.c_int
+            lib.aio_pending.argtypes = [ctypes.c_void_p]
+            _lib = lib
+    return _lib
+
+
+class AsyncIOHandle:
+    """The reference ``aio_handle`` analog over numpy buffers.
+
+    Buffers passed to async ops MUST stay alive until wait(); the handle keeps
+    a reference until the op is waited on to enforce that."""
+
+    def __init__(self, n_threads: int = 4):
+        self._lib = _load()
+        self._h = self._lib.aio_handle_create(n_threads)
+        self._live: Dict[int, np.ndarray] = {}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.aio_wait_all(self._h)
+                self._lib.aio_handle_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ #
+    def async_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
+        buf = np.ascontiguousarray(buf)
+        op = self._lib.aio_submit_pwrite(
+            self._h, path.encode(), buf.ctypes.data_as(ctypes.c_void_p),
+            buf.nbytes, offset)
+        if op < 0:
+            raise OSError(-op, os.strerror(-op), path)
+        self._live[op] = buf
+        return op
+
+    def async_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
+        if not buf.flags["C_CONTIGUOUS"] or not buf.flags["WRITEABLE"]:
+            raise ValueError("pread buffer must be contiguous and writeable")
+        op = self._lib.aio_submit_pread(
+            self._h, path.encode(), buf.ctypes.data_as(ctypes.c_void_p),
+            buf.nbytes, offset)
+        if op < 0:
+            raise OSError(-op, os.strerror(-op), path)
+        self._live[op] = buf
+        return op
+
+    def wait(self, op_id: int) -> int:
+        rc = self._lib.aio_wait(self._h, op_id)
+        self._live.pop(op_id, None)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return int(rc)
+
+    def wait_all(self) -> None:
+        rc = self._lib.aio_wait_all(self._h)
+        self._live.clear()
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def pending(self) -> int:
+        return int(self._lib.aio_pending(self._h))
+
+    # sync convenience (reference sync_pread/sync_pwrite)
+    def sync_pwrite(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.wait(self.async_pwrite(buf, path, offset))
+
+    def sync_pread(self, buf: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.wait(self.async_pread(buf, path, offset))

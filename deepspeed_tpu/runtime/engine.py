@@ -134,8 +134,17 @@ class DeepSpeedTPUEngine:
         self.grad_spec = self.policy.grad_spec(self._axes, self._shapes)
         self.batch_spec = self.policy.batch_spec()
 
+        # ZeRO-Offload: optimizer state lives in host memory between steps
+        # (reference runtime/zero/offload_config.py + swap_tensor swappers;
+        # the device↔host moves bracket the jitted step like the reference's
+        # swap-in/step/swap-out flow, stage_1_and_2.py initialize/step)
+        self._offload_opt = (
+            self.config.zero_optimization.offload_optimizer.device == "cpu")
+
         self.state = self._init_state()
         self._compiled: Dict[Any, Any] = {}
+        if self._offload_opt:
+            self._opt_swap("out")
 
         # eager-API accumulation
         self._grad_buffer: Optional[PyTree] = None
@@ -173,6 +182,13 @@ class DeepSpeedTPUEngine:
             sh["scaler"] = jax.tree.map(lambda _: rep, self.scaler.init_state())
             sh["skips"] = rep
         return sh
+
+    @staticmethod
+    def _to_host_shardings(sh_tree: Any) -> Any:
+        """Same layout, pinned host memory (ZeRO-Offload storage tier)."""
+        return jax.tree.map(
+            lambda s: s.with_memory_kind("pinned_host"), sh_tree,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
 
     def _make_state(self, rng) -> Dict[str, Any]:
         master = self.model_spec.init_fn(rng)
@@ -351,6 +367,37 @@ class DeepSpeedTPUEngine:
     def is_gradient_accumulation_boundary(self) -> bool:
         return self._micro_in_window == 0
 
+    def _opt_swap(self, direction: str) -> None:
+        """Move optimizer moments host↔device around the step ('in'/'out')."""
+        opt_sh = self._state_shardings()["opt"]
+        target = self._to_host_shardings(opt_sh) if direction == "out" else opt_sh
+        self.state["opt"] = jax.device_put(self.state["opt"], target)
+
+    # ------------------------------------------------------------------ #
+    # offload_states / reload_states (reference engine.py:5573/:5603)
+    # ------------------------------------------------------------------ #
+    def offload_states(self, include: Optional[List[str]] = None,
+                       device: str = "cpu") -> None:
+        """Move engine state tiers to host memory on demand.
+
+        ``include`` ⊆ {'optim_states', 'hp_params'}; None = both."""
+        if device != "cpu":
+            raise ValueError("offload_states supports device='cpu' (host memory);"
+                             " use OptimizerSwapper for the NVMe tier")
+        include = include or ["optim_states", "hp_params"]
+        sh = self._state_shardings()
+        if "optim_states" in include:
+            self.state["opt"] = jax.device_put(
+                self.state["opt"], self._to_host_shardings(sh["opt"]))
+        if "hp_params" in include:
+            self.state["master"] = jax.device_put(
+                self.state["master"], self._to_host_shardings(sh["master"]))
+
+    def reload_states(self) -> None:
+        sh = self._state_shardings()
+        self.state["opt"] = jax.device_put(self.state["opt"], sh["opt"])
+        self.state["master"] = jax.device_put(self.state["master"], sh["master"])
+
     # ------------------------------------------------------------------ #
     # fused train path
     # ------------------------------------------------------------------ #
@@ -369,8 +416,12 @@ class DeepSpeedTPUEngine:
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
+        if self._offload_opt:
+            self._opt_swap("in")
         with self.mesh:
             self.state, metrics = step_fn(self.state, batch)
+        if self._offload_opt:
+            self._opt_swap("out")
         self.global_steps += 1
         self.micro_steps += gas
         self._after_step(metrics)
@@ -455,8 +506,12 @@ class DeepSpeedTPUEngine:
                 apply, out_shardings=(state_sh, None), donate_argnums=(0, 1))
         if self.config.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
+        if self._offload_opt:
+            self._opt_swap("in")
         with self.mesh:
             self.state, metrics = self._compiled["apply"](self.state, self._grad_buffer)
+        if self._offload_opt:
+            self._opt_swap("out")
         self._grad_buffer = None
         self.global_steps += 1
         self._after_step(metrics)
@@ -556,6 +611,8 @@ class DeepSpeedTPUEngine:
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
         self.state = state
+        if self._offload_opt:
+            self._opt_swap("out")
         self.global_steps = int(client_state.get("global_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
         if load_lr_scheduler_states and self.lr_scheduler is not None and \

@@ -1,0 +1,132 @@
+"""Offload tests: host-memory optimizer offload, offload_states API, C++ aio,
+NVMe swapping (reference ``tests/unit/runtime/zero`` offload + ``ops/aio``).
+"""
+import itertools
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+
+def _engine(stage=2, offload=None):
+    mesh_mod.reset_mesh()
+    spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+    zero = {"stage": stage}
+    if offload:
+        zero["offload_optimizer"] = offload
+    config = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+        "mesh": {"data": 8},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return engine
+
+
+class TestAio:
+    def test_roundtrip(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(n_threads=2)
+        data = np.random.default_rng(0).standard_normal((1024,)).astype(np.float32)
+        path = os.path.join(str(tmp_path), "buf.bin")
+        assert h.sync_pwrite(data, path) == data.nbytes
+        out = np.empty_like(data)
+        assert h.sync_pread(out, path) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+
+    def test_async_overlap(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(n_threads=4)
+        bufs = [np.full((4096,), i, np.float32) for i in range(8)]
+        ops = [h.async_pwrite(b, os.path.join(str(tmp_path), f"f{i}.bin"))
+               for i, b in enumerate(bufs)]
+        for op in ops:
+            assert h.wait(op) == bufs[0].nbytes
+        reads = [np.empty((4096,), np.float32) for _ in range(8)]
+        ops = [h.async_pread(r, os.path.join(str(tmp_path), f"f{i}.bin"))
+               for i, r in enumerate(reads)]
+        h.wait_all()
+        for i, r in enumerate(reads):
+            np.testing.assert_array_equal(r, bufs[i])
+
+    def test_offset_io(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(n_threads=1)
+        path = os.path.join(str(tmp_path), "seg.bin")
+        a = np.arange(16, dtype=np.int32)
+        b = np.arange(16, 32, dtype=np.int32)
+        h.sync_pwrite(a, path, offset=0)
+        h.sync_pwrite(b, path, offset=a.nbytes)
+        out = np.empty((32,), np.int32)
+        h.sync_pread(out, path)
+        np.testing.assert_array_equal(out, np.arange(32, dtype=np.int32))
+
+
+class TestHostOffload:
+    def test_cpu_offload_trains_identically(self):
+        """offload_optimizer cpu must not change the math."""
+        batch = next(synthetic_lm_data(batch_size=16, seq_len=32, vocab_size=512))
+
+        e1 = _engine(stage=2)
+        l1 = [float(e1.train_batch(itertools.repeat(batch))) for _ in range(4)]
+
+        e2 = _engine(stage=2, offload={"device": "cpu"})
+        assert e2._offload_opt
+        kinds = {leaf.sharding.memory_kind
+                 for leaf in jax.tree.leaves(e2.state["opt"])}
+        assert kinds == {"pinned_host"}
+        l2 = [float(e2.train_batch(itertools.repeat(batch))) for _ in range(4)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        # state returns to host after each step
+        kinds = {leaf.sharding.memory_kind
+                 for leaf in jax.tree.leaves(e2.state["opt"])}
+        assert kinds == {"pinned_host"}
+
+    def test_offload_states_api(self):
+        engine = _engine(stage=2)
+        data = synthetic_lm_data(batch_size=16, seq_len=32, vocab_size=512)
+        engine.train_batch(data)
+        engine.offload_states()
+        for leaf in jax.tree.leaves(engine.state["opt"]):
+            assert leaf.sharding.memory_kind == "pinned_host"
+        for leaf in jax.tree.leaves(engine.state["master"]):
+            assert leaf.sharding.memory_kind == "pinned_host"
+        engine.reload_states()
+        for leaf in jax.tree.leaves(engine.state["master"]):
+            assert leaf.sharding.memory_kind == "device"
+        # still trains after reload
+        loss = engine.train_batch(data)
+        assert np.isfinite(float(loss))
+
+
+class TestNvmeSwap:
+    def test_optimizer_swap_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.swap_tensor import OptimizerSwapper
+
+        engine = _engine(stage=2)
+        data = synthetic_lm_data(batch_size=16, seq_len=32, vocab_size=512)
+        engine.train_batch(data)
+        want = np.asarray(jax.device_get(
+            engine.state["opt"]["exp_avg"]["blocks"]["wq"]))
+
+        swapper = OptimizerSwapper(engine, swap_dir=str(tmp_path))
+        swapper.swap_out_optimizer()
+        swapper.swap_in_optimizer()
+        got = np.asarray(jax.device_get(
+            engine.state["opt"]["exp_avg"]["blocks"]["wq"]))
+        np.testing.assert_array_equal(got, want)
+        # training continues after swap-in
+        loss = engine.train_batch(data)
+        assert np.isfinite(float(loss))

@@ -1,0 +1,4 @@
+"""Autotuning (reference ``deepspeed/autotuning/``)."""
+from deepspeed_tpu.autotuning.autotuner import Autotuner, TuneResult
+
+__all__ = ["Autotuner", "TuneResult"]

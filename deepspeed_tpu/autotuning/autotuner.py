@@ -1,0 +1,97 @@
+"""Autotuner — sweep engine configurations, measure, pick the fastest.
+
+Parity: reference ``deepspeed/autotuning/`` (Autotuner orchestrating ZeRO
+stage / micro-batch experiments through result files and relaunches). TPU
+version is in-process: candidate (micro_batch, remat, zero_stage) configs are
+compiled + timed on the live mesh — no process relaunch needed because JAX
+re-jits per config where the reference must restart workers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class TuneResult:
+    config: Dict[str, Any]
+    throughput: float          # samples/sec (0 on failure)
+    step_time_s: float
+    error: Optional[str] = None
+
+
+class Autotuner:
+    """Sweep micro-batch (and optionally zero stage / remat) for a model.
+
+    Usage::
+
+        tuner = Autotuner(model_spec, base_config)
+        best = tuner.tune(micro_batches=[1, 2, 4, 8])
+        engine = deepspeed_tpu.initialize(model=spec, config=best.config)[0]
+    """
+
+    def __init__(self, model_spec, base_config: Dict[str, Any],
+                 seq_len: int = 128, vocab_size: int = 512,
+                 steps: int = 3, warmup: int = 1):
+        self.model_spec = model_spec
+        self.base_config = base_config
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.steps = steps
+        self.warmup = warmup
+        self.results: List[TuneResult] = []
+
+    def _try_config(self, config: Dict[str, Any]) -> TuneResult:
+        import jax
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        try:
+            mesh_mod.reset_mesh()
+            engine, *_ = dst.initialize(model=self.model_spec, config=config)
+            bs = engine.train_micro_batch_size() * engine.dp_world_size
+            data = synthetic_lm_data(batch_size=bs, seq_len=self.seq_len,
+                                     vocab_size=self.vocab_size)
+            for _ in range(self.warmup):
+                jax.block_until_ready(engine.train_batch(data))
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = engine.train_batch(data)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.steps
+            return TuneResult(config=config,
+                              throughput=engine.train_batch_size() / dt,
+                              step_time_s=dt)
+        except Exception as e:  # noqa: BLE001 — OOM/compile failures expected
+            return TuneResult(config=config, throughput=0.0,
+                              step_time_s=float("inf"), error=repr(e))
+
+    def tune(self, micro_batches: Sequence[int] = (1, 2, 4, 8),
+             zero_stages: Optional[Sequence[int]] = None) -> TuneResult:
+        zero_stages = zero_stages or [
+            self.base_config.get("zero_optimization", {}).get("stage", 1)]
+        dp = None
+        for mb, stage in itertools.product(micro_batches, zero_stages):
+            config = dict(self.base_config)
+            config["zero_optimization"] = dict(
+                config.get("zero_optimization", {}), stage=stage)
+            config["train_micro_batch_size_per_gpu"] = mb
+            gas = config.get("gradient_accumulation_steps", 1)
+            config.pop("train_batch_size", None)  # derive from mb × gas × dp
+            result = self._try_config(config)
+            self.results.append(result)
+            status = (f"{result.throughput:.1f} samples/s"
+                      if not result.error else f"failed: {result.error[:60]}")
+            logger.info(f"autotune mb={mb} stage={stage}: {status}")
+        best = max(self.results, key=lambda r: r.throughput)
+        if best.throughput == 0:
+            raise RuntimeError("autotuning failed for every candidate config")
+        return best

@@ -1,0 +1,14 @@
+"""Elastic training (reference ``deepspeed/elasticity/``)."""
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityError,
+    compute_elastic_config,
+    get_compatible_gpus_v01,
+)
+
+__all__ = [
+    "ElasticityConfig",
+    "ElasticityError",
+    "compute_elastic_config",
+    "get_compatible_gpus_v01",
+]

@@ -1,0 +1,108 @@
+"""Elastic training — chip-count-compatible batch configuration.
+
+Parity: reference ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config`` :233, candidate batch enumeration :27-82, v0.1/v0.2
+algorithms :83/:126). The math is hardware-agnostic and ports directly: find
+global batch sizes compatible with every allowed chip count so a job can
+resume at a different slice size with the same effective batch. On TPU the
+"scale up/down" event is a slice resize: re-initialize the mesh from the new
+topology and reload the (topology-free) checkpoint — see
+``checkpoint/engine.py``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+class ElasticityError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class ElasticityConfig:
+    """Reference ``elasticity/config.py`` analog (same JSON keys)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: Tuple[int, ...] = (2, 4, 6)
+    min_gpus: int = 1
+    max_gpus: int = 10_000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticityConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        if "micro_batch_sizes" in kwargs:
+            kwargs["micro_batch_sizes"] = tuple(kwargs["micro_batch_sizes"])
+        return cls(**kwargs)
+
+
+def _candidate_batch_sizes(base_list: List[int], max_acc: int) -> List[int]:
+    """Candidate global batches = micro_batch × accumulation (reference :27)."""
+    candidates = set()
+    for base in base_list:
+        for acc in range(1, max_acc + 1):
+            candidates.add(base * acc)
+    return sorted(candidates)
+
+
+def _valid_chip_counts(batch: int, micro_batches: List[int],
+                       min_chips: int, max_chips: int) -> List[int]:
+    """Chip counts at which ``batch`` splits evenly over some micro batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch % mb:
+            continue
+        max_dp = batch // mb
+        # any chip count that divides the total accumulation evenly
+        for chips in range(min_chips, min(max_dp, max_chips) + 1):
+            if max_dp % chips == 0:
+                valid.add(chips)
+    return sorted(valid)
+
+
+def get_compatible_gpus_v01(micro_batches: List[int], max_train_batch_size: int,
+                            min_gpus: int = 1, max_gpus: int = 10_000
+                            ) -> Tuple[List[int], int]:
+    """v0.1: single best batch + its compatible chip counts (reference :83)."""
+    max_acc = max_train_batch_size // min(micro_batches)
+    best_batch, best_chips = 0, []
+    for batch in _candidate_batch_sizes(list(micro_batches), max_acc):
+        if batch > max_train_batch_size:
+            continue
+        chips = _valid_chip_counts(batch, list(micro_batches), min_gpus, max_gpus)
+        if (len(chips), batch) > (len(best_chips), best_batch):
+            best_batch, best_chips = batch, chips
+    if not best_chips:
+        raise ElasticityError("no compatible batch size found")
+    return best_chips, best_batch
+
+
+def compute_elastic_config(ds_config: Dict, target_deployment_size: Optional[int] = None
+                           ) -> Tuple[int, int, ElasticityConfig]:
+    """Reference ``compute_elastic_config`` (:233): → (final_batch_size,
+    micro_batch per chip, elastic config) for the target chip count."""
+    econf = ElasticityConfig.from_dict(ds_config.get("elasticity", {}))
+    if not econf.enabled:
+        raise ElasticityError("elasticity section missing or disabled")
+    chips, batch = get_compatible_gpus_v01(
+        list(econf.micro_batch_sizes), econf.max_train_batch_size,
+        econf.min_gpus, econf.max_gpus)
+    if target_deployment_size is None:
+        return batch, batch // max(chips), econf
+    if target_deployment_size not in chips:
+        raise ElasticityError(
+            f"deployment size {target_deployment_size} incompatible; "
+            f"valid sizes: {chips}")
+    per_chip = batch // target_deployment_size
+    micro = max((m for m in econf.micro_batch_sizes if per_chip % m == 0),
+                default=None)
+    if micro is None:
+        raise ElasticityError(
+            f"no micro batch evenly divides per-chip batch {per_chip}")
+    return batch, micro, econf

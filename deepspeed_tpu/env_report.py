@@ -1,0 +1,90 @@
+"""Environment / compatibility report — the ``ds_report`` analog.
+
+Parity: reference ``deepspeed/env_report.py`` (``op_report`` :30 + setup
+report) printed by ``bin/ds_report``. Reports the JAX/XLA toolchain, device
+topology, and the status of every native/Pallas op this framework ships.
+
+CLI: ``python -m deepspeed_tpu.env_report``
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+import subprocess
+import sys
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return RED_NO
+
+
+def op_report() -> list:
+    """Status of each accelerated op (reference ``op_report``)."""
+    rows = []
+
+    def probe(name, fn):
+        try:
+            fn()
+            rows.append((name, GREEN_OK))
+        except Exception as e:  # noqa: BLE001
+            rows.append((name, f"{RED_NO} ({type(e).__name__})"))
+
+    probe("pallas.flash_attention", lambda: importlib.import_module(
+        "deepspeed_tpu.ops.pallas.flash_attention"))
+    probe("pallas.fused_adam", lambda: importlib.import_module(
+        "deepspeed_tpu.ops.pallas.fused_adam"))
+    probe("pallas.norms", lambda: importlib.import_module(
+        "deepspeed_tpu.ops.pallas.norms"))
+    probe("quantized_collectives", lambda: importlib.import_module(
+        "deepspeed_tpu.ops.quantization"))
+
+    def aio():
+        from deepspeed_tpu.ops.aio import _build_library
+
+        _build_library()
+
+    probe("aio (csrc build)", aio)
+    return rows
+
+
+def main() -> None:
+    import jax
+
+    import deepspeed_tpu
+
+    print("-" * 60)
+    print("deepspeed_tpu environment report")
+    print("-" * 60)
+    print(f"deepspeed_tpu version ... {deepspeed_tpu.__version__}")
+    print(f"python .................. {sys.version.split()[0]}")
+    print(f"jax ..................... {_try_version('jax')}")
+    print(f"flax .................... {_try_version('flax')}")
+    print(f"optax ................... {_try_version('optax')}")
+    print(f"orbax.checkpoint ........ {_try_version('orbax.checkpoint')}")
+    print(f"numpy ................... {_try_version('numpy')}")
+    gxx = shutil.which("g++")
+    print(f"g++ ..................... {gxx or RED_NO}")
+    print("-" * 60)
+    print(f"backend ................. {jax.default_backend()}")
+    print(f"process count ........... {jax.process_count()}")
+    print(f"device count ............ {jax.device_count()}")
+    devs = jax.devices()
+    if devs:
+        print(f"device[0] ............... {devs[0].device_kind}")
+    print("-" * 60)
+    print("op compatibility:")
+    for name, status in op_report():
+        print(f"  {name:.<30} {status}")
+    print("-" * 60)
+
+
+if __name__ == "__main__":
+    main()

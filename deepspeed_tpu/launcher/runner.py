@@ -1,0 +1,76 @@
+"""Launcher CLI — bring up a (multi-host) training script.
+
+Parity: reference ``bin/deepspeed`` → ``launcher/runner.py:436``. The
+reference must fork one process per GPU and rendezvous them
+(``launcher/launch.py:145``, PDSH/MPI transports for multi-node); on TPU the
+model is one process per HOST with all local chips owned by that process, and
+the rendezvous is ``jax.distributed.initialize()`` reading the TPU-pod
+metadata — so the launcher reduces to: set env, optionally bootstrap
+jax.distributed, run the script. Multi-host fan-out itself is the platform's
+job (GKE/xpk/gcloud), matching how TPU pods are actually operated.
+
+CLI:
+    python -m deepspeed_tpu.launcher.runner [--bind_cores] script.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="deepspeed_tpu.launcher",
+        description="launch a deepspeed_tpu training script")
+    p.add_argument("--master_addr", default=None,
+                   help="coordinator address for multi-host bring-up "
+                        "(host:port); defaults to TPU-pod auto-discovery")
+    p.add_argument("--num_nodes", type=int, default=None,
+                   help="process count for multi-host bring-up")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this process's index for multi-host bring-up")
+    p.add_argument("--module", action="store_true",
+                   help="run the target as a python module (python -m)")
+    p.add_argument("script", help="training script (or module with --module)")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def maybe_init_distributed(args: argparse.Namespace) -> None:
+    """Bootstrap jax.distributed when multi-host flags/env are present."""
+    import jax
+
+    explicit = args.master_addr is not None
+    env_pod = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or \
+        os.environ.get("TPU_WORKER_HOSTNAMES")
+    if explicit:
+        jax.distributed.initialize(
+            coordinator_address=args.master_addr,
+            num_processes=args.num_nodes,
+            process_id=args.node_rank)
+        logger.info(
+            f"jax.distributed up: process {args.node_rank}/{args.num_nodes}")
+    elif env_pod:
+        jax.distributed.initialize()  # TPU-pod metadata discovery
+        logger.info(
+            f"jax.distributed up via pod metadata: "
+            f"process {jax.process_index()}/{jax.process_count()}")
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = parse_args(argv)
+    maybe_init_distributed(args)
+    sys.argv = [args.script] + args.script_args
+    if args.module:
+        runpy.run_module(args.script, run_name="__main__", alter_sys=True)
+    else:
+        runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

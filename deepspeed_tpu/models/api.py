@@ -27,6 +27,7 @@ class ModelSpec:
     apply_fn: Optional[Callable[[PyTree, Batch], Any]] = None  # → model outputs
     name: str = "model"
     num_params: Optional[int] = None
+    seq_len: Optional[int] = None  # nominal sequence length (profiling etc.)
 
 
 def _tokens_of(batch: Batch) -> jax.Array:
@@ -145,4 +146,5 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         axes_fn=lambda: T.param_logical_axes(cfg),
         name=name,
         num_params=cfg.num_params(),
+        seq_len=cfg.max_seq_len,
     )

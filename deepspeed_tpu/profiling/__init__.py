@@ -1,0 +1,8 @@
+"""Profiling (reference ``deepspeed/profiling/``): FLOPS via XLA cost analysis."""
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    get_model_profile,
+    profile_fn,
+)
+
+__all__ = ["FlopsProfiler", "get_model_profile", "profile_fn"]

@@ -1,0 +1,103 @@
+"""Collective bandwidth benchmark — the ``ds_bench`` analog.
+
+Parity: reference ``bin/ds_bench`` → ``benchmarks/communication`` (sweeps
+all_reduce/all_gather/... sizes, prints GB/s and busbw). Here the sweep runs
+psum / all_gather / psum_scatter / all_to_all as jitted shard_map programs
+over a mesh axis and reports algorithm bandwidth + bus bandwidth with the
+standard ring-collective correction factors.
+
+CLI: ``python -m deepspeed_tpu.utils.comm_bench [--axis data] [--trials 20]``
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _timeit(fn, x, trials: int) -> float:
+    fn(x).block_until_ready()  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = fn(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / trials
+
+
+def bench_collectives(mesh: Optional[Mesh] = None, axis: str = "data",
+                      sizes_mb: Optional[List[float]] = None,
+                      trials: int = 20) -> List[Dict]:
+    """Returns rows: {op, size_bytes, time_s, algbw_gbps, busbw_gbps}."""
+    from deepspeed_tpu.comm.mesh import get_mesh_manager
+
+    mesh = mesh or get_mesh_manager().mesh
+    world = mesh.shape.get(axis, 1)
+    sizes_mb = sizes_mb or [1, 4, 16, 64]
+    rows: List[Dict] = []
+
+    def sm(fn, in_spec, out_spec):
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
+                                 out_specs=out_spec, check_vma=False))
+
+    for mb in sizes_mb:
+        n = int(mb * 1e6 / 4)
+        n = (n // (world * world)) * world * world or world * world
+        x = jnp.ones((n,), jnp.float32)
+        xs = jnp.ones((world, n // world), jnp.float32)
+        bytes_ = n * 4
+
+        ops = {
+            # busbw factors per the NCCL-tests convention
+            "all_reduce": (sm(lambda v: lax.psum(v, axis), P(axis, None), P(axis, None)),
+                           xs, 2 * (world - 1) / world),
+            "all_gather": (sm(lambda v: lax.all_gather(v, axis, tiled=True),
+                              P(axis), P(None)),
+                           x, (world - 1) / world),
+            "reduce_scatter": (sm(lambda v: lax.psum_scatter(v, axis, tiled=True),
+                                  P(None), P(axis)),
+                               x, (world - 1) / world),
+            "all_to_all": (sm(lambda v: lax.all_to_all(
+                v.reshape(world, -1), axis, split_axis=0, concat_axis=0,
+                tiled=True).reshape(1, -1),
+                P(axis, None), P(axis, None)),
+                xs, (world - 1) / world),
+        }
+        for name, (fn, arg, factor) in ops.items():
+            t = _timeit(fn, arg, trials)
+            algbw = bytes_ / t / 1e9
+            rows.append({
+                "op": name, "size_bytes": bytes_, "time_s": t,
+                "algbw_gbps": algbw, "busbw_gbps": algbw * factor,
+            })
+    return rows
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--axis", default="data")
+    p.add_argument("--trials", type=int, default=20)
+    p.add_argument("--sizes-mb", type=float, nargs="*", default=None)
+    args = p.parse_args()
+
+    from deepspeed_tpu.comm.mesh import MeshConfig, get_mesh_manager, initialize_mesh
+
+    try:
+        mesh = get_mesh_manager().mesh
+    except Exception:
+        mesh = initialize_mesh(MeshConfig()).mesh
+    rows = bench_collectives(mesh, args.axis, args.sizes_mb, args.trials)
+    print(f"{'op':<16}{'size':>12}{'time':>12}{'algbw GB/s':>14}{'busbw GB/s':>14}")
+    for r in rows:
+        print(f"{r['op']:<16}{r['size_bytes']:>12}{r['time_s'] * 1e3:>10.2f}ms"
+              f"{r['algbw_gbps']:>14.2f}{r['busbw_gbps']:>14.2f}")
+
+
+if __name__ == "__main__":
+    main()

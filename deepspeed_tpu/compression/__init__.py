@@ -1,0 +1,8 @@
+"""Compression (reference ``deepspeed/compression/``): QAT fake quantization."""
+from deepspeed_tpu.compression.quantize import (
+    compress_spec,
+    fake_quant_symmetric,
+    quantize_param_tree,
+)
+
+__all__ = ["compress_spec", "fake_quant_symmetric", "quantize_param_tree"]

@@ -1,0 +1,75 @@
+"""Compression — quantization-aware training via straight-through fake quant.
+
+Parity: reference ``deepspeed/compression/`` (``basic_layer.py``'s
+``QuantAct``/``LinearLayer_Compress`` weight/activation fake quantization and
+``compress.py``'s module substitution). Instead of swapping nn.Modules, a
+ModelSpec transform wraps ``loss_fn``/``apply_fn`` so every selected parameter
+is fake-quantized on the forward pass while gradients flow straight through
+(STE) — the same training dynamics with zero model-code changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@jax.custom_vjp
+def fake_quant_symmetric(x: jax.Array, num_levels: float) -> jax.Array:
+    """Round to a symmetric per-tensor grid; identity gradient (STE)."""
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / num_levels, 1.0)
+    return jnp.clip(jnp.round(x / scale), -num_levels, num_levels) * scale
+
+
+def _fq_fwd(x, num_levels):
+    return fake_quant_symmetric(x, num_levels), None
+
+
+def _fq_bwd(_, g):
+    return g, None
+
+
+fake_quant_symmetric.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_param_tree(params: PyTree, bits: int = 8,
+                        pattern: Optional[str] = None) -> PyTree:
+    """Fake-quantize matching leaves (name regex; default: every float leaf
+    with ndim >= 2 — weights, not norms/biases)."""
+    num_levels = float(2 ** (bits - 1) - 1)
+    rx = re.compile(pattern) if pattern else None
+
+    def one(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if rx is not None and not rx.search(name):
+            return leaf
+        if rx is None and (leaf.ndim < 2 or not jnp.issubdtype(
+                leaf.dtype, jnp.floating)):
+            return leaf
+        return fake_quant_symmetric(leaf, num_levels)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def compress_spec(spec, bits: int = 8, pattern: Optional[str] = None):
+    """Wrap a ModelSpec for QAT (reference ``init_compression``/``compress.py``
+    entry point): forward sees w_q = FQ(w); backward is straight-through, so
+    the fp32 master keeps training while the loss matches deploy-time
+    quantization."""
+    def loss_fn(params, batch):
+        return spec.loss_fn(quantize_param_tree(params, bits, pattern), batch)
+
+    apply_fn = None
+    if spec.apply_fn is not None:
+        def apply_fn(params, batch):
+            return spec.apply_fn(quantize_param_tree(params, bits, pattern),
+                                 batch)
+
+    return dataclasses.replace(spec, loss_fn=loss_fn, apply_fn=apply_fn,
+                               name=f"{spec.name}-qat{bits}")

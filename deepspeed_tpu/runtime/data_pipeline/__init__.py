@@ -1,0 +1,21 @@
+"""Data efficiency (reference ``runtime/data_pipeline/``): curriculum
+learning + random-LTD."""
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+    curriculum_dataloader,
+)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler,
+    gather_tokens,
+    random_token_select,
+    scatter_tokens,
+)
+
+__all__ = [
+    "CurriculumScheduler",
+    "curriculum_dataloader",
+    "RandomLTDScheduler",
+    "gather_tokens",
+    "random_token_select",
+    "scatter_tokens",
+]

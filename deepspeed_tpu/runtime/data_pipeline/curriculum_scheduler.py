@@ -1,0 +1,84 @@
+"""Curriculum learning scheduler (difficulty ramps, usually sequence length).
+
+Parity: reference ``runtime/data_pipeline/data_sampling/curriculum_scheduler.py``
+(schedule types fixed_linear / fixed_root / fixed_discrete, config keys
+``curriculum_learning`` in ``data_efficiency``). Difficulty here is an integer
+(e.g. tokens of context); the dataloader wrapper truncates batches to the
+current difficulty — under jit this produces one compiled program per bucket,
+so schedules should step in coarse increments (``difficulty_step``).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        self.min_difficulty = int(config.get("min_difficulty", 8))
+        self.max_difficulty = int(config.get("max_difficulty", 1024))
+        self.total_curriculum_step = int(config.get("total_curriculum_step", 1000))
+        self.difficulty_step = int(config.get("difficulty_step", 8))
+        self.root_degree = int(config.get("root_degree", 2))
+        # fixed_discrete: explicit (difficulty, until_step) stairs
+        self.difficulties = config.get("difficulty", [])
+        self.max_steps = config.get("max_step", [])
+        self.current_difficulty = self.min_difficulty
+
+    def _clip(self, d: float) -> int:
+        d = int(d // self.difficulty_step * self.difficulty_step)
+        return int(np.clip(d, self.min_difficulty, self.max_difficulty))
+
+    def get_difficulty(self, global_step: int) -> int:
+        t = min(1.0, global_step / max(1, self.total_curriculum_step))
+        if self.schedule_type == FIXED_LINEAR:
+            d = self.min_difficulty + t * (self.max_difficulty - self.min_difficulty)
+        elif self.schedule_type == FIXED_ROOT:
+            d = self.min_difficulty + (t ** (1.0 / self.root_degree)) * (
+                self.max_difficulty - self.min_difficulty)
+        elif self.schedule_type == FIXED_DISCRETE:
+            d = self.difficulties[-1]
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_step < until:
+                    d = diff
+                    break
+            return int(d)
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+        return self._clip(d)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_difficulty = sd["current_difficulty"]
+
+
+def curriculum_dataloader(data_iter: Iterator, scheduler: CurriculumScheduler,
+                          step_fn, seq_key: str = "tokens") -> Iterator:
+    """Wrap a batch iterator: truncate the sequence dim to the current
+    difficulty (reference truncation semantics in
+    ``deepspeed/runtime/data_pipeline/curriculum_scheduler`` usage).
+    ``step_fn()`` must return the current global step (e.g.
+    ``lambda: engine.global_steps``)."""
+    for batch in data_iter:
+        d = scheduler.update_difficulty(step_fn())
+        if isinstance(batch, dict):
+            out = {k: (np.asarray(v)[:, :d] if k == seq_key or
+                       (hasattr(v, "ndim") and np.asarray(v).ndim >= 2)
+                       else v)
+                   for k, v in batch.items()}
+        else:
+            out = np.asarray(batch)[:, :d]
+        yield out

@@ -1,0 +1,61 @@
+"""Random-LTD — random layerwise token dropping.
+
+Parity: reference ``runtime/data_pipeline/data_routing/`` (random-LTD scheduler
++ ``csrc/random_ltd`` gather/scatter kernels): middle transformer layers train
+on a random subset of tokens, with the kept-token count ramping up over
+training. The gather/scatter is jnp ``take``/``scatter`` (XLA fuses; the CUDA
+kernels' job), the schedule mirrors the reference's linear ramp.
+
+Model integration (``random_ltd_transform``): tokens are dropped once for the
+whole middle stack — the scan-over-layers layout keeps per-layer shapes
+uniform, so the drop boundary sits between scans rather than inside one (same
+memory/compute saving, one fewer degree of freedom than the reference).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Linear ramp of kept-token count (reference
+    ``random_ltd_scheduler.py`` semantics: seq starts at ``start_value``,
+    reaches full length at ``total_steps``)."""
+
+    def __init__(self, config: Dict):
+        self.start_tokens = int(config.get("random_ltd_schedule", {})
+                                .get("start_value", 128))
+        self.step_size = int(config.get("random_ltd_schedule", {})
+                             .get("schedule_config", {}).get("seq_per_step", 16))
+        self.total_steps = int(config.get("random_ltd_schedule", {})
+                               .get("schedule_config", {}).get("require_steps", 1000))
+        self.max_tokens = int(config.get("max_value", 1024))
+
+    def get_kept_tokens(self, global_step: int) -> int:
+        t = min(1.0, global_step / max(1, self.total_steps))
+        kept = self.start_tokens + t * (self.max_tokens - self.start_tokens)
+        kept = int(kept // self.step_size * self.step_size)
+        return max(self.start_tokens, min(self.max_tokens, kept))
+
+
+def random_token_select(rng: jax.Array, seq_len: int, keep: int
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """→ (kept_idx [keep] sorted, mask [seq_len] bool). The gather index set
+    of the reference's ``token_sort``/``gather`` kernels."""
+    perm = jax.random.permutation(rng, seq_len)
+    kept = jnp.sort(perm[:keep])
+    mask = jnp.zeros((seq_len,), bool).at[kept].set(True)
+    return kept, mask
+
+
+def gather_tokens(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x [B, S, ...] → [B, keep, ...] (csrc/random_ltd gather analog)."""
+    return jnp.take(x, idx, axis=1)
+
+
+def scatter_tokens(full: jax.Array, part: jax.Array, idx: jax.Array) -> jax.Array:
+    """Write processed kept tokens back into the full sequence
+    (csrc/random_ltd scatter analog): dropped positions keep ``full``."""
+    return full.at[:, idx].set(part)

@@ -1,0 +1,49 @@
+"""Progressive layer drop — stochastic-depth schedule.
+
+Parity: reference ``runtime/progressive_layer_drop.py`` (``ProgressiveLayerDrop``:
+theta(t) = (1 - theta_0) * exp(-gamma * t) ... keep probability ramps DOWN over
+training; engine hook at ``engine.py:430``). The per-layer keep probability at
+depth l of L is ``1 - (l / L) * (1 - theta)`` (deeper layers drop more, PLD
+paper). Model integration: ``keep_mask`` below is consumed by the transformer
+scan — a dropped layer contributes identity (residual passthrough) for that
+batch.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def update_state(self, global_step: int) -> float:
+        """theta(t) → theta as t → ∞ (keep prob decays from 1 to theta)."""
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+
+def layer_keep_probs(theta: float, num_layers: int) -> jax.Array:
+    """Per-layer keep probability: deeper layers drop more (PLD eq. 6)."""
+    l = jnp.arange(1, num_layers + 1, dtype=jnp.float32)
+    return 1.0 - (l / num_layers) * (1.0 - theta)
+
+
+def sample_keep_mask(rng: jax.Array, theta: float, num_layers: int) -> jax.Array:
+    """[L] float mask (1 keep / 0 drop) for one step's layer scan."""
+    probs = layer_keep_probs(theta, num_layers)
+    return (jax.random.uniform(rng, (num_layers,)) < probs).astype(jnp.float32)

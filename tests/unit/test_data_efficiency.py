@@ -1,0 +1,180 @@
+"""Data-efficiency + training-feature tests (reference
+``tests/unit/runtime/`` curriculum/LTD/PLD/eigenvalue/compression suites).
+"""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+
+
+class TestCurriculum:
+    def test_linear_schedule(self):
+        from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 8,
+            "max_difficulty": 64, "total_curriculum_step": 100,
+            "difficulty_step": 8})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(1000) == 64
+        mid = s.get_difficulty(50)
+        assert 8 < mid < 64 and mid % 8 == 0
+
+    def test_root_schedule_front_loads(self):
+        from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+        lin = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 8,
+            "max_difficulty": 64, "total_curriculum_step": 100,
+            "difficulty_step": 1})
+        root = CurriculumScheduler({
+            "schedule_type": "fixed_root", "min_difficulty": 8,
+            "max_difficulty": 64, "total_curriculum_step": 100,
+            "difficulty_step": 1, "root_degree": 2})
+        assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+    def test_discrete_schedule(self):
+        from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_discrete",
+            "difficulty": [16, 32, 64], "max_step": [10, 20, 10 ** 9]})
+        assert s.get_difficulty(5) == 16
+        assert s.get_difficulty(15) == 32
+        assert s.get_difficulty(25) == 64
+
+    def test_curriculum_dataloader_truncates(self):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler,
+            curriculum_dataloader,
+        )
+
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 8,
+            "max_difficulty": 32, "total_curriculum_step": 10,
+            "difficulty_step": 8})
+        src = ({"tokens": np.zeros((2, 32), np.int32)} for _ in range(100))
+        step = iter(range(100))
+        loader = curriculum_dataloader(src, s, lambda: next(step))
+        first = next(loader)
+        assert first["tokens"].shape == (2, 8)
+        for batch in itertools.islice(loader, 15):
+            pass
+        assert batch["tokens"].shape == (2, 32)
+
+
+class TestRandomLTD:
+    def test_scheduler_ramp(self):
+        from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+
+        s = RandomLTDScheduler({
+            "random_ltd_schedule": {
+                "start_value": 128,
+                "schedule_config": {"seq_per_step": 16, "require_steps": 100}},
+            "max_value": 512})
+        assert s.get_kept_tokens(0) == 128
+        assert s.get_kept_tokens(100) == 512
+        assert 128 < s.get_kept_tokens(50) < 512
+
+    def test_gather_scatter_roundtrip(self):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            gather_tokens,
+            random_token_select,
+            scatter_tokens,
+        )
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4))
+        idx, mask = random_token_select(jax.random.PRNGKey(1), 16, 8)
+        assert int(mask.sum()) == 8
+        part = gather_tokens(x, idx)
+        assert part.shape == (2, 8, 4)
+        # scatter back the same values → identity
+        out = scatter_tokens(x, part, idx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestPLD:
+    def test_theta_decays_to_floor(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop,
+        )
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.update_state(0) == pytest.approx(1.0)
+        assert pld.update_state(10_000) == pytest.approx(0.5, abs=1e-3)
+        mid = pld.update_state(100)
+        assert 0.5 < mid < 1.0
+        assert pld.get_state()["pld_theta"] == mid
+
+    def test_keep_probs_monotone_in_depth(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            layer_keep_probs,
+            sample_keep_mask,
+        )
+
+        probs = np.asarray(layer_keep_probs(0.5, 8))
+        assert np.all(np.diff(probs) < 0)          # deeper → lower keep prob
+        assert probs[0] > 0.9 and probs[-1] == pytest.approx(0.5)
+        mask = sample_keep_mask(jax.random.PRNGKey(0), 0.5, 8)
+        assert mask.shape == (8,)
+        assert set(np.asarray(mask).tolist()) <= {0.0, 1.0}
+
+
+class TestEigenvalue:
+    def test_quadratic_top_eigenvalue(self):
+        """For loss = 0.5 x^T A x the top Hessian eigenvalue is max eig(A)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        rng = np.random.default_rng(0)
+        Q, _ = np.linalg.qr(rng.standard_normal((8, 8)))
+        eigs = np.array([5.0, 3.0, 1.0, 0.5, 0.2, 0.1, 0.05, 0.01])
+        A = jnp.asarray(Q @ np.diag(eigs) @ Q.T, jnp.float32)
+
+        def loss(p):
+            x = p["x"]
+            return 0.5 * x @ A @ x
+
+        est, v = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+            loss, {"x": jnp.ones((8,), jnp.float32)})
+        assert est == pytest.approx(5.0, rel=1e-2)
+
+
+class TestCompression:
+    def test_fake_quant_grid_and_ste(self):
+        from deepspeed_tpu.compression import fake_quant_symmetric
+
+        x = jnp.linspace(-1, 1, 101)
+        q = fake_quant_symmetric(x, 127.0)
+        # on-grid, small error
+        assert float(jnp.max(jnp.abs(q - x))) <= 1.0 / 127.0
+        # straight-through: dL/dx = dL/dq (outer grad passes through unchanged)
+        g = jax.grad(lambda x: jnp.sum(fake_quant_symmetric(x, 127.0) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(2 * q), rtol=1e-5)
+
+    def test_qat_spec_trains(self):
+        from deepspeed_tpu.compression import compress_spec
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        mesh_mod.reset_mesh()
+        spec = compress_spec(
+            dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32), bits=8)
+        assert spec.name.endswith("qat8")
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 1}, "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        batch = next(synthetic_lm_data(batch_size=8, seq_len=32, vocab_size=512))
+        data = itertools.repeat(batch)
+        losses = [float(engine.train_batch(data)) for _ in range(8)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] - 0.05

@@ -37,12 +37,18 @@ def _bucket(n: int, minimum: int = 16) -> int:
 
 
 class InferenceEngine:
-    """Generate-capable engine over the functional model zoo."""
+    """Generate-capable engine over the functional model zoo.
+
+    TP: when a live mesh has a non-trivial 'tensor' axis, params are placed
+    with the AutoTP sharding rules (``parallel/partitioning.py`` — the
+    reference's ``module_inject/auto_tp.py:194`` analog) and the KV cache is
+    sharded over kv-heads; GSPMD inserts the row/col-parallel collectives the
+    reference's ``LinearAllreduce`` layers issue by hand."""
 
     def __init__(self, cfg: Union[str, T.TransformerConfig],
                  params: Optional[PyTree] = None,
                  dtype: Optional[str] = None, seed: int = 0,
-                 max_seq_len: Optional[int] = None, **overrides):
+                 max_seq_len: Optional[int] = None, mesh=None, **overrides):
         if isinstance(cfg, str):
             cfg = T.get_model_config(cfg, **overrides)
         if dtype is not None:
@@ -53,8 +59,43 @@ class InferenceEngine:
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed))
+
+        self.mesh = mesh if mesh is not None else self._live_mesh()
+        if self.mesh is not None:
+            from deepspeed_tpu.parallel.partitioning import ShardingPolicy
+
+            policy = ShardingPolicy(self.mesh, zero_stage=0)
+            sh = policy.to_shardings(policy.tp_spec(T.param_logical_axes(cfg)))
+            params = jax.tree.map(jax.device_put, params, sh)
         self.params = params
         self._compiled: Dict[Any, Any] = {}
+
+    @staticmethod
+    def _live_mesh():
+        from deepspeed_tpu.comm.mesh import get_mesh_manager
+
+        try:
+            mesh = get_mesh_manager().mesh
+        except Exception:
+            return None
+        return mesh if mesh.size > 1 else None
+
+    def _cache_constraint(self, cache):
+        """Shard KV cache [L, B, M, K, D]: batch over data, kv-heads over
+        tensor (only when divisible)."""
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.comm.mesh import DATA_AXIS, TENSOR_AXIS
+
+        data = DATA_AXIS if self.mesh.shape.get(DATA_AXIS, 1) > 1 else None
+        tp = self.mesh.shape.get(TENSOR_AXIS, 1)
+        heads = TENSOR_AXIS if tp > 1 and self.cfg.kv_heads % tp == 0 else None
+        spec = P(None, data, None, heads, None)
+        sh = NamedSharding(self.mesh, spec)
+        return jax.tree.map(
+            lambda c: jax.lax.with_sharding_constraint(c, sh), cache)
 
     # -------------------------------------------------------------- #
     def _build_generate(self, prompt_len: int, max_new: int, temperature: float,
@@ -63,7 +104,8 @@ class InferenceEngine:
 
         def gen(params, prompts, prompt_lens, rng):
             B = prompts.shape[0]
-            cache = T.init_kv_cache(cfg, B, prompt_len + max_new)
+            cache = self._cache_constraint(
+                T.init_kv_cache(cfg, B, prompt_len + max_new))
             zero = jnp.zeros((B,), jnp.int32)
             logits, cache = T.forward_decode(params, prompts, cache, zero, cfg)
             last = jnp.take_along_axis(
@@ -109,9 +151,13 @@ class InferenceEngine:
         if key not in self._compiled:
             self._compiled[key] = self._build_generate(
                 P, max_new_tokens, temperature, top_k, top_p, eos_token_id)
-        toks = np.asarray(jax.device_get(self._compiled[key](
-            self.params, jnp.asarray(batch), jnp.asarray(lens),
-            jax.random.PRNGKey(seed))))
+        import contextlib
+
+        ctx = self.mesh if self.mesh is not None else contextlib.nullcontext()
+        with ctx:
+            toks = np.asarray(jax.device_get(self._compiled[key](
+                self.params, jnp.asarray(batch), jnp.asarray(lens),
+                jax.random.PRNGKey(seed))))
 
         out: List[List[int]] = []
         for row in toks:

@@ -28,6 +28,7 @@ class ModelSpec:
     name: str = "model"
     num_params: Optional[int] = None
     seq_len: Optional[int] = None  # nominal sequence length (profiling etc.)
+    config: Any = None             # underlying model config (zoo: TransformerConfig)
 
 
 def _tokens_of(batch: Batch) -> jax.Array:
@@ -147,4 +148,5 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         name=name,
         num_params=cfg.num_params(),
         seq_len=cfg.max_seq_len,
+        config=cfg,
     )

@@ -1,0 +1,210 @@
+"""Import HuggingFace transformer weights into the model zoo.
+
+Role: the reference consumes HF models directly (AutoTP
+``module_inject/auto_tp.py``, checkpoint loading ``inference/engine.py:303``,
+FastGen's per-arch implementations ``inference/v2/model_implementations``).
+This framework is torch-free at runtime, so interop happens at the weight
+level: convert an HF state dict (torch CPU tensors) into the zoo's
+layer-stacked param pytree once, then everything — ZeRO, TP, inference —
+works on it.
+
+Supported architectures: gpt2, llama (mistral shares the schema), mixtral
+(MoE). Conventions verified by logit-matching tests against ``transformers``:
+* HF ``nn.Linear`` weights are [out, in] → transposed; GPT-2's ``Conv1D`` is
+  already [in, out] → copied as-is.
+* Llama RoPE uses the rotate-half (non-interleaved) convention — identical to
+  ``transformer.apply_rope``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+PyTree = Any
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, L: int, transpose: bool = False
+           ) -> np.ndarray:
+    mats = [_np(sd[fmt.format(i)]) for i in range(L)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+# --------------------------------------------------------------------------- #
+# GPT-2
+# --------------------------------------------------------------------------- #
+
+def config_from_gpt2(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_seq_len=hf_config.n_positions,
+        pos_emb="learned", norm="layernorm", activation="gelu",
+        use_bias=True, tie_embeddings=True,
+        norm_eps=hf_config.layer_norm_epsilon, dtype="float32")
+
+
+def params_from_gpt2(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, H = cfg.num_layers, cfg.hidden_size
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    # Conv1D c_attn: [H, 3H] (in, out) — split into q/k/v without transposing
+    c_attn = _stack(sd, pre + "h.{}.attn.c_attn.weight", L)       # [L, H, 3H]
+    b_attn = _stack(sd, pre + "h.{}.attn.c_attn.bias", L)         # [L, 3H]
+    blocks = {
+        "ln1": {"scale": _stack(sd, pre + "h.{}.ln_1.weight", L),
+                "bias": _stack(sd, pre + "h.{}.ln_1.bias", L)},
+        "ln2": {"scale": _stack(sd, pre + "h.{}.ln_2.weight", L),
+                "bias": _stack(sd, pre + "h.{}.ln_2.bias", L)},
+        "wq": c_attn[:, :, :H], "wk": c_attn[:, :, H:2 * H],
+        "wv": c_attn[:, :, 2 * H:],
+        "bq": b_attn[:, :H], "bk": b_attn[:, H:2 * H], "bv": b_attn[:, 2 * H:],
+        "wo": _stack(sd, pre + "h.{}.attn.c_proj.weight", L),
+        "bo": _stack(sd, pre + "h.{}.attn.c_proj.bias", L),
+        "w_up": _stack(sd, pre + "h.{}.mlp.c_fc.weight", L),
+        "b_up": _stack(sd, pre + "h.{}.mlp.c_fc.bias", L),
+        "w_down": _stack(sd, pre + "h.{}.mlp.c_proj.weight", L),
+        "b_down": _stack(sd, pre + "h.{}.mlp.c_proj.bias", L),
+    }
+    return {
+        "tok_emb": _np(sd[pre + "wte.weight"]),
+        "pos_emb": _np(sd[pre + "wpe.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "ln_f.weight"]),
+                       "bias": _np(sd[pre + "ln_f.bias"])},
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Llama / Mistral
+# --------------------------------------------------------------------------- #
+
+def config_from_llama(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="rmsnorm", activation="swiglu",
+        use_bias=False,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=hf_config.rms_norm_eps, dtype="float32")
+
+
+def params_from_llama(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
+        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+        "w_gate": _stack(sd, lyr + "mlp.gate_proj.weight", L, transpose=True),
+        "w_up": _stack(sd, lyr + "mlp.up_proj.weight", L, transpose=True),
+        "w_down": _stack(sd, lyr + "mlp.down_proj.weight", L, transpose=True),
+    }
+    params = {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# Mixtral (Llama schema + MoE FFN)
+# --------------------------------------------------------------------------- #
+
+def config_from_mixtral(hf_config) -> TransformerConfig:
+    cfg = config_from_llama(hf_config)
+    return dataclasses.replace(
+        cfg,
+        n_experts=hf_config.num_local_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.02)))
+
+
+def params_from_mixtral(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, E = cfg.num_layers, cfg.n_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    moe = lyr + "block_sparse_moe."
+
+    def experts(wname):  # HF w1=gate, w2=down, w3=up; nn.Linear [out,in]
+        return np.stack([
+            np.stack([_np(sd[moe.format(i) + f"experts.{e}.{wname}.weight"]).T
+                      for e in range(E)])
+            for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
+        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+        "gate_w": _stack(sd, moe + "gate.weight", L, transpose=True),
+        "w_gate": experts("w1"),
+        "w_down": experts("w2"),
+        "w_up": experts("w3"),
+    }
+    params = {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# front door
+# --------------------------------------------------------------------------- #
+
+_ARCH_TABLE = {
+    "gpt2": (config_from_gpt2, params_from_gpt2),
+    "llama": (config_from_llama, params_from_llama),
+    "mistral": (config_from_llama, params_from_llama),
+    "mixtral": (config_from_mixtral, params_from_mixtral),
+}
+
+
+def import_hf_model(model, arch: Optional[str] = None
+                    ) -> Tuple[TransformerConfig, PyTree]:
+    """Convert a ``transformers`` model (or (state_dict, config) pair) into
+    (TransformerConfig, zoo params)."""
+    if isinstance(model, tuple):
+        sd, hf_config = model
+    else:
+        sd, hf_config = model.state_dict(), model.config
+    arch = arch or getattr(hf_config, "model_type", None)
+    if arch not in _ARCH_TABLE:
+        raise ValueError(
+            f"unsupported HF architecture {arch!r}; "
+            f"supported: {sorted(_ARCH_TABLE)}")
+    cfg_fn, params_fn = _ARCH_TABLE[arch]
+    cfg = cfg_fn(hf_config)
+    return cfg, params_fn(sd, cfg)

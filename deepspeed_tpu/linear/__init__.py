@@ -1,0 +1,9 @@
+"""Memory-efficient linear/LoRA (reference ``deepspeed/linear/``)."""
+from deepspeed_tpu.linear.lora import (
+    LoRAConfig,
+    init_lora_params,
+    lora_causal_lm_spec,
+    merge_lora,
+)
+
+__all__ = ["LoRAConfig", "init_lora_params", "lora_causal_lm_spec", "merge_lora"]

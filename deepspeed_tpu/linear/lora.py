@@ -1,0 +1,120 @@
+"""LoRA — low-rank adapters over the model zoo.
+
+Parity: reference ``deepspeed/linear/optimized_linear.py:18``
+(``OptimizedLinear`` + ``LoRAConfig``: memory-efficient sharded LoRA linear)
+and the hybrid engine's fuse/unfuse. Functional design: a ModelSpec transform
+adds per-layer A/B factors for the chosen projections; the forward merges
+``W_eff = W + (alpha/r)·A@B`` right before the base forward (the "fused"
+execution mode — one matmul per projection, no extra GEMM at runtime), and a
+``trainable_fn`` mask freezes the base so optimizer state exists only for the
+adapters (see ``ops/optimizer.py MaskedOptimizer``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.api import ModelSpec, causal_lm_spec
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.utils.tree import mask_like
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference ``deepspeed.linear.LoRAConfig`` analog."""
+
+    lora_r: int = 8
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # base stays ZeRO-sharded via the policy
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+
+def _proj_dims(cfg: T.TransformerConfig, name: str) -> Tuple[int, int]:
+    h = cfg.hidden_size
+    qdim = cfg.num_heads * cfg.head_dim
+    kvdim = cfg.kv_heads * cfg.head_dim
+    f = cfg.ffn_size
+    table = {
+        "wq": (h, qdim), "wk": (h, kvdim), "wv": (h, kvdim), "wo": (qdim, h),
+        "w_up": (h, f), "w_down": (f, h), "w_gate": (h, f),
+    }
+    return table[name]
+
+
+def init_lora_params(cfg: T.TransformerConfig, lora: LoRAConfig,
+                     rng: jax.Array) -> PyTree:
+    """A ~ N(0, 1/r), B = 0 (standard LoRA init → identity at step 0)."""
+    L, r = cfg.num_layers, lora.lora_r
+    keys = jax.random.split(rng, len(lora.targets))
+    out = {}
+    for key, name in zip(keys, lora.targets):
+        d_in, d_out = _proj_dims(cfg, name)
+        out[f"{name}_a"] = jax.random.normal(key, (L, d_in, r), jnp.float32) / r
+        out[f"{name}_b"] = jnp.zeros((L, r, d_out), jnp.float32)
+    return out
+
+
+def merge_lora(base_blocks: Dict[str, jax.Array], lora_blocks: Dict[str, jax.Array],
+               lora: LoRAConfig) -> Dict[str, jax.Array]:
+    """W_eff = W + (alpha/r)·A@B per layer (the fused-LoRA execution mode)."""
+    scaling = lora.lora_alpha / lora.lora_r
+    merged = dict(base_blocks)
+    for name in lora.targets:
+        delta = jnp.einsum("lir,lro->lio", lora_blocks[f"{name}_a"],
+                           lora_blocks[f"{name}_b"]) * scaling
+        merged[name] = base_blocks[name] + delta
+    return merged
+
+
+def lora_causal_lm_spec(cfg, lora: Optional[LoRAConfig] = None,
+                        attention: Optional[str] = None,
+                        seed: int = 0, **overrides) -> ModelSpec:
+    """causal_lm_spec with frozen base + trainable LoRA adapters.
+
+    Params: {"base": zoo tree, "lora": {"blocks": {wq_a, wq_b, ...}}}."""
+    lora = lora or LoRAConfig()
+    base_spec = causal_lm_spec(cfg, attention=attention, **overrides)
+    tcfg: T.TransformerConfig = base_spec.config
+    for t in lora.targets:
+        if tcfg.n_experts > 0 and t in ("w_up", "w_down", "w_gate"):
+            raise ValueError("LoRA on MoE expert FFNs is not supported")
+
+    def init_fn(rng):
+        r1, r2 = jax.random.split(rng)
+        return {"base": base_spec.init_fn(r1),
+                "lora": {"blocks": init_lora_params(tcfg, lora, r2)}}
+
+    def merged(params):
+        base = dict(params["base"])
+        base["blocks"] = merge_lora(params["base"]["blocks"],
+                                    params["lora"]["blocks"], lora)
+        return base
+
+    def loss_fn(params, batch):
+        return base_spec.loss_fn(merged(params), batch)
+
+    def apply_fn(params, batch):
+        return base_spec.apply_fn(merged(params), batch)
+
+    def axes_fn():
+        lyr = ("layers",)
+        lora_axes = {}
+        for name in lora.targets:
+            lora_axes[f"{name}_a"] = lyr + ("embed", None)
+            lora_axes[f"{name}_b"] = lyr + (None, None)
+        return {"base": base_spec.axes_fn(), "lora": {"blocks": lora_axes}}
+
+    def trainable_fn():
+        keys = [f"{name}_{ab}" for name in lora.targets for ab in "ab"]
+        return {"base": mask_like(base_spec.axes_fn(), False),
+                "lora": {"blocks": {k: True for k in keys}}}
+
+    return dataclasses.replace(
+        base_spec, init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
+        axes_fn=axes_fn, trainable_fn=trainable_fn,
+        name=f"{base_spec.name}-lora{lora.lora_r}")

@@ -29,6 +29,7 @@ class ModelSpec:
     num_params: Optional[int] = None
     seq_len: Optional[int] = None  # nominal sequence length (profiling etc.)
     config: Any = None             # underlying model config (zoo: TransformerConfig)
+    trainable_fn: Optional[Callable[[], PyTree]] = None  # bool tree; None = all trainable
 
 
 def _tokens_of(batch: Batch) -> jax.Array:

@@ -292,6 +292,38 @@ class Muon(TPUOptimizer):
         return new_params, {"exp_avg": new_m, "exp_avg_sq": new_v, "step": step}
 
 
+@dataclasses.dataclass
+class MaskedOptimizer(TPUOptimizer):
+    """Wraps an optimizer to update only masked-trainable leaves.
+
+    The LoRA/frozen-params path (reference ``linear/optimized_linear.py``'s
+    LoRA param groups; engine frozen-param checkpoint handling): optimizer
+    state exists ONLY for trainable leaves — frozen params carry no moments
+    and pass through update() unchanged."""
+
+    inner: Optional[TPUOptimizer] = None
+    mask: Any = None  # pytree of bools mirroring params
+
+    def __post_init__(self):
+        if self.inner is not None:
+            self.lr = self.inner.lr
+            self.weight_decay = self.inner.weight_decay
+            self.moment_names = self.inner.moment_names
+
+    def init(self, params):
+        from deepspeed_tpu.utils.tree import prune_tree
+
+        return self.inner.init(prune_tree(params, self.mask))
+
+    def update(self, grads, state, params, lr=None):
+        from deepspeed_tpu.utils.tree import merge_tree, prune_tree
+
+        sub_p = prune_tree(params, self.mask)
+        sub_g = prune_tree(grads, self.mask)
+        new_sub_p, new_state = self.inner.update(sub_g, state, sub_p, lr=lr)
+        return merge_tree(params, new_sub_p, self.mask), new_state
+
+
 _OPTIMIZERS = {
     "adam": FusedAdam,
     "adamw": FusedAdam,

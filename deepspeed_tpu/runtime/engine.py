@@ -115,6 +115,14 @@ class DeepSpeedTPUEngine:
             if opt_cfg is None:
                 raise ValueError("config must define an optimizer (or pass one in)")
             optimizer = get_optimizer(opt_cfg.type, opt_cfg.params)
+        # frozen params (LoRA etc.): optimizer state only for trainable leaves
+        self._trainable_mask = None
+        if model.trainable_fn is not None:
+            from deepspeed_tpu.ops.optimizer import MaskedOptimizer
+
+            self._trainable_mask = model.trainable_fn()
+            optimizer = MaskedOptimizer(inner=optimizer,
+                                        mask=self._trainable_mask)
         self.optimizer = optimizer
         if lr_scheduler is None and self.config.scheduler and self.config.scheduler.type:
             lr_scheduler = get_lr_schedule(
@@ -174,7 +182,12 @@ class DeepSpeedTPUEngine:
     def _state_shardings(self) -> Dict[str, Any]:
         to_sh = self.policy.to_shardings
         master_sh = to_sh(self.master_spec)
-        opt_sh = {name: master_sh for name in self.optimizer.moment_names}
+        moment_sh = master_sh
+        if self._trainable_mask is not None:
+            from deepspeed_tpu.utils.tree import prune_tree
+
+            moment_sh = prune_tree(master_sh, self._trainable_mask)
+        opt_sh = {name: moment_sh for name in self.optimizer.moment_names}
         opt_sh["step"] = NamedSharding(self.mesh, P())
         sh = {"step": NamedSharding(self.mesh, P()), "master": master_sh, "opt": opt_sh}
         if self.fp16_enabled:
@@ -247,7 +260,12 @@ class DeepSpeedTPUEngine:
         """Unscale, clip, (maybe skip on overflow), optimizer update."""
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) / grad_scale, grads)
         lr = self._lr_at(state["step"])
-        norm = global_grad_norm(grads)
+        if self._trainable_mask is not None:
+            from deepspeed_tpu.utils.tree import prune_tree
+
+            norm = global_grad_norm(prune_tree(grads, self._trainable_mask))
+        else:
+            norm = global_grad_norm(grads)
         if self.config.gradient_clipping > 0:
             grads = clip_by_global_norm(grads, self.config.gradient_clipping, norm)
 

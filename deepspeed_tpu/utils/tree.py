@@ -1,0 +1,40 @@
+"""Pytree mask utilities: prune/merge for frozen-parameter training.
+
+Used by the LoRA path (``deepspeed_tpu/linear``): the optimizer sees only the
+trainable subtree, so optimizer state (the ZeRO-dominant memory term) scales
+with adapter size, not model size — the reference achieves the same via
+LoRA-aware optimizer param groups (``linear/optimized_linear.py``).
+Dict-structured trees only (the model-zoo convention).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+PyTree = Any
+
+
+def prune_tree(tree: PyTree, mask: PyTree) -> PyTree:
+    """Keep only leaves whose mask is True; drop empty subtrees."""
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            sub = prune_tree(v, mask[k])
+            if sub is not None and (not isinstance(sub, dict) or sub):
+                out[k] = sub
+        return out
+    return tree if mask else None
+
+
+def merge_tree(full: PyTree, sub: PyTree, mask: PyTree) -> PyTree:
+    """Overlay ``sub`` (a pruned tree) onto ``full`` where mask is True."""
+    if isinstance(full, dict):
+        return {k: (merge_tree(v, sub[k], mask[k])
+                    if isinstance(sub, dict) and k in sub else v)
+                for k, v in full.items()}
+    return sub if mask else full
+
+
+def mask_like(tree: PyTree, value: bool) -> PyTree:
+    if isinstance(tree, dict):
+        return {k: mask_like(v, value) for k, v in tree.items()}
+    return value

@@ -1,0 +1,83 @@
+"""Flax adapter — run any ``flax.linen`` module under the engine.
+
+Role: the reference wraps arbitrary ``nn.Module``s (HF models, Megatron
+models) in ``deepspeed.initialize``; the TPU framework's equivalent "bring
+your own model" path accepts a flax module and adapts it to the
+:class:`~deepspeed_tpu.models.api.ModelSpec` contract. Logical sharding axes
+default to unannotated (ZeRO still shards each leaf's largest divisible dim —
+``parallel/partitioning.py _add_zero_axis``); pass ``axes`` to enable TP on
+specific parameters.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.api import ModelSpec
+
+PyTree = Any
+
+
+def _default_axes(params: PyTree) -> PyTree:
+    return jax.tree.map(lambda p: (None,) * jnp.ndim(p), params)
+
+
+def flax_model_spec(module, example_batch: Dict[str, jax.Array],
+                    loss_fn: Optional[Callable] = None,
+                    axes: Optional[PyTree] = None,
+                    name: Optional[str] = None,
+                    batch_key: str = "tokens") -> ModelSpec:
+    """Adapt a flax module to a ModelSpec.
+
+    * ``module(tokens) -> logits`` (causal-LM convention); for other tasks
+      pass a custom ``loss_fn(logits_or_outputs, batch) -> scalar``.
+    * ``example_batch`` supplies init-time shapes/dtypes (shapes only matter
+      up to the batch dim).
+    """
+    example_in = example_batch[batch_key]
+
+    def init_fn(rng):
+        variables = module.init(rng, example_in)
+        params = variables.get("params", variables)
+        # fp32 master copies regardless of module dtype
+        return jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+
+    def apply_fn(params, batch):
+        x = batch[batch_key] if isinstance(batch, dict) else batch
+        return module.apply({"params": params}, x)
+
+    if loss_fn is None:
+        from deepspeed_tpu.models.transformer import causal_lm_loss
+
+        def default_loss(params, batch):
+            tokens = batch[batch_key] if isinstance(batch, dict) else batch
+            logits = apply_fn(params, batch)
+            mask = batch.get("loss_mask") if isinstance(batch, dict) else None
+            return causal_lm_loss(logits, tokens, mask)
+
+        spec_loss = default_loss
+    else:
+        def spec_loss(params, batch):
+            return loss_fn(apply_fn(params, batch), batch)
+
+    if axes is None:
+        shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        axes_tree = _default_axes(shapes)
+    else:
+        axes_tree = axes
+
+    n_params = sum(
+        int(jnp.prod(jnp.asarray(l.shape)))
+        for l in jax.tree.leaves(jax.eval_shape(init_fn, jax.random.PRNGKey(0))))
+
+    return ModelSpec(
+        init_fn=init_fn,
+        loss_fn=spec_loss,
+        apply_fn=apply_fn,
+        axes_fn=lambda: axes_tree,
+        name=name or type(module).__name__,
+        num_params=n_params,
+        seq_len=int(example_in.shape[1]) if example_in.ndim > 1 else None,
+    )

@@ -1,0 +1,80 @@
+"""Knowledge distillation losses + layer reduction.
+
+Parity: reference ``deepspeed/compression/`` layer-reduction (student keeps a
+subset of teacher layers, ``compression/helper.py`` student-initialization from
+teacher) and the KD objectives used by its compression examples (soft-logit KL
+with temperature + hidden-state MSE).
+
+TPU design: pure loss functions composable into any model_spec's ``loss_fn``
+(teacher forward under ``lax.stop_gradient``), plus a parameter-tree surgery
+helper that builds a shallower student from a teacher whose per-layer params are
+stacked on the leading 'layers' scan dim — layer reduction is just an index
+gather on that dim.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def soft_kl_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+                 temperature: float = 1.0) -> jax.Array:
+    """KL(teacher ‖ student) on temperature-softened distributions, scaled by
+    T^2 (Hinton et al.) — the reference examples' kd loss."""
+    t = temperature
+    sl = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    tl = jax.nn.log_softmax(
+        jax.lax.stop_gradient(teacher_logits).astype(jnp.float32) / t, axis=-1)
+    tp = jnp.exp(tl)
+    kl = jnp.sum(tp * (tl - sl), axis=-1)
+    return jnp.mean(kl) * (t * t)
+
+
+def hidden_mse_loss(student_hidden: jax.Array, teacher_hidden: jax.Array,
+                    proj: Optional[jax.Array] = None) -> jax.Array:
+    """Hidden-state matching; ``proj`` maps student width → teacher width when
+    the student is thinner."""
+    s = student_hidden.astype(jnp.float32)
+    if proj is not None:
+        s = s @ proj.astype(jnp.float32)
+    t = jax.lax.stop_gradient(teacher_hidden).astype(jnp.float32)
+    return jnp.mean((s - t) ** 2)
+
+
+def distillation_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+                      hard_loss: jax.Array, alpha: float = 0.5,
+                      temperature: float = 2.0) -> jax.Array:
+    """alpha * soft KD + (1-alpha) * task loss — the standard KD mix."""
+    soft = soft_kl_loss(student_logits, teacher_logits, temperature)
+    return alpha * soft + (1.0 - alpha) * hard_loss
+
+
+def reduce_layers(params: PyTree, keep_layers: Sequence[int],
+                  num_layers: Optional[int] = None,
+                  layer_dim_leaves: Optional[PyTree] = None) -> PyTree:
+    """Layer reduction on a scan-stacked param tree.
+
+    Leaves whose leading dim is the layer-stack get gathered to ``keep_layers``.
+    Stacked leaves are identified either by ``layer_dim_leaves`` (a bool tree,
+    e.g. derived from the model's axes tree checking for a leading 'layers'
+    axis) or by ``num_layers`` (leading dim == num_layers). One of the two must
+    be given — dim-size guessing silently corrupts embeddings whose leading
+    dim happens to dominate.
+    """
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+
+    if layer_dim_leaves is None:
+        if num_layers is None:
+            raise ValueError("pass num_layers or layer_dim_leaves")
+        layer_dim_leaves = jax.tree.map(
+            lambda l: hasattr(l, "shape") and l.ndim > 1
+            and l.shape[0] == num_layers, params)
+
+    def one(leaf, is_stacked):
+        return jnp.take(leaf, idx, axis=0) if is_stacked else leaf
+
+    return jax.tree.map(one, params, layer_dim_leaves)

@@ -10,6 +10,7 @@ specific parameters.
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -62,15 +63,9 @@ def flax_model_spec(module, example_batch: Dict[str, jax.Array],
         def spec_loss(params, batch):
             return loss_fn(apply_fn(params, batch), batch)
 
-    if axes is None:
-        shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-        axes_tree = _default_axes(shapes)
-    else:
-        axes_tree = axes
-
-    n_params = sum(
-        int(jnp.prod(jnp.asarray(l.shape)))
-        for l in jax.tree.leaves(jax.eval_shape(init_fn, jax.random.PRNGKey(0))))
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    axes_tree = _default_axes(shapes) if axes is None else axes
+    n_params = sum(math.prod(l.shape) for l in jax.tree.leaves(shapes))
 
     return ModelSpec(
         init_fn=init_fn,

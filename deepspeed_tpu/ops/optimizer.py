@@ -337,21 +337,22 @@ _OPTIMIZERS = {
     "muon": Muon,
 }
 
-# 1-bit optimizers compress the *communication*; on TPU grads ride ICI and the
-# quantized-collective path (ops/pallas/quantization) plays that role. Map the
-# optimizer math to its base.
-_ONEBIT_ALIASES = {
-    "onebitadam": "adam", "zerooneadam": "adam", "onebitlamb": "lamb",
-}
+
+def _register_onebit():
+    # deferred import: onebit.py imports from this module
+    from deepspeed_tpu.ops.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+    _OPTIMIZERS.update({
+        "onebitadam": OnebitAdam,
+        "zerooneadam": ZeroOneAdam,
+        "onebitlamb": OnebitLamb,
+    })
 
 
 def get_optimizer(name: str, params: Dict[str, Any]) -> TPUOptimizer:
     key = name.lower().replace("_", "")
-    if key in _ONEBIT_ALIASES:
-        logger.warning(
-            f"optimizer {name!r}: 1-bit communication compression is handled by the "
-            "quantized-collective path on TPU; using base optimizer math")
-        key = _ONEBIT_ALIASES[key]
+    if key.startswith(("onebit", "zeroone")) and key not in _OPTIMIZERS:
+        _register_onebit()
     if key not in _OPTIMIZERS:
         raise ValueError(f"unknown optimizer {name!r}; supported: {sorted(_OPTIMIZERS)}")
     cls = _OPTIMIZERS[key]

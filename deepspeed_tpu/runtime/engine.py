@@ -183,11 +183,28 @@ class DeepSpeedTPUEngine:
         to_sh = self.policy.to_shardings
         master_sh = to_sh(self.master_spec)
         moment_sh = master_sh
+        moment_shapes = self._shapes
         if self._trainable_mask is not None:
             from deepspeed_tpu.utils.tree import prune_tree
 
             moment_sh = prune_tree(master_sh, self._trainable_mask)
-        opt_sh = {name: moment_sh for name in self.optimizer.moment_names}
+            moment_shapes = prune_tree(self._shapes, self._trainable_mask)
+        # optimizer state leaves that mirror the param shape inherit its
+        # sharding; auxiliary leaves of other shapes (e.g. OnebitLamb's
+        # per-layer frozen trust scalars) are replicated.
+        rep = NamedSharding(self.mesh, P())
+        opt_shapes = jax.eval_shape(self.optimizer.init, self._shapes)
+        moment_structure = jax.tree.structure(moment_shapes)
+        opt_sh = {}
+        for name in self.optimizer.moment_names:
+            sub = opt_shapes[name]
+            if jax.tree.structure(sub) == moment_structure:
+                opt_sh[name] = jax.tree.map(
+                    lambda os, sh, ms: sh if os.shape == ms.shape else rep,
+                    sub, moment_sh, moment_shapes)
+            else:
+                # schedule scalars etc. that don't mirror the param tree
+                opt_sh[name] = jax.tree.map(lambda _: rep, sub)
         opt_sh["step"] = NamedSharding(self.mesh, P())
         sh = {"step": NamedSharding(self.mesh, P()), "master": master_sh, "opt": opt_sh}
         if self.fp16_enabled:

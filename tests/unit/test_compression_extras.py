@@ -1,0 +1,132 @@
+"""Pruning + distillation tests (reference ``tests/unit/compression/``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression.distillation import (
+    distillation_loss,
+    hidden_mse_loss,
+    reduce_layers,
+    soft_kl_loss,
+)
+from deepspeed_tpu.compression.pruning import (
+    PruningScheduler,
+    PruningSpec,
+    apply_masks,
+    compute_masks,
+    head_mask,
+    row_mask,
+    sparse_mask,
+    sparsity_report,
+)
+
+
+class TestMasks:
+    def test_sparse_mask_ratio(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+        m = sparse_mask(w, 0.75)
+        assert abs(float(m.mean()) - 0.25) < 0.02
+
+    def test_sparse_mask_keeps_largest(self):
+        w = jnp.array([[0.01, 5.0], [-3.0, 0.02]])
+        m = sparse_mask(w, 0.5)
+        np.testing.assert_array_equal(np.asarray(m), [[0, 1], [1, 0]])
+
+    def test_row_mask_structured(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+        m = row_mask(w, 0.5, axis=1)  # prune output cols
+        col_on = np.asarray(m).mean(axis=0)
+        assert set(np.unique(col_on)) <= {0.0, 1.0}
+        assert abs(col_on.mean() - 0.5) < 0.1
+
+    def test_head_mask_whole_heads(self):
+        num_heads, head_dim = 4, 8
+        w = jax.random.normal(jax.random.PRNGKey(2), (16, num_heads * head_dim))
+        m = head_mask(w, 0.5, num_heads=num_heads)
+        per_head = np.asarray(m).reshape(16, num_heads, head_dim)
+        # each head fully kept or fully dropped
+        for h in range(num_heads):
+            vals = np.unique(per_head[:, h])
+            assert len(vals) == 1
+        assert per_head[0, :, 0].sum() == 2
+
+    def test_zero_ratio_identity(self):
+        w = jax.random.normal(jax.random.PRNGKey(3), (8, 8))
+        assert float(sparse_mask(w, 0.0).min()) == 1.0
+
+
+class TestScheduleAndTree:
+    def test_scheduler_ramp(self):
+        s = PruningScheduler(target_ratio=0.8, schedule_offset=100,
+                             schedule_offset_end=200)
+        assert s.ratio_at(0) == 0.0
+        assert s.ratio_at(150) == pytest.approx(0.4)
+        assert s.ratio_at(500) == pytest.approx(0.8)
+
+    def test_compute_and_apply(self):
+        params = {
+            "attn": {"wq": jax.random.normal(jax.random.PRNGKey(0), (32, 32))},
+            "mlp": {"w1": jax.random.normal(jax.random.PRNGKey(1), (32, 64))},
+            "norm": jnp.ones((32,)),
+        }
+        specs = (PruningSpec(pattern=r"mlp", method="sparse", ratio=0.5),)
+        masks = compute_masks(params, specs, step=0)
+        pruned = apply_masks(params, masks)
+        # mlp pruned, attn + norm untouched
+        assert float((np.asarray(pruned["mlp"]["w1"]) == 0).mean()) > 0.45
+        np.testing.assert_array_equal(np.asarray(pruned["attn"]["wq"]),
+                                      np.asarray(params["attn"]["wq"]))
+        np.testing.assert_array_equal(np.asarray(pruned["norm"]),
+                                      np.asarray(params["norm"]))
+        rep = sparsity_report(masks)
+        assert any("mlp" in k for k in rep)
+
+    def test_apply_inside_jit(self):
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+        masks = compute_masks(params, (PruningSpec(pattern="w", ratio=0.5),))
+        out = jax.jit(apply_masks)(params, masks)
+        assert float((np.asarray(out["w"]) == 0).mean()) > 0.4
+
+
+class TestDistillation:
+    def test_kl_zero_when_equal(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 10))
+        assert float(soft_kl_loss(logits, logits, temperature=2.0)) < 1e-5
+
+    def test_kl_positive_and_grads_flow(self):
+        s = jax.random.normal(jax.random.PRNGKey(1), (4, 10))
+        t = jax.random.normal(jax.random.PRNGKey(2), (4, 10))
+        loss, g = jax.value_and_grad(lambda x: soft_kl_loss(x, t))(s)
+        assert float(loss) > 0
+        assert np.abs(np.asarray(g)).max() > 0
+
+    def test_no_grad_through_teacher(self):
+        s = jax.random.normal(jax.random.PRNGKey(1), (4, 10))
+        t = jax.random.normal(jax.random.PRNGKey(2), (4, 10))
+        g = jax.grad(lambda tt: soft_kl_loss(s, tt))(t)
+        assert float(np.abs(np.asarray(g)).max()) == 0.0
+
+    def test_hidden_mse_with_projection(self):
+        s = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        t = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+        proj = jax.random.normal(jax.random.PRNGKey(2), (16, 32))
+        assert float(hidden_mse_loss(s, t, proj)) > 0
+
+    def test_distillation_mix(self):
+        s = jax.random.normal(jax.random.PRNGKey(0), (4, 10))
+        t = s + 0.01
+        hard = jnp.float32(2.0)
+        mixed = distillation_loss(s, t, hard, alpha=0.5, temperature=1.0)
+        assert 0 < float(mixed) < 2.0  # soft ≈ 0 pulls below hard loss
+
+    def test_reduce_layers(self):
+        params = {
+            "blocks": {"w": jnp.arange(6 * 4).reshape(6, 4).astype(jnp.float32)},
+            "emb": jnp.ones((10, 4)),
+        }
+        student = reduce_layers(params, keep_layers=[0, 2, 4], num_layers=6)
+        assert student["blocks"]["w"].shape == (3, 4)
+        np.testing.assert_array_equal(np.asarray(student["blocks"]["w"][1]),
+                                      np.asarray(params["blocks"]["w"][2]))
+        assert student["emb"].shape == (10, 4)

@@ -1,0 +1,82 @@
+"""FP quantizer tests (reference ``tests/unit/ops/fp_quantizer/``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.fp_quantizer import (
+    FPQuantConfig,
+    FPQuantizer,
+    fp8_linear,
+    fp8_matmul,
+    fp8_quantize_tensorwise,
+    quantize_weight_fp8_columnwise,
+)
+
+
+class TestFPQuantizer:
+    @pytest.mark.parametrize("q_bits,rtol", [(6, 0.15), (8, 0.08), (12, 0.01)])
+    def test_roundtrip_error_bounded(self, q_bits, rtol):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4096,)) * 3.0
+        quant = FPQuantizer(FPQuantConfig(q_bits=q_bits, group_size=256))
+        y = quant.roundtrip(x)
+        rel = np.abs(np.asarray(y) - np.asarray(x)) / (np.abs(np.asarray(x)) + 1e-3)
+        assert rel.mean() < rtol
+
+    def test_group_scales_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (1000,))
+        quant = FPQuantizer(q_bits=8, group_size=128)
+        q, s = quant.quantize(x)
+        assert s.shape == (8,)  # ceil(1000/128)
+        y = quant.dequantize(q, s, shape=(1000,))
+        assert y.shape == (1000,)
+
+    def test_fp6_values_on_grid(self):
+        # every quantized value/scale must be exactly representable in e3m2
+        x = jax.random.normal(jax.random.PRNGKey(2), (512,))
+        quant = FPQuantizer(q_bits=6, group_size=512)
+        q, s = quant.quantize(x)
+        vals = np.unique(np.abs(np.asarray(q, np.float32)))
+        vals = vals[vals > 0]
+        # e3m2: mantissa in {1, 1.25, 1.5, 1.75} * 2^e  (e in [-2, 4])
+        mant = vals / (2.0 ** np.floor(np.log2(vals)))
+        ok = np.isin(np.round(mant * 4), [4, 5, 6, 7])
+        assert ok.all()
+
+    def test_preserves_dtype_and_shape(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (17, 33)).astype(jnp.bfloat16)
+        y = FPQuantizer(q_bits=8).roundtrip(x)
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+
+class TestFP8Matmul:
+    def test_matmul_close_to_fp32(self):
+        a = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        b = jax.random.normal(jax.random.PRNGKey(1), (128, 32))
+        got = fp8_matmul(a, b)
+        want = a @ b
+        err = np.abs(np.asarray(got, np.float32) - np.asarray(want))
+        scale = np.abs(np.asarray(want)).mean()
+        assert err.mean() / scale < 0.1
+
+    def test_quantize_tensorwise_roundtrip(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (256,)) * 10
+        q, inv = fp8_quantize_tensorwise(x)
+        y = np.asarray(q, np.float32) * np.asarray(inv)
+        np.testing.assert_allclose(y, np.asarray(x), rtol=0.1, atol=0.05)
+
+    def test_fp8_linear_with_prequantized_weight(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (8, 64))
+        w = jax.random.normal(jax.random.PRNGKey(4), (64, 32))
+        bias = jax.random.normal(jax.random.PRNGKey(5), (32,))
+        wq, ws = quantize_weight_fp8_columnwise(w)
+        got = fp8_linear(x, wq, ws, bias=bias)
+        want = x @ w + bias
+        err = np.abs(np.asarray(got, np.float32) - np.asarray(want))
+        assert err.mean() / (np.abs(np.asarray(want)).mean()) < 0.1
+
+    def test_jittable(self):
+        a = jax.random.normal(jax.random.PRNGKey(6), (32, 32))
+        b = jax.random.normal(jax.random.PRNGKey(7), (32, 32))
+        out = jax.jit(fp8_matmul)(a, b)
+        assert np.isfinite(np.asarray(out, np.float32)).all()

@@ -128,8 +128,10 @@ def test_muon_orthogonalizes():
 def test_factory():
     opt = get_optimizer("Adam", {"lr": 1e-4, "betas": [0.9, 0.95]})
     assert isinstance(opt, FusedAdam) and opt.lr == 1e-4
+    from deepspeed_tpu.ops.onebit import OnebitAdam
+
     opt = get_optimizer("OneBitAdam", {"lr": 1e-4})
-    assert isinstance(opt, FusedAdam)
+    assert isinstance(opt, OnebitAdam)
     with pytest.raises(ValueError):
         get_optimizer("nope", {})
 

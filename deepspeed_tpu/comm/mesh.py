@@ -24,29 +24,38 @@ from deepspeed_tpu.utils.logging import logger
 # Canonical axis names, outermost → innermost.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+ZSHARD_AXIS = "zshard"   # MiCS/hpZ replica-group subdivision of the DP width:
+                         # ZeRO states shard over 'zshard' (the subgroup, inner
+                         # on the ICI torus) and replicate over 'data' (the
+                         # replica groups) — reference zero/mics.py:63 MiCS_Init
+                         # partition groups / ZeRO++ hpZ (zero/config.py:309).
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
-DEFAULT_AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+DEFAULT_AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, ZSHARD_AXIS,
+                                       EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
 
 # Dense-parameter gradients are averaged over every axis that replicates dense
 # params: data, expert (experts-within-dp layout, reference groups.py:304) and seq
 # (Ulysses ranks share parameters, reference sequence/layer.py).
-DENSE_GRAD_REDUCE_AXES: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+DENSE_GRAD_REDUCE_AXES: Tuple[str, ...] = (DATA_AXIS, ZSHARD_AXIS, EXPERT_AXIS,
+                                           SEQ_AXIS)
 # Expert parameters are sharded over 'expert'; their grads reduce over the rest.
-EXPERT_GRAD_REDUCE_AXES: Tuple[str, ...] = (DATA_AXIS, SEQ_AXIS)
+EXPERT_GRAD_REDUCE_AXES: Tuple[str, ...] = (DATA_AXIS, ZSHARD_AXIS, SEQ_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     pipe: int = 1
     data: int = -1  # -1 = absorb all remaining devices
+    zshard: int = 1  # MiCS/hpZ partition size (1 = ZeRO shards over full 'data')
     expert: int = 1
     seq: int = 1
     tensor: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {PIPE_AXIS: self.pipe, DATA_AXIS: self.data, EXPERT_AXIS: self.expert,
+        sizes = {PIPE_AXIS: self.pipe, DATA_AXIS: self.data,
+                 ZSHARD_AXIS: self.zshard, EXPERT_AXIS: self.expert,
                  SEQ_AXIS: self.seq, TENSOR_AXIS: self.tensor}
         fill_axes = [a for a, s in sizes.items() if s == -1]
         fixed = int(np.prod([s for s in sizes.values() if s != -1]))
@@ -82,7 +91,8 @@ class MeshManager:
     @property
     def dp_world_size(self) -> int:
         # "data parallel" in the reference's sense: number of dense-param replicas.
-        return int(np.prod([self.axis_size(a) for a in (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)]))
+        return int(np.prod([self.axis_size(a) for a in
+                            (DATA_AXIS, ZSHARD_AXIS, EXPERT_AXIS, SEQ_AXIS)]))
 
     @property
     def tp_world_size(self) -> int:

@@ -35,6 +35,7 @@ from deepspeed_tpu.comm.mesh import (
     PIPE_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
+    ZSHARD_AXIS,
 )
 
 # Default logical→mesh rules (Megatron-style TP):
@@ -53,8 +54,11 @@ DEFAULT_TP_RULES: Dict[str, Any] = {
 }
 
 # ZeRO shards over every data-like axis so that stage-3 scales with the full DP
-# width (data × expert replicas of dense params).
-ZERO_SHARD_AXES: Tuple[str, ...] = (DATA_AXIS,)
+# width (data × expert replicas of dense params). With a MiCS/hpZ subgroup
+# ('zshard' axis > 1) ZeRO shards over the subgroup ONLY and replicates across
+# 'data' — gathers stay on the inner ICI links (reference zero/mics.py MiCS /
+# ZeRO++ hpZ secondary partition, zero/config.py:309).
+ZERO_SHARD_AXES: Tuple[str, ...] = (DATA_AXIS, ZSHARD_AXIS)
 
 
 AxesTree = Any  # pytree of tuples of logical axis names (str or None), mirroring params
@@ -100,6 +104,10 @@ class ShardingPolicy:
         # (reference PipelineModule layer partitioning, runtime/pipe/module.py:86)
         if self.mesh.shape.get(PIPE_AXIS, 1) > 1:
             self.tp_rules = dict(self.tp_rules, layers=PIPE_AXIS)
+        # MiCS mode: ZeRO shards within the 'zshard' subgroup, replicating the
+        # shards across 'data' replica groups
+        if self.mesh.shape.get(ZSHARD_AXIS, 1) > 1:
+            self.zero_axes = (ZSHARD_AXIS,)
 
     # --- spec trees -------------------------------------------------------- #
     def tp_spec(self, axes_tree: AxesTree) -> Any:
@@ -141,9 +149,9 @@ class ShardingPolicy:
             is_leaf=lambda x: isinstance(x, P))
 
     def batch_spec(self, ndim: int = 2, seq_dim: Optional[int] = 1) -> P:
-        """Global-batch sharding: batch dim over (data, expert), seq dim over 'seq'."""
+        """Global-batch sharding: batch over (data, zshard, expert), seq over 'seq'."""
         parts: list = [None] * ndim
-        batch_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+        batch_axes = tuple(a for a in (DATA_AXIS, ZSHARD_AXIS, EXPERT_AXIS)
                            if self.mesh.shape.get(a, 1) >= 1)
         parts[0] = batch_axes if len(batch_axes) > 1 else batch_axes[0]
         if seq_dim is not None and ndim > seq_dim and self.mesh.shape.get(SEQ_AXIS, 1) > 1:

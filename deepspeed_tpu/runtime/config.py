@@ -95,6 +95,12 @@ class ZeroConfig:
     stage3_gather_16bit_weights_on_model_save: bool = False
     # ZeRO++ knobs (hpZ / qwZ / qgZ — reference zero/config.py:309-330)
     zero_hpz_partition_size: int = 1
+    # MiCS replica-group sharding (reference zero/mics.py:63 MiCS_Init): shard
+    # ZeRO state within groups of this size, replicate across groups. Resolved
+    # onto the 'zshard' mesh axis; zero_hpz_partition_size behaves the same way
+    # (hpZ secondary partition = MiCS-style subgrouping on TPU).
+    mics_shard_size: int = 0
+    mics_hierarchical_params_gather: bool = False
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_quantized_nontrainable_weights: bool = False
@@ -159,13 +165,14 @@ class MeshSectionConfig:
     """TPU-native: named mesh axis sizes. -1 absorbs remaining devices."""
     pipe: int = 1
     data: int = -1
+    zshard: int = 1  # MiCS/hpZ subgroup size (see zero_optimization.mics_shard_size)
     expert: int = 1
     seq: int = 1
     tensor: int = 1
 
     def to_mesh_config(self) -> MeshConfig:
-        return MeshConfig(pipe=self.pipe, data=self.data, expert=self.expert,
-                          seq=self.seq, tensor=self.tensor)
+        return MeshConfig(pipe=self.pipe, data=self.data, zshard=self.zshard,
+                          expert=self.expert, seq=self.seq, tensor=self.tensor)
 
 
 @dataclasses.dataclass

@@ -78,6 +78,13 @@ class DeepSpeedTPUEngine:
     ):
         self.model_spec = model
         self.config: DeepSpeedTPUConfig = load_config(config)
+        # MiCS / ZeRO++ hpZ: replica-group sharding resolves onto the 'zshard'
+        # mesh axis (shard within the subgroup, replicate across 'data')
+        zcfg = self.config.zero_optimization
+        subgroup = zcfg.mics_shard_size or (
+            zcfg.zero_hpz_partition_size if zcfg.zero_hpz_partition_size > 1 else 0)
+        if subgroup and self.config.mesh.zshard == 1:
+            self.config.mesh.zshard = subgroup
         if not dist.is_initialized():
             dist.init_distributed(mesh_config=self.config.mesh.to_mesh_config())
         if mesh_manager is None:
@@ -97,6 +104,7 @@ class DeepSpeedTPUEngine:
 
         # batch triad: dp width = replicas of the model over the batch dim
         self.dp_world_size = (self.mesh_manager.axis_size("data")
+                              * self.mesh_manager.axis_size("zshard")
                               * self.mesh_manager.axis_size("expert"))
         self.config.resolve_batch_size(self.dp_world_size)
 
@@ -655,6 +663,16 @@ class DeepSpeedTPUEngine:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state
+
+    def load_universal_checkpoint(self, universal_dir: str,
+                                  load_optimizer_states: bool = True) -> None:
+        """Load a universal (per-param atom) checkpoint at ANY topology
+        (reference ``load_universal_checkpoint``; converter:
+        ``deepspeed_tpu.checkpoint.universal``)."""
+        from deepspeed_tpu.checkpoint.universal import load_universal_into_engine
+
+        load_universal_into_engine(self, universal_dir, load_optimizer_states)
+        log_dist(f"loaded universal checkpoint from {universal_dir}")
 
     # ------------------------------------------------------------------ #
     def get_fp32_params(self) -> PyTree:

@@ -76,6 +76,33 @@ def resolve_attention(attention: Optional[str]):
         from deepspeed_tpu.sequence import chunked_attention
 
         return chunked_attention
+    if attention.startswith("sparse"):
+        # 'sparse' | 'sparse:fixed' | 'sparse:bigbird' | 'sparse:bslongformer'
+        # (reference ops/sparse_attention SparseSelfAttention patterns)
+        from deepspeed_tpu.ops.pallas import block_sparse as bs
+
+        kind = attention.split(":", 1)[1] if ":" in attention else "fixed"
+        builders = {"fixed": bs.fixed_layout, "bigbird": bs.bigbird_layout,
+                    "bslongformer": bs.bslongformer_layout}
+        if kind not in builders:
+            raise ValueError(f"unknown sparse pattern {kind!r}; "
+                             f"supported: {sorted(builders)}")
+
+        def sparse_attn(q, k, v, causal=True, block_size=64):
+            # model layout is [B, S, N, D]; kernel wants [B, N, S, D]
+            if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads
+                rep = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            lay = builders[kind](q.shape[1] // block_size)
+            if causal:
+                lay = bs.causal_layout(lay)
+            out = bs.block_sparse_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), lay, block_size, causal=causal)
+            return out.transpose(0, 2, 1, 3)
+
+        return sparse_attn
     raise ValueError(f"unknown attention impl {attention!r}")
 
 

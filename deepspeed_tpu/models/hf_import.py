@@ -180,6 +180,408 @@ def params_from_mixtral(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
     return params
 
 
+
+# --------------------------------------------------------------------------- #
+# Qwen2 (Llama schema + attention biases)
+# --------------------------------------------------------------------------- #
+
+def config_from_qwen2(hf_config) -> TransformerConfig:
+    cfg = config_from_llama(hf_config)
+    return dataclasses.replace(cfg, qkv_bias=True)
+
+
+def params_from_qwen2(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    params = params_from_llama(sd, cfg)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    params["blocks"].update({
+        "bq": _stack(sd, lyr + "self_attn.q_proj.bias", L),
+        "bk": _stack(sd, lyr + "self_attn.k_proj.bias", L),
+        "bv": _stack(sd, lyr + "self_attn.v_proj.bias", L),
+    })
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# Phi (phi-1/1.5/2: parallel block, shared norm, partial rotary, biased head)
+# --------------------------------------------------------------------------- #
+
+def config_from_phi(hf_config) -> TransformerConfig:
+    head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="layernorm", activation="gelu",
+        use_bias=True, parallel_block=True, shared_parallel_norm=True,
+        rope_fraction=float(getattr(hf_config, "partial_rotary_factor", 0.5)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        tie_embeddings=False, lm_head_bias=True,
+        norm_eps=hf_config.layer_norm_eps, dtype="float32")
+
+
+def params_from_phi(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L),
+                "bias": _stack(sd, lyr + "input_layernorm.bias", L)},
+        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
+        "bq": _stack(sd, lyr + "self_attn.q_proj.bias", L),
+        "bk": _stack(sd, lyr + "self_attn.k_proj.bias", L),
+        "bv": _stack(sd, lyr + "self_attn.v_proj.bias", L),
+        "wo": _stack(sd, lyr + "self_attn.dense.weight", L, transpose=True),
+        "bo": _stack(sd, lyr + "self_attn.dense.bias", L),
+        "w_up": _stack(sd, lyr + "mlp.fc1.weight", L, transpose=True),
+        "b_up": _stack(sd, lyr + "mlp.fc1.bias", L),
+        "w_down": _stack(sd, lyr + "mlp.fc2.weight", L, transpose=True),
+        "b_down": _stack(sd, lyr + "mlp.fc2.bias", L),
+    }
+    return {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "final_layernorm.weight"]),
+                       "bias": _np(sd[pre + "final_layernorm.bias"])},
+        "lm_head": _np(sd["lm_head.weight"]).T,
+        "lm_head_b": _np(sd["lm_head.bias"]),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Phi-3 (Llama schema with fused qkv_proj / gate_up_proj)
+# --------------------------------------------------------------------------- #
+
+def config_from_phi3(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="rmsnorm", activation="swiglu", use_bias=False,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        norm_eps=hf_config.rms_norm_eps, dtype="float32")
+
+
+def params_from_phi3(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    qdim = cfg.num_heads * cfg.head_dim
+    kvdim = cfg.kv_heads * cfg.head_dim
+    f = cfg.ffn_size
+
+    qkv = _stack(sd, lyr + "self_attn.qkv_proj.weight", L, transpose=True)
+    gate_up = _stack(sd, lyr + "mlp.gate_up_proj.weight", L, transpose=True)
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
+        "wq": qkv[:, :, :qdim],
+        "wk": qkv[:, :, qdim:qdim + kvdim],
+        "wv": qkv[:, :, qdim + kvdim:],
+        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+        "w_gate": gate_up[:, :, :f],
+        "w_up": gate_up[:, :, f:],
+        "w_down": _stack(sd, lyr + "mlp.down_proj.weight", L, transpose=True),
+    }
+    params = {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# Falcon (fused grouped QKV, parallel block; 7B = MQA + shared norm)
+# --------------------------------------------------------------------------- #
+
+def config_from_falcon(hf_config) -> TransformerConfig:
+    n_head = hf_config.num_attention_heads
+    if getattr(hf_config, "new_decoder_architecture", False):
+        n_kv = hf_config.num_kv_heads
+        parallel, shared = True, False   # ln_attn + ln_mlp (dual parallel norms)
+    else:
+        n_kv = 1 if getattr(hf_config, "multi_query", True) else n_head
+        # parallel_attn=True → one norm feeds both branches; False → a plain
+        # sequential block (falcon-rw)
+        parallel = bool(getattr(hf_config, "parallel_attn", True))
+        shared = parallel
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=n_head,
+        num_kv_heads=n_kv,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        pos_emb="alibi" if getattr(hf_config, "alibi", False) else "rope",
+        # HF Falcon adds the alibi tensor with beta=inv_norm_factor — the bias
+        # rides inside the 1/sqrt(d) scaling (unlike BLOOM's beta=1)
+        alibi_bias_scale=1.0 / (hf_config.hidden_size
+                                // hf_config.num_attention_heads) ** 0.5,
+        norm="layernorm", activation="gelu",
+        use_bias=bool(getattr(hf_config, "bias", False)),
+        parallel_block=parallel, shared_parallel_norm=shared,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        tie_embeddings=True,
+        norm_eps=hf_config.layer_norm_epsilon, dtype="float32")
+
+
+def _split_falcon_qkv(w: np.ndarray, cfg: TransformerConfig):
+    """Falcon fused query_key_value [out, in] → wq/wk/wv in [in, out] layout.
+
+    Rows are grouped as [n_kv groups × (q_per_group q-heads, 1 k, 1 v)]."""
+    h, d = cfg.hidden_size, cfg.head_dim
+    n_kv = cfg.kv_heads
+    q_per = cfg.num_heads // n_kv
+    grouped = w.reshape(n_kv, (q_per + 2) * d, h)
+    q = grouped[:, : q_per * d].reshape(n_kv * q_per * d, h)
+    k = grouped[:, q_per * d: (q_per + 1) * d].reshape(n_kv * d, h)
+    v = grouped[:, (q_per + 1) * d:].reshape(n_kv * d, h)
+    return q.T, k.T, v.T
+
+
+def params_from_falcon(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    lyr = pre + "h.{}."
+
+    wq, wk, wv = [], [], []
+    for i in range(L):
+        q, k, v = _split_falcon_qkv(
+            _np(sd[lyr.format(i) + "self_attention.query_key_value.weight"]), cfg)
+        wq.append(q); wk.append(k); wv.append(v)
+
+    if cfg.parallel_block and not cfg.shared_parallel_norm:
+        # new decoder architecture: dual parallel norms
+        blocks = {
+            "ln1": {"scale": _stack(sd, lyr + "ln_attn.weight", L),
+                    "bias": _stack(sd, lyr + "ln_attn.bias", L)},
+            "ln2": {"scale": _stack(sd, lyr + "ln_mlp.weight", L),
+                    "bias": _stack(sd, lyr + "ln_mlp.bias", L)},
+        }
+    elif cfg.parallel_block:
+        # old arch, parallel_attn: one norm feeds both branches
+        blocks = {"ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L),
+                          "bias": _stack(sd, lyr + "input_layernorm.bias", L)}}
+    else:
+        # falcon-rw: plain sequential block
+        blocks = {
+            "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L),
+                    "bias": _stack(sd, lyr + "input_layernorm.bias", L)},
+            "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L),
+                    "bias": _stack(sd, lyr + "post_attention_layernorm.bias", L)},
+        }
+    blocks.update({
+        "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+        "wo": _stack(sd, lyr + "self_attention.dense.weight", L, transpose=True),
+        "w_up": _stack(sd, lyr + "mlp.dense_h_to_4h.weight", L, transpose=True),
+        "w_down": _stack(sd, lyr + "mlp.dense_4h_to_h.weight", L, transpose=True),
+    })
+    return {
+        "tok_emb": _np(sd[pre + "word_embeddings.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "ln_f.weight"]),
+                       "bias": _np(sd[pre + "ln_f.bias"])},
+    }
+
+
+# --------------------------------------------------------------------------- #
+# OPT (learned positions with offset 2, ReLU)
+# --------------------------------------------------------------------------- #
+
+def config_from_opt(hf_config) -> TransformerConfig:
+    if hf_config.word_embed_proj_dim != hf_config.hidden_size:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size (350m-style "
+                         "projection) is not supported")
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise ValueError("OPT with do_layer_norm_before=False is not supported")
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.ffn_dim,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="learned", norm="layernorm",
+        activation="relu" if hf_config.activation_function == "relu" else "gelu",
+        use_bias=True, tie_embeddings=True,
+        norm_eps=1e-5, dtype="float32")
+
+
+def params_from_opt(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    pre = "model.decoder." if any(k.startswith("model.decoder.") for k in sd) \
+        else "decoder." if any(k.startswith("decoder.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "self_attn_layer_norm.weight", L),
+                "bias": _stack(sd, lyr + "self_attn_layer_norm.bias", L)},
+        "ln2": {"scale": _stack(sd, lyr + "final_layer_norm.weight", L),
+                "bias": _stack(sd, lyr + "final_layer_norm.bias", L)},
+        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
+        "bq": _stack(sd, lyr + "self_attn.q_proj.bias", L),
+        "bk": _stack(sd, lyr + "self_attn.k_proj.bias", L),
+        "bv": _stack(sd, lyr + "self_attn.v_proj.bias", L),
+        "wo": _stack(sd, lyr + "self_attn.out_proj.weight", L, transpose=True),
+        "bo": _stack(sd, lyr + "self_attn.out_proj.bias", L),
+        "w_up": _stack(sd, lyr + "fc1.weight", L, transpose=True),
+        "b_up": _stack(sd, lyr + "fc1.bias", L),
+        "w_down": _stack(sd, lyr + "fc2.weight", L, transpose=True),
+        "b_down": _stack(sd, lyr + "fc2.bias", L),
+    }
+    return {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        # HF OPT offsets positions by 2 (pad-token legacy) — drop those rows
+        "pos_emb": _np(sd[pre + "embed_positions.weight"])[2:],
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "final_layer_norm.weight"]),
+                       "bias": _np(sd[pre + "final_layer_norm.bias"])},
+    }
+
+
+# --------------------------------------------------------------------------- #
+# BLOOM (ALiBi, embedding layernorm, per-head-interleaved fused QKV)
+# --------------------------------------------------------------------------- #
+
+def config_from_bloom(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_seq_len=getattr(hf_config, "seq_length", 2048),
+        pos_emb="alibi", norm="layernorm", activation="gelu",
+        use_bias=True, emb_norm=True, tie_embeddings=True,
+        norm_eps=hf_config.layer_norm_epsilon, dtype="float32")
+
+
+def params_from_bloom(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, h, d = cfg.num_layers, cfg.hidden_size, cfg.head_dim
+    n = cfg.num_heads
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    lyr = pre + "h.{}."
+
+    # fused QKV rows are interleaved per head: [n_head, 3, head_dim, hidden]
+    def split_qkv(i):
+        w = _np(sd[lyr.format(i) + "self_attention.query_key_value.weight"])
+        b = _np(sd[lyr.format(i) + "self_attention.query_key_value.bias"])
+        w = w.reshape(n, 3, d, h)
+        b = b.reshape(n, 3, d)
+        return (w[:, 0].reshape(n * d, h).T, w[:, 1].reshape(n * d, h).T,
+                w[:, 2].reshape(n * d, h).T,
+                b[:, 0].reshape(-1), b[:, 1].reshape(-1), b[:, 2].reshape(-1))
+
+    parts = [split_qkv(i) for i in range(L)]
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L),
+                "bias": _stack(sd, lyr + "input_layernorm.bias", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L),
+                "bias": _stack(sd, lyr + "post_attention_layernorm.bias", L)},
+        "wq": np.stack([p[0] for p in parts]),
+        "wk": np.stack([p[1] for p in parts]),
+        "wv": np.stack([p[2] for p in parts]),
+        "bq": np.stack([p[3] for p in parts]),
+        "bk": np.stack([p[4] for p in parts]),
+        "bv": np.stack([p[5] for p in parts]),
+        "wo": _stack(sd, lyr + "self_attention.dense.weight", L, transpose=True),
+        "bo": _stack(sd, lyr + "self_attention.dense.bias", L),
+        "w_up": _stack(sd, lyr + "mlp.dense_h_to_4h.weight", L, transpose=True),
+        "b_up": _stack(sd, lyr + "mlp.dense_h_to_4h.bias", L),
+        "w_down": _stack(sd, lyr + "mlp.dense_4h_to_h.weight", L, transpose=True),
+        "b_down": _stack(sd, lyr + "mlp.dense_4h_to_h.bias", L),
+    }
+    return {
+        "tok_emb": _np(sd[pre + "word_embeddings.weight"]),
+        "emb_norm": {"scale": _np(sd[pre + "word_embeddings_layernorm.weight"]),
+                     "bias": _np(sd[pre + "word_embeddings_layernorm.bias"])},
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "ln_f.weight"]),
+                       "bias": _np(sd[pre + "ln_f.bias"])},
+    }
+
+
+# --------------------------------------------------------------------------- #
+# GPT-NeoX / Pythia (parallel dual-norm block, partial rotary, fused QKV)
+# --------------------------------------------------------------------------- #
+
+def config_from_gpt_neox(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="layernorm", activation="gelu",
+        use_bias=True,
+        parallel_block=bool(getattr(hf_config, "use_parallel_residual", True)),
+        rope_fraction=float(getattr(hf_config, "rotary_pct", 0.25)),
+        rope_theta=float(getattr(hf_config, "rotary_emb_base", 10000.0)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        norm_eps=hf_config.layer_norm_eps, dtype="float32")
+
+
+def params_from_gpt_neox(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, h, d, n = cfg.num_layers, cfg.hidden_size, cfg.head_dim, cfg.num_heads
+    pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+
+    # fused QKV interleaved per head, like BLOOM: [n_head, 3, head_dim, hidden]
+    def split_qkv(i):
+        w = _np(sd[lyr.format(i) + "attention.query_key_value.weight"])
+        b = _np(sd[lyr.format(i) + "attention.query_key_value.bias"])
+        w = w.reshape(n, 3, d, h)
+        b = b.reshape(n, 3, d)
+        return (w[:, 0].reshape(n * d, h).T, w[:, 1].reshape(n * d, h).T,
+                w[:, 2].reshape(n * d, h).T,
+                b[:, 0].reshape(-1), b[:, 1].reshape(-1), b[:, 2].reshape(-1))
+
+    parts = [split_qkv(i) for i in range(L)]
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L),
+                "bias": _stack(sd, lyr + "input_layernorm.bias", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L),
+                "bias": _stack(sd, lyr + "post_attention_layernorm.bias", L)},
+        "wq": np.stack([p[0] for p in parts]),
+        "wk": np.stack([p[1] for p in parts]),
+        "wv": np.stack([p[2] for p in parts]),
+        "bq": np.stack([p[3] for p in parts]),
+        "bk": np.stack([p[4] for p in parts]),
+        "bv": np.stack([p[5] for p in parts]),
+        "wo": _stack(sd, lyr + "attention.dense.weight", L, transpose=True),
+        "bo": _stack(sd, lyr + "attention.dense.bias", L),
+        "w_up": _stack(sd, lyr + "mlp.dense_h_to_4h.weight", L, transpose=True),
+        "b_up": _stack(sd, lyr + "mlp.dense_h_to_4h.bias", L),
+        "w_down": _stack(sd, lyr + "mlp.dense_4h_to_h.weight", L, transpose=True),
+        "b_down": _stack(sd, lyr + "mlp.dense_4h_to_h.bias", L),
+    }
+    params = {
+        "tok_emb": _np(sd[pre + "embed_in.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "final_layer_norm.weight"]),
+                       "bias": _np(sd[pre + "final_layer_norm.bias"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["embed_out.weight"]).T
+    return params
+
+
 # --------------------------------------------------------------------------- #
 # front door
 # --------------------------------------------------------------------------- #
@@ -189,6 +591,15 @@ _ARCH_TABLE = {
     "llama": (config_from_llama, params_from_llama),
     "mistral": (config_from_llama, params_from_llama),
     "mixtral": (config_from_mixtral, params_from_mixtral),
+    "qwen2": (config_from_qwen2, params_from_qwen2),
+    "phi": (config_from_phi, params_from_phi),
+    "phi3": (config_from_phi3, params_from_phi3),
+    "falcon": (config_from_falcon, params_from_falcon),
+    "opt": (config_from_opt, params_from_opt),
+    "bloom": (config_from_bloom, params_from_bloom),
+    "gpt_neox": (config_from_gpt_neox, params_from_gpt_neox),
+    # exaone/qwen-1 etc. share the llama schema under other key names; pass
+    # arch='llama' explicitly after renaming, or extend this table.
 }
 
 

@@ -43,10 +43,18 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None  # None → MHA; < num_heads → GQA
     ffn_hidden_size: Optional[int] = None
     max_seq_len: int = 1024
-    pos_emb: str = "learned"            # learned | rope | none
+    pos_emb: str = "learned"            # learned | rope | alibi | none
     norm: str = "layernorm"             # layernorm | rmsnorm
-    activation: str = "gelu"            # gelu | swiglu
+    activation: str = "gelu"            # gelu | swiglu | relu
     use_bias: bool = True
+    qkv_bias: bool = False              # bias on q/k/v only (Qwen2-style)
+    parallel_block: bool = False        # attn + FFN in parallel (Falcon/NeoX/Phi)
+    shared_parallel_norm: bool = False  # parallel block, ONE norm feeds both
+                                        # branches (Falcon new-arch, Phi)
+    emb_norm: bool = False              # layernorm after embedding (BLOOM)
+    alibi_bias_scale: float = 1.0       # Falcon folds 1/sqrt(d) into the bias
+    lm_head_bias: bool = False          # bias on the LM head (Phi)
+    rope_fraction: float = 1.0          # partial rotary (NeoX 0.25, Phi-2 0.4)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -83,6 +91,20 @@ class TransformerConfig:
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
 
+    @property
+    def attn_bias_enabled(self) -> bool:
+        return self.use_bias or self.qkv_bias
+
+    @property
+    def rope_dim(self) -> int:
+        """Rotary dims (even), = head_dim * rope_fraction."""
+        d = int(self.head_dim * self.rope_fraction)
+        return d - (d % 2)
+
+    @property
+    def has_ln2(self) -> bool:
+        return not (self.parallel_block and self.shared_parallel_norm)
+
     def num_params(self) -> int:
         h, f, v, l = self.hidden_size, self.ffn_size, self.vocab_size, self.num_layers
         kv = self.kv_heads * self.head_dim
@@ -92,8 +114,10 @@ class TransformerConfig:
             per_layer += self.n_experts * ffn_mats * h * f + h * self.n_experts
         else:
             per_layer += ffn_mats * h * f
-        per_layer += 2 * h  # norms
+        per_layer += (2 * h if self.has_ln2 else h)  # norms
         total = l * per_layer + v * h + 2 * h
+        if self.emb_norm:
+            total += 2 * h
         if not self.tie_embeddings:
             total += v * h
         if self.pos_emb == "learned":
@@ -125,12 +149,13 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
 
     block = {
         "ln1": norm_init((L, h)),
-        "ln2": norm_init((L, h)),
         "wq": dense(keys[0], (L, h, qdim), std),
         "wk": dense(keys[1], (L, h, kvdim), std),
         "wv": dense(keys[2], (L, h, kvdim), std),
         "wo": dense(keys[3], (L, qdim, h), out_std),
     }
+    if cfg.has_ln2:
+        block["ln2"] = norm_init((L, h))
     E = cfg.n_experts
     if E > 0:
         # MoE FFN: per-expert weights (no biases), router gate per layer
@@ -144,10 +169,11 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
         block["w_down"] = dense(keys[5], (L, f, h), out_std)
         if cfg.activation == "swiglu":
             block["w_gate"] = dense(keys[6], (L, h, f), std)
-    if cfg.use_bias:
+    if cfg.attn_bias_enabled:
         block["bq"] = jnp.zeros((L, qdim), jnp.float32)
         block["bk"] = jnp.zeros((L, kvdim), jnp.float32)
         block["bv"] = jnp.zeros((L, kvdim), jnp.float32)
+    if cfg.use_bias:
         block["bo"] = jnp.zeros((L, h), jnp.float32)
         if E == 0:
             block["b_up"] = jnp.zeros((L, f), jnp.float32)
@@ -160,8 +186,12 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
     }
     if cfg.pos_emb == "learned":
         params["pos_emb"] = dense(keys[8], (cfg.max_seq_len, h), std)
+    if cfg.emb_norm:
+        params["emb_norm"] = norm_init((h,))
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(keys[9], (h, cfg.vocab_size), std)
+        if cfg.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
     return params
 
 
@@ -176,12 +206,13 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
     lyr = ("layers",)
     block = {
         "ln1": norm_axes(lyr),
-        "ln2": norm_axes(lyr),
         "wq": lyr + ("embed", "heads"),
         "wk": lyr + ("embed", "kv_heads"),
         "wv": lyr + ("embed", "kv_heads"),
         "wo": lyr + ("heads", "embed"),
     }
+    if cfg.has_ln2:
+        block["ln2"] = norm_axes(lyr)
     if cfg.n_experts > 0:
         block["gate_w"] = lyr + ("embed", None)
         block["w_up"] = lyr + ("expert", "embed", "mlp")
@@ -193,11 +224,13 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
         block["w_down"] = lyr + ("mlp", "embed")
         if cfg.activation == "swiglu":
             block["w_gate"] = lyr + ("embed", "mlp")
-    if cfg.use_bias:
+    if cfg.attn_bias_enabled:
         block.update({
-            "bq": lyr + ("heads",), "bk": lyr + ("kv_heads",), "bv": lyr + ("kv_heads",),
-            "bo": lyr + ("embed",),
+            "bq": lyr + ("heads",), "bk": lyr + ("kv_heads",),
+            "bv": lyr + ("kv_heads",),
         })
+    if cfg.use_bias:
+        block["bo"] = lyr + ("embed",)
         if cfg.n_experts == 0:
             block.update({"b_up": lyr + ("mlp",), "b_down": lyr + ("embed",)})
     axes = {
@@ -207,8 +240,12 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
     }
     if cfg.pos_emb == "learned":
         axes["pos_emb"] = ("seq", "embed")
+    if cfg.emb_norm:
+        axes["emb_norm"] = norm_axes(())
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+        if cfg.lm_head_bias:
+            axes["lm_head_b"] = ("vocab",)
     return axes
 
 
@@ -237,18 +274,50 @@ def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, ja
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: [B, S, N, D]; rotates pairs (interleaved halves convention)."""
-    d2 = x.shape[-1] // 2
-    x1, x2 = x[..., :d2], x[..., d2:]
+    """x: [B, S, N, D]; rotates pairs (interleaved halves convention).
+    When the tables cover fewer dims than D (partial rotary, NeoX/Phi), the
+    trailing dims pass through unrotated."""
+    rot = 2 * cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    d2 = rot // 2
+    x1, x2 = x_rot[..., :d2], x_rot[..., d2:]
     cos = cos[None, :, None, :].astype(x.dtype)
     sin = sin[None, :, None, :].astype(x.dtype)
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
+
+
+def alibi_slopes(n_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (BLOOM/press-et-al formula, incl. non-pow2)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        sl = pow2_slopes(n_heads)
+    else:
+        base = 2 ** math.floor(math.log2(n_heads))
+        sl = pow2_slopes(base)
+        extra = pow2_slopes(2 * base)[0::2][: n_heads - base]
+        sl = sl + extra
+    return jnp.asarray(sl, jnp.float32)
+
+
+def alibi_bias(n_heads: int, seq_len: int) -> jax.Array:
+    """[N, S, S] additive attention bias: slope * (key_pos - query_pos)."""
+    slopes = alibi_slopes(n_heads)
+    rel = (jnp.arange(seq_len)[None, :] - jnp.arange(seq_len)[:, None])
+    return slopes[:, None, None] * rel[None].astype(jnp.float32)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
-                          segment_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Reference (XLA-fused) attention. q:[B,S,N,D] k,v:[B,S,K,D]. fp32 softmax."""
+                          segment_mask: Optional[jax.Array] = None,
+                          bias: Optional[jax.Array] = None) -> jax.Array:
+    """Reference (XLA-fused) attention. q:[B,S,N,D] k,v:[B,S,K,D]. fp32 softmax.
+    ``bias``: additive [N, S, S] (ALiBi) applied before masking."""
     B, S, N, D = q.shape
     K = k.shape[2]
     if K != N:
@@ -256,6 +325,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v = jnp.repeat(v, N // K, axis=2)
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias[None]
     if causal:
         mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
         scores = jnp.where(mask[None, None], scores, -1e30)
@@ -269,14 +340,17 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
                    cos: Optional[jax.Array], sin: Optional[jax.Array],
                    attention_fn: AttentionFn) -> Tuple[jax.Array, jax.Array]:
     """One transformer block; lp holds this layer's (unstacked) params.
-    Returns (output, moe aux loss — 0.0 for dense blocks)."""
+    Returns (output, moe aux loss — 0.0 for dense blocks).
+
+    Sequential (GPT/Llama) or parallel (Falcon/NeoX/Phi: attn and FFN both
+    branch off the residual stream and are summed back)."""
     B, S, H = x.shape
     dt = cfg.compute_dtype
 
     def proj(name, inp, shape):
         w = lp[f"w{name}"].astype(dt)
         out = inp @ w
-        if cfg.use_bias:
+        if (cfg.attn_bias_enabled if name in ("q", "k", "v") else cfg.use_bias):
             out = out + lp[f"b{name}"].astype(dt)
         return out.reshape(shape)
 
@@ -287,13 +361,21 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     if cfg.pos_emb == "rope":
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    attn = attention_fn(q, k, v, causal=cfg.causal)
+    attn_kwargs = {}
+    if cfg.pos_emb == "alibi":
+        attn_kwargs["bias"] = alibi_bias(cfg.num_heads, S) * cfg.alibi_bias_scale
+    attn = attention_fn(q, k, v, causal=cfg.causal, **attn_kwargs)
     attn = attn.reshape(B, S, cfg.num_heads * cfg.head_dim)
     attn_out = attn @ lp["wo"].astype(dt)
     if cfg.use_bias:
         attn_out = attn_out + lp["bo"].astype(dt)
-    x = x + attn_out
 
+    if cfg.parallel_block:
+        h2 = h if cfg.shared_parallel_norm else             _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        down, aux = _ffn(h2, lp, cfg)
+        return x + attn_out + down, aux
+
+    x = x + attn_out
     h = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
     down, aux = _ffn(h, lp, cfg)
     return x + down, aux
@@ -319,6 +401,8 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
         if cfg.activation == "swiglu":
             gate = h @ lp["w_gate"].astype(dt)
             act = jax.nn.silu(gate) * up
+        elif cfg.activation == "relu":
+            act = jax.nn.relu(up)
         else:
             act = jax.nn.gelu(up, approximate=True)
         down = act @ lp["w_down"].astype(dt)
@@ -345,11 +429,13 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     x = params["tok_emb"].astype(dt)[tokens]
     if cfg.pos_emb == "learned":
         x = x + params["pos_emb"].astype(dt)[:S][None]
+    if cfg.emb_norm:
+        x = _norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
     x = constrain(x)
 
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
 
     def body(carry, layer_params):
         y, aux = _block_forward(carry, layer_params, cfg, cos, sin, attention_fn)
@@ -375,6 +461,8 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     x, head, _ = forward_hidden(params, tokens, cfg, attention_fn,
                                 activation_constraint)
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
     return logits
 
 
@@ -384,12 +472,18 @@ def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
 
 def apply_rope_at(x: jax.Array, cos_table: jax.Array, sin_table: jax.Array,
                   positions: jax.Array) -> jax.Array:
-    """Rotate x [B, T, N, D] at absolute ``positions`` [B, T]."""
-    d2 = x.shape[-1] // 2
-    cos = cos_table[positions][:, :, None, :].astype(x.dtype)  # [B,T,1,D/2]
+    """Rotate x [B, T, N, D] at absolute ``positions`` [B, T]; partial rotary
+    (tables narrower than D/2) passes trailing dims through."""
+    rot = 2 * cos_table.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    d2 = rot // 2
+    cos = cos_table[positions][:, :, None, :].astype(x.dtype)  # [B,T,1,rot/2]
     sin = sin_table[positions][:, :, None, :].astype(x.dtype)
-    x1, x2 = x[..., :d2], x[..., d2:]
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    x1, x2 = x_rot[..., :d2], x_rot[..., d2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
 
 
 def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
@@ -402,9 +496,11 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
 
 
 def cached_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
-                     positions: jax.Array) -> jax.Array:
+                     positions: jax.Array,
+                     alibi: Optional[jax.Array] = None) -> jax.Array:
     """q [B,T,N,D] at abs ``positions`` [B,T] against cache [B,M,K,D]; causal
-    mask = cache index <= query position (fp32 softmax)."""
+    mask = cache index <= query position (fp32 softmax). ``alibi``: [N] slopes;
+    bias = slope * (cache_pos - query_pos)."""
     B, T, N, D = q.shape
     M, K = kc.shape[1], kc.shape[2]
     if K != N:
@@ -412,6 +508,10 @@ def cached_attention(q: jax.Array, kc: jax.Array, vc: jax.Array,
         vc = jnp.repeat(vc, N // K, axis=2)
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum("btnd,bmnd->bntm", q, kc).astype(jnp.float32) * scale
+    if alibi is not None:
+        rel = (jnp.arange(M)[None, None, :]
+               - positions[:, :, None]).astype(jnp.float32)   # [B,T,M]
+        scores = scores + alibi[None, :, None, None] * rel[:, None]
     mask = jnp.arange(M)[None, None, None, :] <= positions[:, None, :, None]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -438,10 +538,14 @@ def forward_decode(params: PyTree, tokens: jax.Array,
     x = params["tok_emb"].astype(dt)[tokens]
     if cfg.pos_emb == "learned":
         x = x + params["pos_emb"].astype(dt)[positions]
+    if cfg.emb_norm:
+        x = _norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
 
     cos_t = sin_t = None
     if cfg.pos_emb == "rope":
-        cos_t, sin_t = rope_table(M, cfg.head_dim, cfg.rope_theta)
+        cos_t, sin_t = rope_table(M, cfg.rope_dim, cfg.rope_theta)
+    slopes = (alibi_slopes(cfg.num_heads) * cfg.alibi_bias_scale
+              if cfg.pos_emb == "alibi" else None)
 
     def write(c, new, p):
         return lax.dynamic_update_slice(c, new, (p, 0, 0))
@@ -453,7 +557,8 @@ def forward_decode(params: PyTree, tokens: jax.Array,
         def proj(name, shape):
             w = lp[f"w{name}"].astype(dt)
             out = h @ w
-            if cfg.use_bias:
+            if (cfg.attn_bias_enabled if name in ("q", "k", "v")
+                    else cfg.use_bias):
                 out = out + lp[f"b{name}"].astype(dt)
             return out.reshape(shape)
 
@@ -465,11 +570,16 @@ def forward_decode(params: PyTree, tokens: jax.Array,
             k = apply_rope_at(k, cos_t, sin_t, positions)
         kc = jax.vmap(write)(kc, k.astype(kc.dtype), pos)
         vc = jax.vmap(write)(vc, v.astype(vc.dtype), pos)
-        attn = cached_attention(q, kc, vc, positions)
+        attn = cached_attention(q, kc, vc, positions, alibi=slopes)
         attn = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
         attn_out = attn @ lp["wo"].astype(dt)
         if cfg.use_bias:
             attn_out = attn_out + lp["bo"].astype(dt)
+        if cfg.parallel_block:
+            h2 = h if cfg.shared_parallel_norm else \
+                _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+            down, _ = _ffn(h2, lp, cfg)
+            return x + attn_out + down, (kc, vc)
         x = x + attn_out
         h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
         down, _ = _ffn(h2, lp, cfg)
@@ -479,6 +589,8 @@ def forward_decode(params: PyTree, tokens: jax.Array,
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -512,11 +624,13 @@ def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     x = params["tok_emb"].astype(dt)[tokens]
     if cfg.pos_emb == "learned":
         x = x + params["pos_emb"].astype(dt)[:S][None]
+    if cfg.emb_norm:
+        x = _norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
     x = constrain(x)
 
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_table(S, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
 
     head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
     inputs = {"x": microbatch(x, M), "tokens": microbatch(tokens, M)}

@@ -104,3 +104,165 @@ class TestMixtralImport:
         cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
         tokens = np.random.default_rng(4).integers(0, 128, (2, 16), dtype=np.int32)
         _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+
+class TestQwen2Import:
+    def test_logits_match(self):
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(3)
+        model = transformers.Qwen2ForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.qkv_bias and not cfg.use_bias
+        tokens = np.random.default_rng(3).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestPhiImport:
+    def test_logits_match(self):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5)
+        torch.manual_seed(4)
+        model = transformers.PhiForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.parallel_block and cfg.shared_parallel_norm
+        assert cfg.rope_dim == 4  # head_dim 8 * 0.5
+        tokens = np.random.default_rng(4).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestPhi3Import:
+    def test_logits_match(self):
+        hf_cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False,
+            pad_token_id=0)
+        torch.manual_seed(5)
+        model = transformers.Phi3ForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        tokens = np.random.default_rng(5).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestFalconImport:
+    @pytest.mark.parametrize("new_arch,multi_query,alibi", [
+        (False, True, False),   # falcon-7b style: MQA, shared norm, rope
+        (True, False, False),   # falcon-40b style: GQA groups, dual norms
+        (False, False, True),   # falcon-rw style: MHA + alibi
+    ])
+    def test_logits_match(self, new_arch, multi_query, alibi):
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2 if new_arch else None,
+            new_decoder_architecture=new_arch, multi_query=multi_query,
+            alibi=alibi, parallel_attn=True, bias=False,
+            max_position_embeddings=64)
+        torch.manual_seed(6)
+        model = transformers.FalconForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        tokens = np.random.default_rng(6).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+
+class TestOPTImport:
+    def test_logits_match(self):
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            word_embed_proj_dim=32, activation_function="relu",
+            do_layer_norm_before=True)
+        torch.manual_seed(7)
+        model = transformers.OPTForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.activation == "relu"
+        tokens = np.random.default_rng(7).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestBloomImport:
+    def test_logits_match(self):
+        hf_cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+        torch.manual_seed(8)
+        model = transformers.BloomForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.pos_emb == "alibi" and cfg.emb_norm
+        tokens = np.random.default_rng(8).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestGPTNeoXImport:
+    @pytest.mark.parametrize("parallel", [True, False])
+    def test_logits_match(self, parallel):
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.25,
+            use_parallel_residual=parallel, tie_word_embeddings=False)
+        torch.manual_seed(9)
+        model = transformers.GPTNeoXForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.parallel_block == parallel
+        tokens = np.random.default_rng(9).integers(0, 128, (2, 16), dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+
+class TestDecodeParityNewArchs:
+    """forward_decode must agree with forward for the new family features
+    (parallel blocks, shared norms, alibi, partial rotary, head bias)."""
+
+    @pytest.mark.parametrize("maker", ["phi", "bloom", "neox", "falcon7b"])
+    def test_prefill_matches_forward(self, maker):
+        if maker == "phi":
+            hf_cfg = transformers.PhiConfig(
+                vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, partial_rotary_factor=0.5)
+            torch.manual_seed(10)
+            model = transformers.PhiForCausalLM(hf_cfg)
+        elif maker == "bloom":
+            hf_cfg = transformers.BloomConfig(
+                vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+            torch.manual_seed(11)
+            model = transformers.BloomForCausalLM(hf_cfg)
+        elif maker == "neox":
+            hf_cfg = transformers.GPTNeoXConfig(
+                vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, rotary_pct=0.25,
+                use_parallel_residual=True, tie_word_embeddings=False)
+            torch.manual_seed(12)
+            model = transformers.GPTNeoXForCausalLM(hf_cfg)
+        else:
+            hf_cfg = transformers.FalconConfig(
+                vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, new_decoder_architecture=False,
+                multi_query=True, alibi=False, parallel_attn=True, bias=False,
+                max_position_embeddings=64)
+            torch.manual_seed(13)
+            model = transformers.FalconForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+
+        tokens = np.random.default_rng(20).integers(0, 128, (2, 8),
+                                                    dtype=np.int32)
+        full = np.asarray(T.forward(params, jnp.asarray(tokens), cfg))
+
+        cache = T.init_kv_cache(cfg, batch_size=2, max_len=16)
+        logits, cache = T.forward_decode(
+            params, jnp.asarray(tokens), cache, jnp.zeros((2,), jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(logits), full, rtol=2e-4,
+                                   atol=2e-4)
+
+        # one decode step after prefill == forward on the extended sequence
+        nxt = np.random.default_rng(21).integers(0, 128, (2, 1), dtype=np.int32)
+        step_logits, _ = T.forward_decode(
+            params, jnp.asarray(nxt), cache, jnp.full((2,), 8, jnp.int32), cfg)
+        ext = np.concatenate([tokens, nxt], axis=1)
+        full_ext = np.asarray(T.forward(params, jnp.asarray(ext), cfg))
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   full_ext[:, -1], rtol=2e-4, atol=2e-4)

@@ -18,6 +18,7 @@ from deepspeed_tpu.runtime.config_utils import (
     config_from_dict,
 )
 from deepspeed_tpu.comm.mesh import MeshConfig
+from deepspeed_tpu.runtime.zenflow import ZenFlowSectionConfig
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -101,6 +102,9 @@ class ZeroConfig:
     # (hpZ secondary partition = MiCS-style subgrouping on TPU).
     mics_shard_size: int = 0
     mics_hierarchical_params_gather: bool = False
+    # ZenFlow importance-split updates (reference runtime/zenflow/)
+    zenflow: "ZenFlowSectionConfig" = dataclasses.field(
+        default_factory=lambda: ZenFlowSectionConfig())
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_quantized_nontrainable_weights: bool = False

@@ -123,6 +123,10 @@ class DeepSpeedTPUEngine:
             if opt_cfg is None:
                 raise ValueError("config must define an optimizer (or pass one in)")
             optimizer = get_optimizer(opt_cfg.type, opt_cfg.params)
+        # ZenFlow: importance-split hot/cold updates (runtime/zenflow.py)
+        from deepspeed_tpu.runtime.zenflow import maybe_wrap_zenflow
+
+        optimizer = maybe_wrap_zenflow(optimizer, zcfg.zenflow)
         # frozen params (LoRA etc.): optimizer state only for trainable leaves
         self._trainable_mask = None
         if model.trainable_fn is not None:

@@ -1,0 +1,124 @@
+"""ZenFlow: importance-split optimizer updates (hot now, cold deferred).
+
+Parity: reference ``runtime/zenflow/zenflow_stage_1_and_2.py``
+(``ZenFlowZeroOptimizer`` :47, Sequential/Parallel variants :590/:599) +
+``zenflow_stage3.py``. The reference's problem: with CPU-offloaded optimizers
+the CPU-side Adam step takes longer than the backward pass (>4s vs 2s ⇒ >60%
+GPU idle, ``blogs/deepspeed-zenflow/README.md:94``). Its fix: update the
+top-k *important* gradient coordinates on-GPU every step, and batch the
+remaining (cold) coordinates into a CPU update that runs asynchronously every
+``update_interval`` steps.
+
+TPU translation: the optimizer math itself is fused into the XLA step program
+(no CPU Adam to hide), so what remains valuable — and is implemented here —
+is the **semantics**: selective immediate updates for important coordinates,
+deferred accumulated updates for the bulk. Wins on TPU:
+
+* the cold bulk contributes through an accumulator applied every
+  ``update_interval`` steps, matching the reference's staleness model (cold
+  grads land with up to K-step delay) — the convergence-relevant behavior;
+* hot coordinates keep full-rate Adam updates, so loss curves track plain
+  training closely at topk_ratio ≈ 1-5%.
+
+State (checkpointed like any moments): inner optimizer state + ``cold_acc``
+gradient accumulator + schedule scalars.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TPUOptimizer, _tmap
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class ZenFlowSectionConfig:
+    """Config section (reference zenflow config on the zero section)."""
+
+    enabled: bool = False
+    topk_ratio: float = 0.01        # fraction of coordinates updated hot
+    update_interval: int = 4        # cold-update period (steps)
+    full_warm_up_rounds: int = 0    # initial steps with full (non-split) updates
+    select_strategy: str = "auto"   # accepted for parity; importance = |grad|
+    overlap_step: bool = True       # accepted for parity (XLA schedules)
+
+
+@dataclasses.dataclass
+class ZenFlowOptimizer(TPUOptimizer):
+    """Wraps any TPUOptimizer with hot/cold importance-split updates."""
+
+    inner: Optional[TPUOptimizer] = None
+    topk_ratio: float = 0.01
+    update_interval: int = 4
+    full_warm_up_rounds: int = 0
+
+    def __post_init__(self):
+        if self.inner is not None:
+            self.lr = self.inner.lr
+            self.weight_decay = self.inner.weight_decay
+            self.moment_names = tuple(self.inner.moment_names) + ("cold_acc",)
+
+    def init(self, params):
+        state = self.inner.init(params)
+        state["cold_acc"] = _tmap(jnp.zeros_like, params)
+        return state
+
+    def _hot_mask(self, g: jax.Array) -> jax.Array:
+        """{0,1} mask of the top ``topk_ratio`` fraction by |g| (per leaf).
+
+        The reference selects important *columns* per matrix; per-coordinate
+        selection is the shape-agnostic analog and is what its 'auto'
+        strategy degenerates to for 1-D tensors."""
+        if g.size == 0:
+            return jnp.ones_like(g)
+        flat = jnp.abs(g.reshape(-1))
+        k = max(1, int(flat.shape[0] * self.topk_ratio))
+        threshold = jax.lax.top_k(flat, k)[0][-1]
+        return (jnp.abs(g) >= threshold).astype(g.dtype)
+
+    def update(self, grads, state, params, lr=None):
+        step = state["step"] + 1  # inner increments too; use for scheduling
+        warm = step <= self.full_warm_up_rounds
+        boundary = (step % self.update_interval) == 0
+
+        def split(g, acc):
+            hot = self._hot_mask(g)
+            g32 = g.astype(jnp.float32)
+            hot_g = g32 * hot
+            new_acc = acc + g32 * (1.0 - hot)
+            # at the boundary the cold accumulator (mean over the window)
+            # joins the applied gradient and resets
+            applied = jnp.where(
+                warm, g32,
+                jnp.where(boundary, hot_g + new_acc / self.update_interval,
+                          hot_g))
+            new_acc = jnp.where(jnp.logical_or(warm, boundary),
+                                jnp.zeros_like(new_acc), new_acc)
+            return applied, new_acc
+
+        out = _tmap(split, grads, state["cold_acc"])
+        applied = _tmap(lambda o: o[0], out,
+                        is_leaf=lambda x: isinstance(x, tuple))
+        new_acc = _tmap(lambda o: o[1], out,
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+        inner_state = {k: v for k, v in state.items() if k != "cold_acc"}
+        new_params, new_inner = self.inner.update(applied, inner_state, params,
+                                                 lr=lr)
+        new_inner["cold_acc"] = new_acc
+        return new_params, new_inner
+
+
+def maybe_wrap_zenflow(optimizer: TPUOptimizer,
+                       zcfg: Optional[ZenFlowSectionConfig]) -> TPUOptimizer:
+    if zcfg is None or not zcfg.enabled:
+        return optimizer
+    return ZenFlowOptimizer(
+        inner=optimizer, topk_ratio=zcfg.topk_ratio,
+        update_interval=zcfg.update_interval,
+        full_warm_up_rounds=zcfg.full_warm_up_rounds)

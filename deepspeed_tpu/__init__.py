@@ -15,7 +15,11 @@ version = __version__
 
 from deepspeed_tpu import comm  # noqa: E402
 from deepspeed_tpu.accelerator import get_accelerator  # noqa: E402
-from deepspeed_tpu.models.api import ModelSpec, causal_lm_spec  # noqa: E402
+from deepspeed_tpu.models.api import (  # noqa: E402
+    ModelSpec,
+    causal_lm_spec,
+    spec_from_hf,
+)
 from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig, load_config  # noqa: E402
 from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine  # noqa: E402
 from deepspeed_tpu.utils.logging import logger  # noqa: E402

@@ -178,3 +178,27 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         seq_len=cfg.max_seq_len,
         config=cfg,
     )
+
+
+def spec_from_hf(model, arch: Optional[str] = None, attention: Optional[str] = None,
+                 loss_tiles: int = 0, **overrides) -> ModelSpec:
+    """Build a trainable ModelSpec from a HuggingFace model (or
+    ``(state_dict, config)`` pair): weights are imported once
+    (``models/hf_import.py``) and become the spec's initial parameters.
+
+    The reference's equivalent is passing an HF model straight to
+    ``deepspeed.initialize`` — here interop happens at the weight level."""
+    import dataclasses as _dc
+
+    import jax.numpy as _jnp
+
+    from deepspeed_tpu.models.hf_import import import_hf_model
+
+    cfg, params = import_hf_model(model, arch=arch)
+    if overrides:
+        cfg = _dc.replace(cfg, **overrides)
+    base = causal_lm_spec(cfg, attention=attention, loss_tiles=loss_tiles)
+    init_params = jax.tree.map(lambda x: _jnp.asarray(x, _jnp.float32), params)
+    name = getattr(getattr(model, "config", None), "model_type", None) \
+        or (arch or "hf_model")
+    return _dc.replace(base, init_fn=lambda rng: init_params, name=str(name))

@@ -1,0 +1,111 @@
+"""AutoEP: automatic expert-parallel detection, planning and injection.
+
+Parity: reference ``module_inject/auto_ep.py`` (+ ``auto_ep_layer.py``,
+``auto_ep_folding.py``, presets): detects MoE blocks inside an HF model,
+replaces them with expert-parallel sharded layers, folds expert weights into
+the EP layout, and records universal-checkpoint metadata.
+
+TPU translation: expert layout is declarative — expert tensors carry an
+'expert' logical axis that the sharding policy maps onto the 'expert' mesh
+axis (``parallel/partitioning.py``), and dispatch is the all-to-all MoE layer
+(``moe/layer.py``). What AutoEP contributes here:
+
+* **detection** (:func:`detect_moe`): recognizes MoE in an HF config or a
+  zoo TransformerConfig (n_experts, top-k, per-arch attribute names);
+* **planning** (:func:`plan_ep`): picks the expert-parallel width from the
+  device count and expert count (largest divisor of both ≤ n_experts —
+  the reference preset logic);
+* **injection** (:func:`auto_ep`): imports the HF MoE model (or takes a zoo
+  spec) and returns (spec, mesh_section) to pass straight into
+  ``deepspeed_tpu.initialize`` with the 'expert' axis sized per plan.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# HF config attribute names that mark MoE archs (the detector table)
+_MOE_ATTRS = (
+    ("num_local_experts", "num_experts_per_tok"),      # mixtral
+    ("num_experts", "num_experts_per_tok"),            # qwen2_moe, deepseek
+    ("moe_num_experts", "moe_top_k"),                  # misc
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EPPlan:
+    enabled: bool
+    n_experts: int = 0
+    top_k: int = 0
+    ep_size: int = 1
+    reason: str = ""
+
+    def describe(self) -> str:
+        if not self.enabled:
+            return f"AutoEP: disabled ({self.reason})"
+        return (f"AutoEP: {self.n_experts} experts top-{self.top_k} over "
+                f"ep={self.ep_size} ({self.reason})")
+
+
+def detect_moe(config: Any) -> Tuple[int, int]:
+    """→ (n_experts, top_k); (0, 0) when the model is dense.
+
+    Accepts an HF config object or a zoo TransformerConfig."""
+    n = getattr(config, "n_experts", 0)
+    if n:
+        return int(n), int(getattr(config, "moe_top_k", 2))
+    for n_attr, k_attr in _MOE_ATTRS:
+        n = getattr(config, n_attr, 0) or 0
+        if n:
+            return int(n), int(getattr(config, k_attr, 2) or 2)
+    return 0, 0
+
+
+def plan_ep(config: Any, n_devices: Optional[int] = None,
+            max_ep: Optional[int] = None) -> EPPlan:
+    """Pick the expert-parallel width: the largest divisor of the device
+    count that also divides the expert count (capped by ``max_ep``)."""
+    n_experts, top_k = detect_moe(config)
+    if not n_experts:
+        return EPPlan(False, reason="no MoE layers detected")
+    if n_devices is None:
+        import jax
+
+        n_devices = jax.device_count()
+    ep = 1
+    for cand in range(1, min(n_experts, n_devices, max_ep or n_experts) + 1):
+        if n_devices % cand == 0 and n_experts % cand == 0:
+            ep = cand
+    if ep == 1:
+        return EPPlan(True, n_experts, top_k, 1,
+                      "no common divisor > 1 of devices and experts; "
+                      "experts replicated")
+    return EPPlan(True, n_experts, top_k, ep,
+                  f"{n_experts} experts over {n_devices} devices")
+
+
+def auto_ep(model_or_spec, n_devices: Optional[int] = None,
+            max_ep: Optional[int] = None,
+            **spec_kwargs) -> Tuple[Any, Dict[str, int], EPPlan]:
+    """Detect + plan + inject. Accepts an HF model (anything
+    ``import_hf_model`` takes) or a zoo ModelSpec.
+
+    → (model_spec, mesh_section, plan); pass ``config={'mesh': mesh_section,
+    ...}`` to ``initialize`` (mesh_section = {'expert': ep_size})."""
+    from deepspeed_tpu.models.api import ModelSpec, causal_lm_spec
+
+    if isinstance(model_or_spec, ModelSpec):
+        spec = model_or_spec
+        cfg = spec.config
+    else:
+        from deepspeed_tpu.models.api import spec_from_hf
+
+        spec = spec_from_hf(model_or_spec, **spec_kwargs)
+        cfg = spec.config
+
+    plan = plan_ep(cfg, n_devices=n_devices, max_ep=max_ep)
+    log_dist(plan.describe())
+    mesh_section = {"expert": plan.ep_size} if plan.enabled else {}
+    return spec, mesh_section, plan

@@ -1,0 +1,100 @@
+"""AutoSP: automatic sequence-parallel planning and spec rewriting.
+
+Parity: reference ``sequence/auto_sp.py`` + ``autosp_detector.py`` /
+``autosp_fusion.py`` and the DeepCompile pass ``compile/passes/sp_compile.py``
+(engine hook ``compile_autosp`` ``engine.py:1160``): a compiler pass that
+detects attention subgraphs in the fx graph and inserts sequence-dim
+partitioning + the Ulysses all-to-alls automatically.
+
+TPU translation: there is no fx graph to rewrite — the model is declarative
+(TransformerConfig + pluggable attention), so AutoSP is a **planning pass
+over the spec**: given the live mesh and the model's shape, it decides
+
+* whether SP applies (mesh 'seq' axis > 1),
+* which mechanism fits — Ulysses head-scatter (heads % sp == 0: cheapest,
+  all-to-all keeps full-attention exactness) vs ring/blockwise attention
+  (head-count indivisible or very long sequences: KV rotates over `ppermute`),
+* whether to tile the logits/loss computation (long seq → ALST
+  TiledFusedLogitsLoss analog),
+
+and returns a rewritten ModelSpec plus a human-readable plan. The engine
+applies it when ``sequence_parallel.auto`` is set; it is also a library
+entry point for direct use.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from deepspeed_tpu.comm.mesh import SEQ_AXIS, get_mesh_manager
+from deepspeed_tpu.utils.logging import log_dist
+
+# sequences at or beyond this many tokens get tiled loss by default
+TILED_LOSS_SEQ_THRESHOLD = 16_384
+
+
+@dataclasses.dataclass(frozen=True)
+class SPPlan:
+    enabled: bool
+    sp_size: int = 1
+    mechanism: str = "none"     # none | ulysses | ring
+    loss_tiles: int = 0
+    reason: str = ""
+
+    def describe(self) -> str:
+        if not self.enabled:
+            return f"AutoSP: disabled ({self.reason})"
+        return (f"AutoSP: {self.mechanism} over seq={self.sp_size}"
+                + (f", loss tiled x{self.loss_tiles}" if self.loss_tiles > 1
+                   else "") + f" ({self.reason})")
+
+
+def plan_sp(num_heads: int, seq_len: Optional[int] = None,
+            sp_size: Optional[int] = None) -> SPPlan:
+    """Decide the SP mechanism (the detector analog)."""
+    if sp_size is None:
+        try:
+            sp_size = get_mesh_manager().axis_size(SEQ_AXIS)
+        except Exception:
+            sp_size = 1
+    if sp_size <= 1:
+        return SPPlan(False, 1, "none", 0, "mesh has no 'seq' axis > 1")
+    tiles = 0
+    if seq_len and seq_len >= TILED_LOSS_SEQ_THRESHOLD:
+        tiles = max(2, seq_len // (TILED_LOSS_SEQ_THRESHOLD // 2))
+    if num_heads % sp_size == 0:
+        return SPPlan(True, sp_size, "ulysses", tiles,
+                      f"heads {num_heads} divisible by sp {sp_size}")
+    return SPPlan(True, sp_size, "ring", tiles,
+                  f"heads {num_heads} not divisible by sp {sp_size}; "
+                  "KV ring over ppermute")
+
+
+def apply_sp_plan(spec, plan: SPPlan):
+    """Rewrite a causal-LM ModelSpec according to the plan (the fusion-pass
+    analog: swaps the attention callable, retiles the loss)."""
+    if not plan.enabled:
+        return spec
+    from deepspeed_tpu.models.api import causal_lm_spec
+
+    cfg = getattr(spec, "config", None)
+    if cfg is None:
+        raise ValueError("apply_sp_plan needs a spec built by causal_lm_spec "
+                         "(carries its TransformerConfig)")
+    attention = "ulysses" if plan.mechanism == "ulysses" else "ring"
+    new = causal_lm_spec(cfg, attention=attention,
+                         loss_tiles=plan.loss_tiles)
+    return dataclasses.replace(new, name=spec.name + f"+autosp:{plan.mechanism}")
+
+
+def auto_sp(spec, seq_len: Optional[int] = None, sp_size: Optional[int] = None):
+    """One-call AutoSP: plan from the live mesh + rewrite. Returns
+    (new_spec, plan)."""
+    cfg = getattr(spec, "config", None)
+    heads = cfg.num_heads if cfg is not None else 0
+    plan = plan_sp(heads, seq_len or (cfg.max_seq_len if cfg else None),
+                   sp_size)
+    log_dist(plan.describe())
+    if not plan.enabled:
+        return spec, plan
+    return apply_sp_plan(spec, plan), plan

@@ -1,0 +1,138 @@
+"""AutoSP + AutoEP planning/injection tests (reference ``sequence/auto_sp``,
+``module_inject/auto_ep``)."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, reset_mesh
+from deepspeed_tpu.moe.auto_ep import auto_ep, detect_moe, plan_ep
+from deepspeed_tpu.sequence.auto_sp import auto_sp, plan_sp
+
+
+class TestAutoSPPlanning:
+    def test_disabled_without_seq_axis(self):
+        plan = plan_sp(num_heads=8, sp_size=1)
+        assert not plan.enabled and plan.mechanism == "none"
+
+    def test_ulysses_when_heads_divisible(self):
+        plan = plan_sp(num_heads=8, sp_size=4)
+        assert plan.enabled and plan.mechanism == "ulysses"
+
+    def test_ring_when_heads_indivisible(self):
+        plan = plan_sp(num_heads=6, sp_size=4)
+        assert plan.enabled and plan.mechanism == "ring"
+
+    def test_loss_tiling_for_long_seq(self):
+        plan = plan_sp(num_heads=8, seq_len=32768, sp_size=2)
+        assert plan.loss_tiles > 1
+
+    def test_plan_reads_live_mesh(self):
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        plan = plan_sp(num_heads=4)
+        assert plan.sp_size == 2 and plan.mechanism == "ulysses"
+
+
+class TestAutoSPInjection:
+    def test_rewritten_spec_trains(self):
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                                  num_layers=2, num_heads=4, max_seq_len=32)
+        config = {
+            "train_batch_size": 4, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 4, "seq": 2},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10 ** 9,
+        }
+        # mesh must exist before planning reads it
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        new_spec, plan = auto_sp(spec)
+        assert plan.mechanism == "ulysses"
+        assert "autosp" in new_spec.name
+        engine, *_ = dst.initialize(model=new_spec, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(4, 32)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        l0 = float(engine.train_batch(it))
+        for _ in range(3):
+            loss = engine.train_batch(it)
+        assert float(loss) < l0
+
+    def test_noop_without_sp(self):
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=8))
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                                  num_layers=2, num_heads=4, max_seq_len=32)
+        new_spec, plan = auto_sp(spec)
+        assert new_spec is spec and not plan.enabled
+
+
+class _FakeHFConfig:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class TestAutoEP:
+    def test_detect_zoo_config(self):
+        from deepspeed_tpu.models import transformer as T
+
+        cfg = T.get_model_config("tiny", n_experts=8, moe_top_k=2)
+        assert detect_moe(cfg) == (8, 2)
+
+    def test_detect_hf_mixtral_style(self):
+        cfg = _FakeHFConfig(num_local_experts=8, num_experts_per_tok=2)
+        assert detect_moe(cfg) == (8, 2)
+
+    def test_detect_dense(self):
+        assert detect_moe(_FakeHFConfig(hidden_size=32)) == (0, 0)
+
+    def test_plan_picks_common_divisor(self):
+        cfg = _FakeHFConfig(num_local_experts=8, num_experts_per_tok=2)
+        plan = plan_ep(cfg, n_devices=8)
+        assert plan.ep_size == 8
+        plan = plan_ep(cfg, n_devices=6)   # gcd-style: 2 divides both
+        assert plan.ep_size == 2
+        plan = plan_ep(cfg, n_devices=8, max_ep=4)
+        assert plan.ep_size == 4
+
+    def test_auto_ep_on_zoo_spec_trains(self):
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                                  num_layers=2, num_heads=4, max_seq_len=32,
+                                  n_experts=4, moe_top_k=2)
+        spec2, mesh_section, plan = auto_ep(spec, n_devices=8, max_ep=4)
+        assert plan.enabled and mesh_section == {"expert": 4}
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 2, **mesh_section},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec2, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(8, 32)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        l0 = float(engine.train_batch(it))
+        for _ in range(3):
+            loss = engine.train_batch(it)
+        assert float(loss) < l0
+
+    def test_auto_ep_via_hf_import(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        reset_mesh()
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, num_local_experts=4,
+            num_experts_per_tok=2, tie_word_embeddings=False)
+        torch.manual_seed(0)
+        model = transformers.MixtralForCausalLM(hf_cfg)
+        spec, mesh_section, plan = auto_ep(model, n_devices=8, max_ep=4)
+        assert plan.n_experts == 4 and mesh_section == {"expert": 4}
+        assert spec.config.n_experts == 4

@@ -1,0 +1,90 @@
+"""Elastic agent: supervised training with checkpoint-based recovery.
+
+Parity: reference ``elasticity/elastic_agent.py`` (``DSElasticAgent`` :32 —
+extends torch-elastic's ``LocalElasticAgent``: monitors workers, restarts
+them through the rendezvous on failure or scale events). On TPU there is no
+per-GPU worker fleet to babysit inside one host — failure modes are slice
+preemption/resize and software faults — so the agent is a **supervision
+loop**: run the training function; on a restartable failure, re-probe the
+device topology, rebuild the mesh-bound engine through the user's factory,
+reload the latest (topology-free) checkpoint, and continue. Batch-size
+compatibility across sizes comes from ``compute_elastic_config``
+(``elasticity.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class RestartableFailure(Exception):
+    """Raise inside the train step to request an agent-managed restart
+    (the analog of a worker failure reaching torch-elastic)."""
+
+
+@dataclasses.dataclass
+class ElasticAgentConfig:
+    max_restarts: int = 3                # torch-elastic max_restarts analog
+    restart_backoff_s: float = 1.0
+    reload_on_restart: bool = True
+
+
+class ElasticAgent:
+    """Supervises an elastic training run.
+
+    ``engine_factory(n_devices) -> engine`` must build a fresh engine for the
+    current topology (typically ``deepspeed_tpu.initialize`` with an elastic
+    batch config). ``train_fn(engine, start_step) -> None`` runs the loop and
+    is expected to checkpoint periodically to ``checkpoint_dir``.
+    """
+
+    def __init__(self, engine_factory: Callable[[int], Any],
+                 train_fn: Callable[[Any, int], None],
+                 checkpoint_dir: Optional[str] = None,
+                 config: Optional[ElasticAgentConfig] = None):
+        self.engine_factory = engine_factory
+        self.train_fn = train_fn
+        self.checkpoint_dir = checkpoint_dir
+        self.config = config or ElasticAgentConfig()
+        self.restarts = 0
+
+    def _build(self) -> Tuple[Any, int]:
+        import jax
+
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        n = jax.device_count()
+        engine = self.engine_factory(n)
+        start_step = 0
+        if self.checkpoint_dir and self.config.reload_on_restart:
+            try:
+                engine.load_checkpoint(self.checkpoint_dir)
+                start_step = engine.global_steps
+                log_dist(f"elastic agent: resumed at step {start_step}")
+            except FileNotFoundError:
+                log_dist("elastic agent: no checkpoint yet, cold start")
+        return engine, start_step
+
+    def run(self) -> Any:
+        """Run until train_fn returns; restart on RestartableFailure up to
+        ``max_restarts`` times. Returns the last engine."""
+        while True:
+            engine, start_step = self._build()
+            try:
+                self.train_fn(engine, start_step)
+                return engine
+            except RestartableFailure as e:
+                self.restarts += 1
+                if self.restarts > self.config.max_restarts:
+                    logger.error(
+                        f"elastic agent: giving up after {self.restarts - 1} "
+                        f"restarts: {e}")
+                    raise
+                logger.warning(
+                    f"elastic agent: restart {self.restarts}/"
+                    f"{self.config.max_restarts} after: {e}")
+                time.sleep(self.config.restart_backoff_s)

@@ -97,6 +97,33 @@ class WandbMonitor(Monitor):
             wandb.log({tag: float(value)}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Comet ML backend (reference ``monitor/comet.py``)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.experiment = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import comet_ml
+
+                self.experiment = comet_ml.Experiment(
+                    project_name=getattr(config, "project", None),
+                    workspace=getattr(config, "team", None))
+                name = getattr(config, "job_name", None)
+                if name:
+                    self.experiment.set_name(name)
+            except Exception as e:
+                logger.warning(f"comet_ml unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if self.experiment is None:
+            return
+        for tag, value, step in events:
+            self.experiment.log_metric(tag, float(value), step=step)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all enabled backends (reference ``monitor/monitor.py:30``)."""
 
@@ -106,6 +133,7 @@ class MonitorMaster(Monitor):
             (TensorBoardMonitor, ds_config.tensorboard),
             (csvMonitor, ds_config.csv_monitor),
             (WandbMonitor, ds_config.wandb),
+            (CometMonitor, ds_config.comet),
         ):
             if getattr(cfg, "enabled", False):
                 self.backends.append(backend_cls(cfg))

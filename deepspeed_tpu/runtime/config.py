@@ -225,6 +225,7 @@ class DeepSpeedTPUConfig:
     tensorboard: MonitorBackendConfig = dataclasses.field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = dataclasses.field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = dataclasses.field(default_factory=MonitorBackendConfig)
+    comet: MonitorBackendConfig = dataclasses.field(default_factory=MonitorBackendConfig)
     data_types: DataTypesConfig = dataclasses.field(default_factory=DataTypesConfig)
     mesh: MeshSectionConfig = dataclasses.field(default_factory=MeshSectionConfig)
     tensor_parallel: TensorParallelConfig = dataclasses.field(default_factory=TensorParallelConfig)

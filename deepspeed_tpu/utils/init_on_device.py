@@ -1,0 +1,71 @@
+"""OnDevice: construct models abstractly ("meta") or on a chosen device.
+
+Parity: reference ``utils/init_on_device.py`` (``OnDevice`` ctx — patches
+tensor constructors so huge models materialize on the meta device or a target
+device; used to defer allocation until ZeRO-3 partitioning is known).
+
+TPU translation: parameter construction is already functional — the engine
+calls ``jax.eval_shape`` on ``init_fn`` for planning and materializes
+directly INTO the sharded layout (``jax.jit(init, out_shardings=...)``), so
+the reference's deferred-allocation problem does not arise. This module
+provides the same *API shape* for user code:
+
+* ``OnDevice(device='meta')``: inside the context, :func:`materialize`
+  returns ``ShapeDtypeStruct`` trees (no memory);
+* ``OnDevice(device=...jax.Device..., dtype=...)``: materializes on that
+  device in that dtype.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+_CURRENT: list = []
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    def __init__(self, dtype: Any = None, device: Any = "meta",
+                 enabled: bool = True):
+        self.dtype = jnp.dtype(dtype) if dtype is not None else None
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        if self.enabled:
+            _CURRENT.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            _CURRENT.pop()
+        return False
+
+
+def current_on_device() -> Optional[OnDevice]:
+    return _CURRENT[-1] if _CURRENT else None
+
+
+def materialize(init_fn: Callable[[jax.Array], PyTree],
+                rng: Optional[jax.Array] = None) -> PyTree:
+    """Run ``init_fn`` honoring the active :class:`OnDevice` context."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ctx = current_on_device()
+    if ctx is None:
+        return init_fn(rng)
+    if ctx.device == "meta":
+        shapes = jax.eval_shape(init_fn, rng)
+        if ctx.dtype is not None:
+            shapes = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, ctx.dtype), shapes)
+        return shapes
+    out = jax.jit(init_fn)(rng)
+    if ctx.dtype is not None:
+        out = jax.tree.map(lambda x: x.astype(ctx.dtype), out)
+    if ctx.device is not None:
+        out = jax.device_put(out, ctx.device)
+    return out

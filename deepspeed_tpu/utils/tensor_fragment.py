@@ -1,0 +1,114 @@
+"""Tensor-fragment API: read/write full fp32 params, grads and optimizer
+state of a live engine by parameter path.
+
+Parity: reference ``utils/tensor_fragment.py`` (481 LoC mapping each rank's
+flat-buffer fragments back to parameters: ``safe_get_full_fp32_param``,
+``safe_set_full_fp32_param``, ``safe_get_full_optimizer_state``,
+``safe_set_full_optimizer_state``, ``safe_get_full_grad`` — the debugging /
+model-surgery API that hides ZeRO partitioning).
+
+TPU translation: state lives as *global* sharded ``jax.Array`` trees, so
+"defragmentation" is a gather (``device_get``) and a write is a sharded
+``device_put`` — no offset arithmetic. Paths are '/'-joined tree keys, e.g.
+``"blocks/wq"`` (list them with :func:`parameter_names`).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _walk(tree: PyTree, path: str):
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            node = node[part]
+    return node
+
+
+def _set(tree: PyTree, path: str, value) -> None:
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[int(part)] if isinstance(node, (list, tuple)) else node[part]
+    last = parts[-1]
+    if isinstance(node, list):
+        node[int(last)] = value
+    else:
+        node[last] = value
+
+
+def parameter_names(engine) -> List[str]:
+    """All '/'-joined parameter paths of the engine's master tree."""
+    out = []
+    for path, _ in jax.tree_util.tree_flatten_with_path(
+            engine.state["master"])[0]:
+        out.append("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path))
+    return out
+
+
+def safe_get_full_fp32_param(engine, name: str) -> np.ndarray:
+    """Full (gathered) fp32 master value of parameter ``name``
+    (reference ``safe_get_full_fp32_param``)."""
+    return np.asarray(jax.device_get(_walk(engine.state["master"], name)))
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """Overwrite a master parameter, preserving its sharded placement
+    (reference ``safe_set_full_fp32_param``)."""
+    current = _walk(engine.state["master"], name)
+    arr = jax.numpy.asarray(value, dtype=current.dtype)
+    if arr.shape != current.shape:
+        raise ValueError(f"shape mismatch for {name!r}: "
+                         f"{arr.shape} != {current.shape}")
+    placed = jax.device_put(arr, current.sharding)
+    _set(engine.state["master"], name, placed)
+
+
+def safe_get_full_optimizer_state(engine, name: str, state_key: str
+                                  ) -> np.ndarray:
+    """Full value of one optimizer moment (e.g. 'exp_avg') for ``name``
+    (reference ``safe_get_full_optimizer_state``)."""
+    moments = engine.state["opt"]
+    if state_key not in moments:
+        raise KeyError(f"optimizer has no state {state_key!r}; "
+                       f"available: {sorted(k for k in moments if k != 'step')}")
+    return np.asarray(jax.device_get(_walk(moments[state_key], name)))
+
+
+def safe_set_full_optimizer_state(engine, name: str, state_key: str,
+                                  value) -> None:
+    current = _walk(engine.state["opt"][state_key], name)
+    arr = jax.numpy.asarray(value, dtype=current.dtype)
+    if arr.shape != current.shape:
+        raise ValueError(f"shape mismatch for {name}/{state_key}: "
+                         f"{arr.shape} != {current.shape}")
+    _set(engine.state["opt"][state_key], name, jax.device_put(arr, current.sharding))
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Accumulated gradient for ``name`` from the eager path's buffer
+    (None when no grads are buffered — e.g. the fused train_batch path
+    applies grads inside one program and never exposes them; reference
+    ``safe_get_full_grad`` similarly requires grads to still exist)."""
+    buf = getattr(engine, "_grad_buffer", None)
+    if buf is None:
+        return None
+    return np.asarray(jax.device_get(_walk(buf, name)))
+
+
+def state_summary(engine) -> Dict[str, Dict[str, Any]]:
+    """{param: {shape, dtype, sharding}} — debugging aid."""
+    out = {}
+    for name in parameter_names(engine):
+        leaf = _walk(engine.state["master"], name)
+        out[name] = {"shape": tuple(leaf.shape), "dtype": str(leaf.dtype),
+                     "sharding": str(getattr(leaf, "sharding", None))}
+    return out

@@ -1,0 +1,169 @@
+"""Elastic agent, OnDevice, tensor-fragment, Comet monitor tests
+(reference ``tests/unit/elasticity``, ``utils`` coverage)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm.mesh import reset_mesh
+from deepspeed_tpu.elasticity.elastic_agent import (
+    ElasticAgent,
+    ElasticAgentConfig,
+    RestartableFailure,
+)
+from deepspeed_tpu.utils.init_on_device import OnDevice, materialize
+from deepspeed_tpu.utils import tensor_fragment as tf
+
+
+def _spec():
+    return dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                              num_layers=2, num_heads=4, max_seq_len=32)
+
+
+def _config():
+    return {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10 ** 9,
+    }
+
+
+def _batch():
+    return {"tokens": np.random.RandomState(0).randint(
+        0, 256, size=(8, 32)).astype(np.int32)}
+
+
+class TestElasticAgent:
+    def test_recovers_from_failure_and_resumes(self, tmp_path):
+        ckpt = str(tmp_path)
+        batch = _batch()
+        crashes = {"n": 0}
+
+        def factory(n_devices):
+            engine, *_ = dst.initialize(model=_spec(), config=_config())
+            return engine
+
+        def train_fn(engine, start_step):
+            it = iter(lambda: batch, None)
+            for step in range(start_step, 6):
+                engine.train_batch(it)
+                engine.save_checkpoint(ckpt)
+                if step == 2 and crashes["n"] == 0:
+                    crashes["n"] += 1
+                    raise RestartableFailure("simulated preemption")
+
+        agent = ElasticAgent(factory, train_fn, checkpoint_dir=ckpt,
+                             config=ElasticAgentConfig(restart_backoff_s=0.0))
+        engine = agent.run()
+        assert agent.restarts == 1
+        assert engine.global_steps == 6
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        def factory(n):
+            engine, *_ = dst.initialize(model=_spec(), config=_config())
+            return engine
+
+        def train_fn(engine, start_step):
+            raise RestartableFailure("always broken")
+
+        agent = ElasticAgent(
+            factory, train_fn, checkpoint_dir=None,
+            config=ElasticAgentConfig(max_restarts=2, restart_backoff_s=0.0))
+        with pytest.raises(RestartableFailure):
+            agent.run()
+        assert agent.restarts == 3
+
+
+class TestOnDevice:
+    def test_meta_returns_shapes(self):
+        spec = _spec()
+        with OnDevice(device="meta"):
+            out = materialize(spec.init_fn)
+        leaves = jax.tree.leaves(
+            out, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+
+    def test_meta_with_dtype_override(self):
+        spec = _spec()
+        with OnDevice(dtype="bfloat16", device="meta"):
+            out = materialize(spec.init_fn)
+        assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(
+            out, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)))
+
+    def test_no_context_materializes(self):
+        spec = _spec()
+        out = materialize(spec.init_fn)
+        assert all(isinstance(l, jax.Array) for l in jax.tree.leaves(out))
+
+
+class TestTensorFragment:
+    def test_get_set_roundtrip(self):
+        reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=_config())
+        names = tf.parameter_names(engine)
+        assert "tok_emb" in names and any("wq" in n for n in names)
+
+        emb = tf.safe_get_full_fp32_param(engine, "tok_emb")
+        assert emb.dtype == np.float32
+        new = np.zeros_like(emb)
+        tf.safe_set_full_fp32_param(engine, "tok_emb", new)
+        np.testing.assert_array_equal(
+            tf.safe_get_full_fp32_param(engine, "tok_emb"), 0.0)
+
+    def test_optimizer_state_access(self):
+        reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=_config())
+        it = iter(lambda: _batch(), None)
+        engine.train_batch(it)
+        m = tf.safe_get_full_optimizer_state(engine, "tok_emb", "exp_avg")
+        assert np.abs(m).max() > 0
+        with pytest.raises(KeyError):
+            tf.safe_get_full_optimizer_state(engine, "tok_emb", "nope")
+
+    def test_shape_mismatch_rejected(self):
+        reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=_config())
+        with pytest.raises(ValueError):
+            tf.safe_set_full_fp32_param(engine, "tok_emb", np.zeros((2, 2)))
+
+    def test_grad_buffer_access(self):
+        reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=_config())
+        assert tf.safe_get_full_grad(engine, "tok_emb") is None
+        engine.forward(_batch())
+        engine.backward()
+        g = tf.safe_get_full_grad(engine, "tok_emb")
+        assert g is not None and np.abs(g).max() > 0
+
+    def test_state_summary(self):
+        reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=_config())
+        summary = tf.state_summary(engine)
+        assert summary["tok_emb"]["dtype"] == "float32"
+
+
+class TestCometMonitor:
+    def test_disabled_gracefully_without_comet(self):
+        from deepspeed_tpu.monitor.monitor import CometMonitor
+
+        class Cfg:
+            enabled = True
+            project = "p"
+            team = None
+            job_name = "j"
+
+        mon = CometMonitor(Cfg())
+        # comet_ml not installed in this image → must disable, not raise
+        assert mon.enabled is False
+        mon.write_events([("a", 1.0, 1)])  # no-op
+
+    def test_master_includes_comet_section(self):
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        from deepspeed_tpu.runtime.config import load_config
+
+        cfg = load_config({"comet": {"enabled": False},
+                           "csv_monitor": {"enabled": False}})
+        master = MonitorMaster(cfg)
+        assert master.enabled is False

@@ -1,0 +1,201 @@
+"""Pluggable checkpoint engines: orbax (default), fast (C++ aio writer),
+decoupled (background-thread async).
+
+Parity: reference ``runtime/checkpoint_engine/`` — ``CheckpointEngine`` ABC
+(``checkpoint_engine.py:21``: create/save/load/commit), ``TorchCheckpointEngine``,
+``FastCheckpointEngine`` (``fast_checkpoint_engine.py:16`` — double-buffered
+native writers from ``deepspeed/io``), ``DecoupledCheckpointEngine``
+(``decoupled_checkpoint_engine.py:78`` — a separate writer process draining a
+queue). Selected by config ``checkpoint.writer`` (orbax | fast | decoupled).
+
+TPU mapping:
+
+* **orbax** — the TorchCheckpointEngine analog and the default: sharded
+  global-array I/O, GCS-aware (used by ``checkpoint/engine.py``).
+* **fast** — per-host flat binary dumps through the ``csrc/aio`` C++ thread
+  pool (``build/libdstpu_aio.so``): tensors are staged to host numpy, then
+  written by N native threads with the python thread free to continue —
+  the double-buffered-writer design, for local NVMe scratch on TPU VMs.
+* **decoupled** — wraps any engine; save() enqueues and returns immediately,
+  a daemon thread drains; commit semantics via ``wait()``.
+
+All engines write a self-describing directory: ``manifest.json`` (tree paths,
+shapes, dtypes) + one ``.bin`` per leaf (fast) or the orbax tree.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+
+class CheckpointEngine:
+    """ABC (reference ``checkpoint_engine.py:21``)."""
+
+    def save(self, state: PyTree, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, template: PyTree) -> PyTree:
+        raise NotImplementedError
+
+    def wait(self) -> None:
+        """Block until queued saves are durable (commit analog)."""
+
+    def close(self) -> None:
+        self.wait()
+
+
+def _flatten_with_paths(tree: PyTree):
+    import jax
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        yield name, leaf
+
+
+def _unflatten_like(template: PyTree, flat: Dict[str, np.ndarray]) -> PyTree:
+    import jax
+
+    def one(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        return flat[name]
+
+    return jax.tree_util.tree_map_with_path(one, template)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Default sharded-array engine (delegates to orbax PyTreeCheckpointer)."""
+
+    def save(self, state: PyTree, path: str) -> None:
+        import orbax.checkpoint as ocp
+
+        ocp.PyTreeCheckpointer().save(os.path.abspath(path), state, force=True)
+
+    def load(self, path: str, template: PyTree) -> PyTree:
+        import orbax.checkpoint as ocp
+
+        return ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+
+
+class FastCheckpointEngine(CheckpointEngine):
+    """Native-writer engine over the csrc/aio thread pool.
+
+    Stages device arrays to host, then hands each leaf's bytes to the C++
+    async writer; ``save`` returns once writes are *queued* (call ``wait``
+    for durability — the reference's double-buffer flush)."""
+
+    def __init__(self, n_threads: int = 4):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        self.handle = AsyncIOHandle(n_threads=n_threads)
+
+    def save(self, state: PyTree, path: str) -> None:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        manifest = {}
+        host_state = jax.device_get(state)
+        self._staged = []  # keep buffers alive until wait()
+        for name, leaf in _flatten_with_paths(host_state):
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            # bfloat16 etc. → raw bytes tagged with the jax dtype name
+            dtype_name = str(arr.dtype)
+            raw = arr.view(np.uint8).reshape(-1)
+            fname = name.replace("/", "__") + ".bin"
+            manifest[name] = {"shape": list(arr.shape), "dtype": dtype_name,
+                              "file": fname}
+            self._staged.append(raw)
+            self.handle.async_pwrite(raw, os.path.join(path, fname))
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+
+    def wait(self) -> None:
+        self.handle.wait_all()
+        self._staged = []
+
+    def load(self, path: str, template: PyTree) -> PyTree:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        flat = {}
+        for name, info in manifest.items():
+            nbytes = int(np.prod(info["shape"]) or 1) * \
+                np.dtype(info["dtype"]).itemsize
+            buf = np.empty(nbytes, np.uint8)
+            self.handle.async_pread(buf, os.path.join(path, info["file"]))
+            flat[name] = (buf, info)
+        self.handle.wait_all()
+        out = {}
+        for name, (buf, info) in flat.items():
+            out[name] = buf.view(np.dtype(info["dtype"])).reshape(info["shape"])
+        return _unflatten_like(template, out)
+
+
+class DecoupledCheckpointEngine(CheckpointEngine):
+    """Async wrapper: save() enqueues + returns; a daemon drains the queue
+    (reference ``DecoupledCheckpointEngine`` — separate process there, a
+    writer thread here; the GIL is released inside orbax/aio I/O)."""
+
+    def __init__(self, inner: Optional[CheckpointEngine] = None,
+                 max_queue: int = 2):
+        self.inner = inner or OrbaxCheckpointEngine()
+        self.queue: "queue.Queue[Optional[Tuple[PyTree, str]]]" = \
+            queue.Queue(maxsize=max_queue)
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self.queue.get()
+            if item is None:
+                self.queue.task_done()
+                return
+            state, path = item
+            try:
+                self.inner.save(state, path)
+                self.inner.wait()
+            except BaseException as e:  # surfaced on next wait()
+                self._err = e
+            finally:
+                self.queue.task_done()
+
+    def save(self, state: PyTree, path: str) -> None:
+        import jax
+
+        # snapshot to host so donation/updates can't mutate queued state
+        self.queue.put((jax.device_get(state), path))
+
+    def wait(self) -> None:
+        self.queue.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def load(self, path: str, template: PyTree) -> PyTree:
+        self.wait()
+        return self.inner.load(path, template)
+
+    def close(self) -> None:
+        self.wait()
+        self.queue.put(None)
+        self._thread.join(timeout=10)
+
+
+def get_checkpoint_engine(name: str, **kw) -> CheckpointEngine:
+    name = (name or "orbax").lower()
+    if name in ("orbax", "torch", "default"):
+        return OrbaxCheckpointEngine()
+    if name == "fast":
+        return FastCheckpointEngine(**kw)
+    if name == "decoupled":
+        return DecoupledCheckpointEngine(**kw)
+    raise ValueError(f"unknown checkpoint engine {name!r}; "
+                     "supported: orbax | fast | decoupled")

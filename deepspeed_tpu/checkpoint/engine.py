@@ -45,17 +45,33 @@ def _finalize_async() -> None:
 
 def save_state(save_dir: str, tag: str, state: PyTree,
                client_state: Optional[Dict] = None, save_latest: bool = True,
-               async_save: bool = False) -> None:
+               async_save: bool = False, writer: str = "orbax") -> None:
     """``async_save=True`` returns immediately with the write in flight — the
     reference's decoupled/fast checkpoint engines
     (``runtime/checkpoint_engine/decoupled_checkpoint_engine.py:78``,
     ``fast_checkpoint_engine.py:16``); orbax's async checkpointer provides the
-    double-buffered background writer."""
+    double-buffered background writer. ``writer='fast'`` routes through the
+    C++ aio thread-pool engine (``checkpoint/checkpoint_engine.py``)."""
     import orbax.checkpoint as ocp
 
     global _async_ckptr, _async_pending
     path = os.path.abspath(_tag_dir(save_dir, tag))
     os.makedirs(path, exist_ok=True)
+    if writer == "fast":
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            FastCheckpointEngine,
+        )
+
+        eng = FastCheckpointEngine()
+        eng.save(state, os.path.join(path, "state_fast"))
+        eng.wait()
+        if _is_primary():
+            with open(os.path.join(path, "client_state.json"), "w") as f:
+                json.dump(client_state or {}, f, default=str)
+            if save_latest:
+                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                    f.write(tag)
+        return
     if async_save:
         _finalize_async()  # at most one save in flight
         if _async_ckptr is None:
@@ -91,6 +107,21 @@ def load_state(load_dir: str, tag: Optional[str], template_state: PyTree,
     if tag is None:
         raise FileNotFoundError(f"no 'latest' tag file in {load_dir}")
     path = os.path.abspath(_tag_dir(load_dir, tag))
+    fast_path = os.path.join(path, "state_fast")
+    if os.path.isdir(fast_path):
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            FastCheckpointEngine,
+        )
+
+        restored = FastCheckpointEngine().load(fast_path, template_state)
+        restored = jax.tree.map(
+            lambda x, sh: jax.device_put(x, sh), restored, shardings)
+        client_state: Dict = {}
+        cs_path = os.path.join(path, "client_state.json")
+        if os.path.exists(cs_path):
+            with open(cs_path) as f:
+                client_state = json.load(f)
+        return restored, client_state
     state_path = os.path.join(path, "state")
     if not os.path.exists(state_path):
         raise FileNotFoundError(f"checkpoint not found: {state_path}")

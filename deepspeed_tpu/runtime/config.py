@@ -233,6 +233,7 @@ class DeepSpeedTPUConfig:
     seed: int = 1234
     zero_force_ds_cpu_optimizer: bool = False
     checkpoint_tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    checkpoint_writer: str = "orbax"  # orbax | fast (checkpoint_engine.py)
 
     # resolved fields (filled by _resolve_batch_size)
     _dp_world_size: int = 1

@@ -624,7 +624,8 @@ class DeepSpeedTPUEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
         })
         save_state(save_dir, tag, self.state, client_state,
-                   save_latest=save_latest, async_save=async_save)
+                   save_latest=save_latest, async_save=async_save,
+                   writer=self.config.checkpoint_writer)
         log_dist(f"saved checkpoint {save_dir}/{tag}"
                  + (" (async, in flight)" if async_save else ""))
 

@@ -1,0 +1,115 @@
+"""Pluggable checkpoint-engine tests (reference
+``tests/unit/checkpoint/test_*_engine``)."""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.checkpoint_engine import (
+    DecoupledCheckpointEngine,
+    FastCheckpointEngine,
+    OrbaxCheckpointEngine,
+    get_checkpoint_engine,
+)
+
+
+def _state(key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 2)
+    return {
+        "master": {"w": jax.random.normal(ks[0], (64, 32)),
+                   "b": jax.random.normal(ks[1], (32,)).astype(jnp.bfloat16)},
+        "step": jnp.int32(7),
+    }
+
+
+def _assert_state_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x, np.float32),
+                                      np.asarray(y, np.float32))
+
+
+class TestEngines:
+    @pytest.mark.parametrize("name", ["orbax", "fast", "decoupled"])
+    def test_roundtrip(self, name, tmp_path):
+        eng = get_checkpoint_engine(name)
+        state = _state()
+        path = str(tmp_path / "ckpt")
+        eng.save(state, path)
+        eng.wait()
+        restored = eng.load(path, state)
+        _assert_state_equal(state, restored)
+        eng.close()
+
+    def test_fast_preserves_bfloat16(self, tmp_path):
+        eng = FastCheckpointEngine()
+        state = _state()
+        path = str(tmp_path / "ckpt")
+        eng.save(state, path)
+        eng.wait()
+        restored = eng.load(path, state)
+        assert restored["master"]["b"].dtype == jnp.bfloat16
+        assert os.path.exists(os.path.join(path, "manifest.json"))
+
+    def test_decoupled_save_is_async(self, tmp_path):
+        eng = DecoupledCheckpointEngine(inner=FastCheckpointEngine())
+        big = {"w": jnp.ones((2048, 2048), jnp.float32)}
+        t0 = time.perf_counter()
+        eng.save(big, str(tmp_path / "a"))
+        enqueue_time = time.perf_counter() - t0
+        eng.wait()
+        # enqueue must be much faster than a 16MB durable write
+        restored = eng.load(str(tmp_path / "a"), big)
+        _assert_state_equal(big, restored)
+        assert enqueue_time < 1.0
+        eng.close()
+
+    def test_decoupled_surfaces_errors_on_wait(self):
+        class Broken(OrbaxCheckpointEngine):
+            def save(self, state, path):
+                raise IOError("disk gone")
+
+        eng = DecoupledCheckpointEngine(inner=Broken())
+        eng.save({"w": jnp.ones(2)}, "/nonexistent-dir-xyz/x")
+        with pytest.raises(IOError):
+            eng.wait()
+        eng.close()
+
+    def test_unknown_engine_rejected(self):
+        with pytest.raises(ValueError):
+            get_checkpoint_engine("nope")
+
+
+class TestEngineFastWriter:
+    def test_engine_checkpoint_with_fast_writer(self, tmp_path):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                                  num_layers=2, num_heads=4, max_seq_len=32)
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "checkpoint_writer": "fast",
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(8, 32)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        for _ in range(2):
+            engine.train_batch(it)
+        engine.save_checkpoint(str(tmp_path))
+        assert os.path.isdir(os.path.join(
+            tmp_path, "global_step2", "state_fast"))
+        l1 = float(engine.eval_batch(batch))
+
+        reset_mesh()
+        e2, *_ = dst.initialize(model=spec, config=config)
+        e2.load_checkpoint(str(tmp_path))
+        assert e2.global_steps == 2
+        np.testing.assert_allclose(float(e2.eval_batch(batch)), l1, rtol=1e-5)

@@ -1,0 +1,31 @@
+"""Packaging for deepspeed_tpu (reference setup.py + bin/ console scripts).
+
+The op-builder story differs from the reference by design: the only native
+component built at install time is the aio library (csrc/aio), compiled
+lazily on first use by ``deepspeed_tpu/ops/aio.py``; TPU kernels are Pallas
+(no compilation step).
+"""
+from setuptools import find_packages, setup
+
+setup(
+    name="deepspeed_tpu",
+    version="0.1.0",
+    description="TPU-native distributed training & inference framework "
+                "(DeepSpeed-compatible API on JAX/XLA/Pallas)",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy", "orbax-checkpoint", "einops"],
+    extras_require={
+        "hf": ["transformers", "torch"],
+        "monitor": ["tensorboardX", "wandb", "comet-ml"],
+    },
+    entry_points={
+        "console_scripts": [
+            "dstpu=deepspeed_tpu.launcher.runner:main",
+            "dstpu_report=deepspeed_tpu.env_report:main",
+            "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
+        ],
+    },
+    scripts=["bin/dstpu", "bin/dstpu_report", "bin/dstpu_bench",
+             "bin/dstpu_elastic", "bin/dstpu_io"],
+)

@@ -1,0 +1,182 @@
+"""Config-driven compression front door.
+
+Parity: reference ``deepspeed/compression/compress.py``
+(``init_compression`` — walks the ``compression_training`` config section,
+matches module groups by name patterns, and swaps in compressed layers) and
+``redundancy_clean`` (materializes pruning after training).
+
+TPU translation: compression is a **spec transform** — the same JSON schema
+(``weight_quantization``, ``activation_quantization``, ``sparse_pruning``,
+``row_pruning``, ``head_pruning``, ``layer_reduction`` groups with
+``modules`` patterns and ``schedule_offset``\\s) configures pure-functional
+passes: fake-quant wrapping (``quantize.py``), pruning masks applied inside
+the forward (``pruning.py``), and scan-stack layer gathering
+(``distillation.reduce_layers``).
+
+Example config (same keys as the reference docs)::
+
+    {"compression_training": {
+        "weight_quantization": {"shared_parameters": {"enabled": true},
+            "different_groups": {"wq1": {"params": {"target_bits": 8},
+                                         "modules": ["attn", "mlp"]}}},
+        "sparse_pruning": {"shared_parameters": {"enabled": true,
+                                                 "schedule_offset": 1000},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["mlp"]}}}}}
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.compression.pruning import (
+    PruningScheduler,
+    PruningSpec,
+    apply_masks,
+    compute_masks,
+)
+from deepspeed_tpu.compression.quantize import quantize_param_tree
+from deepspeed_tpu.utils.logging import log_dist
+
+PyTree = Any
+
+
+def _groups(section: Optional[Dict]) -> List[Tuple[str, Dict, List[str]]]:
+    """→ [(group_name, params, module_patterns)] for an enabled section."""
+    if not section:
+        return []
+    shared = section.get("shared_parameters", {})
+    if not shared.get("enabled", False):
+        return []
+    out = []
+    for name, grp in section.get("different_groups", {}).items():
+        out.append((name, grp.get("params", {}),
+                    [str(m) for m in grp.get("modules", ["*"])]))
+    return out
+
+
+def _patterns_to_regex(mods: List[str]) -> str:
+    import re as _re
+
+    parts = [".*" if m == "*" else _re.escape(m).replace(r"\*", ".*")
+             for m in mods]
+    return "|".join(parts) or ".*"
+
+
+@dataclasses.dataclass
+class CompressionPlan:
+    """Resolved passes from a compression_training section."""
+
+    quant_groups: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    pruning_specs: Tuple[PruningSpec, ...] = ()
+    layer_reduction: Optional[Dict] = None
+    schedule_offset: int = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.quant_groups or self.pruning_specs
+                    or self.layer_reduction)
+
+
+def plan_compression(ds_config: Dict) -> CompressionPlan:
+    """Parse the reference-schema config into a plan (init_compression's
+    config walk)."""
+    section = ds_config.get("compression_training", {}) or {}
+    plan = CompressionPlan()
+
+    for name, params, mods in _groups(section.get("weight_quantization")):
+        bits = int(params.get("target_bits", params.get("start_bits", 8)))
+        plan.quant_groups.append((bits, _patterns_to_regex(mods)))
+
+    specs: List[PruningSpec] = []
+    for method, key, ratio_key in (
+            ("sparse", "sparse_pruning", "dense_ratio"),
+            ("row", "row_pruning", "dense_ratio"),
+            ("head", "head_pruning", "dense_ratio")):
+        sec = section.get(key)
+        shared = (sec or {}).get("shared_parameters", {})
+        offset = int(shared.get("schedule_offset", 0))
+        for name, params, mods in _groups(sec):
+            dense = float(params.get(ratio_key, 0.5))
+            specs.append(PruningSpec(
+                pattern=_patterns_to_regex(mods), method=method,
+                scheduler=PruningScheduler(
+                    target_ratio=1.0 - dense, schedule_offset=offset),
+                num_heads=int(params.get("num_heads", 1))))
+    plan.pruning_specs = tuple(specs)
+
+    lr = section.get("layer_reduction", {})
+    if lr.get("enabled", False):
+        plan.layer_reduction = {
+            "keep_number_layer": lr.get("keep_number_layer"),
+            "teacher_layer": lr.get("teacher_layer"),
+        }
+    return plan
+
+
+def init_compression(spec, ds_config: Dict, step_fn=None):
+    """Apply the compression plan to a ModelSpec (the reference's
+    ``init_compression(model, config)``).
+
+    Returns a new spec whose forward fake-quantizes configured weights and
+    applies pruning masks (re-derived from the live weights at the step given
+    by ``step_fn()``, default 0 — masks ramp per the schedule). Layer
+    reduction (when configured) gathers the student layers up front.
+    """
+    import dataclasses as _dc
+
+    plan = plan_compression(ds_config)
+    if not plan.enabled:
+        return spec
+    log_dist(f"compression: quant_groups={len(plan.quant_groups)} "
+             f"pruning_specs={len(plan.pruning_specs)} "
+             f"layer_reduction={bool(plan.layer_reduction)}")
+
+    base_init = spec.init_fn
+    if plan.layer_reduction and plan.layer_reduction["teacher_layer"]:
+        from deepspeed_tpu.compression.distillation import reduce_layers
+
+        keep = list(plan.layer_reduction["teacher_layer"])
+        n_layers = spec.config.num_layers if spec.config else None
+
+        def init_fn(rng):
+            return reduce_layers(base_init(rng), keep, num_layers=n_layers)
+    else:
+        init_fn = base_init
+
+    step_fn = step_fn or (lambda: 0)
+
+    def transform(params):
+        out = params
+        for bits, pattern in plan.quant_groups:
+            out = quantize_param_tree(out, bits=bits, pattern=pattern)
+        if plan.pruning_specs:
+            masks = compute_masks(out, plan.pruning_specs, step=step_fn())
+            out = apply_masks(out, masks)
+        return out
+
+    base_loss, base_apply = spec.loss_fn, spec.apply_fn
+    new = _dc.replace(
+        spec, init_fn=init_fn,
+        loss_fn=lambda p, b: base_loss(transform(p), b),
+        apply_fn=(lambda p, b: base_apply(transform(p), b))
+        if base_apply else None,
+        name=spec.name + "+compressed")
+    return new
+
+
+def redundancy_clean(params: PyTree, ds_config: Dict,
+                     step: Optional[int] = None) -> PyTree:
+    """Materialize the compression into the weights (reference
+    ``redundancy_clean`` — run after training to bake masks/quant in)."""
+    plan = plan_compression(ds_config)
+    out = params
+    for bits, pattern in plan.quant_groups:
+        out = quantize_param_tree(out, bits=bits, pattern=pattern)
+    if plan.pruning_specs:
+        big = step if step is not None else 10 ** 9
+        masks = compute_masks(out, plan.pruning_specs, step=big)
+        out = apply_masks(out, masks)
+    return jax.tree.map(lambda x: x, out)

@@ -1,0 +1,99 @@
+"""Config-driven compression API tests (reference
+``tests/unit/compression/test_compression.py`` config schema)."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.compression.compress import (
+    init_compression,
+    plan_compression,
+    redundancy_clean,
+)
+
+
+def _spec():
+    return dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                              num_layers=4, num_heads=4, max_seq_len=32)
+
+
+CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8},
+                        "modules": ["blocks"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["w_up"]}}},
+    }
+}
+
+
+class TestPlan:
+    def test_parses_groups(self):
+        plan = plan_compression(CONFIG)
+        assert plan.enabled
+        assert plan.quant_groups == [(8, "blocks")]
+        assert len(plan.pruning_specs) == 1
+        assert plan.pruning_specs[0].method == "sparse"
+        assert plan.pruning_specs[0].scheduler.target_ratio == pytest.approx(0.5)
+
+    def test_disabled_sections_ignored(self):
+        cfg = {"compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": False},
+                "different_groups": {"g": {"params": {}, "modules": ["x"]}}}}}
+        assert not plan_compression(cfg).enabled
+
+    def test_empty_config(self):
+        assert not plan_compression({}).enabled
+
+
+class TestInitCompression:
+    def test_noop_without_config(self):
+        spec = _spec()
+        assert init_compression(spec, {}) is spec
+
+    def test_compressed_spec_trains(self):
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = init_compression(_spec(), CONFIG)
+        assert "compressed" in spec.name
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(8, 32)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        l0 = float(engine.train_batch(it))
+        for _ in range(3):
+            loss = engine.train_batch(it)
+        assert float(loss) < l0
+
+    def test_layer_reduction(self):
+        cfg = {"compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                                "teacher_layer": [0, 2]}}}
+        spec = init_compression(_spec(), cfg)
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        assert params["blocks"]["wq"].shape[0] == 2
+
+
+class TestRedundancyClean:
+    def test_bakes_pruning_in(self):
+        spec = _spec()
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        cleaned = redundancy_clean(params, CONFIG)
+        w = np.asarray(cleaned["blocks"]["w_up"])
+        assert (w == 0).mean() > 0.45        # ~50% sparse
+        norm = np.asarray(cleaned["blocks"]["ln1"]["scale"])
+        assert (norm != 0).all()             # norms untouched

@@ -76,6 +76,10 @@ def resolve_attention(attention: Optional[str]):
         from deepspeed_tpu.sequence import chunked_attention
 
         return chunked_attention
+    if attention == "fpdt":
+        from deepspeed_tpu.sequence.tiled import fpdt_attention
+
+        return fpdt_attention
     if attention.startswith("sparse"):
         # 'sparse' | 'sparse:fixed' | 'sparse:bigbird' | 'sparse:bslongformer'
         # (reference ops/sparse_attention SparseSelfAttention patterns)

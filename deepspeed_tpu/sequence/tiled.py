@@ -144,3 +144,99 @@ def chunked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         chunk_body = jax.checkpoint(chunk_body)
     _, chunks = lax.scan(chunk_body, None, (jnp.arange(num_chunks), qc))
     return chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, N, D)
+
+
+def _memory_constraint(x: jax.Array, kind: str) -> jax.Array:
+    """Move an intermediate to a memory kind ('pinned_host'/'device', TPU
+    memories API, jit-traceable device_put); identity where unsupported
+    (CPU test backend)."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return x
+    try:
+        return jax.device_put(x, jax.sharding.TransferToMemoryKind(kind))
+    except Exception:  # memories API unavailable on this backend/version
+        return x
+
+
+def _host_constraint(x: jax.Array) -> jax.Array:
+    return _memory_constraint(x, "pinned_host")
+
+
+def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True, segment_mask=None,
+                   num_chunks: int = 4, kv_chunks: int = 4,
+                   offload_kv: bool = True, remat: bool = True) -> jax.Array:
+    """FPDT attention with host-offloaded KV (``sequence/fpdt_layer.py``
+    ``_FPDTGPUOffloadingAttentionImpl_`` :545 analog).
+
+    The full K/V live in **pinned host memory**; the scan walks (q-chunk,
+    kv-chunk) pairs with online-softmax accumulation, so device HBM holds one
+    [B, C, N, D] KV chunk at a time — the multi-million-token recipe. XLA
+    emits the host↔device DMAs from the memory-kind constraints and its
+    scheduler overlaps the next chunk's fetch with the current chunk's
+    matmuls (the reference's double-buffered prefetch, compiler-scheduled).
+    On non-TPU backends the host constraint is an identity and the math is
+    unchanged.
+    """
+    import math
+
+    if segment_mask is not None:
+        raise NotImplementedError("segment_mask unsupported in FPDT attention")
+    B, S, N, D = q.shape
+    K = k.shape[2]
+    if K != N:
+        k = jnp.repeat(k, N // K, axis=2)
+        v = jnp.repeat(v, N // K, axis=2)
+    if (num_chunks <= 1 or S % num_chunks or kv_chunks <= 1
+            or S % kv_chunks):
+        return chunked_attention(q, k, v, causal=causal,
+                                 num_chunks=max(num_chunks, 1), remat=remat)
+    C = S // num_chunks
+    CK = S // kv_chunks
+    scale = 1.0 / math.sqrt(D)
+
+    kh = k.reshape(B, kv_chunks, CK, N, D).transpose(1, 0, 2, 3, 4)
+    vh = v.reshape(B, kv_chunks, CK, N, D).transpose(1, 0, 2, 3, 4)
+    if offload_kv:
+        kh = _host_constraint(kh)
+        vh = _host_constraint(vh)
+    qc = q.reshape(B, num_chunks, C, N, D).transpose(1, 0, 2, 3, 4)
+
+    def q_body(_, operand):
+        qi_idx, qi = operand                      # qi: [B, C, N, D]
+        q32 = qi.astype(jnp.float32)
+        q_pos = qi_idx * C + jnp.arange(C)
+
+        def kv_body(carry, kv_operand):
+            acc, m, l = carry
+            kj_idx, kj, vj = kv_operand           # [B, CK, N, D]
+            if offload_kv:
+                # pull ONE chunk into device HBM (the streamed fetch)
+                kj = _memory_constraint(kj, "device")
+                vj = _memory_constraint(vj, "device")
+            kj = kj.astype(jnp.float32)
+            vj = vj.astype(jnp.float32)
+            s = jnp.einsum("bcnd,btnd->bnct", q32, kj) * scale
+            if causal:
+                kv_pos = kj_idx * CK + jnp.arange(CK)
+                mask = q_pos[:, None] >= kv_pos[None, :]
+                s = jnp.where(mask[None, None], s, -1e30)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum("bnct,btnd->bnc d".replace(" ", ""), p, vj)
+            return (acc_new, m_new, l_new), None
+
+        init = (jnp.zeros((B, N, C, D), jnp.float32),
+                jnp.full((B, N, C, 1), -1e30, jnp.float32),
+                jnp.zeros((B, N, C, 1), jnp.float32))
+        (acc, m, l), _ = lax.scan(
+            kv_body, init, (jnp.arange(kv_chunks), kh, vh))
+        out = acc / jnp.maximum(l, 1e-30)
+        return None, out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, C, N, D]
+
+    if remat:
+        q_body = jax.checkpoint(q_body)
+    _, chunks = lax.scan(q_body, None, (jnp.arange(num_chunks), qc))
+    return chunks.transpose(1, 0, 2, 3, 4).reshape(B, S, N, D)

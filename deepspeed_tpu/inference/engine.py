@@ -176,12 +176,20 @@ class InferenceEngine:
         return self._compiled["forward"](self.params, jnp.asarray(tokens))
 
 
-def init_inference(model: Union[str, T.TransformerConfig],
+def init_inference(model: Any,
                    params: Optional[PyTree] = None,
                    config: Optional[Dict] = None, **kwargs) -> InferenceEngine:
-    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:328``)."""
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:328``).
+
+    ``model``: zoo preset name, TransformerConfig, or a HuggingFace model /
+    ``(state_dict, config)`` pair (imported via ``models/hf_import.py`` —
+    the kernel-injection analog)."""
     config = dict(config or {})
     config.update(kwargs)
+    if not isinstance(model, (str, T.TransformerConfig)):
+        from deepspeed_tpu.models.hf_import import import_hf_model
+
+        model, params = import_hf_model(model, arch=config.pop("arch", None))
     dtype = config.pop("dtype", None)
     max_seq_len = config.pop("max_out_tokens", None)
     config.pop("replace_with_kernel_inject", None)  # kernels are default here

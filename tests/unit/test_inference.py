@@ -170,3 +170,19 @@ class TestRaggedEngine:
         ragged.put([3], [[5, 6]])
         done, toks = ragged.query(3)
         assert len(toks) == 1
+
+
+class TestInitInferenceHF:
+    def test_accepts_hf_model_directly(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        from deepspeed_tpu.inference.engine import init_inference
+
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+        torch.manual_seed(0)
+        model = transformers.GPT2LMHeadModel(hf_cfg)
+        eng = init_inference(model, dtype="float32")
+        out = eng.generate([[3, 1, 4]], max_new_tokens=4)
+        assert len(out[0]) == 4

@@ -159,9 +159,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             loss = tiled_lm_loss(hidden, head, tokens, _mask_of(batch),
                                  num_tiles=loss_tiles)
         else:
-            import jax.numpy as _jnp
-
-            logits = hidden.astype(_jnp.float32) @ head.astype(_jnp.float32)
+            logits = T.head_matmul(hidden, head.astype(hidden.dtype))
             loss = T.causal_lm_loss(logits, tokens, _mask_of(batch))
         if cfg.n_experts > 0:
             loss = loss + cfg.moe_aux_coef * aux

@@ -81,11 +81,13 @@ def tiled_lm_loss(hidden: jax.Array, head: jax.Array, tokens: jax.Array,
     hid_t = _split_tiles(hid, num_tiles, 1)    # [T, tile, B, H]
     tgt_t = _split_tiles(tgt, num_tiles, 1)    # [T, tile, B]
     mask_t = _split_tiles(mask, num_tiles, 1)  # [T, tile, B]
-    head32 = head.astype(jnp.float32)
+    head_c = head.astype(hidden.dtype)
 
     def tile_body(carry, operand):
+        from deepspeed_tpu.models.transformer import head_matmul
+
         h, t, mk = operand                     # [tile,B,H], [tile,B], [tile,B]
-        logits = h.astype(jnp.float32) @ head32          # [tile, B, V]
+        logits = head_matmul(h, head_c)                  # [tile, B, V] fp32
         logz = jax.nn.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
         nll = (logz - picked) * mk

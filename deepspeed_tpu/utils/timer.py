@@ -120,7 +120,17 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Tracks samples/sec and TFLOPS across steps (reference ``utils/timer.py`` analog)."""
+    """Tracks samples/sec across steps (reference ``utils/timer.py`` analog).
+
+    Unlike the reference (CUDA events are cheap), a device fence on TPU —
+    especially through a remote-execution tunnel — costs a full host↔device
+    round trip and serializes the dispatch pipeline. So this timer measures
+    WINDOWS: it fences once per ``steps_per_output`` report boundary and
+    divides the window wall time by the steps in it. Between boundaries a
+    train step pays zero sync overhead; with ``steps_per_output=None`` it
+    never fences at all. Aggregate throughput is identical (each window is
+    fence-to-fence wall time).
+    """
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: Optional[int] = None,
                  monitor_memory: bool = False, logging_fn=None):
@@ -129,22 +139,27 @@ class ThroughputTimer:
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
-        self.initialized = False
         self.global_step_count = 0
         self.local_step_count = 0
-        self.total_elapsed_time = 0.0
-        self.step_elapsed_time = 0.0
-        self._start_time = 0.0
+        self.total_elapsed_time = 0.0   # fenced wall time since start_step
+        self._counted_steps = 0         # steps covered by total_elapsed_time
+        self._window_start: Optional[float] = None
+        self._window_steps = 0
         self.started = False
 
     def update_epoch_count(self) -> None:
         self.local_step_count = 0
 
+    def _should_report(self) -> bool:
+        return bool(self.steps_per_output) and \
+            self.global_step_count % self.steps_per_output == 0
+
     def start(self) -> None:
         self.started = True
-        if self.global_step_count >= self.start_step:
-            _sync()
-            self._start_time = time.perf_counter()
+        if self._window_start is None and self.global_step_count >= self.start_step:
+            _sync()  # one fence to open the measurement window
+            self._window_start = time.perf_counter()
+            self._window_steps = 0
 
     def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
         if not self.started:
@@ -153,22 +168,33 @@ class ThroughputTimer:
         self.local_step_count += 1
         if global_step:
             self.global_step_count += 1
-        if self._start_time and self.global_step_count > self.start_step:
-            _sync()
-            duration = time.perf_counter() - self._start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            if global_step and report_speed and self.steps_per_output and \
-                    self.global_step_count % self.steps_per_output == 0:
+        if self._window_start is None or not global_step:
+            return
+        self._window_steps += 1
+        if self._should_report():
+            duration, steps = self._close_window()
+            if report_speed and steps:
                 self.logging(
                     f"step={self.global_step_count} "
                     f"samples/sec={self.avg_samples_per_sec():.2f} "
-                    f"ms/step={self.step_elapsed_time / self.steps_per_output * 1000:.1f}"
-                )
-                self.step_elapsed_time = 0.0
+                    f"ms/step={duration / steps * 1000:.1f}")
+
+    def _close_window(self):
+        """Fence, accrue the open window, and start a new one."""
+        _sync()
+        duration = time.perf_counter() - self._window_start
+        steps = self._window_steps
+        self.total_elapsed_time += duration
+        self._counted_steps += steps
+        self._window_start = time.perf_counter()
+        self._window_steps = 0
+        return duration, steps
 
     def avg_samples_per_sec(self) -> float:
-        if self.global_step_count <= self.start_step or self.total_elapsed_time == 0.0:
+        # close the in-flight window lazily so the query is accurate at any
+        # step (one fence per query, none per step)
+        if self._window_start is not None and self._window_steps:
+            self._close_window()
+        if self._counted_steps == 0 or self.total_elapsed_time == 0.0:
             return 0.0
-        steps = self.global_step_count - self.start_step
-        return self.batch_size / (self.total_elapsed_time / steps)
+        return self.batch_size / (self.total_elapsed_time / self._counted_steps)

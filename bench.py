@@ -1,18 +1,24 @@
 #!/usr/bin/env python
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Metric: tokens/sec/chip for GPT-2-125M causal-LM training (ZeRO-1, bf16,
-fused jitted train step) on the available device(s). ``vs_baseline`` compares
-against an estimated NCCL/A100 DeepSpeed throughput for the same model
-(A100 bf16 peak 312 TFLOPs at ~40% MFU → ~167k tokens/s for a 125M-param model;
-see BASELINE.md — the reference publishes no directly comparable table).
-The line also reports achieved model TFLOP/s and MFU against the chip's bf16
-peak so progress is self-evident independent of the baseline estimate.
+Headline metric: tokens/sec/chip for GPT-2-125M causal-LM training (ZeRO-1,
+bf16, fused jitted train step). ``vs_baseline`` compares against an estimated
+NCCL/A100 DeepSpeed throughput for the same model (A100 bf16 peak 312 TFLOPs at
+~40% MFU → ~167k tokens/s for a 125M-param model; see BASELINE.md — the
+reference publishes no directly comparable table). The line also reports
+achieved model TFLOP/s and MFU against the chip's bf16 peak.
 
-Tuned config (measured on v5e, see PROFILE.md): micro-batch 32, remat=full,
-Pallas flash attention with 512/1024 blocks, bf16 head matmul with fp32
-accumulation. BENCH_* env vars override for ablations.
+The ``configs`` section covers the driver's north-star milestone configs
+(BASELINE.json): ZeRO-2 + FusedAdam BERT-large fp16, ZeRO-3 llama-style
+(largest fitting 16G HBM single-chip), AutoTP-style inference generate, and
+MoE + Ulysses SP. ``comm_bw`` records collective algorithm/bus bandwidth via
+``utils/comm_bench`` (degenerate on 1 chip; real on a pod).
+
+Tuned defaults (measured on v5e, see PROFILE.md): micro-batch 32, remat=full,
+Pallas flash attention 512/1024 blocks, bf16 head matmul with fp32
+accumulation. BENCH_* env vars override; BENCH_SUITE=0 runs the headline only.
 """
+import gc
 import json
 import os
 import sys
@@ -33,7 +39,24 @@ def chip_peak_tflops(device) -> float:
     return 197.0
 
 
-def main():
+def _flops_per_token(cfg, n_params, seq_len):
+    # 6*N_active per token (fwd+bwd matmuls) + causal-halved attention
+    # 12*L*H*S*0.5; remat recompute is NOT counted (model FLOPs, not hardware)
+    if cfg.n_experts > cfg.moe_top_k:
+        # only top_k of n_experts FFNs execute per token
+        ffn_mats = 3 if cfg.activation == "swiglu" else 2
+        per_expert = ffn_mats * cfg.hidden_size * cfg.ffn_size
+        n_params = n_params - cfg.num_layers * \
+            (cfg.n_experts - cfg.moe_top_k) * per_expert
+    attn = 6 * cfg.num_layers * cfg.hidden_size * seq_len
+    if not cfg.causal:
+        attn *= 2
+    return 6 * n_params + attn
+
+
+def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
+                batch, seq_len, gas, steps, attention="flash", remat="full",
+                spec_kwargs=None, config_extra=None, note=None):
     import jax
 
     import deepspeed_tpu as dst
@@ -41,66 +64,170 @@ def main():
     from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
 
     n_chips = jax.device_count()
-    batch_per_chip = int(os.environ.get("BENCH_BATCH", 32))
-    seq_len = int(os.environ.get("BENCH_SEQ", 1024))
-    steps = int(os.environ.get("BENCH_STEPS", 6))
-    gas = int(os.environ.get("BENCH_GAS", 4))
-    model = os.environ.get("BENCH_MODEL", "gpt2_125m")
-
-    # flash attention (no [S,S] score materialization — fits 16G HBM at
-    # batch 32 x 1024) + per-layer remat; gas micro-batches scanned INSIDE one
-    # jitted step so per-dispatch overhead amortizes over gas x batch x seq
-    # tokens.
-    attention = os.environ.get("BENCH_ATTENTION",
-                               "flash" if model != "tiny" else "xla")
-    remat = os.environ.get("BENCH_REMAT", "full")
-    loss_tiles = int(os.environ.get("BENCH_LOSS_TILES", 0))
-    spec = dst.causal_lm_spec(model, remat=remat,
-                              attention=attention, loss_tiles=loss_tiles)
+    spec_kwargs = dict(spec_kwargs or {})
+    if precision == "fp16":
+        # the engine's fp16 flag scales the loss and casts the master copy;
+        # the model's compute dtype must be switched too or matmuls stay bf16
+        spec_kwargs.setdefault("dtype", "float16")
+    spec = dst.causal_lm_spec(model, remat=remat, attention=attention,
+                              **spec_kwargs)
     config = {
-        "train_batch_size": batch_per_chip * gas * n_chips,
-        "train_micro_batch_size_per_gpu": batch_per_chip,
+        "train_batch_size": batch * gas * n_chips,
+        "train_micro_batch_size_per_gpu": batch,
         "gradient_accumulation_steps": gas,
-        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": optimizer, "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10 ** 9,
     }
+    if precision == "bf16":
+        config["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        config["fp16"] = {"enabled": True, "initial_scale_power": 12}
+    config.update(config_extra or {})
     engine, *_ = dst.initialize(model=spec, config=config)
     cfg = PRESETS[model]
-    data = synthetic_lm_data(batch_per_chip * n_chips, seq_len,
-                             cfg.vocab_size, seed=0)
-
-    # warmup (compile); float() forces a real host sync (block_until_ready
-    # may return early through remote-execution tunnels)
+    data = synthetic_lm_data(batch * n_chips, seq_len, cfg.vocab_size, seed=0)
     for _ in range(2):
         loss = engine.train_batch(data)
     float(loss)
-
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(data)
     float(loss)
     dt = time.perf_counter() - t0
-
-    tokens = steps * gas * batch_per_chip * n_chips * seq_len
-    tokens_per_sec_chip = tokens / dt / n_chips
-    # model FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention
-    # 12*L*H*S*0.5; remat recompute is NOT counted (model FLOPs, not hardware)
-    n_params = spec.num_params or 0
-    flops_per_token = 6 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq_len
-    achieved_tflops = flops_per_token * tokens_per_sec_chip / 1e12
+    tokens = steps * gas * batch * n_chips * seq_len
+    tps_chip = tokens / dt / n_chips
+    achieved = _flops_per_token(cfg, spec.num_params, seq_len) * tps_chip / 1e12
     peak = chip_peak_tflops(jax.devices()[0])
+    del engine
+    gc.collect()
+    out = {
+        "tokens_per_sec_chip": round(tps_chip, 1),
+        "model_tflops_per_sec_chip": round(achieved, 1),
+        "mfu": round(achieved / peak, 3),
+        "loss": round(float(loss), 4),
+    }
+    if note:
+        out["note"] = note
+    return out
+
+
+def inference_bench(model="gpt2_125m", batch=8, prompt_len=128, max_new=128):
+    """AutoTP-style inference generate (driver config #4): decode throughput."""
+    import numpy as np
+
+    import deepspeed_tpu as dst
+
+    engine = dst.init_inference(model, dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 50000, prompt_len).tolist() for _ in range(batch)]
+    out = engine.generate(prompts, max_new_tokens=max_new)  # compile + warm
+    t0 = time.perf_counter()
+    trials = 3
+    for _ in range(trials):
+        out = engine.generate(prompts, max_new_tokens=max_new)
+    dt = (time.perf_counter() - t0) / trials
+    del engine
+    gc.collect()
+    return {
+        "decode_tokens_per_sec": round(batch * max_new / dt, 1),
+        "batch": batch, "prompt_len": prompt_len, "max_new": max_new,
+    }
+
+
+def comm_bw_bench():
+    from deepspeed_tpu.utils.comm_bench import bench_collectives
+
+    rows = bench_collectives(axis="data", sizes_mb=[64], trials=5)
+    return [{"op": r["op"], "size_mb": round(r["size_bytes"] / 1e6),
+             "algbw_gbps": round(r["algbw_gbps"], 2),
+             "busbw_gbps": round(r["busbw_gbps"], 2)} for r in rows]
+
+
+SUITE_ENTRIES = {
+    "zero2_fusedadam_bert_large_fp16": lambda: train_bench(
+        "bert_large", zero_stage=2, precision="fp16",
+        optimizer="fusedadam", batch=16, seq_len=512, gas=4, steps=4,
+        spec_kwargs={"dtype": "bfloat16"},
+        note="fp16 loss scaling/master + bf16 matmuls: the TPU MXU has no "
+             "fp16 mode (f16 dots fail TPU compilation); bf16 is the "
+             "hardware's 16-bit format"),
+    "zero3_llama_750m_bf16": lambda: train_bench(
+        "llama_750m", zero_stage=3, precision="bf16",
+        batch=4, seq_len=2048, gas=4, steps=4),
+    "autotp_inference_gpt2_generate": lambda: inference_bench(),
+    "moe_ulysses_moe_350m_bf16": lambda: train_bench(
+        "moe_350m", zero_stage=2, precision="bf16",
+        batch=8, seq_len=1024, gas=2, steps=4,
+        attention="ulysses_flash"),
+}
+
+
+def _run_entry_subprocess(name: str):
+    """Run one suite entry in a child process so an XLA OOM/abort in a
+    deliberately-HBM-tight config can't take the headline JSON down with it."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--entry", name],
+        capture_output=True, text=True, timeout=1200)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no output"]
+    return {"error": f"rc={proc.returncode}: {tail[0][:180]}"}
+
+
+def main():
+    import jax
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
+        name = sys.argv[2]
+        try:
+            print(json.dumps(SUITE_ENTRIES[name]()))
+        except Exception as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"[:200]}))
+        return 0
+
+    n_chips = jax.device_count()
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", 32))
+    seq_len = int(os.environ.get("BENCH_SEQ", 1024))
+    steps = int(os.environ.get("BENCH_STEPS", 6))
+    gas = int(os.environ.get("BENCH_GAS", 4))
+    model = os.environ.get("BENCH_MODEL", "gpt2_125m")
+    attention = os.environ.get("BENCH_ATTENTION",
+                               "flash" if model != "tiny" else "xla")
+    remat = os.environ.get("BENCH_REMAT", "full")
+    loss_tiles = int(os.environ.get("BENCH_LOSS_TILES", 0))
+
+    headline = train_bench(
+        model, zero_stage=1, precision="bf16", batch=batch_per_chip,
+        seq_len=seq_len, gas=gas, steps=steps, attention=attention,
+        remat=remat, spec_kwargs={"loss_tiles": loss_tiles})
+
     baseline = 167_000.0  # est. A100 DeepSpeed tokens/s/GPU for 125M @ 40% MFU
-    print(json.dumps({
+    result = {
         "metric": f"tokens/sec/chip {model} zero1 bf16",
-        "value": round(tokens_per_sec_chip, 1),
+        "value": headline["tokens_per_sec_chip"],
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tokens_per_sec_chip / baseline, 3),
-        "model_tflops_per_sec_chip": round(achieved_tflops, 1),
-        "mfu": round(achieved_tflops / peak, 3),
-        "peak_tflops": peak,
-    }))
+        "vs_baseline": round(headline["tokens_per_sec_chip"] / baseline, 3),
+        "model_tflops_per_sec_chip": headline["model_tflops_per_sec_chip"],
+        "mfu": headline["mfu"],
+        "peak_tflops": chip_peak_tflops(jax.devices()[0]),
+        "n_chips": n_chips,
+    }
+
+    if os.environ.get("BENCH_SUITE", "1") != "0":
+        result["configs"] = {
+            name: _run_entry_subprocess(name) for name in SUITE_ENTRIES}
+        try:
+            result["comm_bw"] = comm_bw_bench()
+        except Exception as e:
+            result["comm_bw"] = [{"error": f"{type(e).__name__}: {e}"[:200]}]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -732,6 +732,18 @@ PRESETS: Dict[str, TransformerConfig] = {
                                    num_heads=25, max_seq_len=1024),
     "bert_large": TransformerConfig(vocab_size=30528, hidden_size=1024, num_layers=24,
                                     num_heads=16, max_seq_len=512, causal=False),
+    # llama-style model sized so fp32 master + Adam moments + fp32 grads fit a
+    # single 16G-HBM chip (ZeRO-3 single-host bench; ~665M params ≈ 12G state)
+    "llama_750m": TransformerConfig(vocab_size=32000, hidden_size=1536,
+                                    num_layers=20, num_heads=12,
+                                    ffn_hidden_size=4096, max_seq_len=2048,
+                                    pos_emb="rope", norm="rmsnorm",
+                                    activation="swiglu", use_bias=False,
+                                    tie_embeddings=False),
+    # mixtral-style MoE sized for one chip (4 experts, top-2)
+    "moe_350m": TransformerConfig(vocab_size=32000, hidden_size=768,
+                                  num_layers=12, num_heads=12, max_seq_len=1024,
+                                  use_bias=False, n_experts=4, moe_top_k=2),
     "llama2_7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
                                    num_heads=32, ffn_hidden_size=11008,
                                    max_seq_len=4096, pos_emb="rope", norm="rmsnorm",

@@ -53,12 +53,19 @@ class OnebitAdam(TPUOptimizer):
     """1-bit Adam (reference ``runtime/fp16/onebit/adam.py:14``)."""
 
     betas: Tuple[float, float] = (0.9, 0.999)
+    # wire transport for the compressed momentum exchange: (m_new, err) ->
+    # (m_eff, new_err). None = local sign compression (convergence parity
+    # only); the engine injects a packed-sign ICI allreduce when per-rank
+    # gradients are explicit (parallel/compressed.py packed_sign_allreduce,
+    # reference runtime/comm/nccl.py:52 compressed_allreduce)
+    transport: Optional[Any] = None
     eps: float = 1e-8
     freeze_step: int = 100
     moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq", "worker_error")
 
     def update(self, grads, state, params, lr=None):
         lr = self.lr if lr is None else lr
+        compress = self.transport or _sign_compress_with_error
         b1, b2 = self.betas
         step = state["step"] + 1
         sf = step.astype(jnp.float32)
@@ -75,7 +82,7 @@ class OnebitAdam(TPUOptimizer):
             m_new = b1 * m + (1.0 - b1) * g
             # warmup: exact momentum, variance updates. frozen: compressed
             # momentum (sign+scale, error feedback), variance held.
-            m_comp, err_new = _sign_compress_with_error(m_new, err)
+            m_comp, err_new = compress(m_new, err)
             m_eff = jnp.where(frozen, m_comp, m_new)
             err_eff = jnp.where(frozen, err_new, err)
             v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * jnp.square(g))
@@ -110,6 +117,7 @@ class ZeroOneAdam(TPUOptimizer):
     eps: float = 1e-8
     var_freeze_step: int = 100
     var_update_scaler: int = 16     # initial refresh interval after freeze
+    transport: Optional[Any] = None
     moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq", "worker_error",
                                      "var_interval", "next_var_update")
 
@@ -125,6 +133,7 @@ class ZeroOneAdam(TPUOptimizer):
 
     def update(self, grads, state, params, lr=None):
         lr = self.lr if lr is None else lr
+        compress = self.transport or _sign_compress_with_error
         b1, b2 = self.betas
         step = state["step"] + 1
         sf = step.astype(jnp.float32)
@@ -144,7 +153,7 @@ class ZeroOneAdam(TPUOptimizer):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             m_new = b1 * m + (1.0 - b1) * g
-            m_comp, err_new = _sign_compress_with_error(m_new, err)
+            m_comp, err_new = compress(m_new, err)
             m_eff = jnp.where(frozen, m_comp, m_new)
             err_eff = jnp.where(frozen, err_new, err)
             v_new = jnp.where(refresh, b2 * v + (1.0 - b2) * jnp.square(g), v)
@@ -173,6 +182,7 @@ class OnebitLamb(TPUOptimizer):
     freeze_step: int = 100
     max_coeff: float = 10.0
     min_coeff: float = 0.01
+    transport: Optional[Any] = None
     moment_names: Tuple[str, ...] = ("exp_avg", "exp_avg_sq", "worker_error",
                                      "frozen_trust")
 
@@ -186,6 +196,7 @@ class OnebitLamb(TPUOptimizer):
 
     def update(self, grads, state, params, lr=None):
         lr = self.lr if lr is None else lr
+        compress = self.transport or _sign_compress_with_error
         b1, b2 = self.betas
         step = state["step"] + 1
         sf = step.astype(jnp.float32)
@@ -198,7 +209,7 @@ class OnebitLamb(TPUOptimizer):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             m_new = b1 * m + (1.0 - b1) * g
-            m_comp, err_new = _sign_compress_with_error(m_new, err)
+            m_comp, err_new = compress(m_new, err)
             m_eff = jnp.where(frozen, m_comp, m_new)
             err_eff = jnp.where(frozen, err_new, err)
             v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * jnp.square(g))

@@ -1,0 +1,193 @@
+"""Compressed collectives wired into the training step (ZeRO++ qwZ/qgZ and
+the 1-bit optimizer transport).
+
+Parity targets:
+
+* qgZ — quantized gradient reduce-scatter
+  (reference ``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``
+  backed by ``csrc/quantization/quant_reduce.cu``).
+* qwZ — quantized parameter all-gather
+  (reference ``runtime/zero/partition_parameters.py:829 CUDAQuantizer``, used by
+  ``all_gather_coalesced`` :1446 when ``zero_quantized_weights`` is set).
+* 1-bit transport — sign+scale compressed allreduce with per-worker error
+  feedback (reference ``runtime/comm/nccl.py:52 compressed_allreduce``).
+
+TPU design: the engine's train step is GSPMD — gradients are reduced by
+whatever collectives the partitioner emits, so there is no seam to compress.
+This module provides that seam as ONE primitive: a straight-through
+:func:`gather_with_compressed_vjp` whose
+
+* **forward** is the ZeRO parameter all-gather (wire = int8 blocks + fp32
+  scales when qwZ, else bf16 — half of fp32 either way), and whose
+* **backward** is the gradient reduce-scatter (wire = int8 all-to-all +
+  local dequant-sum when qgZ, else exact psum_scatter).
+
+The engine wraps grad computation in a ``shard_map`` manual over the ZeRO/data
+axes and differentiates through this gather, so autodiff *derives* the
+reference's hand-written reduce-scatter placement — one hop per parameter per
+micro-step, exactly the IPG-bucket flow (``stage_1_and_2.py:1277``).
+
+Quantization noise note: qwZ noise enters the forward (by design — same as the
+reference's quantized weights); qgZ noise enters the gradients. Both are
+block-symmetric int8 (rtol ~1e-2), validated by loss-curve parity tests
+(``tests/unit/test_compressed_comm.py``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.quantization import (
+    DEFAULT_BLOCK,
+    dequantize_int8,
+    pad_to_block,
+    quantize_int8,
+)
+
+PyTree = Any
+AxesT = Tuple[str, ...]
+
+
+from deepspeed_tpu.ops.quantization import (  # noqa: F401  (re-export)
+    pack_signs,
+    packed_sign_allreduce,
+    unpack_signs,
+)
+
+
+# --------------------------------------------------------------------------- #
+# straight-through compressed gather (qwZ fwd / qgZ bwd)
+# --------------------------------------------------------------------------- #
+
+def _q_allgather(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
+    """int8-wire all-gather of a local fp32/bf16 flat vector → [world, n]."""
+    n = flat.shape[0]
+    fp, _ = pad_to_block(flat.astype(jnp.float32), block)
+    q, s = quantize_int8(fp, block)
+    qg = lax.all_gather(q, axes, tiled=False)                   # [world, n_pad]
+    sg = lax.all_gather(s, axes, tiled=False)
+    rows = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qg, sg)
+    return rows[:, :n]
+
+
+def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
+                      block: int) -> jax.Array:
+    """int8-wire reduce-scatter: rows [world, n] per-rank contributions →
+    my reduced row [n] (sum). all_to_all int8 blocks, dequant-sum locally —
+    the qgZ quant_reduce flow."""
+    n = rows.shape[1]
+    pad = (-n) % block
+    rp = jnp.pad(rows.astype(jnp.float32), ((0, 0), (0, pad)))
+    q, s = jax.vmap(lambda r: quantize_int8(r, block))(rp)      # [world, n_pad]
+    q = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    s = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
+    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
+    return jnp.sum(deq, axis=0)[:n]
+
+
+def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
+                               out_dtype, quant_weights: bool,
+                               quant_grads: bool,
+                               block: int = DEFAULT_BLOCK):
+    """Build the straight-through gather for one parameter leaf.
+
+    ``dim`` — the dimension sharded over ``axes`` (None → leaf is replicated:
+    forward is a cast, backward is an exact psum-mean — too small to quantize).
+    Forward: local shard → full parameter in ``out_dtype``.
+    Backward: full cotangent → local shard of the MEAN-reduced gradient.
+    """
+    if dim is None:
+        @jax.custom_vjp
+        def rep(x):
+            return x.astype(out_dtype)
+
+        def rep_fwd(x):
+            return rep(x), x
+
+        def rep_bwd(x, g):
+            return ((lax.psum(g.astype(jnp.float32), axes) / world)
+                    .astype(x.dtype),)
+
+        rep.defvjp(rep_fwd, rep_bwd)
+        return rep
+
+    @jax.custom_vjp
+    def gather(x_local):
+        m = jnp.moveaxis(x_local, dim, 0)
+        flat = m.reshape(-1)
+        if quant_weights:
+            rows = _q_allgather(flat, axes, block)              # [world, n]
+        else:
+            rows = lax.all_gather(flat.astype(out_dtype), axes, tiled=False)
+        full_m = rows.reshape((world * m.shape[0],) + m.shape[1:])
+        return jnp.moveaxis(full_m, 0, dim).astype(out_dtype)
+
+    def gather_fwd(x_local):
+        return gather(x_local), x_local
+
+    def gather_bwd(x_local, g):
+        local_shape, in_dtype = x_local.shape, x_local.dtype
+        gm = jnp.moveaxis(g, dim, 0)
+        rows = gm.reshape(world, -1).astype(jnp.float32)        # [world, n_loc]
+        if quant_grads:
+            mine = _q_reduce_scatter(rows, axes, world, block)
+        else:
+            mine = lax.psum_scatter(rows, axes, scatter_dimension=0,
+                                    tiled=False)
+        mine = mine / world                                     # mean over DP
+        m_shape = (local_shape[dim],) + tuple(
+            s for i, s in enumerate(local_shape) if i != dim)
+        dx = jnp.moveaxis(mine.reshape(m_shape), 0, dim)
+        return dx.astype(in_dtype),
+
+    gather.defvjp(gather_fwd, gather_bwd)
+    return gather
+
+
+def manual_spec(spec: P, manual_axes: AxesT) -> P:
+    """Project a PartitionSpec onto the shard_map manual axes (other axes
+    stay under GSPMD auto sharding)."""
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n in manual_axes)
+        parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*parts)
+
+
+def sharded_dim(spec: P, manual_axes: AxesT) -> Optional[int]:
+    """Index of the dim sharded over any of ``manual_axes`` (None if none)."""
+    for i, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if any(n in manual_axes for n in names):
+            return i
+    return None
+
+
+def gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
+                   out_dtype, quant_weights: bool, quant_grads: bool,
+                   block: int = DEFAULT_BLOCK):
+    """Tree-level gather: local master shards → full compute params, with the
+    compressed VJP per leaf. Returns f(master_local_tree) for use inside
+    shard_map."""
+    gathers = jax.tree.map(
+        lambda spec: gather_with_compressed_vjp(
+            sharded_dim(spec, manual_axes), manual_axes, world, out_dtype,
+            quant_weights, quant_grads, block),
+        spec_tree, is_leaf=lambda x: isinstance(x, P))
+
+    def gather_tree(master_local):
+        return jax.tree.map(lambda fn, x: fn(x), gathers, master_local,
+                            is_leaf=lambda x: callable(x) and not isinstance(x, jax.Array))
+
+    return gather_tree

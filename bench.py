@@ -135,6 +135,47 @@ def inference_bench(model="gpt2_125m", batch=8, prompt_len=128, max_new=128):
     }
 
 
+def fastgen_bench(model="gpt2_125m", n_seqs=16, max_new=64):
+    """FastGen-class serving (paged KV + SplitFuse + Pallas decode kernel)
+    vs the v1 slot engine on a mixed-length workload (driver config #4's
+    continuous-batching side)."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.fastgen import FastGenEngine
+    from deepspeed_tpu.inference.ragged import RaggedInferenceEngine
+
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(16, 480, n_seqs)]
+    prompts = [rng.integers(0, 50000, n).tolist() for n in lens]
+    uids = list(range(n_seqs))
+
+    fg = FastGenEngine(model, n_blocks=512, block_size=64,
+                       max_blocks_per_seq=16, token_budget=256,
+                       temperature=0.0, seed=0, max_seq_len=1024)
+    fg.generate_all(uids, prompts, max_new_tokens=4)  # warm/compile
+    t0 = time.perf_counter()
+    out = fg.generate_all(uids, prompts, max_new_tokens=max_new)
+    t_fg = time.perf_counter() - t0
+    gen = sum(len(v) for v in out.values())
+    del fg
+
+    slot = RaggedInferenceEngine(model, max_slots=n_seqs, max_len=1024,
+                                 temperature=0.0, seed=0)
+    slot.generate_all(uids, prompts, max_new_tokens=4)  # warm/compile
+    t0 = time.perf_counter()
+    out = slot.generate_all(uids, prompts, max_new_tokens=max_new)
+    t_slot = time.perf_counter() - t0
+    gen_slot = sum(len(v) for v in out.values())
+    del slot
+    gc.collect()
+    return {
+        "decode_tokens_per_sec": round(gen / t_fg, 1),
+        "slot_engine_tokens_per_sec": round(gen_slot / t_slot, 1),
+        "speedup_vs_slot": round((gen / t_fg) / (gen_slot / t_slot), 2),
+        "n_seqs": n_seqs, "prompt_lens": "16-480", "max_new": max_new,
+    }
+
+
 def comm_bw_bench():
     from deepspeed_tpu.utils.comm_bench import bench_collectives
 
@@ -156,6 +197,7 @@ SUITE_ENTRIES = {
         "llama_750m", zero_stage=3, precision="bf16",
         batch=4, seq_len=2048, gas=4, steps=4),
     "autotp_inference_gpt2_generate": lambda: inference_bench(),
+    "fastgen_paged_splitfuse_gpt2": lambda: fastgen_bench(),
     "moe_ulysses_moe_350m_bf16": lambda: train_bench(
         "moe_350m", zero_stage=2, precision="bf16",
         batch=8, seq_len=1024, gas=2, steps=4,

@@ -1,0 +1,315 @@
+"""FastGen-class continuous batching: paged KV + Dynamic SplitFuse scheduling.
+
+Parity: reference ``inference/v2/engine_v2.py`` (``put`` :107, ``query`` :158,
+``flush`` :242 and the Dynamic SplitFuse policy in ``scheduling_utils.py:1-54``),
+``inference/v2/ragged/blocked_allocator.py:1-105`` (block allocator) and
+``ragged/kv_cache.py:1-208`` (blocked KV).
+
+TPU design — one compiled program for EVERYTHING:
+
+* KV lives in a block pool ``[L, NB, bs, K, D]``; each sequence owns a
+  host-side block table (``BlockAllocator`` free list, block 0 = pad trash).
+* Every ``step()`` packs a fixed token budget T: one decode token per running
+  sequence plus prefill CHUNKS of admitted prompts (long prompts split across
+  ticks, short ones fused together — Dynamic SplitFuse), padded to T.
+* The jitted tick (``models/paged.forward_paged``) embeds the flat tokens,
+  writes K/V through the block tables, runs paged attention (Pallas kernel on
+  TPU, XLA gather reference elsewhere) and samples every row; the host keeps
+  only rows flagged as sequence heads. Admission NEVER recompiles — shapes are
+  (T,), (T, MB) regardless of batch composition.
+
+vs the v1 slot engine (``inference/ragged.py``): no per-sequence prefill
+dispatch (admission is just host bookkeeping), no per-prompt-length compile
+cache, prefill and decode share ticks so decode latency is bounded while
+prompts stream in (the SplitFuse headline property).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.models import paged as PG
+from deepspeed_tpu.models import transformer as T
+
+PyTree = Any
+
+
+class BlockAllocator:
+    """Fixed-pool block allocator (reference ``blocked_allocator.py:1-105``).
+
+    Block 0 is reserved as the trash block pad tokens write into."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(1, n_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: want {n} blocks, {len(self._free)} free")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b:
+                self._free.append(b)
+
+
+class _Seq:
+    """Host-side descriptor (reference ``sequence_descriptor.py``)."""
+
+    def __init__(self, uid: int, prompt: List[int], max_blocks: int):
+        self.uid = uid
+        self.prompt = prompt
+        self.prefilled = 0            # prompt tokens written to cache
+        self.pos = 0                  # total tokens in cache
+        self.blocks: List[int] = []   # block table (grows)
+        self.table = np.zeros((max_blocks,), np.int32)
+        self.generated: List[int] = []
+        self.last_tok: Optional[int] = None   # next decode input
+        self.done = False
+
+    @property
+    def prefill_remaining(self) -> int:
+        return len(self.prompt) - self.prefilled
+
+
+class FastGenEngine:
+    """``put/query/flush`` continuous-batching engine (engine_v2 analog)."""
+
+    def __init__(self, cfg: Union[str, T.TransformerConfig],
+                 params: Optional[PyTree] = None,
+                 n_blocks: int = 128, block_size: int = 32,
+                 max_blocks_per_seq: int = 16, token_budget: int = 64,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 use_pallas_kernel: Optional[bool] = None, **overrides):
+        if isinstance(cfg, str):
+            cfg = T.get_model_config(cfg, **overrides)
+        if cfg.pos_emb == "alibi":
+            raise NotImplementedError(
+                "FastGenEngine does not support ALiBi position bias yet — "
+                "use the v1 slot engine (inference/ragged.py) for "
+                "bloom/falcon-alibi models")
+        self.cfg = cfg
+        if params is None:
+            params = T.init_params(cfg, jax.random.PRNGKey(seed))
+        self.params = jax.tree.map(
+            lambda x: jnp.asarray(x, cfg.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else jnp.asarray(x), params)
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.token_budget = token_budget
+        self.max_len = block_size * max_blocks_per_seq
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self.eos_token_id = eos_token_id
+
+        self.allocator = BlockAllocator(n_blocks)
+        self.pool = PG.init_paged_kv(cfg, n_blocks, block_size)
+        self.seqs: Dict[int, _Seq] = {}
+        self._admit_order: List[int] = []
+        self._rng = jax.random.PRNGKey(seed)
+        self._ticks: Dict[int, Any] = {}   # bucketed by tick token count
+        if use_pallas_kernel is None:
+            use_pallas_kernel = jax.default_backend() == "tpu"
+        self._use_kernel = use_pallas_kernel
+
+    def _bucket(self, need: int) -> int:
+        """Two tick-size tiers (small for decode-heavy ticks, full budget
+        otherwise) — each tier is one compiled program; admission
+        composition never adds one."""
+        small = max(8, self.token_budget // 8)
+        return small if need <= small else self.token_budget
+
+    # ------------------------------------------------------------------ #
+    def _build_tick(self):
+        cfg = self.cfg
+        if self._use_kernel:
+            from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
+            attn = paged_attention
+        else:
+            attn = PG.paged_attention_reference
+
+        def tick(params, pool, tokens, positions, tables, rng):
+            logits, pool = PG.forward_paged(
+                params, tokens, positions, tables, pool, cfg,
+                attention_fn=attn)
+            sampled = sample_logits(logits, rng, self.temperature,
+                                    self.top_k, self.top_p).astype(jnp.int32)
+            return sampled, pool
+
+        return jax.jit(tick, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ #
+    def can_schedule(self) -> bool:
+        return self.allocator.free_blocks > 0
+
+    def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]]) -> None:
+        """Admit sequences — host bookkeeping ONLY (no device dispatch, no
+        compile). Prefill happens chunked inside subsequent ``step()`` ticks
+        (reference ``put`` :107 + SplitFuse chunking)."""
+        for uid, prompt in zip(uids, prompts):
+            prompt = list(prompt)
+            if uid in self.seqs:
+                raise ValueError(
+                    f"uid {uid} is still active — flush() it before re-use")
+            if len(prompt) >= self.max_len:
+                raise ValueError(
+                    f"prompt len {len(prompt)} >= max_len {self.max_len}")
+            self.seqs[uid] = _Seq(uid, prompt, self.max_blocks_per_seq)
+            self._admit_order.append(uid)
+
+    def _ensure_blocks(self, seq: _Seq, upto_pos: int) -> bool:
+        """Grow the sequence's block table to cover ``upto_pos``. Returns
+        False (leaving per-seq state untouched) when the pool can't supply
+        the blocks — the scheduler then defers that sequence (capacity
+        backpressure, reference ``scheduling_utils`` CacheBlock result)."""
+        need = upto_pos // self.block_size + 1
+        grow = need - len(seq.blocks)
+        if grow > self.allocator.free_blocks:
+            return False
+        for blk in self.allocator.allocate(max(grow, 0)):
+            seq.table[len(seq.blocks)] = blk
+            seq.blocks.append(blk)
+        return True
+
+    def step(self) -> Dict[int, int]:
+        """One SplitFuse tick: decode every running sequence + prefill chunks
+        under the token budget. Returns {uid: sampled token} for sequences
+        that produced one this tick."""
+        live = [self.seqs[u] for u in self._admit_order
+                if u in self.seqs and not self.seqs[u].done]
+        need = sum(1 for s in live
+                   if s.prefill_remaining == 0 and s.last_tok is not None)
+        need += sum(s.prefill_remaining for s in live)
+        Tn = self._bucket(need)
+        tokens = np.zeros((Tn,), np.int32)
+        positions = np.zeros((Tn,), np.int32)
+        tables = np.zeros((Tn, self.max_blocks_per_seq), np.int32)
+        # (row, seq, is_decode): rows whose logits get sampled this tick
+        heads: List[tuple] = []
+        row = 0
+
+        # 1) decode tokens — one per fully-prefilled live sequence
+        for uid in self._admit_order:
+            seq = self.seqs.get(uid)
+            if seq is None or seq.done or seq.prefill_remaining > 0 \
+                    or seq.last_tok is None:
+                continue
+            if row >= Tn:
+                break
+            if not self._ensure_blocks(seq, seq.pos):
+                continue   # pool full — this sequence waits a tick
+            tokens[row] = seq.last_tok
+            positions[row] = seq.pos
+            tables[row] = seq.table
+            heads.append((row, seq, True))
+            row += 1
+
+        # 2) prefill chunks — FIFO admission, split to fit the remaining
+        # budget (Dynamic SplitFuse: long prompts stream across ticks)
+        for uid in self._admit_order:
+            seq = self.seqs.get(uid)
+            if seq is None or seq.done or seq.prefill_remaining == 0:
+                continue
+            if row >= Tn:
+                break
+            chunk = min(seq.prefill_remaining, Tn - row)
+            # capacity backpressure: shrink the chunk to the blocks the pool
+            # can actually supply; zero → the prompt waits for a flush
+            fits = (len(seq.blocks) + self.allocator.free_blocks) \
+                * self.block_size - seq.pos
+            chunk = min(chunk, fits)
+            if chunk <= 0:
+                continue
+            self._ensure_blocks(seq, seq.pos + chunk - 1)
+            lo = seq.prefilled
+            tokens[row:row + chunk] = seq.prompt[lo:lo + chunk]
+            positions[row:row + chunk] = np.arange(seq.pos, seq.pos + chunk)
+            tables[row:row + chunk] = seq.table
+            row += chunk
+            seq.prefilled += chunk
+            seq.pos += chunk
+            if seq.prefill_remaining == 0:
+                heads.append((row - 1, seq, False))  # first generated token
+
+        if row == 0:
+            return {}
+
+        # bucket the table width too (two tiers only — each (Tn, mb) pair is
+        # a compiled program): short-context ticks gather/walk a quarter of
+        # max_blocks_per_seq, long ones the full table
+        mb_need = int(positions[:row].max()) // self.block_size + 1
+        quarter = max(2, self.max_blocks_per_seq // 4)
+        mb = quarter if mb_need <= quarter else self.max_blocks_per_seq
+
+        key = (Tn, mb)
+        if key not in self._ticks:
+            self._ticks[key] = self._build_tick()
+        self._rng, sub = jax.random.split(self._rng)
+        sampled, self.pool = self._ticks[key](
+            self.params, self.pool, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(tables[:, :mb]), sub)
+        sampled = np.asarray(jax.device_get(sampled))
+
+        out: Dict[int, int] = {}
+        for r, seq, is_decode in heads:
+            tok = int(sampled[r])
+            if is_decode:
+                seq.pos += 1   # the decode input token entered the cache
+            seq.last_tok = tok
+            self._note_token(seq, tok)
+            out[seq.uid] = tok
+        return out
+
+    def _note_token(self, seq: _Seq, tok: int) -> None:
+        if seq.done:
+            return
+        if self.eos_token_id is not None and tok == self.eos_token_id:
+            seq.done = True
+            return
+        seq.generated.append(tok)
+        if seq.pos + 1 >= self.max_len:
+            seq.done = True
+
+    def query(self, uid: int):
+        d = self.seqs[uid]
+        return d.done, list(d.generated)
+
+    def flush(self, uids: Sequence[int]) -> None:
+        for uid in uids:
+            d = self.seqs.pop(uid, None)
+            if d is not None:
+                self.allocator.free(d.blocks)
+                if uid in self._admit_order:
+                    self._admit_order.remove(uid)
+
+    def generate_all(self, uids, prompts, max_new_tokens: int = 32):
+        """Convenience driver: put + step until everyone has max_new tokens."""
+        self.put(uids, prompts)
+        while True:
+            for u in uids:
+                s = self.seqs.get(u)
+                if s and len(s.generated) >= max_new_tokens:
+                    s.done = True
+            if not any(u in self.seqs and not self.seqs[u].done
+                       for u in uids):
+                break
+            out = self.step()
+            if not out and not any(
+                    s.prefill_remaining > 0 and not s.done
+                    for s in self.seqs.values()):
+                break  # stalled: no tokens and nothing left to prefill
+        out = {u: self.query(u)[1][:max_new_tokens] for u in uids}
+        self.flush(uids)
+        return out

@@ -1,0 +1,144 @@
+"""Paged (block-table) KV forward pass — the FastGen blocked-KV analog.
+
+Parity: reference ``inference/v2/ragged/kv_cache.py:1-208`` (blocked KV with a
+host-side allocator) + ``inference/v2/kernels/ragged_ops`` (blocked attention /
+KV writes that take a ragged batch of mixed prefill chunks and decode tokens).
+
+TPU design: XLA wants one static shape, so the ragged batch is a FLAT token
+batch of fixed budget T: each tick packs decode tokens (one per running
+sequence) and prefill chunks (Dynamic SplitFuse) into ``tokens[T]`` with
+per-token ``positions[T]`` and ``tables[T, MB]`` (the owning sequence's block
+table). The KV pool is ``[L, NB, bs, K, D]``; token (t) writes its K/V at
+``pool[tables[t, pos//bs], pos % bs]`` and attends to its first ``pos+1``
+cache slots via block gathers. Pad tokens carry an all-zeros table and write
+into reserved trash block 0.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models import transformer as T
+
+PyTree = Any
+
+
+def init_paged_kv(cfg: T.TransformerConfig, n_blocks: int, block_size: int,
+                  dtype=None) -> Dict[str, jax.Array]:
+    """Block pool per layer. Block 0 is the trash block for pad writes."""
+    dt = dtype or cfg.compute_dtype
+    shape = (cfg.num_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_attention_reference(q: jax.Array, kpool: jax.Array, vpool: jax.Array,
+                              tables: jax.Array, lengths: jax.Array
+                              ) -> jax.Array:
+    """Pure-XLA paged attention (the CPU/fallback path; the Pallas kernel in
+    ``ops/pallas/paged_attention.py`` computes the same thing without
+    materializing the gathered KV).
+
+    q [T, N, D]; pools [NB, bs, K, D]; tables [T, MB]; lengths [T] (= pos+1).
+    Token t attends to its sequence's first ``lengths[t]`` cache slots.
+    """
+    Tn, N, D = q.shape
+    bs = kpool.shape[1]
+    K = kpool.shape[2]
+    MB = tables.shape[1]
+    kg = kpool[tables]                                   # [T, MB, bs, K, D]
+    vg = vpool[tables]
+    kg = kg.reshape(Tn, MB * bs, K, D)
+    vg = vg.reshape(Tn, MB * bs, K, D)
+    if K != N:
+        kg = jnp.repeat(kg, N // K, axis=2)
+        vg = jnp.repeat(vg, N // K, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    s = jnp.einsum("tnd,tcnd->tnc", q.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale       # [T, N, ctx]
+    mask = jnp.arange(MB * bs)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("tnc,tcnd->tnd", p, vg.astype(jnp.float32)).astype(q.dtype)
+
+
+def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
+                  tables: jax.Array, pool: Dict[str, jax.Array],
+                  cfg: T.TransformerConfig,
+                  attention_fn: Optional[Callable] = None
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One SplitFuse tick over a flat token batch.
+
+    tokens [T] int32, positions [T] int32, tables [T, MB] int32 (rows shared
+    by tokens of the same sequence). Returns (logits [T, vocab] fp32,
+    updated pool). Parity: the reference's model-implementation forward over
+    a RaggedBatchWrapper (``inference/v2/model_implementations``).
+    """
+    attention_fn = attention_fn or paged_attention_reference
+    dt = cfg.compute_dtype
+    Tn = tokens.shape[0]
+    bs = pool["k"].shape[2]
+
+    x = params["tok_emb"].astype(dt)[tokens]             # [T, H]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_emb"].astype(dt)[positions]
+    if cfg.emb_norm:
+        x = T._norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
+
+    max_pos = pool["k"].shape[1] * bs
+    cos_t = sin_t = None
+    if cfg.pos_emb == "rope":
+        cos_t, sin_t = T.rope_table(max_pos, cfg.rope_dim, cfg.rope_theta)
+    block_idx = jnp.take_along_axis(
+        tables, (positions // bs)[:, None], axis=1)[:, 0]  # [T]
+    offsets = positions % bs
+    lengths = positions + 1
+
+    def body(x, scans):
+        lp, kl, vl = scans                                # kl/vl [NB, bs, K, D]
+        h = T._norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+
+        def proj(name, shape):
+            w = lp[f"w{name}"].astype(dt)
+            out = h @ w
+            if (cfg.attn_bias_enabled if name in ("q", "k", "v")
+                    else cfg.use_bias):
+                out = out + lp[f"b{name}"].astype(dt)
+            return out.reshape(shape)
+
+        q = proj("q", (Tn, cfg.num_heads, cfg.head_dim))
+        k = proj("k", (Tn, cfg.kv_heads, cfg.head_dim))
+        v = proj("v", (Tn, cfg.kv_heads, cfg.head_dim))
+        if cfg.pos_emb == "rope":
+            q = T.apply_rope_at(q[None], cos_t, sin_t, positions[None])[0]
+            k = T.apply_rope_at(k[None], cos_t, sin_t, positions[None])[0]
+        # blocked KV write (reference ragged_ops KV-copy kernels): token t →
+        # pool[block_idx[t], offsets[t]]. Pad tokens all hit trash block 0.
+        kl = kl.at[block_idx, offsets].set(k.astype(kl.dtype), mode="drop")
+        vl = vl.at[block_idx, offsets].set(v.astype(vl.dtype), mode="drop")
+
+        attn = attention_fn(q, kl, vl, tables, lengths)   # [T, N, D]
+        attn = attn.reshape(Tn, cfg.num_heads * cfg.head_dim)
+        attn_out = attn @ lp["wo"].astype(dt)
+        if cfg.use_bias:
+            attn_out = attn_out + lp["bo"].astype(dt)
+        if cfg.parallel_block:
+            h2 = h if cfg.shared_parallel_norm else \
+                T._norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+            down, _ = T._ffn(h2, lp, cfg)
+            return x + attn_out + down, (kl, vl)
+        x = x + attn_out
+        h2 = T._norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        down, _ = T._ffn(h2, lp, cfg)
+        return x + down, (kl, vl)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["blocks"], pool["k"], pool["v"]))
+    x = T._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    logits = T.head_matmul(x, head.astype(x.dtype))
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}

@@ -110,7 +110,9 @@ class FastGenEngine:
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.token_budget = token_budget
-        self.max_len = block_size * max_blocks_per_seq
+        # cap at the model's position range: learned pos-emb gathers clamp
+        # silently out of range, so never let sequences grow past it
+        self.max_len = min(block_size * max_blocks_per_seq, cfg.max_seq_len)
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self.eos_token_id = eos_token_id
 
@@ -118,6 +120,7 @@ class FastGenEngine:
         self.pool = PG.init_paged_kv(cfg, n_blocks, block_size)
         self.seqs: Dict[int, _Seq] = {}
         self._admit_order: List[int] = []
+        self._decode_rr = 0
         self._rng = jax.random.PRNGKey(seed)
         self._ticks: Dict[int, Any] = {}   # bucketed by tick token count
         if use_pallas_kernel is None:
@@ -200,8 +203,12 @@ class FastGenEngine:
         heads: List[tuple] = []
         row = 0
 
-        # 1) decode tokens — one per fully-prefilled live sequence
-        for uid in self._admit_order:
+        # 1) decode tokens — one per fully-prefilled live sequence, starting
+        # from a rotating offset so tails never starve when live sequences
+        # exceed the budget (the reference scheduler's fairness rotation)
+        order = self._admit_order
+        rr = self._decode_rr % max(len(order), 1)
+        for uid in order[rr:] + order[:rr]:
             seq = self.seqs.get(uid)
             if seq is None or seq.done or seq.prefill_remaining > 0 \
                     or seq.last_tok is None:
@@ -215,6 +222,7 @@ class FastGenEngine:
             tables[row] = seq.table
             heads.append((row, seq, True))
             row += 1
+        self._decode_rr += 1
 
         # 2) prefill chunks — FIFO admission, split to fit the remaining
         # budget (Dynamic SplitFuse: long prompts stream across ticks)
@@ -276,11 +284,20 @@ class FastGenEngine:
         if seq.done:
             return
         if self.eos_token_id is not None and tok == self.eos_token_id:
-            seq.done = True
+            self._finish(seq)
             return
         seq.generated.append(tok)
         if seq.pos + 1 >= self.max_len:
-            seq.done = True
+            self._finish(seq)
+
+    def _finish(self, seq: _Seq) -> None:
+        """Mark done and release KV blocks immediately — a finished sequence
+        never decodes again, and holding its blocks until flush() starves
+        waiting prompts (livelock if the caller only flushes at the end)."""
+        seq.done = True
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        seq.table[:] = 0
 
     def query(self, uid: int):
         d = self.seqs[uid]
@@ -300,8 +317,8 @@ class FastGenEngine:
         while True:
             for u in uids:
                 s = self.seqs.get(u)
-                if s and len(s.generated) >= max_new_tokens:
-                    s.done = True
+                if s and not s.done and len(s.generated) >= max_new_tokens:
+                    self._finish(s)
             if not any(u in self.seqs and not self.seqs[u].done
                        for u in uids):
                 break

@@ -30,6 +30,11 @@ class ModelSpec:
     seq_len: Optional[int] = None  # nominal sequence length (profiling etc.)
     config: Any = None             # underlying model config (zoo: TransformerConfig)
     trainable_fn: Optional[Callable[[], PyTree]] = None  # bool tree; None = all trainable
+    # optional explicit (loss, grads) path — used by schedules whose backward
+    # cannot be derived by autodiff over the loss (1F1B pipeline). Called as
+    # fn(compute_params, batch, loss_scale); returning None falls back to
+    # value_and_grad over loss_fn. The decision must be trace-static.
+    loss_and_grads_fn: Optional[Callable] = None
 
 
 def _tokens_of(batch: Batch) -> jax.Array:
@@ -114,12 +119,17 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    attention_fn=None, activation_constraint=None,
                    attention: Optional[str] = None,
                    loss_tiles: int = 0,
+                   pipeline_schedule: str = "1f1b",
                    **overrides) -> ModelSpec:
     """Build a ModelSpec for a causal-LM transformer preset or config.
 
     ``loss_tiles > 1`` computes the LM loss over sequence tiles without
     materializing full logits (ALST TiledFusedLogitsLoss analog,
-    reference ``runtime/sequence_parallel/ulysses_sp.py:1065``)."""
+    reference ``runtime/sequence_parallel/ulysses_sp.py:1065``).
+    ``pipeline_schedule``: '1f1b' (explicit backward, O(stages) activation
+    memory — reference ``runtime/pipe/schedule.py:189``) or 'gpipe'
+    (autodiff-reversed wavefront, O(microbatches)); only used when the mesh
+    has a 'pipe' axis > 1."""
     if attention_fn is not None and attention is not None:
         raise ValueError("pass either attention_fn or attention=, not both")
     if attention_fn is None:
@@ -170,6 +180,14 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                          attention_fn=attention_fn,
                          activation_constraint=activation_constraint)
 
+    def loss_and_grads_fn(params, batch, loss_scale=None):
+        if pipeline_schedule != "1f1b" or _pipe_stages() <= 1:
+            return None   # engine falls back to value_and_grad(loss_fn)
+        return T.pipelined_lm_loss_and_grads(
+            params, _tokens_of(batch), cfg, attention_fn=attention_fn,
+            activation_constraint=activation_constraint,
+            loss_mask=_mask_of(batch), loss_scale=loss_scale)
+
     return ModelSpec(
         init_fn=lambda rng: T.init_params(cfg, rng),
         loss_fn=loss_fn,
@@ -179,6 +197,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         num_params=cfg.num_params(),
         seq_len=cfg.max_seq_len,
         config=cfg,
+        loss_and_grads_fn=loss_and_grads_fn,
     )
 
 

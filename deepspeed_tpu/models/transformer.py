@@ -624,20 +624,15 @@ def forward_decode(params: PyTree, tokens: jax.Array,
     return logits, {"k": new_k, "v": new_v}
 
 
-def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
-                      mesh=None, n_micro: Optional[int] = None,
-                      attention_fn: Optional[AttentionFn] = None,
-                      activation_constraint: Optional[Callable] = None,
-                      loss_mask: Optional[jax.Array] = None
-                      ) -> Tuple[jax.Array, jax.Array]:
-    """Causal-LM loss with the layer stack pipelined over the 'pipe' mesh axis.
-
-    Embedding runs replicated across stages (cheap); blocks are stage-sharded;
-    final norm + head + loss run on the last stage; returns (loss, moe_aux).
-    See ``parallel/pipeline.py`` (reference ``runtime/pipe/engine.py:337``).
-    """
+def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+                    mesh, n_micro, attention_fn, activation_constraint,
+                    loss_mask):
+    """Shared scaffolding for the GPipe and 1F1B schedules: embedding,
+    microbatched inputs, extra params, stage_fn and finalize_fn. Both
+    schedules MUST consume this so the 1F1B-vs-GPipe parity tests stay
+    meaningful."""
     from deepspeed_tpu.comm.mesh import PIPE_AXIS, get_mesh_manager
-    from deepspeed_tpu.parallel.pipeline import microbatch, pipelined_apply
+    from deepspeed_tpu.parallel.pipeline import microbatch
 
     if mesh is None:
         mesh = get_mesh_manager().mesh
@@ -645,19 +640,31 @@ def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     if cfg.num_layers % n_stages != 0:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe={n_stages}")
+    if cfg.lm_head_bias:
+        raise NotImplementedError(
+            "lm_head_bias unsupported in the pipelined path")
     attention_fn = attention_fn or dot_product_attention
     constrain = activation_constraint or (lambda x: x)
     dt = cfg.compute_dtype
     B, S = tokens.shape
     M = n_micro or n_stages
 
-    x = params["tok_emb"].astype(dt)[tokens]
-    if cfg.pos_emb == "learned":
-        x = x + params["pos_emb"].astype(dt)[:S][None]
-    if cfg.emb_norm:
-        x = _norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
-    x = constrain(x)
+    def embed(embp, toks):
+        e = embp["tok_emb"].astype(dt)[toks]
+        if cfg.pos_emb == "learned":
+            e = e + embp["pos_emb"].astype(dt)[:S][None]
+        if cfg.emb_norm:
+            e = _norm(e, embp["emb_norm"], cfg.norm, cfg.norm_eps)
+        return constrain(e)
 
+    emb_keys = ["tok_emb"]
+    if cfg.pos_emb == "learned":
+        emb_keys.append("pos_emb")
+    if cfg.emb_norm:
+        emb_keys.append("emb_norm")
+    embp = {k: params[k] for k in emb_keys}
+
+    x = embed(embp, tokens)
     cos = sin = None
     if cfg.pos_emb == "rope":
         cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
@@ -687,13 +694,94 @@ def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         h = _norm(y, ex["final_norm"], cfg.norm, cfg.norm_eps)
         # plain dot (not the custom-vjp head_matmul): inside the pipe
         # shard_map the replicated head's cotangent needs the automatic
-        # varying→replicated psum, which a custom_vjp would bypass
+        # varying->replicated psum, which a custom_vjp would bypass
         logits = jnp.matmul(h, ex["head"].astype(h.dtype),
                             preferred_element_type=jnp.float32)
         return causal_lm_loss(logits, micro["tokens"], micro.get("loss_mask"))
 
+    return mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn
+
+
+def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
+                      mesh=None, n_micro: Optional[int] = None,
+                      attention_fn: Optional[AttentionFn] = None,
+                      activation_constraint: Optional[Callable] = None,
+                      loss_mask: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Causal-LM loss with the layer stack pipelined over the 'pipe' mesh axis
+    (GPipe forward wavefront — the InferenceSchedule analog; backward via
+    autodiff). Returns (loss, moe_aux).
+    See ``parallel/pipeline.py`` (reference ``runtime/pipe/engine.py:337``).
+    """
+    from deepspeed_tpu.parallel.pipeline import pipelined_apply
+
+    mesh, M, _, _, inputs, extra, stage_fn, finalize_fn = _pipeline_parts(
+        params, tokens, cfg, mesh, n_micro, attention_fn,
+        activation_constraint, loss_mask)
     return pipelined_apply(inputs, params["blocks"], extra, stage_fn,
                            finalize_fn, mesh)
+
+
+def pipelined_lm_loss_and_grads(params: PyTree, tokens: jax.Array,
+                                cfg: TransformerConfig, mesh=None,
+                                n_micro: Optional[int] = None,
+                                attention_fn: Optional[AttentionFn] = None,
+                                activation_constraint: Optional[Callable] = None,
+                                loss_mask: Optional[jax.Array] = None,
+                                loss_scale=None
+                                ) -> Tuple[jax.Array, PyTree]:
+    """1F1B pipelined loss AND grads (reference ``runtime/pipe/schedule.py:189``
+    ``TrainSchedule``): explicit backward schedule with O(P) activation
+    residency instead of letting autodiff reverse the GPipe wavefront (O(M)).
+    Returns (loss incl. any MoE aux term, grads w.r.t. ``params`` — same
+    tree, fp32 leaves). Not supported: ``lm_head_bias`` models (same as the
+    GPipe path)."""
+    from deepspeed_tpu.parallel.pipeline import pipelined_train_1f1b
+
+    mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn = \
+        _pipeline_parts(params, tokens, cfg, mesh, n_micro, attention_fn,
+                        activation_constraint, loss_mask)
+    dt = cfg.compute_dtype
+
+    def input_grad_fn(dx, micro, acc):
+        if dx is None:   # zeros accumulators (also defines the out structure)
+            return jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), embp)
+        _, vjp = jax.vjp(lambda ep: embed(ep, micro["tokens"]), embp)
+        (d,) = vjp(dx.astype(dt))
+        return jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, d)
+
+    aux_seed = None
+    if cfg.n_experts > 0:
+        aux_seed = jnp.float32(cfg.moe_aux_coef) * (
+            loss_scale if loss_scale is not None else 1.0)
+
+    loss, aux, gblocks, gextra, gemb = pipelined_train_1f1b(
+        inputs, params["blocks"], extra, stage_fn, finalize_fn, input_grad_fn,
+        mesh, loss_scale=loss_scale, aux_seed=aux_seed)
+    if cfg.n_experts > 0:
+        # keep the reported loss comparable with the GPipe path (loss_fn
+        # adds the aux term there)
+        loss = loss + cfg.moe_aux_coef * aux
+
+    grads: Dict[str, Any] = {"blocks": gblocks,
+                             "final_norm": gextra["final_norm"]}
+    g_tok = gemb["tok_emb"]
+    if cfg.tie_embeddings:
+        g_tok = g_tok + gextra["head"].T
+    else:
+        grads["lm_head"] = gextra["head"]
+    grads["tok_emb"] = g_tok
+    if cfg.pos_emb == "learned":
+        grads["pos_emb"] = gemb["pos_emb"]
+    if cfg.emb_norm:
+        grads["emb_norm"] = gemb["emb_norm"]
+    missing = set(params) - set(grads)
+    if missing:
+        raise NotImplementedError(
+            f"pipelined grads missing for param groups {sorted(missing)}")
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    return loss, grads
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,

@@ -117,3 +117,162 @@ def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
     if B % n_micro != 0:
         raise ValueError(f"batch {B} not divisible by pipeline microbatches {n_micro}")
     return x.reshape((n_micro, B // n_micro) + x.shape[1:])
+
+
+# --------------------------------------------------------------------------- #
+# 1F1B training schedule
+# --------------------------------------------------------------------------- #
+
+def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
+                         extra: PyTree, stage_fn: Callable,
+                         finalize_fn: Callable, input_grad_fn: Callable,
+                         mesh: Mesh, axis_name: str = PIPE_AXIS,
+                         loss_scale=None, aux_seed=None
+                         ) -> Tuple[jax.Array, PyTree, PyTree, PyTree]:
+    """1F1B pipeline schedule with EXPLICIT backward (reference
+    ``runtime/pipe/schedule.py:189 TrainSchedule``).
+
+    The GPipe path (:func:`pipelined_apply`) lets autodiff reverse the tick
+    scan, which saves one activation per tick — backward memory grows O(M)
+    with microbatch count. Here every tick runs ONE forward and ONE backward
+    (``jax.vjp`` with stage-input recompute, i.e. activation checkpointing at
+    stage granularity, reference ``pipe/engine.py`` + Megatron-style 1F1B):
+    stage s forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2P-2-s)``, so at most ``2(P-1-s)+1 ≤ 2P-1`` stage inputs are ever
+    live — O(P), independent of M. Activation hops ride ``lax.ppermute``
+    (forward to s+1, cotangent to s-1) exactly like the reference's
+    SendActivation/SendGrad instruction pairs.
+
+    * ``stage_fn(x, blocks_l, extra) -> (y, aux)``
+    * ``finalize_fn(y, micro_inputs, extra) -> scalar loss`` (last stage)
+    * ``input_grad_fn(dx, micro_inputs, acc) -> acc`` — folds the cotangent
+      of the stage-0 INPUT back onto the embedding parameters (runs at
+      stage 0's backward tick; the reference's tied-embedding grad path).
+      ``acc`` is a pytree of embedding-grad accumulators (zeros-init by the
+      caller via ``input_grad_fn(None, None, None)``).
+
+    Returns (mean loss, blocks grads [stage-sharded], extra grads
+    [replicated, psum over pipe], embedding grads [replicated]).
+    ``loss_scale`` multiplies the cotangent seed (fp16 loss scaling);
+    ``aux_seed`` seeds each stage's aux output (MoE aux-loss coefficient,
+    already including the scale; None → aux ignored).
+    """
+    n_stages = mesh.shape[axis_name]
+    M = jax.tree.leaves(inputs)[0].shape[0]
+    P_ = n_stages
+    T = M + 2 * P_ - 2
+    buf_n = 2 * P_
+    fwd_perm = stage_perm(n_stages)
+    bwd_perm = [(d, s) for (s, d) in fwd_perm]
+
+    def local(inputs_l, blocks_l, extra_l):
+        stage = lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        xm = inputs_l["x"]
+        b_shape = xm.shape[1:]
+        dt = xm.dtype
+        zeros_act = jnp.zeros(b_shape, dt)
+
+        gblocks0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), blocks_l)
+        gextra0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), extra_l)
+        gemb0 = input_grad_fn(None, None, None)   # zeros accumulators
+
+        def micro_of(m):
+            return {k: v[jnp.clip(m, 0, M - 1)]
+                    for k, v in inputs_l.items() if k != "x"}
+
+        def tick(carry, t):
+            (fwd_recv, bwd_recv, store, gblocks, gextra, gemb,
+             loss_sum, aux_sum) = carry
+
+            # ---- forward: microbatch t - s --------------------------------
+            m_f = t - stage
+            valid_f = (m_f >= 0) & (m_f < M)
+            x_in = jnp.where(is_first, xm[jnp.clip(m_f, 0, M - 1)], fwd_recv)
+            y, aux = stage_fn(x_in, blocks_l, extra_l)
+            aux_sum = aux_sum + jnp.where(valid_f, aux, 0.0)
+            store = lax.dynamic_update_index_in_dim(
+                store, x_in, jnp.clip(m_f, 0, None) % buf_n, 0)
+
+            # ---- backward: microbatch t - (2P-2-s) ------------------------
+            m_b = t - (2 * (P_ - 1) - stage)
+            valid_b = (m_b >= 0) & (m_b < M)
+            x_saved = store[jnp.clip(m_b, 0, None) % buf_n]
+            micro_b = micro_of(m_b)
+
+            def last_stage_bwd(operand):
+                x_s, _gy = operand
+
+                def stage_loss(x, bl, ex):
+                    yy, aux = stage_fn(x, bl, ex)
+                    loss = finalize_fn(yy, micro_b, ex)
+                    return loss, aux
+
+                (loss_m, aux_m), vjp = jax.vjp(stage_loss, x_s, blocks_l,
+                                               extra_l, has_aux=False)
+                seed = jnp.float32(1.0) if loss_scale is None else loss_scale
+                aseed = jnp.float32(0.0) if aux_seed is None else aux_seed
+                dx, dbl, dex = vjp((seed.astype(loss_m.dtype),
+                                    aseed.astype(loss_m.dtype)))
+                return loss_m, dx, dbl, dex
+
+            def mid_stage_bwd(operand):
+                x_s, gy = operand
+
+                def stage_out(x, bl, ex):
+                    yy, aux = stage_fn(x, bl, ex)
+                    return yy, aux
+
+                (_, _), vjp = jax.vjp(stage_out, x_s, blocks_l, extra_l)
+                aseed = jnp.float32(0.0) if aux_seed is None else aux_seed
+                dx, dbl, dex = vjp((gy, aseed.astype(jnp.float32)))
+                return jnp.float32(0.0), dx, dbl, dex
+
+            loss_m, dx, dbl, dex = lax.cond(
+                is_last, last_stage_bwd, mid_stage_bwd, (x_saved, bwd_recv))
+
+            keep = valid_b.astype(jnp.float32)
+            gblocks = jax.tree.map(
+                lambda a, g: a + keep * g.astype(jnp.float32), gblocks, dbl)
+            gextra = jax.tree.map(
+                lambda a, g: a + keep * g.astype(jnp.float32), gextra, dex)
+            loss_sum = loss_sum + jnp.where(valid_b & is_last, loss_m, 0.0)
+            # stage 0's input cotangent folds onto the embedding params
+            gemb = jax.tree.map(
+                lambda a, g: a + jnp.where(valid_b & is_first, 1.0, 0.0) * g,
+                gemb, input_grad_fn(dx, micro_b, gemb0))
+
+            # ---- hops: activation →s+1, cotangent →s-1 --------------------
+            send_f = lax.ppermute(y, axis_name, fwd_perm)
+            dx_masked = jnp.where(valid_b, dx.astype(dt), zeros_act)
+            send_b = lax.ppermute(dx_masked, axis_name, bwd_perm)
+            return (send_f, send_b, store, gblocks, gextra, gemb,
+                    loss_sum, aux_sum), None
+
+        carry0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis_name,), to="varying"),
+            (zeros_act, jnp.zeros(b_shape, dt),
+             jnp.zeros((buf_n,) + b_shape, dt),
+             gblocks0, gextra0, gemb0, jnp.float32(0.0), jnp.float32(0.0)))
+        (_, _, _, gblocks, gextra, gemb, loss_sum, aux_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        loss = lax.psum(loss_sum, axis_name) / M
+        aux = lax.psum(aux_sum, axis_name) / M
+        gextra = jax.tree.map(lambda g: lax.psum(g, axis_name) / M, gextra)
+        gemb = jax.tree.map(lambda g: lax.psum(g, axis_name) / M, gemb)
+        gblocks = jax.tree.map(lambda g: g / M, gblocks)
+        return loss, aux, gblocks, gextra, gemb
+
+    in_specs = (_replicated_specs(inputs),
+                _stage_sharded_specs(blocks, axis_name),
+                _replicated_specs(extra))
+    out_specs = (P(), P(), _stage_sharded_specs(blocks, axis_name),
+                 _replicated_specs(extra),
+                 jax.tree.map(lambda _: P(), input_grad_fn(None, None, None)))
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   axis_names={axis_name}, check_vma=False)
+    return fn(inputs, blocks, extra)

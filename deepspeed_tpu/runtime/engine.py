@@ -375,6 +375,18 @@ class DeepSpeedTPUEngine:
         return jax.tree.map(jax.lax.with_sharding_constraint, grads, grad_sh)
 
     def _loss_and_grads(self, master: PyTree, batch: PyTree, scale) -> Tuple[jax.Array, PyTree]:
+        # schedules with an explicit backward (1F1B pipeline) return grads
+        # directly — autodiff over the loss would rebuild the O(M)-memory
+        # GPipe reverse wavefront
+        fn = getattr(self.model_spec, "loss_and_grads_fn", None)
+        if fn is not None:
+            out = fn(self._compute_params(master), batch, scale)
+            if out is not None:
+                loss, grads = out
+                grads = jax.tree.map(
+                    lambda g, m: g.astype(m.dtype), grads, master)
+                return loss, self._constrain_grads(grads)
+
         def scaled_loss(m):
             params = self._compute_params(m)
             loss = self.model_spec.loss_fn(params, batch)

@@ -164,6 +164,21 @@ def test_fastgen_pool_backpressure():
         fg.put([2], _prompts(rng, [4]))
 
 
+def test_fastgen_generate_all_frees_blocks_of_done_seqs():
+    """Regression: done-but-unflushed sequences release their KV blocks so
+    waiting prompts can prefill — generate_all must not livelock when the
+    pool only fits a subset of the batch at once."""
+    rng = np.random.default_rng(9)
+    fg = FastGenEngine("tiny", n_blocks=8, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0, **CFG)
+    out = fg.generate_all([1, 2, 3], _prompts(rng, [40, 40, 40]),
+                          max_new_tokens=6)
+    assert all(len(out[u]) == 6 for u in (1, 2, 3)), {
+        u: len(v) for u, v in out.items()}
+    assert fg.allocator.free_blocks == 7
+
+
 def test_fastgen_alibi_rejected():
     with pytest.raises(NotImplementedError, match="ALiBi"):
         FastGenEngine("tiny", **dict(CFG, pos_emb="alibi"))

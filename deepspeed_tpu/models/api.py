@@ -160,9 +160,15 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             if cfg.n_experts > 0:
                 loss = loss + cfg.moe_aux_coef * aux
             return loss
+        # engine-injected data-efficiency controls (PLD mask, random-LTD
+        # kept-token indices) ride the batch dict under underscore keys
+        pld_keep = batch.get("_pld_keep") if isinstance(batch, dict) else None
+        ltd_idx = batch.get("_random_ltd_idx") if isinstance(batch, dict) \
+            else None
         hidden, head, aux = T.forward_hidden(
             params, tokens, cfg, attention_fn=attention_fn,
-            activation_constraint=activation_constraint)
+            activation_constraint=activation_constraint,
+            pld_keep=pld_keep, random_ltd_idx=ltd_idx)
         if loss_tiles > 1:
             from deepspeed_tpu.sequence.tiled import tiled_lm_loss
 

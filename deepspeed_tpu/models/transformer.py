@@ -447,14 +447,27 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
 
 def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                    attention_fn: Optional[AttentionFn] = None,
-                   activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None
+                   activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None,
+                   pld_keep: Optional[jax.Array] = None,
+                   random_ltd_idx: Optional[jax.Array] = None
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """tokens [B, S] int32 → (final hidden [B, S, H], lm head [H, vocab],
-    moe aux loss — summed over layers, 0.0 for dense models)."""
+    moe aux loss — summed over layers, 0.0 for dense models).
+
+    ``pld_keep`` [L] float 0/1: progressive-layer-drop mask — a dropped layer
+    contributes identity (reference ``runtime/progressive_layer_drop.py``;
+    under jit both branches are computed, so PLD acts as the stochastic-depth
+    regularizer, not a compute saver — documented TPU semantics).
+    ``random_ltd_idx`` [K] sorted positions: random-LTD — the MIDDLE layers
+    (all but first and last) run on only these K tokens; dropped tokens skip
+    the middle stack via gather/scatter (reference ``data_routing/`` +
+    ``csrc/random_ltd``; here the drop set is shared across the middle stack
+    so the scan keeps uniform shapes)."""
     attention_fn = attention_fn or dot_product_attention
     constrain = activation_constraint or (lambda x: x)
     dt = cfg.compute_dtype
     B, S = tokens.shape
+    L = cfg.num_layers
 
     x = params["tok_emb"].astype(dt)[tokens]
     if cfg.pos_emb == "learned":
@@ -467,20 +480,62 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     if cfg.pos_emb == "rope":
         cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
 
-    def body(carry, layer_params):
-        y, aux = _block_forward(carry, layer_params, cfg, cos, sin, attention_fn)
-        return constrain(y), aux
+    def make_body(cos_b, sin_b, with_pld: bool):
+        def body(carry, xs):
+            if with_pld:
+                layer_params, keep = xs
+            else:
+                layer_params, keep = xs, None
+            y, aux = _block_forward(carry, layer_params, cfg, cos_b, sin_b,
+                                    attention_fn)
+            if keep is not None:
+                k = keep.astype(y.dtype)   # don't promote the bf16 carry
+                y = k * y + (1 - k) * carry
+                aux = keep * aux
+            return constrain(y), aux
 
-    if cfg.remat == "full":
-        body = jax.checkpoint(body)
-    elif cfg.remat == "dots_saveable":
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_saveable)
+        if cfg.remat == "full":
+            body = jax.checkpoint(body)
+        elif cfg.remat == "dots_saveable":
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.dots_saveable)
+        return body
 
-    x, auxes = lax.scan(body, x, params["blocks"])
+    with_pld = pld_keep is not None
+
+    def run(x, blocks, cos_b, sin_b, keep):
+        xs = (blocks, keep) if with_pld else blocks
+        return lax.scan(make_body(cos_b, sin_b, with_pld), x, xs)
+
+    if random_ltd_idx is not None and cfg.pos_emb == "alibi":
+        raise NotImplementedError(
+            "random-LTD with ALiBi positions is unsupported: the middle-stack "
+            "bias would be computed from compacted indices (rope tables are "
+            "index-gathered; ALiBi distances cannot be)")
+    if random_ltd_idx is None or L < 3:
+        x, auxes = run(x, params["blocks"], cos, sin, pld_keep)
+        aux_total = jnp.sum(auxes)
+    else:
+        blk = params["blocks"]
+        first = jax.tree.map(lambda p: p[:1], blk)
+        middle = jax.tree.map(lambda p: p[1:L - 1], blk)
+        last = jax.tree.map(lambda p: p[L - 1:], blk)
+        k1 = k2 = k3 = None
+        if with_pld:
+            k1, k2, k3 = pld_keep[:1], pld_keep[1:L - 1], pld_keep[L - 1:]
+        cos_k = sin_k = None
+        if cos is not None:
+            cos_k, sin_k = cos[random_ltd_idx], sin[random_ltd_idx]
+        x, a1 = run(x, first, cos, sin, k1)
+        xk = jnp.take(x, random_ltd_idx, axis=1)          # gather kept
+        xk, a2 = run(xk, middle, cos_k, sin_k, k2)
+        x = x.at[:, random_ltd_idx].set(xk)               # scatter back
+        x, a3 = run(x, last, cos, sin, k3)
+        aux_total = jnp.sum(a1) + jnp.sum(a2) + jnp.sum(a3)
+
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
-    return x, head, jnp.sum(auxes)
+    return x, head, aux_total
 
 
 def forward(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,

@@ -192,12 +192,85 @@ class PipelineSectionConfig:
     activation_checkpoint_interval: int = 0
 
 
+@dataclasses.dataclass
+class CurriculumConfig:
+    """Reference ``data_efficiency.data_sampling.curriculum_learning`` keys
+    (``runtime/data_pipeline/data_sampling/curriculum_scheduler.py``)."""
+    enabled: bool = False
+    schedule_type: str = "fixed_linear"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    total_curriculum_step: int = 1000
+    difficulty_step: int = 8
+    root_degree: int = 2
+    difficulty: list = dataclasses.field(default_factory=list)
+    max_step: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class DynamicBatchingConfig:
+    """Reference ``variable_batch_size_and_lr.py`` (492 LoC): token-budget
+    batching of variable-length samples with LR scaling."""
+    enabled: bool = False
+    max_tokens: int = 8192
+    lr_scaling_method: str = "linear"   # linear | sqrt | none
+    min_batch_size: int = 1
+    max_batch_size: int = 0             # 0 → unlimited
+    sentence_picking_order: str = "dataloader"  # dataloader | random | seqlen
+
+
+@dataclasses.dataclass
+class RandomLTDConfig:
+    """Reference ``data_efficiency.data_routing.random_ltd``."""
+    enabled: bool = False
+    total_layer_num: int = 0            # 0 → all middle layers
+    random_ltd_layer_num: int = 0
+    max_value: int = 1024
+    random_ltd_schedule: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DataSamplingConfig:
+    enabled: bool = False
+    curriculum_learning: CurriculumConfig = dataclasses.field(
+        default_factory=CurriculumConfig)
+    dynamic_batching: DynamicBatchingConfig = dataclasses.field(
+        default_factory=DynamicBatchingConfig)
+
+
+@dataclasses.dataclass
+class DataRoutingConfig:
+    enabled: bool = False
+    random_ltd: RandomLTDConfig = dataclasses.field(
+        default_factory=RandomLTDConfig)
+
+
+@dataclasses.dataclass
+class DataEfficiencyConfig:
+    """Reference ``data_efficiency`` section (``runtime/data_pipeline/``)."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: DataSamplingConfig = dataclasses.field(
+        default_factory=DataSamplingConfig)
+    data_routing: DataRoutingConfig = dataclasses.field(
+        default_factory=DataRoutingConfig)
+
+
+@dataclasses.dataclass
+class ProgressiveLayerDropConfig:
+    """Reference ``progressive_layer_drop`` section
+    (``runtime/progressive_layer_drop.py``; engine hook engine.py:430)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 # CUDA-only reference sections accepted and ignored (keeps real DeepSpeed JSON
 # configs loadable); each logs once when present.
 _IGNORED_SECTIONS = (
     "amp", "autotuning", "aio", "hybrid_engine", "compression_training",
     "sparse_attention", "zero_allow_untested_optimizer", "communication_data_type",
-    "elasticity", "checkpoint", "data_efficiency", "curriculum_learning",
+    "elasticity", "checkpoint",
 )
 
 
@@ -234,6 +307,24 @@ class DeepSpeedTPUConfig:
     zero_force_ds_cpu_optimizer: bool = False
     checkpoint_tag_validation: str = "Warn"  # Ignore | Warn | Fail
     checkpoint_writer: str = "orbax"  # orbax | fast (checkpoint_engine.py)
+    data_efficiency: DataEfficiencyConfig = dataclasses.field(
+        default_factory=DataEfficiencyConfig)
+    # legacy top-level section (reference supports both placements)
+    curriculum_learning: CurriculumConfig = dataclasses.field(
+        default_factory=CurriculumConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = dataclasses.field(
+        default_factory=ProgressiveLayerDropConfig)
+
+    @property
+    def curriculum(self) -> CurriculumConfig:
+        """Active curriculum config: the data_efficiency placement applies
+        when its parent gates are on (reference semantics); the legacy
+        top-level section needs no parent."""
+        de = self.data_efficiency
+        cur = de.data_sampling.curriculum_learning
+        if cur.enabled and de.enabled and de.data_sampling.enabled:
+            return cur
+        return self.curriculum_learning
 
     # resolved fields (filled by _resolve_batch_size)
     _dp_world_size: int = 1

@@ -1,0 +1,115 @@
+"""Variable batch size + LR scaling — token-budget batching.
+
+Parity: reference ``runtime/data_pipeline/data_sampling/
+variable_batch_size_and_lr.py:1-492`` (``batch_by_size``: group
+variable-length samples so each batch holds ≈``max_tokens``; scale the LR per
+batch so the update magnitude matches the nominal batch size).
+
+TPU adaptation: XLA needs static shapes, so each emitted batch is PADDED to a
+(batch-bucket × seq-bucket) grid — a handful of compiled programs instead of
+one per composition. The LR scale rides the batch dict (``"lr_scale"``) and
+the engine folds it into the step's learning rate inside jit.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def batch_by_tokens(lengths: Sequence[int], max_tokens: int,
+                    min_batch_size: int = 1, max_batch_size: int = 0,
+                    order: str = "dataloader",
+                    seed: int = 0) -> List[List[int]]:
+    """Group sample indices into batches of ≈``max_tokens`` total (padded)
+    tokens (reference ``batch_by_size``). Batch cost = n_samples × max_len
+    (padded rectangle, what the chip actually computes)."""
+    idx = list(range(len(lengths)))
+    if order == "random":
+        np.random.default_rng(seed).shuffle(idx)
+    elif order == "seqlen":
+        idx.sort(key=lambda i: lengths[i])
+    batches: List[List[int]] = []
+    cur: List[int] = []
+    cur_max = 0
+    for i in idx:
+        new_max = max(cur_max, lengths[i])
+        if cur and ((len(cur) + 1) * new_max > max_tokens
+                    or (max_batch_size and len(cur) >= max_batch_size)):
+            batches.append(cur)
+            cur, cur_max = [], 0
+            new_max = lengths[i]
+        cur.append(i)
+        cur_max = new_max
+    if cur:
+        batches.append(cur)
+    for b in batches:
+        if len(b) < min_batch_size and len(batches) > 1:
+            # fold undersized tail into the previous batch (reference drops
+            # or merges; merging loses no data)
+            batches[batches.index(b) - 1].extend(b)
+            batches.remove(b)
+    return batches
+
+
+def lr_scale_for(batch_size: int, base_batch_size: int,
+                 method: str = "linear") -> float:
+    """Reference ``scale_lr``: linear (Goyal et al.) or sqrt (Hoffer et al.)
+    scaling of the LR with the realized batch size."""
+    if method == "none" or base_batch_size <= 0:
+        return 1.0
+    r = batch_size / base_batch_size
+    if method == "linear":
+        return r
+    if method == "sqrt":
+        return math.sqrt(r)
+    raise ValueError(f"unknown lr_scaling_method {method!r}")
+
+
+def _bucket_pow2(n: int, minimum: int = 1) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def variable_batch_dataloader(samples: Sequence[np.ndarray], max_tokens: int,
+                              base_batch_size: int,
+                              lr_scaling_method: str = "linear",
+                              min_batch_size: int = 1,
+                              max_batch_size: int = 0,
+                              order: str = "dataloader",
+                              pad_token: int = 0,
+                              seed: int = 0,
+                              batch_multiple: int = 1,
+                              loop: bool = True) -> Iterator[Dict[str, Any]]:
+    """Yield dict batches {'tokens': [B_pad, S_pad], 'loss_mask', 'lr_scale'}.
+
+    B and S are bucketed to powers of two so the engine compiles a bounded
+    program set; ``batch_multiple`` additionally rounds B up to the data-
+    parallel width so the batch dim shards evenly. ``lr_scale`` reflects the
+    REAL (unpadded) sample count; padded rows carry a zero loss mask.
+    """
+    lengths = [len(s) for s in samples]
+    batches = batch_by_tokens(lengths, max_tokens, min_batch_size,
+                              max_batch_size, order, seed)
+    while True:
+        for group in batches:
+            real_b = len(group)
+            s_max = max(lengths[i] for i in group)
+            B = _bucket_pow2(real_b, minimum=max(1, batch_multiple))
+            B = -(-B // batch_multiple) * batch_multiple
+            S = _bucket_pow2(s_max, minimum=8)
+            tokens = np.full((B, S), pad_token, np.int32)
+            mask = np.zeros((B, S), np.float32)
+            for r, i in enumerate(group):
+                n = lengths[i]
+                tokens[r, :n] = samples[i]
+                mask[r, :n] = 1.0
+            yield {"tokens": tokens, "loss_mask": mask,
+                   "lr_scale": np.float32(
+                       lr_scale_for(real_b, base_batch_size,
+                                    lr_scaling_method))}
+        if not loop:
+            return

@@ -158,11 +158,19 @@ class DeepSpeedTPUEngine:
         # (reference runtime/zero/offload_config.py + swap_tensor swappers;
         # the device↔host moves bracket the jitted step like the reference's
         # swap-in/step/swap-out flow, stage_1_and_2.py initialize/step)
-        self._offload_opt = (
-            self.config.zero_optimization.offload_optimizer.device == "cpu")
+        offload_dev = self.config.zero_optimization.offload_optimizer.device
+        self._offload_opt = offload_dev == "cpu"
+        # NVMe tier: optimizer state swapped to local disk around the step
+        # (reference swap_tensor/partitioned_optimizer_swapper.py:27)
+        self._offload_nvme = offload_dev == "nvme"
+        self._opt_swapper = None   # built lazily (needs self.state)
 
         # ZeRO++ compressed collectives (qwZ/qgZ) + 1-bit optimizer transport
         self._resolve_compressed_modes(zcfg)
+
+        # data-efficiency features (reference runtime/data_pipeline/ +
+        # progressive_layer_drop.py — config-driven, engine-injected)
+        self._setup_data_efficiency()
 
         self.state = self._init_state()
         self._compiled: Dict[Any, Any] = {}
@@ -279,6 +287,92 @@ class DeepSpeedTPUEngine:
             logger.warning("qwZ/qgZ and 1-bit transport are mutually "
                            "exclusive — using 1-bit transport")
             self._compressed = None
+
+    # ------------------------------------------------------------------ #
+    # data efficiency (curriculum / random-LTD / PLD / variable batch)
+    # ------------------------------------------------------------------ #
+    def _setup_data_efficiency(self) -> None:
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler,
+            RandomLTDScheduler,
+        )
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop,
+        )
+
+        import dataclasses as _dc
+
+        pipe = self.mesh_manager.axis_size("pipe") > 1
+        self._curriculum = None
+        cur = self.config.curriculum
+        if cur.enabled:
+            self._curriculum = CurriculumScheduler(_dc.asdict(cur))
+            log_dist(f"curriculum learning active: {cur.schedule_type} "
+                     f"{cur.min_difficulty}→{cur.max_difficulty}")
+
+        self._ltd = None
+        de = self.config.data_efficiency
+        ltd = de.data_routing.random_ltd
+        if ltd.enabled and not (de.enabled and de.data_routing.enabled):
+            logger.warning(
+                "random_ltd.enabled is set but data_efficiency.enabled / "
+                "data_routing.enabled are not — random-LTD stays OFF "
+                "(reference parent-gate semantics)")
+        elif ltd.enabled:
+            if pipe:
+                logger.warning("random-LTD is not supported with pipeline "
+                               "parallelism — disabled")
+            else:
+                self._ltd = RandomLTDScheduler(
+                    {"random_ltd_schedule": ltd.random_ltd_schedule,
+                     "max_value": ltd.max_value})
+                log_dist("random-LTD active")
+
+        self._pld = None
+        pld = self.config.progressive_layer_drop
+        if pld.enabled:
+            if pipe:
+                logger.warning("progressive layer drop is not supported with "
+                               "pipeline parallelism — disabled")
+            else:
+                self._pld = ProgressiveLayerDrop(pld.theta, pld.gamma)
+                log_dist(f"progressive layer drop active: theta={pld.theta} "
+                         f"gamma={pld.gamma}")
+        self._np_rng = np.random.default_rng(self.config.seed)
+
+    def _n_layers(self) -> int:
+        cfg = getattr(self.model_spec, "config", None)
+        return getattr(cfg, "num_layers", 0) or 0
+
+    def _inject_data_efficiency(self, stacked: PyTree, gas: int) -> PyTree:
+        """Add per-micro PLD keep masks / random-LTD kept-token indices to
+        the stacked batch dict (underscore keys — replicated, consumed by the
+        model spec's loss_fn)."""
+        if self._ltd is None and self._pld is None:
+            return stacked
+        if not isinstance(stacked, dict):
+            stacked = {"tokens": stacked}
+        else:
+            stacked = dict(stacked)
+        if self._pld is not None:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                layer_keep_probs,
+            )
+
+            L = self._n_layers()
+            theta = self._pld.update_state(self.global_steps)
+            probs = np.asarray(jax.device_get(layer_keep_probs(theta, L)))
+            stacked["_pld_keep"] = (
+                self._np_rng.random((gas, L)) < probs[None]
+            ).astype(np.float32)
+        if self._ltd is not None:
+            seq_len = np.asarray(stacked["tokens"]).shape[-1]
+            kept = min(self._ltd.get_kept_tokens(self.global_steps), seq_len)
+            idx = np.stack([
+                np.sort(self._np_rng.choice(seq_len, kept, replace=False))
+                for _ in range(gas)]).astype(np.int32)
+            stacked["_random_ltd_idx"] = idx
+        return stacked
 
     # ------------------------------------------------------------------ #
     # state construction
@@ -403,10 +497,15 @@ class DeepSpeedTPUEngine:
         return jnp.asarray(self.optimizer.lr, jnp.float32)
 
     def _apply_update(self, state: Dict[str, Any], grads: PyTree,
-                      grad_scale) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
+                      grad_scale, lr_mult=None
+                      ) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
         """Unscale, clip, (maybe skip on overflow), optimizer update."""
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) / grad_scale, grads)
         lr = self._lr_at(state["step"])
+        if lr_mult is not None:
+            # variable-batch LR scaling (reference
+            # variable_batch_size_and_lr.py scale_lr)
+            lr = lr * lr_mult
         if self._trainable_mask is not None:
             from deepspeed_tpu.utils.tree import prune_tree
 
@@ -468,7 +567,11 @@ class DeepSpeedTPUEngine:
                 mean_loss = jnp.mean(losses)
 
             grad_scale = jnp.float32(gas) * (scale if scale is not None else 1.0)
-            new_state, metrics = self._apply_update(state, grads_sum, grad_scale)
+            lr_mult = None
+            if isinstance(batch, dict) and "lr_scale" in batch:
+                lr_mult = jnp.mean(batch["lr_scale"].astype(jnp.float32))
+            new_state, metrics = self._apply_update(state, grads_sum,
+                                                    grad_scale, lr_mult)
             metrics["loss"] = mean_loss
             return new_state, metrics
 
@@ -663,9 +766,23 @@ class DeepSpeedTPUEngine:
 
     def _shard_batch(self, batch: PyTree, leading: bool = False) -> PyTree:
         spec_for = self._batch_shardings(leading)
-        return jax.tree.map(
-            lambda x: shard_host_batch(np.asarray(x), spec_for(np.asarray(x).ndim)),
-            batch)
+        rep = NamedSharding(self.mesh, P())
+
+        def one(path, x):
+            x = np.asarray(x)
+            # underscore keys (engine-injected controls: PLD masks, LTD
+            # indices, lr_scale) and scalars are replicated, not batch-sharded
+            keys = [getattr(p, "key", None) for p in path]
+            if x.ndim == 0 or any(isinstance(k, str) and k.startswith("_")
+                                  for k in keys) or "lr_scale" in keys:
+                if leading and x.ndim > 0:
+                    return shard_host_batch(
+                        x, NamedSharding(self.mesh,
+                                         P(*([None] * x.ndim))))
+                return shard_host_batch(x, rep)
+            return shard_host_batch(x, spec_for(x.ndim))
+
+        return jax.tree_util.tree_map_with_path(one, batch)
 
     # ------------------------------------------------------------------ #
     # public batch-size queries (reference engine API)
@@ -711,6 +828,18 @@ class DeepSpeedTPUEngine:
         target = self._to_host_shardings(opt_sh) if direction == "out" else opt_sh
         self.state["opt"] = jax.device_put(self.state["opt"], target)
 
+    def _nvme_swapper(self):
+        """Lazy NVMe optimizer-state swapper (reference
+        ``swap_tensor/partitioned_optimizer_swapper.py:27``; config path
+        ``offload_optimizer.device == "nvme"``)."""
+        if self._opt_swapper is None:
+            from deepspeed_tpu.runtime.swap_tensor import OptimizerSwapper
+
+            self._opt_swapper = OptimizerSwapper(self)
+            log_dist("NVMe optimizer offload active: "
+                     f"{self._opt_swapper.swapper.swap_dir}")
+        return self._opt_swapper
+
     # ------------------------------------------------------------------ #
     # offload_states / reload_states (reference engine.py:5573/:5603)
     # ------------------------------------------------------------------ #
@@ -743,7 +872,18 @@ class DeepSpeedTPUEngine:
         """Pull GAS micro-batches, run the fused jitted step. Returns mean loss."""
         gas = self.gradient_accumulation_steps()
         micros = [next(data_iter) for _ in range(gas)]
-        stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *micros)
+
+        def stack(*xs):
+            arrs = [np.asarray(x) for x in xs]
+            if len({a.shape for a in arrs}) > 1:
+                raise ValueError(
+                    "micro-batches in one accumulation window have different "
+                    f"shapes {[a.shape for a in arrs]} — variable/token-"
+                    "budget batching requires gradient_accumulation_steps=1")
+            return np.stack(arrs)
+
+        stacked = jax.tree.map(stack, *micros)
+        stacked = self._inject_data_efficiency(stacked, gas)
 
         key = ("train_step", gas)
         if key not in self._compiled:
@@ -761,10 +901,14 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         if self._offload_opt:
             self._opt_swap("in")
+        if self._offload_nvme:
+            self._nvme_swapper().swap_in_optimizer()
         with self.mesh:
             self.state, metrics = step_fn(self.state, batch)
         if self._offload_opt:
             self._opt_swap("out")
+        if self._offload_nvme:
+            self._nvme_swapper().swap_out_optimizer()
         self.global_steps += 1
         self.micro_steps += gas
         self._after_step(metrics)
@@ -798,6 +942,11 @@ class DeepSpeedTPUEngine:
                 "the eager forward()/backward()/step() path is unavailable "
                 "with 1-bit wire transport (per-rank error buffers live "
                 "inside the fused step's shard_map) — use train_batch()")
+        if self._offload_nvme:
+            raise NotImplementedError(
+                "the eager forward()/backward()/step() path is unavailable "
+                "with offload_optimizer.device='nvme' (moments are swapped "
+                "around the fused step) — use train_batch()")
         if "fwd_bwd" not in self._compiled:
             def fwd_bwd(state, b):
                 scale = state["scaler"].scale if self.fp16_enabled else None
@@ -901,11 +1050,52 @@ class DeepSpeedTPUEngine:
 
         Re-iterable sources are wrapped in RepeatingLoader when ``repeat``;
         one-shot iterators/generators pass through unchanged (make them infinite
-        if you need repetition)."""
+        if you need repetition). With ``curriculum_learning`` enabled in the
+        config, batches are difficulty-truncated per step (reference
+        ``data_pipeline/data_sampling/curriculum_scheduler.py``). With
+        ``data_efficiency.data_sampling.dynamic_batching`` enabled, ``source``
+        must be a SEQUENCE OF SAMPLES (variable-length 1-D token arrays) and
+        is regrouped into token-budget batches with per-batch LR scaling
+        (reference ``variable_batch_size_and_lr.py``; requires gas=1)."""
+        de = self.config.data_efficiency
+        dyn = de.data_sampling.dynamic_batching
+        if dyn.enabled and de.enabled and de.data_sampling.enabled:
+            from deepspeed_tpu.runtime.data_pipeline.variable_batch import (
+                variable_batch_dataloader,
+            )
+
+            samples = list(source)
+            if not samples or np.asarray(samples[0]).ndim != 1:
+                raise ValueError(
+                    "dynamic_batching needs a sequence of 1-D token samples")
+            if self.gradient_accumulation_steps() != 1:
+                raise ValueError("dynamic_batching requires "
+                                 "gradient_accumulation_steps=1")
+            return variable_batch_dataloader(
+                samples, max_tokens=dyn.max_tokens,
+                base_batch_size=self.train_micro_batch_size(),
+                lr_scaling_method=dyn.lr_scaling_method,
+                min_batch_size=dyn.min_batch_size,
+                max_batch_size=dyn.max_batch_size,
+                order=dyn.sentence_picking_order,
+                seed=de.seed, batch_multiple=self.dp_world_size,
+                loop=repeat)
+        elif dyn.enabled:
+            logger.warning(
+                "dynamic_batching.enabled is set but data_efficiency.enabled "
+                "/ data_sampling.enabled are not — dynamic batching stays OFF")
         loader = source
         if repeat and hasattr(source, "__iter__") and iter(source) is not source:
             loader = RepeatingLoader(source)
-        return iter(loader)
+        it = iter(loader)
+        if self._curriculum is not None:
+            from deepspeed_tpu.runtime.data_pipeline import (
+                curriculum_dataloader,
+            )
+
+            it = curriculum_dataloader(it, self._curriculum,
+                                       lambda: self.global_steps)
+        return it
 
     # ------------------------------------------------------------------ #
     # checkpointing (reference engine.py:4557 / :4079)
@@ -916,6 +1106,8 @@ class DeepSpeedTPUEngine:
                         async_save: bool = False) -> None:
         from deepspeed_tpu.checkpoint.engine import save_state
 
+        if self._offload_nvme:
+            self._nvme_swapper().swap_in_optimizer()
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -923,6 +1115,8 @@ class DeepSpeedTPUEngine:
             "micro_steps": self.micro_steps,
             "skipped_steps": self.skipped_steps,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
+            "curriculum": (self._curriculum.state_dict()
+                           if self._curriculum else None),
         })
         save_state(save_dir, tag, self.state, client_state,
                    save_latest=save_latest, async_save=async_save,
@@ -955,6 +1149,10 @@ class DeepSpeedTPUEngine:
                         load_lr_scheduler_states: bool = True):
         from deepspeed_tpu.checkpoint.engine import load_state
 
+        if self._offload_nvme and self._opt_swapper is not None:
+            # the on-disk moments predate this load — never restore them
+            self._opt_swapper._swapped = False
+            self._opt_swapper._template = None
         state, client_state = load_state(
             load_dir, tag, self.state, self._state_shardings())
         if not load_optimizer_states:
@@ -967,6 +1165,8 @@ class DeepSpeedTPUEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and \
                 client_state.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        if self._curriculum is not None and client_state.get("curriculum"):
+            self._curriculum.load_state_dict(client_state["curriculum"])
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state
 

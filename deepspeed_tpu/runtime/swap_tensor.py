@@ -101,9 +101,19 @@ class OptimizerSwapper:
         self.swapper = TensorSwapper(os.path.join(swap_dir, "optimizer"),
                                      n_threads)
         self._swapped = False
+        self._template = None
 
     def swap_out_optimizer(self, wait: bool = True) -> None:
-        self.swapper.swap_out(self.engine.state["opt"], wait=wait)
+        """Write moments to NVMe and DROP the device buffers (the engine's
+        ``state['opt']`` holds ShapeDtypeStructs while swapped — HBM is
+        actually freed, matching the reference swapper's release). Call
+        ``swap_in_optimizer`` before anything that reads optimizer state
+        (next step, checkpoint save)."""
+        opt = self.engine.state["opt"]
+        self._template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), opt)
+        self.swapper.swap_out(opt, wait=wait)
+        self.engine.state["opt"] = self._template
         self._swapped = True
 
     def swap_in_optimizer(self) -> None:
@@ -111,5 +121,5 @@ class OptimizerSwapper:
             return
         shardings = self.engine._state_shardings()["opt"]
         self.engine.state["opt"] = self.swapper.swap_in(
-            self.engine.state["opt"], shardings)
+            self._template, shardings)
         self._swapped = False

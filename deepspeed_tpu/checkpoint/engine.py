@@ -142,3 +142,28 @@ def load_state(load_dir: str, tag: Optional[str], template_state: PyTree,
         with open(cs_path) as f:
             client_state = json.load(f)
     return restored, client_state
+
+
+def load_16bit_model(save_dir: str, filename: str = "pytorch_model.npz"):
+    """Load a ``save_16bit_model`` export with original dtypes restored.
+
+    numpy reads bfloat16 npz entries back as raw V2; the sidecar
+    ``<filename>.dtypes.json`` manifest written at save time view-casts them
+    back (reference: ``load_state_dict_from_zero_checkpoint`` consumption of
+    ``save_16bit_model`` output, engine.py:5355)."""
+    import json as _json
+
+    import ml_dtypes
+    import numpy as _np
+
+    path = os.path.join(save_dir, filename)
+    data = dict(_np.load(path))
+    manifest_path = path + ".dtypes.json"
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            dtypes = _json.load(f)
+        for k, dt in dtypes.items():
+            want = ml_dtypes.bfloat16 if dt == "bfloat16" else _np.dtype(dt)
+            if data[k].dtype != want:
+                data[k] = data[k].view(want)
+    return data

@@ -195,7 +195,9 @@ class PipelineSectionConfig:
 @dataclasses.dataclass
 class CurriculumConfig:
     """Reference ``data_efficiency.data_sampling.curriculum_learning`` keys
-    (``runtime/data_pipeline/data_sampling/curriculum_scheduler.py``)."""
+    (``runtime/data_pipeline/data_sampling/curriculum_scheduler.py``).
+    Real DeepSpeed JSON nests ramp parameters under ``schedule_config`` —
+    both placements are accepted (``schedule_config`` wins)."""
     enabled: bool = False
     schedule_type: str = "fixed_linear"
     min_difficulty: int = 8
@@ -205,6 +207,12 @@ class CurriculumConfig:
     root_degree: int = 2
     difficulty: list = dataclasses.field(default_factory=list)
     max_step: list = dataclasses.field(default_factory=list)
+    schedule_config: dict = dataclasses.field(default_factory=dict)
+
+    def scheduler_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.update(d.pop("schedule_config") or {})
+        return d
 
 
 @dataclasses.dataclass

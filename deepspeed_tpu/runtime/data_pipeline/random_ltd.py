@@ -25,13 +25,16 @@ class RandomLTDScheduler:
     reaches full length at ``total_steps``)."""
 
     def __init__(self, config: Dict):
-        self.start_tokens = int(config.get("random_ltd_schedule", {})
-                                .get("start_value", 128))
-        self.step_size = int(config.get("random_ltd_schedule", {})
-                             .get("schedule_config", {}).get("seq_per_step", 16))
-        self.total_steps = int(config.get("random_ltd_schedule", {})
-                               .get("schedule_config", {}).get("require_steps", 1000))
-        self.max_tokens = int(config.get("max_value", 1024))
+        sched = config.get("random_ltd_schedule", {})
+        sub = sched.get("schedule_config", {})
+        # reference JSON nests min_value/max_value inside random_ltd_schedule;
+        # start_value / top-level max_value kept as aliases
+        self.start_tokens = int(sched.get("min_value",
+                                          sched.get("start_value", 128)))
+        self.step_size = int(sub.get("seq_per_step", 16))
+        self.total_steps = int(sub.get("require_steps", 1000))
+        self.max_tokens = int(sched.get("max_value",
+                                        config.get("max_value", 1024)))
 
     def get_kept_tokens(self, global_step: int) -> int:
         t = min(1.0, global_step / max(1, self.total_steps))

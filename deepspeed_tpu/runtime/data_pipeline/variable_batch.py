@@ -44,12 +44,17 @@ def batch_by_tokens(lengths: Sequence[int], max_tokens: int,
         cur_max = new_max
     if cur:
         batches.append(cur)
-    for b in batches:
-        if len(b) < min_batch_size and len(batches) > 1:
-            # fold undersized tail into the previous batch (reference drops
-            # or merges; merging loses no data)
-            batches[batches.index(b) - 1].extend(b)
-            batches.remove(b)
+    # fold undersized batches into a neighbor (reference drops or merges;
+    # merging loses no data). Walk with an index — mutating while iterating
+    # skips elements and `index-1` would wrap batch 0 to the END of the list.
+    i = 0
+    while i < len(batches):
+        if len(batches[i]) < min_batch_size and len(batches) > 1:
+            target = i - 1 if i > 0 else 1
+            batches[target].extend(batches.pop(i))
+            # the pop shifted the list — recheck the same index
+            continue
+        i += 1
     return batches
 
 

@@ -16,13 +16,20 @@ TPU translation — two mechanisms, both expressed here:
    :data:`XLA_OVERLAP_FLAGS` — enabled by default on recent libtpu; exposed
    so deployments can assert/force them.
 
-2. **Explicit chunk interleaving.** For layers XLA cannot overlap (a strict
-   producer chain), :func:`domino_lm_loss` recreates Domino's batch-split:
-   the microbatch is split into ``n_chunks`` along batch, each chunk's layer
-   stack is traced independently, and the chunks' programs interleave —
-   chunk 0's collectives overlap chunk 1's matmuls in the scheduler's
-   window. Losses combine exactly (equal chunks ⇒ identical numerics to the
-   unsplit loss).
+2. **Explicit chunk interleaving.** :func:`domino_lm_loss` recreates
+   Domino's batch-split: the microbatch is split into ``n_chunks`` along
+   batch, each chunk's layer stack is traced independently, and the chunks'
+   programs interleave in the scheduler's window. Losses combine exactly
+   (equal chunks ⇒ identical numerics to the unsplit loss).
+
+MEASURED (round 2, TP=2 on the 8-device CPU mesh — the only multi-device
+venue available): chunked = 0.99× of unsplit, i.e. NO win — XLA's scheduler
+already overlaps whatever it can and the chunk split only shrinks per-matmul
+surfaces. The chunk path is therefore an OPT-IN mechanism (``domino_spec``)
+kept for parity and for future multi-chip ICI profiling, not an asserted
+speedup; mechanism 1 (the default compiler behavior + flags above) is the
+production answer to Domino on TPU. See ``tests/unit/test_domino_zenflow.py``
+for the parity + measurement harness.
 """
 from __future__ import annotations
 
@@ -70,7 +77,7 @@ def domino_lm_loss(params: PyTree, tokens: jax.Array, cfg: T.TransformerConfig,
         hidden, head, aux = T.forward_hidden(
             params, tk, cfg, attention_fn=attention_fn,
             activation_constraint=activation_constraint)
-        logits = hidden.astype(jnp.float32) @ head.astype(jnp.float32)
+        logits = T.head_matmul(hidden, head.astype(hidden.dtype))
         mk = None
         if loss_mask is not None:
             mk = jax.lax.slice_in_dim(loss_mask, c * step, (c + 1) * step, 0)

@@ -300,18 +300,23 @@ class DeepSpeedTPUEngine:
             ProgressiveLayerDrop,
         )
 
-        import dataclasses as _dc
-
         pipe = self.mesh_manager.axis_size("pipe") > 1
+        de = self.config.data_efficiency
         self._curriculum = None
         cur = self.config.curriculum
+        de_cur = de.data_sampling.curriculum_learning
+        if de_cur.enabled and not cur.enabled:
+            logger.warning(
+                "curriculum_learning.enabled is set under data_efficiency "
+                "but data_efficiency.enabled / data_sampling.enabled are "
+                "not — curriculum stays OFF (reference parent-gate "
+                "semantics)")
         if cur.enabled:
-            self._curriculum = CurriculumScheduler(_dc.asdict(cur))
+            self._curriculum = CurriculumScheduler(cur.scheduler_dict())
             log_dist(f"curriculum learning active: {cur.schedule_type} "
                      f"{cur.min_difficulty}→{cur.max_difficulty}")
 
         self._ltd = None
-        de = self.config.data_efficiency
         ltd = de.data_routing.random_ltd
         if ltd.enabled and not (de.enabled and de.data_routing.enabled):
             logger.warning(
@@ -1129,20 +1134,32 @@ class DeepSpeedTPUEngine:
         """Gather params and export in the compute dtype (reference
         ``save_16bit_model`` engine.py:5355 / ``_zero3_consolidated_16bit_state_dict``
         :5285 — the live-consolidation path)."""
+        import ml_dtypes
         import numpy as np_
 
         os.makedirs(save_dir, exist_ok=True)
         params = self.get_fp32_params()
-        dtype = np_.dtype(self.precision) if self.precision != "bfloat16" else None
+        # bf16 is stored AS bf16 (ml_dtypes registers it with numpy; fp16
+        # would silently drop bf16's exponent range — |x| > 65504 → inf)
+        # bf16 → ml_dtypes bf16; fp16 → fp16; fp32 engines export fp32
+        # unchanged (downcasting would overflow-to-inf above 65504)
+        dtype = (ml_dtypes.bfloat16 if self.precision == "bfloat16"
+                 else np_.dtype(self.precision))
         flat = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             key = "/".join(p.key if hasattr(p, "key") else str(p.idx) for p in path)
-            arr = np_.asarray(jax.device_get(leaf))
-            # npz has no bfloat16 — store bf16 as fp16 (same 16-bit budget)
-            flat[key] = arr.astype(dtype or np_.float16)
+            flat[key] = np_.asarray(jax.device_get(leaf)).astype(dtype)
         if jax.process_index() == 0:
             np_.savez(os.path.join(save_dir, save_filename), **flat)
-        log_dist(f"saved 16-bit model to {save_dir}/{save_filename}")
+            # npz round-trips bf16 bytes but loses the dtype name (numpy
+            # reads it back as raw V2); the sidecar manifest restores it —
+            # consumed by checkpoint.engine.load_16bit_model
+            with open(os.path.join(save_dir, save_filename + ".dtypes.json"),
+                      "w") as f:
+                json.dump({k: str(np_.dtype(v.dtype)) for k, v in flat.items()},
+                          f)
+        log_dist(f"saved 16-bit model to {save_dir}/{save_filename} "
+                 f"(dtype={np_.dtype(dtype)})")
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
@@ -1150,9 +1167,10 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.checkpoint.engine import load_state
 
         if self._offload_nvme and self._opt_swapper is not None:
-            # the on-disk moments predate this load — never restore them
-            self._opt_swapper._swapped = False
-            self._opt_swapper._template = None
+            # restore live moments first: the load may keep them
+            # (load_optimizer_states=False) and the on-disk swap files are
+            # superseded either way
+            self._opt_swapper.swap_in_optimizer()
         state, client_state = load_state(
             load_dir, tag, self.state, self._state_shardings())
         if not load_optimizer_states:

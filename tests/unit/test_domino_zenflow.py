@@ -141,3 +141,38 @@ class TestZenFlow:
         for _ in range(4):
             loss = engine.train_batch(it)
         assert float(loss) < l0
+
+
+def test_domino_chunked_numerically_identical_and_measured():
+    """Round-1 verdict #10: measure the chunk-interleaving claim. Measured
+    0.99x at TP=2 on the CPU mesh (no win — XLA already overlaps), so the
+    test asserts only what holds: exact numerical parity with the unsplit
+    loss. The docstring in runtime/domino.py records the measurement."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.comm.mesh import MeshConfig
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.runtime.domino import domino_lm_loss
+
+    mesh_mod.reset_mesh()
+    mesh_mod.initialize_mesh(MeshConfig(data=4, tensor=2))
+    cfg = T.get_model_config("tiny", dtype="float32", hidden_size=64,
+                             num_layers=2, num_heads=4, max_seq_len=32,
+                             vocab_size=256)
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (8, 32)), jnp.int32)
+
+    def unsplit(p, t):
+        hidden, head, _ = T.forward_hidden(p, t, cfg)
+        return T.causal_lm_loss(
+            T.head_matmul(hidden, head.astype(hidden.dtype)), t)
+
+    l1 = float(jax.jit(unsplit)(params, tokens))
+    l2 = float(jax.jit(
+        lambda p, t: domino_lm_loss(p, t, cfg, n_chunks=2))(params, tokens))
+    assert abs(l1 - l2) < 1e-5, (l1, l2)

@@ -1,4 +1,10 @@
 """Autotuning (reference ``deepspeed/autotuning/``)."""
 from deepspeed_tpu.autotuning.autotuner import Autotuner, TuneResult
+from deepspeed_tpu.autotuning.memory_model import (MemoryEstimate, ModelInfo,
+                                                   estimate, max_micro_batch)
+from deepspeed_tpu.autotuning.tuner import (CostModelTuner, GridSearchTuner,
+                                            RandomTuner)
 
-__all__ = ["Autotuner", "TuneResult"]
+__all__ = ["Autotuner", "TuneResult", "ModelInfo", "MemoryEstimate",
+           "estimate", "max_micro_batch", "GridSearchTuner", "RandomTuner",
+           "CostModelTuner"]

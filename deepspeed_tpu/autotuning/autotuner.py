@@ -1,53 +1,166 @@
-"""Autotuner — sweep engine configurations, measure, pick the fastest.
+"""Autotuner — memory-model-pruned search over engine configurations.
 
-Parity: reference ``deepspeed/autotuning/`` (Autotuner orchestrating ZeRO
-stage / micro-batch experiments through result files and relaunches). TPU
-version is in-process: candidate (micro_batch, remat, zero_stage) configs are
-compiled + timed on the live mesh — no process relaunch needed because JAX
-re-jits per config where the reference must restart workers.
+Parity: reference ``deepspeed/autotuning/autotuner.py`` (1,113 LoC). The flow
+matches the reference's ``tune()``:
+
+1. model info           — ``model_info_profile_run`` (reference :663) becomes
+                          an analytic :class:`ModelInfo` from the spec (exact
+                          param counts; no profile launch needed);
+2. memory estimation    — ``get_instantiation_memory_required_per_gpu`` (:278)
+                          + activation memory per micro-batch → per-candidate
+                          HBM estimates (``memory_model.py``);
+3. space pruning        — stages that don't fit at mbs=1 are skipped without
+                          compiling (:441-521); a stage whose computed max
+                          micro-batch can't beat the previous stage's is
+                          skipped (:536-540);
+4. candidate generation — per-stage micro-batch ladders up to the computed
+                          max (:523 ``tune_space``), crossed with remat policy
+                          and optimizer offload (the TPU analogs of the
+                          reference's ZeRO sub-config templates);
+5. search               — grid / random / cost-model tuners with early
+                          stopping (``tuner.py``; reference ``tuner/``).
+
+In-process where the reference re-launches worker processes per experiment:
+JAX re-jits per candidate on the live mesh, so an experiment is seconds, not
+minutes.
 """
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-import numpy as np
-
+from deepspeed_tpu.autotuning import memory_model as mm
+from deepspeed_tpu.autotuning.tuner import make_tuner
 from deepspeed_tpu.utils.logging import logger
+
+# Micro-batch ladder length per tuning space (reference
+# DEFAULT_NUM_TUNING_MICRO_BATCH_SIZES = 3). Plateau tolerance lives in
+# tuner.PLATEAU_TOL (wired into BaseTuner._record).
+NUM_TUNING_MICRO_BATCH_SIZES = 3
 
 
 @dataclasses.dataclass
 class TuneResult:
     config: Dict[str, Any]
-    throughput: float          # samples/sec (0 on failure)
+    throughput: float          # samples/sec (0 on failure/prune)
     step_time_s: float
     error: Optional[str] = None
+    estimated_hbm: Optional[int] = None
 
 
 class Autotuner:
-    """Sweep micro-batch (and optionally zero stage / remat) for a model.
+    """Tune ZeRO stage × micro-batch × remat × offload for a model spec.
 
     Usage::
 
         tuner = Autotuner(model_spec, base_config)
-        best = tuner.tune(micro_batches=[1, 2, 4, 8])
+        best = tuner.tune(zero_stages=[1, 2, 3])        # auto micro-batches
         engine = deepspeed_tpu.initialize(model=spec, config=best.config)[0]
+
+    ``tuner.pruned`` lists candidates rejected by the memory model without
+    compilation; ``tuner.results`` lists measured candidates.
     """
 
     def __init__(self, model_spec, base_config: Dict[str, Any],
                  seq_len: int = 128, vocab_size: int = 512,
-                 steps: int = 3, warmup: int = 1):
+                 steps: int = 3, warmup: int = 1,
+                 hbm_bytes: Optional[int] = None,
+                 model_info: Optional[mm.ModelInfo] = None):
         self.model_spec = model_spec
         self.base_config = base_config
         self.seq_len = seq_len
         self.vocab_size = vocab_size
         self.steps = steps
         self.warmup = warmup
+        self.hbm_bytes = hbm_bytes or mm.hbm_capacity_bytes()
+        self.model_info = model_info or mm.ModelInfo.from_spec(
+            model_spec, seq_len=seq_len)
         self.results: List[TuneResult] = []
+        self.pruned: List[TuneResult] = []
 
-    def _try_config(self, config: Dict[str, Any]) -> TuneResult:
+    # ---------------------------------------------------------------- mesh
+    def _parallel_shape(self) -> Dict[str, int]:
+        """ZeRO shard width + model-parallel width for the memory model,
+        mirroring ShardingPolicy (``parallel/partitioning.py``): dense state
+        shards over data×zshard, EXCEPT under MiCS (zshard>1) where it shards
+        over the zshard subgroup only, replicating across 'data'. The
+        'expert' axis replicates dense params — it widens the batch, not the
+        shard count, so it must not enter the estimate."""
+        mesh = self.base_config.get("mesh", {}) or {}
+        data = max(1, int(mesh.get("data", 1)))
+        zshard = max(1, int(mesh.get("zshard", 1)))
+        dp = zshard if zshard > 1 else data
+        mp = max(1, int(mesh.get("tensor", 1)))
+        return {"dp": dp, "mp": mp}
+
+    def _base_knobs(self) -> Dict[str, Any]:
+        z = self.base_config.get("zero_optimization", {}) or {}
+        ac = self.base_config.get("activation_checkpointing", {}) or {}
+        opt = (self.base_config.get("optimizer", {}) or {}).get("type", "adam")
+        off = (z.get("offload_optimizer", {}) or {}).get("device", "none")
+        # mirror DeepSpeedTPUConfig.precision_dtype: fp16 > bf16 > fp32
+        if (self.base_config.get("fp16", {}) or {}).get("enabled"):
+            precision = "float16"
+        elif (self.base_config.get("bf16", {}) or {}).get("enabled"):
+            precision = "bfloat16"
+        else:
+            precision = "float32"
+        return {"stage": int(z.get("stage", 1)),
+                "remat": ac.get("policy", "none"),
+                "optimizer": opt, "offload": off != "none",
+                "precision": precision}
+
+    # -------------------------------------------------------- memory model
+    def estimate_candidate(self, cand: Dict[str, Any]) -> mm.MemoryEstimate:
+        par = self._parallel_shape()
+        knobs = self._base_knobs()
+        return mm.estimate(
+            self.model_info, zero_stage=cand.get("zero_stage", knobs["stage"]),
+            dp_shards=par["dp"], mp_size=par["mp"],
+            micro_batch=cand.get("micro_batch", 1), seq_len=self.seq_len,
+            remat=cand.get("remat", knobs["remat"]),
+            precision=knobs["precision"], optimizer=knobs["optimizer"],
+            offload_optimizer=cand.get("offload_optimizer", knobs["offload"]))
+
+    def max_micro_batch(self, stage: int, remat: str = "none",
+                        offload_optimizer: bool = False) -> int:
+        par = self._parallel_shape()
+        knobs = self._base_knobs()
+        return mm.max_micro_batch(
+            self.model_info, hbm_bytes=self.hbm_bytes, zero_stage=stage,
+            dp_shards=par["dp"], mp_size=par["mp"], seq_len=self.seq_len,
+            remat=remat, precision=knobs["precision"],
+            optimizer=knobs["optimizer"], offload_optimizer=offload_optimizer)
+
+    # --------------------------------------------------------- evaluation
+    def _candidate_config(self, cand: Dict[str, Any]) -> Dict[str, Any]:
+        config = dict(self.base_config)
+        config["zero_optimization"] = dict(
+            config.get("zero_optimization", {}),
+            stage=cand.get("zero_stage", self._base_knobs()["stage"]))
+        if "remat" in cand:
+            config["activation_checkpointing"] = dict(
+                config.get("activation_checkpointing", {}),
+                policy=cand["remat"])
+        if "offload_optimizer" in cand:
+            base_off = dict(config["zero_optimization"].get(
+                "offload_optimizer", {}) or {})
+            if cand["offload_optimizer"]:
+                # keep the user's target tier (cpu/nvme + nvme_path) if they
+                # configured one; default to host memory otherwise
+                if base_off.get("device", "none") == "none":
+                    base_off["device"] = "cpu"
+                config["zero_optimization"]["offload_optimizer"] = base_off
+            else:
+                config["zero_optimization"]["offload_optimizer"] = dict(
+                    base_off, device="none")
+        config["train_micro_batch_size_per_gpu"] = cand["micro_batch"]
+        config.pop("train_batch_size", None)  # derive from mb × gas × dp
+        return config
+
+    def _try_config(self, config: Dict[str, Any],
+                    estimated_hbm: Optional[int] = None) -> TuneResult:
         import jax
 
         import deepspeed_tpu as dst
@@ -69,29 +182,133 @@ class Autotuner:
             dt = (time.perf_counter() - t0) / self.steps
             return TuneResult(config=config,
                               throughput=engine.train_batch_size() / dt,
-                              step_time_s=dt)
+                              step_time_s=dt, estimated_hbm=estimated_hbm)
         except Exception as e:  # noqa: BLE001 — OOM/compile failures expected
             return TuneResult(config=config, throughput=0.0,
-                              step_time_s=float("inf"), error=repr(e))
+                              step_time_s=float("inf"), error=repr(e),
+                              estimated_hbm=estimated_hbm)
 
-    def tune(self, micro_batches: Sequence[int] = (1, 2, 4, 8),
-             zero_stages: Optional[Sequence[int]] = None) -> TuneResult:
-        zero_stages = zero_stages or [
-            self.base_config.get("zero_optimization", {}).get("stage", 1)]
-        dp = None
-        for mb, stage in itertools.product(micro_batches, zero_stages):
-            config = dict(self.base_config)
-            config["zero_optimization"] = dict(
-                config.get("zero_optimization", {}), stage=stage)
-            config["train_micro_batch_size_per_gpu"] = mb
-            gas = config.get("gradient_accumulation_steps", 1)
-            config.pop("train_batch_size", None)  # derive from mb × gas × dp
-            result = self._try_config(config)
+    def _prune(self, cand: Dict[str, Any], reason: str) -> None:
+        est = self.estimate_candidate(cand)
+        logger.info(f"autotune prune {cand}: {reason} "
+                    f"(est {est.total/2**30:.2f} GiB vs "
+                    f"{self.hbm_bytes/2**30:.2f} GiB HBM)")
+        self.pruned.append(TuneResult(
+            config=self._candidate_config(cand), throughput=0.0,
+            step_time_s=float("inf"), error=f"pruned: {reason}",
+            estimated_hbm=est.total))
+
+    # ------------------------------------------------------------- search
+    def _mbs_ladder(self, max_mb: int) -> List[int]:
+        """Powers of two up to max_mb, keeping the top few (the reference
+        tunes ``num_tuning_micro_batch_sizes`` sizes biased to the top of
+        the feasible range, ``get_tuning_micro_batch_size_list``)."""
+        ladder = []
+        mb = 1
+        while mb <= max_mb:
+            ladder.append(mb)
+            mb *= 2
+        return ladder[-NUM_TUNING_MICRO_BATCH_SIZES:] if ladder else []
+
+    def generate_candidates(
+            self, micro_batches: Optional[Sequence[int]],
+            zero_stages: Sequence[int], remats: Sequence[str],
+            offloads: Sequence[bool]) -> List[Dict[str, Any]]:
+        """Memory-pruned candidate list. Records prunes as it goes."""
+        cands: List[Dict[str, Any]] = []
+        prev_max_mb = 0
+        # ascending stage order: the dominance prune below is only valid when
+        # the already-seen stages shard *less* (lower comm cost) — a higher
+        # stage that can't fit a bigger micro-batch than a lower one can't win
+        # (reference autotuner.py:536), but not vice versa.
+        zero_stages = sorted(zero_stages)
+        for stage in zero_stages:
+            stage_max = 0
+            stage_cands: List[Dict[str, Any]] = []
+            for remat in remats:
+                for off in offloads:
+                    max_mb = self.max_micro_batch(stage, remat, off)
+                    if max_mb == 0:
+                        self._prune({"zero_stage": stage, "remat": remat,
+                                     "offload_optimizer": off,
+                                     "micro_batch": 1},
+                                    "does not fit HBM at micro_batch=1")
+                        continue
+                    stage_max = max(stage_max, max_mb)
+                    mbs = (list(micro_batches) if micro_batches
+                           else self._mbs_ladder(max_mb))
+                    for mb in mbs:
+                        cand = {"zero_stage": stage, "remat": remat,
+                                "offload_optimizer": off, "micro_batch": mb}
+                        if mb > max_mb:
+                            self._prune(cand, f"micro_batch {mb} > computed "
+                                              f"max {max_mb}")
+                            continue
+                        stage_cands.append(cand)
+            # reference autotuner.py:536-540 — a higher stage that cannot fit
+            # a larger micro-batch than the previous stage already achieved
+            # cannot win (same math, more comm); skip it.
+            if (len(zero_stages) > 1 and prev_max_mb > 0
+                    and stage_max <= prev_max_mb and stage > min(zero_stages)):
+                for cand in stage_cands:
+                    self._prune(cand, f"stage {stage} max micro-batch "
+                                      f"{stage_max} <= previous stage's "
+                                      f"{prev_max_mb}")
+                stage_cands = []
+            prev_max_mb = max(prev_max_mb, stage_max)
+            cands.extend(stage_cands)
+        return cands
+
+    def tune(self, micro_batches: Optional[Sequence[int]] = None,
+             zero_stages: Optional[Sequence[int]] = None,
+             remats: Optional[Sequence[str]] = None,
+             offloads: Optional[Sequence[bool]] = None,
+             tuner_type: str = "gridsearch",
+             n_trials: Optional[int] = None,
+             early_stopping: Optional[int] = None) -> TuneResult:
+        knobs = self._base_knobs()
+        zero_stages = list(zero_stages) if zero_stages else [knobs["stage"]]
+        remats = list(remats) if remats else [knobs["remat"]]
+        offloads = list(offloads) if offloads is not None else [knobs["offload"]]
+
+        info = self.model_info
+        logger.info(
+            f"autotune: model {info.num_params:,} params, HBM "
+            f"{self.hbm_bytes/2**30:.2f} GiB, stages={zero_stages}, "
+            f"remats={remats}, offloads={offloads}")
+        candidates = self.generate_candidates(
+            micro_batches, zero_stages, remats, offloads)
+        if not candidates:
+            raise RuntimeError(
+                "autotuning: every candidate was pruned by the memory model; "
+                f"model needs more than {self.hbm_bytes/2**30:.2f} GiB HBM "
+                "at micro_batch=1 in every requested config")
+
+        def evaluate(cand: Dict[str, Any]) -> float:
+            config = self._candidate_config(cand)
+            result = self._try_config(config,
+                                      self.estimate_candidate(cand).total)
             self.results.append(result)
             status = (f"{result.throughput:.1f} samples/s"
                       if not result.error else f"failed: {result.error[:60]}")
-            logger.info(f"autotune mb={mb} stage={stage}: {status}")
-        best = max(self.results, key=lambda r: r.throughput)
+            logger.info(f"autotune {cand}: {status}")
+            return result.throughput
+
+        # one tuning space per (stage, remat, offload) triple — the stale
+        # counter resets at space boundaries so a slow space can't starve
+        # later ones (reference plateaus within one micro-batch ladder)
+        tuner = make_tuner(
+            tuner_type, candidates, evaluate,
+            group_fn=lambda c: (c["zero_stage"], c["remat"],
+                                c["offload_optimizer"]))
+        # default early stopping: one full micro-batch ladder without
+        # improvement (plateau detection, reference get_plateau_mbs)
+        if early_stopping is None and micro_batches is None:
+            early_stopping = NUM_TUNING_MICRO_BATCH_SIZES + 1
+        tuner.tune(n_trials=n_trials, early_stopping=early_stopping)
+
+        best = max(self.results, key=lambda r: r.throughput,
+                   default=TuneResult({}, 0.0, float("inf")))
         if best.throughput == 0:
             raise RuntimeError("autotuning failed for every candidate config")
         return best

@@ -1,0 +1,230 @@
+"""Analytic HBM memory model for autotuning.
+
+Parity: the reference autotuner's memory estimation
+(``deepspeed/autotuning/autotuner.py:274-302`` — ``get_activation_memory_per_gpu``
+via a profile run + ``get_instantiation_memory_required_per_gpu`` from param
+count and ZeRO stage). The TPU version is analytic end to end: the model zoo's
+``TransformerConfig`` gives exact parameter counts, and activation residency is
+derived from the engine's remat policy — so infeasible candidates are pruned
+*before* any compilation, where the reference needs a measurement run.
+
+When a compiled step is available, :func:`compiled_memory_bytes` refines the
+estimate with XLA's own ``memory_analysis()`` (exact, no execution) — something
+the CUDA reference has no analog for.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+GiB = 1024 ** 3
+
+# Default HBM per chip when the runtime can't report it (v5e-class chip).
+DEFAULT_HBM_BYTES = 16 * GiB
+
+# Fraction of HBM usable for the train state + activations. XLA reserves
+# workspace for collective buffers / fusion temps; being exact here risks
+# compiling candidates that OOM in steady state.
+HBM_USABLE_FRACTION = 0.92
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelInfo:
+    """What the reference's ``model_info_profile_run`` measures
+    (``autotuner.py:663`` → {num_params, activation_mem_per_gpu}), derived
+    analytically from the model spec instead."""
+    num_params: int
+    hidden_size: int = 0
+    num_layers: int = 0
+    ffn_size: int = 0
+    vocab_size: int = 0
+    seq_len: int = 1024
+    activation: str = "gelu"
+    n_experts: int = 0
+
+    @classmethod
+    def from_spec(cls, spec: Any, seq_len: Optional[int] = None) -> "ModelInfo":
+        cfg = getattr(spec, "config", None)
+        n = getattr(spec, "num_params", None)
+        if cfg is not None and hasattr(cfg, "hidden_size"):
+            return cls(
+                num_params=n if n is not None else cfg.num_params(),
+                hidden_size=cfg.hidden_size,
+                num_layers=cfg.num_layers,
+                ffn_size=getattr(cfg, "ffn_size", 4 * cfg.hidden_size),
+                vocab_size=cfg.vocab_size,
+                seq_len=seq_len or getattr(spec, "seq_len", None)
+                or cfg.max_seq_len,
+                activation=getattr(cfg, "activation", "gelu"),
+                n_experts=getattr(cfg, "n_experts", 0),
+            )
+        if n is None:
+            raise ValueError(
+                "model spec carries neither .config nor .num_params; pass "
+                "model_info explicitly to the Autotuner")
+        return cls(num_params=n, seq_len=seq_len or 1024)
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Per-chip steady-state HBM breakdown for one candidate config."""
+    master_bytes: int        # fp32 master params
+    optimizer_bytes: int     # optimizer moments (fp32)
+    compute_bytes: int       # 16-bit compute-cast params live during fwd/bwd
+    grad_bytes: int          # gradient accumulator
+    activation_bytes: int    # saved residuals under the remat policy
+    logits_bytes: int        # lm-head logits + softmax temporaries
+    total: int = 0
+
+    def __post_init__(self):
+        self.total = (self.master_bytes + self.optimizer_bytes
+                      + self.compute_bytes + self.grad_bytes
+                      + self.activation_bytes + self.logits_bytes)
+
+    def breakdown(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+# Optimizer moment multiplier (fp32 elements per param).
+_OPT_MOMENTS = {
+    "adam": 2, "adamw": 2, "fusedadam": 2, "lamb": 2, "onebitadam": 2,
+    "onebitlamb": 2, "zerooneadam": 2, "lion": 1, "muon": 1, "momentum": 1,
+    "sgd": 0, "adagrad": 1,
+}
+
+
+def activation_bytes_per_token(info: ModelInfo, remat: str,
+                               bytes_per_el: int = 2) -> int:
+    """Saved-residual bytes per token across the whole stack.
+
+    The engine scans over layers with a ``jax.checkpoint`` policy
+    (``runtime/engine.py`` + ``ActivationCheckpointingConfig.policy``); what
+    autodiff keeps per layer depends on that policy:
+
+    * ``none``      — every intermediate: norms, qkv, attn out, proj, ffn pre/post
+    * ``dots_saveable`` — matmul outputs only (XLA recomputes elementwise)
+    * ``full`` / ``save_nothing`` — layer-boundary carries only, one layer
+      recomputed at a time during backward
+    * ``offload_dots`` — like dots_saveable but residuals live on host: only
+      the double-buffered transfer window stays in HBM (~2 layers)
+    """
+    h, f, L = info.hidden_size, info.ffn_size, info.num_layers
+    if h == 0:          # unknown architecture: fall back to a linear-in-params guess
+        return max(1, int(12 * (info.num_params ** 0.5)))
+    ffn_mats = 3 if info.activation == "swiglu" else 2
+    per_layer_full = (8 * h + ffn_mats * f)          # all intermediates
+    per_layer_dots = (6 * h + (ffn_mats - 1) * f)    # matmul outputs
+    if remat in ("full", "save_nothing"):
+        elems = L * h + per_layer_full               # boundaries + 1 recompute
+    elif remat == "dots_saveable":
+        elems = L * per_layer_dots + per_layer_full
+    elif remat == "offload_dots":
+        elems = 2 * per_layer_dots + per_layer_full  # transfer window
+    else:                                            # "none"
+        elems = L * per_layer_full
+    return elems * bytes_per_el
+
+
+def estimate(info: ModelInfo, *, zero_stage: int, dp_shards: int,
+             mp_size: int = 1, micro_batch: int = 1,
+             seq_len: Optional[int] = None, remat: str = "none",
+             precision: str = "bfloat16", optimizer: str = "adam",
+             offload_optimizer: bool = False,
+             offload_param: bool = False) -> MemoryEstimate:
+    """Steady-state per-chip HBM for one candidate.
+
+    Mirrors the reference's stage arithmetic
+    (``autotuner.py:278-302``: optimizer mem /N at stage>=1, grads /N at
+    stage>=2, params /N at stage>=3, everything /mp), adapted to this
+    engine's actual state layout: fp32 master + moments (sharded per stage),
+    16-bit compute cast (stage-3 gathers per layer under scan, so only ~2
+    layers of gathered params are ever live), bf16 grads.
+    """
+    S = seq_len or info.seq_len
+    N = info.num_params
+    n_opt = dp_shards if zero_stage >= 1 else 1
+    n_grad = dp_shards if zero_stage >= 2 else 1
+    n_par = dp_shards if zero_stage >= 3 else 1
+
+    master = 4 * N // (n_par * mp_size)
+    opt = 4 * _OPT_MOMENTS.get(optimizer.lower(), 2) * N // (n_opt * mp_size)
+    if offload_optimizer:
+        opt = 0
+    if offload_param:
+        master = 0
+    # compute-cast params: full set at stages 0-2; at stage 3 the scan gathers
+    # one layer at a time (plus prefetch), so bound by 2 layers + embeddings.
+    if zero_stage >= 3 and info.num_layers > 0:
+        per_layer = max(1, (N - info.vocab_size * info.hidden_size)
+                        // max(1, info.num_layers))
+        compute = 2 * (2 * per_layer + info.vocab_size * info.hidden_size
+                       + N // (n_par * mp_size))
+    else:
+        compute = 2 * N // mp_size
+    grads = 2 * N // (n_grad * mp_size)
+    if precision in ("fp32", "float32"):
+        compute, grads = 2 * compute, 2 * grads
+
+    tokens = micro_batch * S
+    act = activation_bytes_per_token(info, remat) * tokens // mp_size
+    # logits + fp32 softmax/one-hot temporaries at the loss
+    logits = tokens * info.vocab_size * 6 // mp_size if info.vocab_size else 0
+    return MemoryEstimate(master_bytes=master, optimizer_bytes=opt,
+                          compute_bytes=compute, grad_bytes=grads,
+                          activation_bytes=act, logits_bytes=logits)
+
+
+def hbm_capacity_bytes() -> int:
+    """Usable per-chip HBM from the live runtime, else the v5e default."""
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        stats = get_accelerator().memory_stats()
+        limit = stats.get("bytes_limit", 0)
+        if limit:
+            return int(limit * HBM_USABLE_FRACTION)
+    except Exception:
+        pass
+    return int(DEFAULT_HBM_BYTES * HBM_USABLE_FRACTION)
+
+
+def max_micro_batch(info: ModelInfo, *, hbm_bytes: int, zero_stage: int,
+                    dp_shards: int, mp_size: int = 1,
+                    seq_len: Optional[int] = None, remat: str = "none",
+                    precision: str = "bfloat16", optimizer: str = "adam",
+                    offload_optimizer: bool = False,
+                    offload_param: bool = False) -> int:
+    """Largest micro-batch that fits, or 0 if even mbs=1 does not.
+
+    The reference's ``calculated_max_micro_batch_size``
+    (``autotuner.py:532-534``): (HBM - instantiation) // activation(mbs=1).
+    """
+    fixed = estimate(info, zero_stage=zero_stage, dp_shards=dp_shards,
+                     mp_size=mp_size, micro_batch=0, seq_len=seq_len,
+                     remat=remat, precision=precision, optimizer=optimizer,
+                     offload_optimizer=offload_optimizer,
+                     offload_param=offload_param)
+    per_mb = estimate(info, zero_stage=zero_stage, dp_shards=dp_shards,
+                      mp_size=mp_size, micro_batch=1, seq_len=seq_len,
+                      remat=remat, precision=precision, optimizer=optimizer,
+                      offload_optimizer=offload_optimizer,
+                      offload_param=offload_param).total - fixed.total
+    if per_mb <= 0:
+        per_mb = 1
+    return max(0, (hbm_bytes - fixed.total) // per_mb)
+
+
+def compiled_memory_bytes(compiled: Any) -> Optional[int]:
+    """Exact HBM need of a compiled step from XLA's memory analysis.
+
+    ``jit(f).lower(args).compile().memory_analysis()`` — available on TPU
+    backends; returns None where the backend doesn't report (CPU tests).
+    """
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                   + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:
+        return None

@@ -1,0 +1,193 @@
+"""Experiment tuners: grid / random / cost-model search over candidate configs.
+
+Parity: reference ``deepspeed/autotuning/tuner/`` — ``GridSearchTuner`` and
+``RandomTuner`` (``index_based_tuner.py``), ``ModelBasedTuner``
+(``model_based_tuner.py``: XGBoost cost model, epsilon-greedy exploration,
+early stopping). The TPU cost model is a numpy ridge regression over config
+features — no xgboost dependency — which is plenty for the small, structured
+spaces ZeRO tuning produces.
+
+Tuners are in-process: ``evaluate_fn(candidate) -> metric`` compiles + times a
+config on the live mesh, where the reference schedules experiment *processes*
+through a ResourceManager. Early stopping semantics match: stop after
+``early_stopping`` consecutive trials without improvement.
+"""
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+Candidate = Dict[str, Any]
+
+
+# Relative improvement below which a trial counts as stale for early
+# stopping (reference METRIC_PERCENT_DIFF_CONST plateau semantics).
+PLATEAU_TOL = 0.05
+
+
+class BaseTuner:
+    """Evaluate candidates in some order, tracking the best.
+
+    Reference ``tuner/base_tuner.py`` (``tune(sample_size, n_trials,
+    early_stopping)`` driving ``run_experiments``)."""
+
+    def __init__(self, candidates: Sequence[Candidate],
+                 evaluate_fn: Callable[[Candidate], float],
+                 group_fn: Optional[Callable[[Candidate], Any]] = None):
+        self.candidates = list(candidates)
+        self.evaluate_fn = evaluate_fn
+        # group_fn partitions candidates into tuning spaces (e.g. one per
+        # ZeRO stage); the stale counter resets at group boundaries so a
+        # slow space cannot starve the next one (the reference plateaus
+        # within one micro-batch ladder, not across spaces).
+        self.group_fn = group_fn
+        self.best_candidate: Optional[Candidate] = None
+        self.best_metric_val: float = 0.0
+        self.history: List[Tuple[Candidate, float]] = []
+
+    def next_batch(self) -> List[Candidate]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _record(self, cand: Candidate, val: float) -> bool:
+        """Record a trial. Returns True when the metric improved by more
+        than the plateau tolerance (noise-level gains count as stale)."""
+        self.history.append((cand, val))
+        improved = val > self.best_metric_val * (1.0 + PLATEAU_TOL)
+        if val > self.best_metric_val:
+            self.best_metric_val = val
+            self.best_candidate = cand
+        return improved
+
+    def tune(self, n_trials: Optional[int] = None,
+             early_stopping: Optional[int] = None) -> int:
+        n_trials = n_trials or len(self.candidates)
+        stale = 0
+        trials = 0
+        group = object()
+        while trials < n_trials:
+            batch = self.next_batch()
+            if not batch:
+                break
+            for cand in batch:
+                if trials >= n_trials:
+                    break
+                if self.group_fn is not None:
+                    g = self.group_fn(cand)
+                    if g != group:
+                        stale = 0
+                        group = g
+                val = self.evaluate_fn(cand)
+                improved = self._record(cand, val)
+                trials += 1
+                stale = 0 if improved else stale + 1
+                if early_stopping and stale >= early_stopping:
+                    logger.info(
+                        f"autotune early stop: {stale} trials without "
+                        f"improvement (best={self.best_metric_val:.1f})")
+                    return trials
+        return trials
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order sweep (reference ``GridSearchTuner``)."""
+
+    def __init__(self, candidates, evaluate_fn, group_fn=None):
+        super().__init__(candidates, evaluate_fn, group_fn)
+        self._i = 0
+
+    def next_batch(self) -> List[Candidate]:
+        if self._i >= len(self.candidates):
+            return []
+        batch = [self.candidates[self._i]]
+        self._i += 1
+        return batch
+
+
+class RandomTuner(GridSearchTuner):
+    """Shuffled sweep (reference ``RandomTuner``)."""
+
+    def __init__(self, candidates, evaluate_fn, group_fn=None, seed: int = 0):
+        cands = list(candidates)
+        _random.Random(seed).shuffle(cands)
+        super().__init__(cands, evaluate_fn, group_fn)
+
+
+def _featurize(cand: Candidate) -> List[float]:
+    """Numeric feature vector for the cost model."""
+    remat_ord = {"none": 0.0, "dots_saveable": 1.0, "offload_dots": 2.0,
+                 "full": 3.0, "save_nothing": 3.0}
+    return [
+        1.0,
+        float(np.log2(max(1, cand.get("micro_batch", 1)))),
+        float(cand.get("zero_stage", 0)),
+        remat_ord.get(cand.get("remat", "none"), 0.0),
+        1.0 if cand.get("offload_optimizer") else 0.0,
+        float(np.log2(max(1, cand.get("gas", 1)))),
+    ]
+
+
+class CostModelTuner(BaseTuner):
+    """Fit a cheap regression on evaluated trials; pick the best predicted
+    unvisited candidate next, with epsilon-greedy random exploration.
+
+    Reference ``ModelBasedTuner`` (``model_based_tuner.py:19``): INIT_NUM
+    random seeds, cost-model ranking of the remainder, 0.2 exploration ratio.
+    """
+
+    INIT_NUM = 2
+    EXPLORE_RATIO = 0.2
+
+    def __init__(self, candidates, evaluate_fn, group_fn=None, seed: int = 0):
+        super().__init__(candidates, evaluate_fn, group_fn)
+        self._rng = _random.Random(seed)
+        self._unvisited = list(range(len(self.candidates)))
+        self._init_left = min(self.INIT_NUM, len(self.candidates))
+
+    def _predict(self) -> Optional[int]:
+        if len(self.history) < 2:
+            return None
+        X = np.array([_featurize(c) for c, _ in self.history])
+        y = np.array([v for _, v in self.history])
+        # ridge: (X'X + lam I)^-1 X'y
+        lam = 1e-3 * np.eye(X.shape[1])
+        try:
+            w = np.linalg.solve(X.T @ X + lam, X.T @ y)
+        except np.linalg.LinAlgError:
+            return None
+        preds = [(float(np.dot(_featurize(self.candidates[i]), w)), i)
+                 for i in self._unvisited]
+        return max(preds)[1] if preds else None
+
+    def next_batch(self) -> List[Candidate]:
+        if not self._unvisited:
+            return []
+        if self._init_left > 0 or self._rng.random() < self.EXPLORE_RATIO:
+            self._init_left -= 1
+            idx = self._rng.choice(self._unvisited)
+        else:
+            idx = self._predict()
+            if idx is None or idx not in self._unvisited:
+                idx = self._rng.choice(self._unvisited)
+        self._unvisited.remove(idx)
+        return [self.candidates[idx]]
+
+
+TUNER_TYPES = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": CostModelTuner,
+}
+
+
+def make_tuner(tuner_type: str, candidates, evaluate_fn,
+               group_fn=None) -> BaseTuner:
+    try:
+        cls = TUNER_TYPES[tuner_type]
+    except KeyError:
+        raise ValueError(
+            f"unknown tuner_type {tuner_type!r}; one of {sorted(TUNER_TYPES)}")
+    return cls(candidates, evaluate_fn, group_fn=group_fn)

@@ -1166,10 +1166,13 @@ class DeepSpeedTPUEngine:
                         load_lr_scheduler_states: bool = True):
         from deepspeed_tpu.checkpoint.engine import load_state
 
-        if self._offload_nvme and self._opt_swapper is not None:
-            # restore live moments first: the load may keep them
-            # (load_optimizer_states=False) and the on-disk swap files are
-            # superseded either way
+        if (self._offload_nvme and self._opt_swapper is not None
+                and not load_optimizer_states):
+            # the checkpoint will NOT supply moments, so the live (NVMe-swapped)
+            # ones must be materialized before `state["opt"]` is carried over;
+            # on the default path the restore overwrites them anyway and the
+            # placeholders suffice as the orbax target template — swapping in
+            # there would transiently double optimizer-state HBM
             self._opt_swapper.swap_in_optimizer()
         state, client_state = load_state(
             load_dir, tag, self.state, self._state_shardings())

@@ -1,0 +1,211 @@
+"""Autotuner memory-model + search tests (reference
+``tests/unit/autotuning/test_autotuning.py`` — tuning-space generation and
+resource handling; here the space is generated from an analytic HBM model so
+prune decisions are testable without hardware)."""
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.autotuning import (Autotuner, CostModelTuner,
+                                      GridSearchTuner, ModelInfo, RandomTuner,
+                                      estimate, max_micro_batch)
+
+GiB = 1024 ** 3
+
+
+def llama7b_info():
+    # llama-2-7b-shaped (hidden 4096, 32 layers, ffn 11008, vocab 32000)
+    return ModelInfo(num_params=6_738_000_000, hidden_size=4096,
+                     num_layers=32, ffn_size=11008, vocab_size=32000,
+                     seq_len=2048, activation="swiglu")
+
+
+class TestMemoryModel:
+    def test_stage_sharding_monotonic(self):
+        """Higher ZeRO stage → less per-chip state (reference
+        get_instantiation_memory_required_per_gpu semantics)."""
+        info = llama7b_info()
+        totals = [estimate(info, zero_stage=s, dp_shards=64,
+                           micro_batch=0).total for s in (0, 1, 2, 3)]
+        assert totals[0] > totals[1] > totals[2] > totals[3]
+
+    def test_stage0_7b_needs_adam_budget(self):
+        """7B + Adam fp32 state without sharding ≈ 16·N bytes — far beyond
+        one chip (sanity-pins the constants in the model)."""
+        info = llama7b_info()
+        est = estimate(info, zero_stage=0, dp_shards=64, micro_batch=0)
+        assert est.total > 80 * GiB
+        # master 4N + moments 8N dominate
+        assert est.master_bytes == pytest.approx(4 * info.num_params, rel=0.01)
+        assert est.optimizer_bytes == pytest.approx(8 * info.num_params, rel=0.01)
+
+    def test_remat_reduces_activation_memory(self):
+        info = llama7b_info()
+        none = estimate(info, zero_stage=3, dp_shards=64, micro_batch=1,
+                        remat="none").activation_bytes
+        dots = estimate(info, zero_stage=3, dp_shards=64, micro_batch=1,
+                        remat="dots_saveable").activation_bytes
+        full = estimate(info, zero_stage=3, dp_shards=64, micro_batch=1,
+                        remat="full").activation_bytes
+        assert none > dots > full
+
+    def test_offload_zeroes_optimizer_hbm(self):
+        info = llama7b_info()
+        est = estimate(info, zero_stage=2, dp_shards=8, micro_batch=1,
+                       offload_optimizer=True)
+        assert est.optimizer_bytes == 0
+
+    def test_max_micro_batch_prunes_infeasible(self):
+        """7B at ZeRO-0 on a 16-GiB chip: mbs=1 must not fit; at ZeRO-3 over
+        64 chips with full remat it must."""
+        info = llama7b_info()
+        assert max_micro_batch(info, hbm_bytes=16 * GiB, zero_stage=0,
+                               dp_shards=1) == 0
+        assert max_micro_batch(info, hbm_bytes=16 * GiB, zero_stage=3,
+                               dp_shards=64, remat="full") >= 1
+
+
+class TestTuners:
+    def _cands(self):
+        return [{"micro_batch": m, "zero_stage": 1} for m in (1, 2, 4, 8)]
+
+    def test_grid_visits_in_order(self):
+        seen = []
+        t = GridSearchTuner(self._cands(), lambda c: seen.append(
+            c["micro_batch"]) or float(c["micro_batch"]))
+        t.tune()
+        assert seen == [1, 2, 4, 8]
+        assert t.best_candidate["micro_batch"] == 8
+
+    def test_random_visits_all(self):
+        seen = []
+        t = RandomTuner(self._cands(), lambda c: seen.append(
+            c["micro_batch"]) or float(c["micro_batch"]))
+        t.tune()
+        assert sorted(seen) == [1, 2, 4, 8]
+
+    def test_early_stopping(self):
+        calls = []
+        t = GridSearchTuner(self._cands(),
+                            lambda c: calls.append(c) or 1.0)  # flat metric
+        n = t.tune(early_stopping=2)
+        assert n == 3  # first improves (0→1), then two stale trials
+
+    def test_cost_model_finds_best(self):
+        # metric peaks at micro_batch=4
+        t = CostModelTuner(self._cands(),
+                           lambda c: {1: 1.0, 2: 2.0, 4: 3.0, 8: 0.5}[
+                               c["micro_batch"]])
+        t.tune()
+        assert t.best_candidate["micro_batch"] == 4
+
+
+class TestAutotunerPruning:
+    def _tuner(self, hbm_bytes):
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        base = {
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+        }
+        return Autotuner(spec, base, seq_len=32, steps=1, warmup=0,
+                         hbm_bytes=hbm_bytes)
+
+    def test_infeasible_pruned_without_compiling(self):
+        """With a tiny HBM budget every candidate is rejected by the memory
+        model alone — no engine construction, no compile."""
+        tuner = self._tuner(hbm_bytes=1024)  # 1 KiB: nothing fits
+        compiles = []
+        tuner._try_config = lambda *a, **k: compiles.append(1)  # must not run
+        with pytest.raises(RuntimeError, match="pruned by the memory model"):
+            tuner.tune(zero_stages=[0, 1])
+        assert compiles == []
+        assert tuner.pruned and all(
+            "pruned" in r.error for r in tuner.pruned)
+
+    def test_candidate_ladder_capped_by_memory(self):
+        tuner = self._tuner(hbm_bytes=64 * GiB)
+        cands = tuner.generate_candidates(None, [1], ["none"], [False])
+        assert cands, "tiny model must fit"
+        mbs = [c["micro_batch"] for c in cands]
+        assert len(mbs) <= 3  # ladder keeps top NUM_TUNING sizes
+        assert all(m <= tuner.max_micro_batch(1) for m in mbs)
+
+    def test_offload_candidate_roundtrip(self):
+        """offload=False must actively disable a base-config offload, and
+        offload=True must keep the user's nvme tier instead of clobbering it."""
+        tuner = self._tuner(hbm_bytes=64 * GiB)
+        tuner.base_config["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": "/tmp/nv"}
+        on = tuner._candidate_config({"micro_batch": 1, "zero_stage": 1,
+                                      "offload_optimizer": True})
+        off = tuner._candidate_config({"micro_batch": 1, "zero_stage": 1,
+                                       "offload_optimizer": False})
+        assert on["zero_optimization"]["offload_optimizer"]["device"] == "nvme"
+        assert on["zero_optimization"]["offload_optimizer"]["nvme_path"] == "/tmp/nv"
+        assert off["zero_optimization"]["offload_optimizer"]["device"] == "none"
+
+    def test_fp32_config_modeled_at_fp32(self):
+        """No fp16/bf16 section → precision float32 in the memory model
+        (mirrors DeepSpeedTPUConfig.precision_dtype), not bfloat16."""
+        tuner = self._tuner(hbm_bytes=64 * GiB)
+        assert tuner._base_knobs()["precision"] == "float32"
+        est32 = tuner.estimate_candidate({"micro_batch": 1, "zero_stage": 0})
+        tuner.base_config["bf16"] = {"enabled": True}
+        est16 = tuner.estimate_candidate({"micro_batch": 1, "zero_stage": 0})
+        assert est32.compute_bytes == 2 * est16.compute_bytes
+        assert est32.grad_bytes == 2 * est16.grad_bytes
+
+    def test_mics_and_expert_mesh_shard_width(self):
+        """MiCS (zshard>1) shards over the subgroup only; the expert axis
+        replicates dense state and must not shrink the estimate."""
+        tuner = self._tuner(hbm_bytes=64 * GiB)
+        tuner.base_config["mesh"] = {"data": 4, "zshard": 2}
+        assert tuner._parallel_shape()["dp"] == 2  # not 8
+        tuner.base_config["mesh"] = {"data": 2, "expert": 4}
+        assert tuner._parallel_shape()["dp"] == 2  # not 8
+
+    def test_unsorted_stages_do_not_prune_lower_stage(self):
+        """zero_stages=[3, 1] must not let stage 3 (seen first) prune
+        stage 1 — stages are sorted ascending before the dominance check."""
+        tuner = self._tuner(hbm_bytes=64 * GiB)
+        cands = tuner.generate_candidates(None, [3, 1], ["none"], [False])
+        assert 1 in {c["zero_stage"] for c in cands}
+
+    def test_dominated_stage_skipped(self):
+        """A higher stage whose computed max micro-batch does not beat the
+        lower stage's is pruned wholesale (reference autotuner.py:536)."""
+        info = ModelInfo(num_params=10_000, hidden_size=32, num_layers=2,
+                         ffn_size=128, vocab_size=256, seq_len=32)
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        base = {"optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}, "mesh": {"data": 8}}
+        tuner = Autotuner(spec, base, seq_len=32, hbm_bytes=64 * GiB,
+                          model_info=info)
+        cands = tuner.generate_candidates(None, [1, 2, 3], ["none"], [False])
+        # tiny model: all stages fit the same max mbs → stages 2/3 dominated
+        stages = {c["zero_stage"] for c in cands}
+        assert stages == {1}
+        assert any("<= previous stage" in r.error for r in tuner.pruned)
+
+
+class TestAutotunerEndToEnd:
+    def test_auto_ladder_runs_and_picks(self):
+        from deepspeed_tpu.comm import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        base = {
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": 8},
+            "steps_per_print": 10 ** 9,
+        }
+        tuner = Autotuner(spec, base, seq_len=32, steps=1, warmup=1,
+                          hbm_bytes=GiB)
+        best = tuner.tune(n_trials=2)  # auto micro-batch ladder
+        assert best.throughput > 0
+        assert best.estimated_hbm is not None and best.estimated_hbm < GiB
+        assert len(tuner.results) <= 2

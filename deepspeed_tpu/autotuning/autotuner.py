@@ -301,10 +301,10 @@ class Autotuner:
             tuner_type, candidates, evaluate,
             group_fn=lambda c: (c["zero_stage"], c["remat"],
                                 c["offload_optimizer"]))
-        # default early stopping: one full micro-batch ladder without
-        # improvement (plateau detection, reference get_plateau_mbs)
+        # default early stopping: two stale rungs close a ladder (per-group
+        # plateau detection, reference get_plateau_mbs); later spaces still run
         if early_stopping is None and micro_batches is None:
-            early_stopping = NUM_TUNING_MICRO_BATCH_SIZES + 1
+            early_stopping = 2
         tuner.tune(n_trials=n_trials, early_stopping=early_stopping)
 
         best = max(self.results, key=lambda r: r.throughput,

@@ -166,9 +166,11 @@ def estimate(info: ModelInfo, *, zero_stage: int, dp_shards: int,
         compute, grads = 2 * compute, 2 * grads
 
     tokens = micro_batch * S
-    act = activation_bytes_per_token(info, remat) * tokens // mp_size
+    act_el = 4 if precision in ("fp32", "float32") else 2
+    act = activation_bytes_per_token(info, remat, act_el) * tokens // mp_size
     # logits + fp32 softmax/one-hot temporaries at the loss
-    logits = tokens * info.vocab_size * 6 // mp_size if info.vocab_size else 0
+    logits = (tokens * info.vocab_size * (4 + act_el) // mp_size
+              if info.vocab_size else 0)
     return MemoryEstimate(master_bytes=master, optimizer_bytes=opt,
                           compute_bytes=compute, grad_bytes=grads,
                           activation_bytes=act, logits_bytes=logits)

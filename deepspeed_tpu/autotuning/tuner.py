@@ -64,10 +64,15 @@ class BaseTuner:
 
     def tune(self, n_trials: Optional[int] = None,
              early_stopping: Optional[int] = None) -> int:
+        """With a group_fn, ``early_stopping`` stale trials close the current
+        GROUP (its remaining candidates are skipped unevaluated) and the
+        search moves on — the reference's within-ladder plateau. Without
+        grouping it ends the whole search."""
         n_trials = n_trials or len(self.candidates)
         stale = 0
         trials = 0
         group = object()
+        closed = set()
         while trials < n_trials:
             batch = self.next_batch()
             if not batch:
@@ -75,20 +80,27 @@ class BaseTuner:
             for cand in batch:
                 if trials >= n_trials:
                     break
-                if self.group_fn is not None:
-                    g = self.group_fn(cand)
-                    if g != group:
-                        stale = 0
-                        group = g
+                g = self.group_fn(cand) if self.group_fn is not None else None
+                if g is not None and g in closed:
+                    continue
+                if g != group:
+                    stale = 0
+                    group = g
                 val = self.evaluate_fn(cand)
                 improved = self._record(cand, val)
                 trials += 1
                 stale = 0 if improved else stale + 1
                 if early_stopping and stale >= early_stopping:
+                    if g is None:
+                        logger.info(
+                            f"autotune early stop: {stale} trials without "
+                            f"improvement (best={self.best_metric_val:.1f})")
+                        return trials
+                    closed.add(g)
+                    stale = 0
                     logger.info(
-                        f"autotune early stop: {stale} trials without "
-                        f"improvement (best={self.best_metric_val:.1f})")
-                    return trials
+                        f"autotune plateau in space {g}: skipping its "
+                        "remaining candidates")
         return trials
 
 

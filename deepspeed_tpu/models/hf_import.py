@@ -112,24 +112,13 @@ def params_from_llama(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
     L = cfg.num_layers
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
     lyr = pre + "layers.{}."
-    blocks = {
-        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
-        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
-        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
-        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
-        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
-        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+    blocks, params = _llama_attn_blocks(sd, cfg, pre)
+    blocks.update({
         "w_gate": _stack(sd, lyr + "mlp.gate_proj.weight", L, transpose=True),
         "w_up": _stack(sd, lyr + "mlp.up_proj.weight", L, transpose=True),
         "w_down": _stack(sd, lyr + "mlp.down_proj.weight", L, transpose=True),
-    }
-    params = {
-        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
-        "blocks": blocks,
-        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    })
+    params["blocks"] = blocks
     return params
 
 
@@ -149,8 +138,7 @@ def config_from_mixtral(hf_config) -> TransformerConfig:
 def params_from_mixtral(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
     L, E = cfg.num_layers, cfg.n_experts
     pre = "model." if any(k.startswith("model.") for k in sd) else ""
-    lyr = pre + "layers.{}."
-    moe = lyr + "block_sparse_moe."
+    moe = pre + "layers.{}.block_sparse_moe."
 
     def experts(wname):  # HF w1=gate, w2=down, w3=up; nn.Linear [out,in]
         return np.stack([
@@ -158,25 +146,14 @@ def params_from_mixtral(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
                       for e in range(E)])
             for i in range(L)])
 
-    blocks = {
-        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
-        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
-        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
-        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
-        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
-        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+    blocks, params = _llama_attn_blocks(sd, cfg, pre)
+    blocks.update({
         "gate_w": _stack(sd, moe + "gate.weight", L, transpose=True),
         "w_gate": experts("w1"),
         "w_down": experts("w2"),
         "w_up": experts("w3"),
-    }
-    params = {
-        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
-        "blocks": blocks,
-        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    })
+    params["blocks"] = blocks
     return params
 
 
@@ -200,6 +177,126 @@ def params_from_qwen2(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
         "bk": _stack(sd, lyr + "self_attn.k_proj.bias", L),
         "bv": _stack(sd, lyr + "self_attn.v_proj.bias", L),
     })
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# Qwen2-MoE / Qwen3-MoE (AutoEP presets; reference module_inject/auto_ep_presets/
+# {qwen3_moe,qwen3_5_moe}.py detection patterns — here realized as importers)
+# --------------------------------------------------------------------------- #
+
+def _assert_homogeneous_moe(hf_config) -> None:
+    """The zoo scans a homogeneous layer stack; Qwen-MoE configs that mix
+    dense and sparse layers (decoder_sparse_step > 1 or mlp_only_layers)
+    can't be stacked."""
+    step = int(getattr(hf_config, "decoder_sparse_step", 1) or 1)
+    only = list(getattr(hf_config, "mlp_only_layers", []) or [])
+    if step != 1 or only:
+        raise NotImplementedError(
+            f"heterogeneous MoE stack (decoder_sparse_step={step}, "
+            f"mlp_only_layers={only}) is not supported by the stacked-layer "
+            "zoo; every layer must be sparse")
+
+
+def config_from_qwen2_moe(hf_config) -> TransformerConfig:
+    _assert_homogeneous_moe(hf_config)
+    cfg = config_from_llama(hf_config)
+    return dataclasses.replace(
+        cfg, qkv_bias=True,
+        n_experts=hf_config.num_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_ffn_size=hf_config.moe_intermediate_size,
+        moe_shared_size=hf_config.shared_expert_intermediate_size,
+        moe_shared_gate=True,
+        moe_route_norm=bool(hf_config.norm_topk_prob),
+        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)))
+
+
+def _llama_attn_blocks(sd: Dict[str, Any], cfg: TransformerConfig,
+                       pre: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Shared Llama-schema attention/norm/embedding pieces (no FFN)."""
+    L = cfg.num_layers
+    lyr = pre + "layers.{}."
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
+        "wq": _stack(sd, lyr + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, lyr + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, lyr + "self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(sd, lyr + "self_attn.o_proj.weight", L, transpose=True),
+    }
+    params = {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return blocks, params
+
+
+def _qwen_moe_experts(sd: Dict[str, Any], moe_fmt: str, L: int, E: int):
+    """Stack per-expert gate/up/down ModuleList weights → [L, E, in, out]."""
+    def experts(wname):
+        return np.stack([
+            np.stack([_np(sd[moe_fmt.format(i) + f"experts.{e}.{wname}.weight"]).T
+                      for e in range(E)])
+            for i in range(L)])
+
+    return {"w_gate": experts("gate_proj"), "w_up": experts("up_proj"),
+            "w_down": experts("down_proj")}
+
+
+def params_from_qwen2_moe(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, E = cfg.num_layers, cfg.n_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    moe = lyr + "mlp."
+    blocks, params = _llama_attn_blocks(sd, cfg, pre)
+    blocks.update({
+        "bq": _stack(sd, lyr + "self_attn.q_proj.bias", L),
+        "bk": _stack(sd, lyr + "self_attn.k_proj.bias", L),
+        "bv": _stack(sd, lyr + "self_attn.v_proj.bias", L),
+        "gate_w": _stack(sd, moe + "gate.weight", L, transpose=True),
+        "sw_gate": _stack(sd, moe + "shared_expert.gate_proj.weight", L,
+                          transpose=True),
+        "sw_up": _stack(sd, moe + "shared_expert.up_proj.weight", L,
+                        transpose=True),
+        "sw_down": _stack(sd, moe + "shared_expert.down_proj.weight", L,
+                          transpose=True),
+        "shared_gate_w": _stack(sd, moe + "shared_expert_gate.weight", L,
+                                transpose=True),
+    })
+    blocks.update(_qwen_moe_experts(sd, moe, L, E))
+    params["blocks"] = blocks
+    return params
+
+
+def config_from_qwen3_moe(hf_config) -> TransformerConfig:
+    _assert_homogeneous_moe(hf_config)
+    cfg = config_from_llama(hf_config)
+    head_dim = getattr(hf_config, "head_dim", None)
+    return dataclasses.replace(
+        cfg, qk_norm=True, attn_head_dim=head_dim,
+        n_experts=hf_config.num_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_ffn_size=hf_config.moe_intermediate_size,
+        moe_route_norm=bool(hf_config.norm_topk_prob),
+        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)))
+
+
+def params_from_qwen3_moe(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L, E = cfg.num_layers, cfg.n_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    moe = lyr + "mlp."
+    blocks, params = _llama_attn_blocks(sd, cfg, pre)
+    blocks.update({
+        "gate_w": _stack(sd, moe + "gate.weight", L, transpose=True),
+        "q_norm": _stack(sd, lyr + "self_attn.q_norm.weight", L),
+        "k_norm": _stack(sd, lyr + "self_attn.k_norm.weight", L),
+    })
+    blocks.update(_qwen_moe_experts(sd, moe, L, E))
+    params["blocks"] = blocks
     return params
 
 
@@ -592,6 +689,8 @@ _ARCH_TABLE = {
     "mistral": (config_from_llama, params_from_llama),
     "mixtral": (config_from_mixtral, params_from_mixtral),
     "qwen2": (config_from_qwen2, params_from_qwen2),
+    "qwen2_moe": (config_from_qwen2_moe, params_from_qwen2_moe),
+    "qwen3_moe": (config_from_qwen3_moe, params_from_qwen3_moe),
     "phi": (config_from_phi, params_from_phi),
     "phi3": (config_from_phi3, params_from_phi3),
     "falcon": (config_from_falcon, params_from_falcon),

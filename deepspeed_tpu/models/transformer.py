@@ -68,6 +68,15 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_min_capacity: int = 4
     moe_aux_coef: float = 0.01
+    # MoE routing/arch variants (AutoEP presets: mixtral/qwen-moe/deepseek)
+    moe_ffn_size: Optional[int] = None  # routed-expert intermediate (≠ dense ffn)
+    moe_shared_size: int = 0            # shared-expert intermediate; 0 = none
+    moe_shared_gate: bool = False       # sigmoid gate on shared out (Qwen2-MoE)
+    moe_score_func: str = "softmax"     # softmax | sigmoid (DeepSeek-V3)
+    moe_route_norm: bool = True         # renormalize top-k weights to sum 1
+    moe_route_scale: float = 1.0        # routed_scaling_factor (DeepSeek)
+    qk_norm: bool = False               # RMSNorm on q/k head dim (Qwen3)
+    attn_head_dim: Optional[int] = None  # explicit head dim (Qwen3 ≠ H/N)
 
     @property
     def kv_heads(self) -> int:
@@ -75,7 +84,14 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.attn_head_dim is not None:
+            return self.attn_head_dim
         return self.hidden_size // self.num_heads
+
+    @property
+    def moe_ffn(self) -> int:
+        """Routed-expert intermediate size."""
+        return self.moe_ffn_size if self.moe_ffn_size is not None else self.ffn_size
 
     @property
     def ffn_size(self) -> int:
@@ -108,13 +124,19 @@ class TransformerConfig:
     def num_params(self) -> int:
         h, f, v, l = self.hidden_size, self.ffn_size, self.vocab_size, self.num_layers
         kv = self.kv_heads * self.head_dim
-        per_layer = h * h + 2 * h * kv + h * h  # q, k, v, o
+        qdim = self.num_heads * self.head_dim
+        per_layer = h * qdim + 2 * h * kv + qdim * h  # q, k, v, o
         ffn_mats = 3 if self.activation == "swiglu" else 2
         if self.n_experts > 0:
-            per_layer += self.n_experts * ffn_mats * h * f + h * self.n_experts
+            per_layer += self.n_experts * ffn_mats * h * self.moe_ffn + h * self.n_experts
+            per_layer += ffn_mats * h * self.moe_shared_size  # shared expert
+            if self.moe_shared_gate:
+                per_layer += h
         else:
             per_layer += ffn_mats * h * f
         per_layer += (2 * h if self.has_ln2 else h)  # norms
+        if self.qk_norm:
+            per_layer += 2 * self.head_dim
         total = l * per_layer + v * h + 2 * h
         if self.emb_norm:
             total += 2 * h
@@ -156,14 +178,27 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
     }
     if cfg.has_ln2:
         block["ln2"] = norm_init((L, h))
+    if cfg.qk_norm:
+        block["q_norm"] = jnp.ones((L, cfg.head_dim), jnp.float32)
+        block["k_norm"] = jnp.ones((L, cfg.head_dim), jnp.float32)
     E = cfg.n_experts
     if E > 0:
         # MoE FFN: per-expert weights (no biases), router gate per layer
+        fe = cfg.moe_ffn
         block["gate_w"] = dense(keys[10], (L, h, E), std)
-        block["w_up"] = dense(keys[4], (L, E, h, f), std)
-        block["w_down"] = dense(keys[5], (L, E, f, h), out_std)
+        block["w_up"] = dense(keys[4], (L, E, h, fe), std)
+        block["w_down"] = dense(keys[5], (L, E, fe, h), out_std)
         if cfg.activation == "swiglu":
-            block["w_gate"] = dense(keys[6], (L, E, h, f), std)
+            block["w_gate"] = dense(keys[6], (L, E, h, fe), std)
+        fs = cfg.moe_shared_size
+        if fs > 0:
+            # always-on shared expert (Qwen2-MoE/DeepSeek)
+            block["sw_up"] = dense(keys[11], (L, h, fs), std)
+            block["sw_down"] = dense(keys[12], (L, fs, h), out_std)
+            if cfg.activation == "swiglu":
+                block["sw_gate"] = dense(keys[13], (L, h, fs), std)
+            if cfg.moe_shared_gate:
+                block["shared_gate_w"] = dense(keys[14], (L, h, 1), std)
     else:
         block["w_up"] = dense(keys[4], (L, h, f), std)
         block["w_down"] = dense(keys[5], (L, f, h), out_std)
@@ -213,12 +248,22 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
     }
     if cfg.has_ln2:
         block["ln2"] = norm_axes(lyr)
+    if cfg.qk_norm:
+        block["q_norm"] = lyr + (None,)
+        block["k_norm"] = lyr + (None,)
     if cfg.n_experts > 0:
         block["gate_w"] = lyr + ("embed", None)
         block["w_up"] = lyr + ("expert", "embed", "mlp")
         block["w_down"] = lyr + ("expert", "mlp", "embed")
         if cfg.activation == "swiglu":
             block["w_gate"] = lyr + ("expert", "embed", "mlp")
+        if cfg.moe_shared_size > 0:
+            block["sw_up"] = lyr + ("embed", "mlp")
+            block["sw_down"] = lyr + ("mlp", "embed")
+            if cfg.activation == "swiglu":
+                block["sw_gate"] = lyr + ("embed", "mlp")
+            if cfg.moe_shared_gate:
+                block["shared_gate_w"] = lyr + ("embed", None)
     else:
         block["w_up"] = lyr + ("embed", "mlp")
         block["w_down"] = lyr + ("mlp", "embed")
@@ -264,6 +309,14 @@ def _norm(x: jax.Array, p: Dict[str, jax.Array], kind: str, eps: float) -> jax.A
         var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
         out = (x32 - mean) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
     return out.astype(dtype)
+
+
+def _head_rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """QK-norm (Qwen3): RMSNorm over the head dim of [B,S,N,D] q/k."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale).astype(dtype)
 
 
 def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
@@ -388,6 +441,9 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
     k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
     v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
+    if cfg.qk_norm:
+        q = _head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+        k = _head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
     if cfg.pos_emb == "rope":
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -420,10 +476,14 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
         from deepspeed_tpu.moe.layer import moe_ffn
 
         experts = {k_: lp[k_] for k_ in ("w_up", "w_down", "w_gate") if k_ in lp}
+        shared = {k_: lp[k_] for k_ in ("sw_up", "sw_down", "sw_gate",
+                                        "shared_gate_w") if k_ in lp}
         down, aux = moe_ffn(
             h, lp["gate_w"], experts, activation=cfg.activation,
             k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
-            min_capacity=cfg.moe_min_capacity)
+            min_capacity=cfg.moe_min_capacity,
+            score_func=cfg.moe_score_func, route_norm=cfg.moe_route_norm,
+            route_scale=cfg.moe_route_scale, shared=shared or None)
     else:
         up = h @ lp["w_up"].astype(dt)
         if cfg.use_bias:
@@ -650,6 +710,9 @@ def forward_decode(params: PyTree, tokens: jax.Array,
         q = proj("q", (B, T, cfg.num_heads, cfg.head_dim))
         k = proj("k", (B, T, cfg.kv_heads, cfg.head_dim))
         v = proj("v", (B, T, cfg.kv_heads, cfg.head_dim))
+        if cfg.qk_norm:
+            q = _head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+            k = _head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
         if cfg.pos_emb == "rope":
             q = apply_rope_at(q, cos_t, sin_t, positions)
             k = apply_rope_at(k, cos_t, sin_t, positions)

@@ -18,6 +18,9 @@ from deepspeed_tpu.moe.gating import (
     topk_gating,
 )
 from deepspeed_tpu.moe.layer import moe_ffn
+from deepspeed_tpu.moe.presets import (EPTopology, MoEPreset, PRESETS,
+                                       ep_topology, fold_group_tables,
+                                       preset_for_model_type, resolve_preset)
 
 __all__ = [
     "GateOutput",
@@ -26,4 +29,11 @@ __all__ = [
     "top2_gating",
     "topk_gating",
     "moe_ffn",
+    "MoEPreset",
+    "PRESETS",
+    "EPTopology",
+    "ep_topology",
+    "fold_group_tables",
+    "preset_for_model_type",
+    "resolve_preset",
 ]

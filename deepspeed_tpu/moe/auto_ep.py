@@ -1,35 +1,44 @@
 """AutoEP: automatic expert-parallel detection, planning and injection.
 
-Parity: reference ``module_inject/auto_ep.py`` (+ ``auto_ep_layer.py``,
-``auto_ep_folding.py``, presets): detects MoE blocks inside an HF model,
-replaces them with expert-parallel sharded layers, folds expert weights into
-the EP layout, and records universal-checkpoint metadata.
+Parity: reference ``module_inject/auto_ep.py`` (599 LoC detection/replacement
+driver) + ``auto_ep_presets/`` (family registry — see ``moe/presets.py``) +
+``auto_ep_folding.py`` (topology math — also ``presets.py``) +
+``auto_ep_layer.py`` (the EP layer — ``moe/layer.py``'s sharded dispatch).
 
 TPU translation: expert layout is declarative — expert tensors carry an
 'expert' logical axis that the sharding policy maps onto the 'expert' mesh
 axis (``parallel/partitioning.py``), and dispatch is the all-to-all MoE layer
 (``moe/layer.py``). What AutoEP contributes here:
 
-* **detection** (:func:`detect_moe`): recognizes MoE in an HF config or a
-  zoo TransformerConfig (n_experts, top-k, per-arch attribute names);
-* **planning** (:func:`plan_ep`): picks the expert-parallel width from the
-  device count and expert count (largest divisor of both ≤ n_experts —
-  the reference preset logic);
-* **injection** (:func:`auto_ep`): imports the HF MoE model (or takes a zoo
-  spec) and returns (spec, mesh_section) to pass straight into
-  ``deepspeed_tpu.initialize`` with the 'expert' axis sized per plan.
+* **detection** (:func:`detect_moe`): preset-registry resolution of the MoE
+  family from an HF config (mixtral / qwen2_moe / qwen3_moe / deepseek_v2/v3)
+  with per-family routing knobs, plus a generic attribute fallback and zoo
+  TransformerConfig support;
+* **planning** (:func:`plan_ep`): expert-parallel width from the device count
+  and expert count, with edp/etp widths and divisibility validation
+  (reference ParallelFoldingSpec);
+* **injection** (:func:`auto_ep`): imports the HF MoE model through the
+  preset's schema (weight *folding* = stacking ModuleList experts into
+  [L, E, in, out] arrays at import) and returns (spec, mesh_section, plan)
+  to pass straight into ``deepspeed_tpu.initialize``. Families the zoo can't
+  run (DeepSeek MLA attention) fail with the preset's documented note
+  (reference ``unsupported_preset_for_hf_model_type``).
 """
 from __future__ import annotations
 
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+from deepspeed_tpu.moe.presets import (EPTopology, ep_topology,
+                                       preset_for_model_type, resolve_preset)
 from deepspeed_tpu.utils.logging import log_dist
 
-# HF config attribute names that mark MoE archs (the detector table)
+# Generic HF config attribute names marking MoE archs (fallback when no
+# preset matches the model_type)
 _MOE_ATTRS = (
-    ("num_local_experts", "num_experts_per_tok"),      # mixtral
-    ("num_experts", "num_experts_per_tok"),            # qwen2_moe, deepseek
+    ("num_local_experts", "num_experts_per_tok"),      # mixtral-like
+    ("num_experts", "num_experts_per_tok"),            # qwen-moe-like
+    ("n_routed_experts", "num_experts_per_tok"),       # deepseek-like
     ("moe_num_experts", "moe_top_k"),                  # misc
 )
 
@@ -40,22 +49,38 @@ class EPPlan:
     n_experts: int = 0
     top_k: int = 0
     ep_size: int = 1
+    edp_size: int = 1
+    etp_size: int = 1
+    preset: Optional[str] = None
     reason: str = ""
 
     def describe(self) -> str:
         if not self.enabled:
             return f"AutoEP: disabled ({self.reason})"
-        return (f"AutoEP: {self.n_experts} experts top-{self.top_k} over "
-                f"ep={self.ep_size} ({self.reason})")
+        fam = f" [{self.preset}]" if self.preset else ""
+        return (f"AutoEP{fam}: {self.n_experts} experts top-{self.top_k} over "
+                f"ep={self.ep_size}×edp={self.edp_size}×etp={self.etp_size} "
+                f"({self.reason})")
+
+    def topology(self) -> EPTopology:
+        return EPTopology(
+            world_size=self.ep_size * self.edp_size * self.etp_size,
+            ep_size=self.ep_size, edp_size=self.edp_size,
+            etp_size=self.etp_size)
 
 
 def detect_moe(config: Any) -> Tuple[int, int]:
     """→ (n_experts, top_k); (0, 0) when the model is dense.
 
-    Accepts an HF config object or a zoo TransformerConfig."""
+    Accepts an HF config object or a zoo TransformerConfig. Preset registry
+    first (family semantics), generic attribute sweep second."""
     n = getattr(config, "n_experts", 0)
     if n:
         return int(n), int(getattr(config, "moe_top_k", 2))
+    resolved = resolve_preset(config)
+    if resolved is not None:
+        knobs = resolved[1]
+        return knobs["n_experts"], knobs["top_k"]
     for n_attr, k_attr in _MOE_ATTRS:
         n = getattr(config, n_attr, 0) or 0
         if n:
@@ -64,48 +89,76 @@ def detect_moe(config: Any) -> Tuple[int, int]:
 
 
 def plan_ep(config: Any, n_devices: Optional[int] = None,
-            max_ep: Optional[int] = None) -> EPPlan:
+            max_ep: Optional[int] = None,
+            etp_size: int = 1) -> EPPlan:
     """Pick the expert-parallel width: the largest divisor of the device
-    count that also divides the expert count (capped by ``max_ep``)."""
+    count that also divides the expert count (capped by ``max_ep``); the
+    remaining width becomes expert-data parallelism."""
     n_experts, top_k = detect_moe(config)
+    preset = preset_for_model_type(getattr(config, "model_type", None))
+    pname = preset.name if preset else None
     if not n_experts:
         return EPPlan(False, reason="no MoE layers detected")
     if n_devices is None:
         import jax
 
         n_devices = jax.device_count()
+    if n_devices % etp_size != 0:
+        raise ValueError(f"etp_size {etp_size} does not divide device count "
+                         f"{n_devices}")
+    avail = n_devices // etp_size
     ep = 1
-    for cand in range(1, min(n_experts, n_devices, max_ep or n_experts) + 1):
-        if n_devices % cand == 0 and n_experts % cand == 0:
+    for cand in range(1, min(n_experts, avail, max_ep or n_experts) + 1):
+        if avail % cand == 0 and n_experts % cand == 0:
             ep = cand
+    edp = avail // ep
     if ep == 1:
-        return EPPlan(True, n_experts, top_k, 1,
+        return EPPlan(True, n_experts, top_k, 1, edp, etp_size, pname,
                       "no common divisor > 1 of devices and experts; "
                       "experts replicated")
-    return EPPlan(True, n_experts, top_k, ep,
+    plan = EPPlan(True, n_experts, top_k, ep, edp, etp_size, pname,
                   f"{n_experts} experts over {n_devices} devices")
+    plan.topology().validate(n_experts)
+    return plan
 
 
 def auto_ep(model_or_spec, n_devices: Optional[int] = None,
-            max_ep: Optional[int] = None,
+            max_ep: Optional[int] = None, etp_size: int = 1,
             **spec_kwargs) -> Tuple[Any, Dict[str, int], EPPlan]:
     """Detect + plan + inject. Accepts an HF model (anything
     ``import_hf_model`` takes) or a zoo ModelSpec.
 
     → (model_spec, mesh_section, plan); pass ``config={'mesh': mesh_section,
-    ...}`` to ``initialize`` (mesh_section = {'expert': ep_size})."""
-    from deepspeed_tpu.models.api import ModelSpec, causal_lm_spec
+    ...}`` to ``initialize``. Unsupported families (DeepSeek MLA) raise with
+    the preset's documented note."""
+    from deepspeed_tpu.models.api import ModelSpec
 
+    preset = None
     if isinstance(model_or_spec, ModelSpec):
         spec = model_or_spec
         cfg = spec.config
     else:
+        hf_cfg = getattr(model_or_spec, "config", None)
+        if hf_cfg is None and isinstance(model_or_spec, tuple):
+            hf_cfg = model_or_spec[1]
+        preset = preset_for_model_type(
+            getattr(hf_cfg, "model_type", None)) if hf_cfg is not None else None
+        if preset is not None and not preset.importable:
+            raise NotImplementedError(
+                f"AutoEP preset {preset.name!r}: {preset.unsupported_note}")
         from deepspeed_tpu.models.api import spec_from_hf
 
         spec = spec_from_hf(model_or_spec, **spec_kwargs)
         cfg = spec.config
 
-    plan = plan_ep(cfg, n_devices=n_devices, max_ep=max_ep)
+    plan = plan_ep(cfg, n_devices=n_devices, max_ep=max_ep, etp_size=etp_size)
+    if preset is not None and plan.preset is None:
+        # the zoo config the plan saw has no model_type; carry the family over
+        plan = dataclasses.replace(plan, preset=preset.name)
     log_dist(plan.describe())
-    mesh_section = {"expert": plan.ep_size} if plan.enabled else {}
+    mesh_section: Dict[str, int] = {}
+    if plan.enabled:
+        mesh_section["expert"] = plan.ep_size
+        if plan.etp_size > 1:
+            mesh_section["tensor"] = plan.etp_size
     return spec, mesh_section, plan

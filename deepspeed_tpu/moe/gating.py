@@ -40,11 +40,27 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
                 min_capacity: int = 4,
                 rng: Optional[jax.Array] = None,
                 noise_std: float = 0.0,
-                normalize: bool = True) -> GateOutput:
-    """Generic top-k gate (k=1 → top1gating, k=2 → top2gating semantics)."""
+                normalize: bool = True,
+                score_func: str = "softmax") -> GateOutput:
+    """Generic top-k gate (k=1 → top1gating, k=2 → top2gating semantics).
+
+    ``score_func``: 'softmax' (GShard/Mixtral/Qwen-MoE) or 'sigmoid'
+    (DeepSeek-V3-style: per-expert sigmoid affinities; ``normalize``
+    renormalizes the selected scores to sum 1). The aux loss always uses a
+    distribution over experts (sigmoid scores are sum-normalized for it).
+    """
     T, E = logits.shape
     logits = logits.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
+    if score_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+        probs = scores / jnp.maximum(
+            jnp.sum(scores, axis=-1, keepdims=True), 1e-9)
+        gate_source = scores
+    elif score_func == "softmax":
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_source = probs
+    else:
+        raise ValueError(f"score_func must be softmax|sigmoid, got {score_func!r}")
     C = gate_capacity(T, E, k, capacity_factor, min_capacity)
 
     sel_logits = logits
@@ -61,7 +77,7 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
     for _ in range(k):
         idx = jnp.argmax(masked, axis=-1)                    # [T]
         mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [T, E]
-        gates_list.append(jnp.sum(probs * mask, axis=-1))    # [T]
+        gates_list.append(jnp.sum(gate_source * mask, axis=-1))  # [T]
         masks.append(mask)
         masked = jnp.where(mask.astype(bool), -jnp.inf, masked)
 

@@ -39,15 +39,38 @@ def _expert_constraint(x: jax.Array, n_lead: int = 1) -> jax.Array:
     return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
+def _dense_ffn(xt: jax.Array, w_up: jax.Array, w_down: jax.Array,
+               w_gate: Optional[jax.Array], activation: str) -> jax.Array:
+    """Plain FFN on flat tokens [T,H] (the shared-expert path)."""
+    dt = xt.dtype
+    up = xt @ w_up.astype(dt)
+    if w_gate is not None:
+        up = jax.nn.silu(xt @ w_gate.astype(dt)) * up
+    elif activation == "gelu":
+        up = jax.nn.gelu(up, approximate=True)
+    else:
+        up = jax.nn.relu(up)
+    return up @ w_down.astype(dt)
+
+
 def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],
             activation: str = "gelu", k: int = 2,
             capacity_factor: float = 1.25, min_capacity: int = 4,
-            rng: Optional[jax.Array] = None, noise_std: float = 0.0
+            rng: Optional[jax.Array] = None, noise_std: float = 0.0,
+            score_func: str = "softmax", route_norm: bool = True,
+            route_scale: float = 1.0,
+            shared: Optional[Dict[str, jax.Array]] = None
             ) -> Tuple[jax.Array, jax.Array]:
     """Mixture-of-experts FFN.
 
     x: [B, S, H]; gate_w: [H, E]; experts: w_up [E, H, F], w_down [E, F, H],
     optional w_gate [E, H, F] (swiglu). Returns (y [B,S,H], aux_loss scalar).
+
+    Routing variants (AutoEP presets): ``score_func`` softmax|sigmoid,
+    ``route_norm`` renormalizes top-k weights, ``route_scale`` scales the
+    routed output (DeepSeek routed_scaling_factor). ``shared`` adds an
+    always-on shared expert (sw_up [H,Fs], sw_down [Fs,H], optional sw_gate
+    [H,Fs], optional shared_gate_w [H,1] sigmoid gate — Qwen2-MoE).
     """
     B, S, H = x.shape
     dt = x.dtype
@@ -57,7 +80,8 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],
     logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)   # [T, E]
     gate: GateOutput = topk_gating(
         logits, k=k, capacity_factor=capacity_factor,
-        min_capacity=min_capacity, rng=rng, noise_std=noise_std)
+        min_capacity=min_capacity, rng=rng, noise_std=noise_std,
+        normalize=route_norm, score_func=score_func)
 
     # dispatch: [T,E,C] × [T,H] → [E,C,H]; GSPMD turns the resharding of the
     # token dim (data/expert-sharded) onto the expert dim into an all-to-all
@@ -76,4 +100,14 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],
     ye = _expert_constraint(ye)
 
     y = jnp.einsum("tec,ech->th", gate.combine.astype(dt), ye)
+    if route_scale != 1.0:
+        y = y * jnp.asarray(route_scale, dt)
+    if shared:
+        ys = _dense_ffn(xt, shared["sw_up"], shared["sw_down"],
+                        shared.get("sw_gate"), activation)
+        if "shared_gate_w" in shared:
+            sg = jax.nn.sigmoid(
+                xt.astype(jnp.float32) @ shared["shared_gate_w"].astype(jnp.float32))
+            ys = ys * sg.astype(dt)
+        y = y + ys
     return y.reshape(B, S, H), gate.aux_loss

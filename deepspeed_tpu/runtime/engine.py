@@ -1181,6 +1181,14 @@ class DeepSpeedTPUEngine:
         self.state = state
         if self._offload_opt:
             self._opt_swap("out")
+        if (self._offload_nvme and self._opt_swapper is not None
+                and load_optimizer_states):
+            # the restore put real moments in state['opt'] but the swapper
+            # still thinks its (stale) swap files are authoritative
+            # (_swapped=True) — the next step's swap_in would clobber the
+            # restored moments. Re-swap-out: fresh files, consistent state,
+            # HBM freed again.
+            self._opt_swapper.swap_out_optimizer()
         self.global_steps = int(client_state.get("global_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
         if load_lr_scheduler_states and self.lr_scheduler is not None and \

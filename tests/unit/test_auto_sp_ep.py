@@ -136,3 +136,111 @@ class TestAutoEP:
         spec, mesh_section, plan = auto_ep(model, n_devices=8, max_ep=4)
         assert plan.n_experts == 4 and mesh_section == {"expert": 4}
         assert spec.config.n_experts == 4
+
+
+class TestMoEPresets:
+    def test_registry_resolves_model_types(self):
+        from deepspeed_tpu.moe.presets import preset_for_model_type
+
+        assert preset_for_model_type("mixtral").name == "mixtral"
+        assert preset_for_model_type("qwen2_moe").shared_gate
+        assert preset_for_model_type("qwen3_moe").name == "qwen3_moe"
+        assert preset_for_model_type("deepseek_v3").score_func == "sigmoid"
+        assert preset_for_model_type("llama") is None
+
+    def test_preset_extracts_knobs(self):
+        from deepspeed_tpu.moe.presets import resolve_preset
+
+        cfg = _FakeHFConfig(model_type="qwen2_moe", num_experts=8,
+                            num_experts_per_tok=2, moe_intermediate_size=24,
+                            shared_expert_intermediate_size=40,
+                            norm_topk_prob=False)
+        preset, knobs = resolve_preset(cfg)
+        assert knobs["n_experts"] == 8 and knobs["shared_size"] == 40
+        assert not knobs["route_norm"] and knobs["shared_gate"]
+
+    def test_deepseek_detection_and_unsupported_import(self):
+        from deepspeed_tpu.moe.presets import resolve_preset
+
+        cfg = _FakeHFConfig(model_type="deepseek_v3", n_routed_experts=64,
+                            num_experts_per_tok=8, routed_scaling_factor=2.5,
+                            first_k_dense_replace=3, n_shared_experts=1)
+        preset, knobs = resolve_preset(cfg)
+        assert knobs["score_func"] == "sigmoid"
+        assert knobs["route_scale"] == 2.5 and knobs["first_dense"] == 3
+        assert not preset.importable
+        assert detect_moe(cfg) == (64, 8)
+        # auto_ep on an unimportable family raises the preset's note
+        with pytest.raises(NotImplementedError, match="MLA"):
+            auto_ep((object(), cfg), n_devices=8)
+
+
+class TestEPTopology:
+    def test_topology_and_validation(self):
+        from deepspeed_tpu.moe.presets import ep_topology
+
+        topo = ep_topology({"data": 2, "expert": 4, "tensor": 2})
+        assert (topo.world_size, topo.ep_size, topo.edp_size,
+                topo.etp_size) == (16, 4, 2, 2)
+        topo.validate(8)  # 4 | 8 ok
+        with pytest.raises(ValueError, match="does not divide"):
+            topo.validate(6)
+
+    def test_group_tables_partition_world(self):
+        from deepspeed_tpu.moe.presets import fold_group_tables
+
+        tables = fold_group_tables({"data": 2, "expert": 2, "tensor": 2})
+        world = set(range(8))
+        for dim in ("tp", "ep", "edp", "dense_dp"):
+            ranks = [r for g in tables[dim] for r in g]
+            assert sorted(ranks) == sorted(world), dim
+        # an ep group varies only the expert coordinate (stride = tensor)
+        assert tables["ep"][0] == (0, 2)
+        # dense dp covers data×expert for a fixed tensor coordinate
+        assert tables["dense_dp"][0] == (0, 2, 4, 6)
+
+    def test_plan_with_etp(self):
+        cfg = _FakeHFConfig(num_local_experts=4, num_experts_per_tok=2)
+        plan = plan_ep(cfg, n_devices=8, etp_size=2)
+        assert plan.ep_size == 4 and plan.edp_size == 1 and plan.etp_size == 2
+        assert plan.topology().world_size == 8
+
+
+class TestAutoEPQwen2Moe:
+    def test_auto_ep_imports_and_trains(self):
+        """AutoEP over a real HF Qwen2-MoE model: preset-schema weight
+        folding (stacked experts + shared expert) + EP mesh plan + e2e
+        training step."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        reset_mesh()
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, shared_expert_intermediate_size=40,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(7)
+        model = transformers.Qwen2MoeForCausalLM(hf_cfg)
+        spec, mesh_section, plan = auto_ep(model, n_devices=8, max_ep=4,
+                                           dtype="float32")
+        assert plan.preset == "qwen2_moe"
+        assert plan.ep_size == 4 and plan.edp_size == 2
+        assert spec.config.moe_shared_size == 40
+
+        config = {
+            "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 2, **mesh_section},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 128, size=(16, 16)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        l0 = float(engine.train_batch(it))
+        for _ in range(3):
+            loss = engine.train_batch(it)
+        assert float(loss) < l0

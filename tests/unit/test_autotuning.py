@@ -180,11 +180,11 @@ class TestAutotunerPruning:
                          ffn_size=128, vocab_size=256, seq_len=32)
         spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
         base = {"optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                "zero_optimization": {"stage": 1}, "mesh": {"data": 8}}
+                "zero_optimization": {"stage": 1}, "mesh": {"data": 1}}
         tuner = Autotuner(spec, base, seq_len=32, hbm_bytes=64 * GiB,
                           model_info=info)
         cands = tuner.generate_candidates(None, [1, 2, 3], ["none"], [False])
-        # tiny model: all stages fit the same max mbs → stages 2/3 dominated
+        # dp=1: no stage shards anything → identical max mbs → 2/3 dominated
         stages = {c["zero_stage"] for c in cands}
         assert stages == {1}
         assert any("<= previous stage" in r.error for r in tuner.pruned)

@@ -266,3 +266,86 @@ class TestDecodeParityNewArchs:
         full_ext = np.asarray(T.forward(params, jnp.asarray(ext), cfg))
         np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
                                    full_ext[:, -1], rtol=2e-4, atol=2e-4)
+
+
+class TestQwen2MoeImport:
+    def _model(self):
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, shared_expert_intermediate_size=40,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(30)
+        return transformers.Qwen2MoeForCausalLM(hf_cfg)
+
+    def test_logits_match_generous_capacity(self):
+        """Qwen2-MoE: shared expert + sigmoid shared gate + un-normalized
+        top-k softmax routing (norm_topk_prob=False default)."""
+        model = self._model()
+        cfg, params = import_hf_model(model)
+        assert cfg.n_experts == 4 and cfg.moe_shared_size == 40
+        assert cfg.moe_shared_gate and not cfg.moe_route_norm
+        assert cfg.moe_ffn == 24
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(30).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+    def test_heterogeneous_stack_rejected(self):
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=2, num_attention_heads=2, num_experts=4,
+            mlp_only_layers=[0])
+        torch.manual_seed(31)
+        model = transformers.Qwen2MoeForCausalLM(hf_cfg)
+        with pytest.raises(NotImplementedError, match="heterogeneous"):
+            import_hf_model(model)
+
+
+class TestQwen3MoeImport:
+    def test_logits_match_generous_capacity(self):
+        """Qwen3-MoE: QK-norm attention, explicit head_dim, normalized top-k
+        routing, no shared expert."""
+        hf_cfg = transformers.Qwen3MoeConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(32)
+        model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.qk_norm and cfg.head_dim == 16
+        assert cfg.moe_route_norm and cfg.moe_shared_size == 0
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(32).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+    def test_decode_matches_forward(self):
+        """QK-norm + MoE through the KV-cache decode path."""
+        hf_cfg = transformers.Qwen3MoeConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            num_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(33)
+        model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(33).integers(0, 128, (2, 8),
+                                                    dtype=np.int32)
+        full = np.asarray(T.forward(params, jnp.asarray(tokens), cfg))
+        cache = T.init_kv_cache(cfg, batch_size=2, max_len=16)
+        logits, _ = T.forward_decode(
+            params, jnp.asarray(tokens), cache, jnp.zeros((2,), jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(logits), full, rtol=2e-3,
+                                   atol=2e-3)

@@ -162,3 +162,87 @@ class TestEndToEndEP:
                 lambda p, t: T.causal_lm_loss(T.forward(p, t, cfg), t))(
                     params, tokens))
         np.testing.assert_allclose(loss_ep, loss_single, rtol=1e-4)
+
+
+class TestRoutingVariants:
+    """AutoEP preset routing math: sigmoid scores, route scale, shared
+    experts (reference auto_ep_presets score_func/score_apply/route_norm)."""
+
+    def _setup(self, E=4, H=16, F=32):
+        ks = jax.random.split(jax.random.PRNGKey(9), 6)
+        x = jax.random.normal(ks[0], (2, 8, H))
+        gate_w = jax.random.normal(ks[1], (H, E)) * 0.5
+        experts = {
+            "w_up": jax.random.normal(ks[2], (E, H, F)) * 0.1,
+            "w_down": jax.random.normal(ks[3], (E, F, H)) * 0.1,
+        }
+        return x, gate_w, experts, ks
+
+    def test_sigmoid_gate_values(self):
+        """score_func='sigmoid' + route_norm: combine weights are selected
+        sigmoid scores renormalized over the top-k (DeepSeek-V3 routing)."""
+        from deepspeed_tpu.moe.gating import topk_gating
+
+        logits = jnp.array([[2.0, 1.0, -1.0, 0.0]])
+        out = topk_gating(logits, k=2, capacity_factor=8.0,
+                          score_func="sigmoid", normalize=True)
+        s = jax.nn.sigmoid(logits[0])
+        want = jnp.array([s[0], s[1]]) / (s[0] + s[1])
+        got = jnp.sum(out.combine[0], axis=-1)  # [E]
+        np.testing.assert_allclose(np.asarray(got[:2]), np.asarray(want),
+                                   rtol=1e-5)
+        assert float(got[2]) == 0.0 and float(got[3]) == 0.0
+
+    def test_unnormalized_softmax_gates(self):
+        """route_norm=False (Qwen2-MoE norm_topk_prob=False): gates are raw
+        softmax probs of the selected experts."""
+        from deepspeed_tpu.moe.gating import topk_gating
+
+        logits = jnp.array([[2.0, 1.0, -1.0, 0.0]])
+        out = topk_gating(logits, k=2, capacity_factor=8.0, normalize=False)
+        p = jax.nn.softmax(logits[0])
+        got = jnp.sum(out.combine[0], axis=-1)
+        np.testing.assert_allclose(np.asarray(got[:2]), np.asarray(p[:2]),
+                                   rtol=1e-5)
+
+    def test_route_scale_scales_routed_only(self):
+        x, gate_w, experts, ks = self._setup()
+        y1, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0)
+        y2, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0,
+                        route_scale=2.5)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y1) * 2.5,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_shared_expert_adds_dense_path(self):
+        x, gate_w, experts, ks = self._setup()
+        Fs = 24
+        shared = {
+            "sw_up": jax.random.normal(ks[4], (16, Fs)) * 0.1,
+            "sw_down": jax.random.normal(ks[5], (Fs, 16)) * 0.1,
+        }
+        y0, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0)
+        y1, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0,
+                        shared=shared)
+        xt = x.reshape(-1, 16)
+        dense = jax.nn.gelu(xt @ shared["sw_up"], approximate=True) @ shared["sw_down"]
+        np.testing.assert_allclose(
+            np.asarray(y1 - y0).reshape(-1, 16), np.asarray(dense),
+            rtol=1e-4, atol=1e-5)
+
+    def test_shared_gate_sigmoid(self):
+        x, gate_w, experts, ks = self._setup()
+        Fs = 24
+        shared = {
+            "sw_up": jax.random.normal(ks[4], (16, Fs)) * 0.1,
+            "sw_down": jax.random.normal(ks[5], (Fs, 16)) * 0.1,
+            "shared_gate_w": jax.random.normal(ks[1], (16, 1)) * 0.3,
+        }
+        y0, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0)
+        y1, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=16.0,
+                        shared=shared)
+        xt = x.reshape(-1, 16)
+        dense = jax.nn.gelu(xt @ shared["sw_up"], approximate=True) @ shared["sw_down"]
+        sg = jax.nn.sigmoid(xt @ shared["shared_gate_w"])
+        np.testing.assert_allclose(
+            np.asarray(y1 - y0).reshape(-1, 16), np.asarray(dense * sg),
+            rtol=1e-4, atol=1e-5)

@@ -130,3 +130,30 @@ class TestNvmeSwap:
         # training continues after swap-in
         loss = engine.train_batch(data)
         assert np.isfinite(float(loss))
+
+    def test_checkpoint_reload_does_not_clobber_restored_moments(self, tmp_path):
+        """load_checkpoint(load_optimizer_states=True) on an NVMe-offload
+        engine must leave the RESTORED moments authoritative: the next step's
+        swap-in must not resurrect stale pre-checkpoint swap files."""
+        engine = _engine(stage=2, offload={"device": "nvme",
+                                           "nvme_path": str(tmp_path / "sw")})
+        data = synthetic_lm_data(batch_size=16, seq_len=32, vocab_size=512)
+        engine.train_batch(data)
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        # two more steps: swap files + moments advance past the checkpoint
+        engine.train_batch(data)
+        engine.train_batch(data)
+
+        engine.load_checkpoint(str(tmp_path / "ck"))
+        assert engine.global_steps == 1
+        engine._nvme_swapper().swap_in_optimizer()
+        got = np.asarray(jax.device_get(
+            engine.state["opt"]["exp_avg"]["blocks"]["wq"]))
+        engine._nvme_swapper().swap_out_optimizer()
+
+        # reference: a fresh engine restored from the same checkpoint
+        ref = _engine(stage=2)
+        ref.load_checkpoint(str(tmp_path / "ck"))
+        want = np.asarray(jax.device_get(
+            ref.state["opt"]["exp_avg"]["blocks"]["wq"]))
+        np.testing.assert_array_equal(got, want)

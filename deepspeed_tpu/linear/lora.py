@@ -114,7 +114,12 @@ def lora_causal_lm_spec(cfg, lora: Optional[LoRAConfig] = None,
         return {"base": mask_like(base_spec.axes_fn(), False),
                 "lora": {"blocks": {k: True for k in keys}}}
 
+    def _rebuild(attention=None, loss_tiles=0):
+        ov = dict(overrides, loss_tiles=loss_tiles)
+        return lora_causal_lm_spec(cfg, lora=lora, attention=attention,
+                                   seed=seed, **ov)
+
     return dataclasses.replace(
         base_spec, init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
         axes_fn=axes_fn, trainable_fn=trainable_fn,
-        name=f"{base_spec.name}-lora{lora.lora_r}")
+        name=f"{base_spec.name}-lora{lora.lora_r}", builder=_rebuild)

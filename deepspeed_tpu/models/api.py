@@ -35,6 +35,12 @@ class ModelSpec:
     # fn(compute_params, batch, loss_scale); returning None falls back to
     # value_and_grad over loss_fn. The decision must be trace-static.
     loss_and_grads_fn: Optional[Callable] = None
+    # optional self-rebuild factory: fn(attention=None, loss_tiles=0) →
+    # an equivalent ModelSpec with those knobs changed, preserving every
+    # customization (LoRA adapters, imported weights, trainable masks...).
+    # AutoSP uses this to swap the attention mechanism; specs without a
+    # builder are left untouched (plan disabled).
+    builder: Optional[Callable[..., "ModelSpec"]] = None
 
 
 def _tokens_of(batch: Batch) -> jax.Array:
@@ -194,6 +200,12 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             activation_constraint=activation_constraint,
             loss_mask=_mask_of(batch), loss_scale=loss_scale)
 
+    def _rebuild(attention: Optional[str] = None,
+                 loss_tiles: int = 0) -> "ModelSpec":
+        return causal_lm_spec(cfg, attention=attention, loss_tiles=loss_tiles,
+                              activation_constraint=activation_constraint,
+                              pipeline_schedule=pipeline_schedule)
+
     return ModelSpec(
         init_fn=lambda rng: T.init_params(cfg, rng),
         loss_fn=loss_fn,
@@ -204,6 +216,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         seq_len=cfg.max_seq_len,
         config=cfg,
         loss_and_grads_fn=loss_and_grads_fn,
+        builder=_rebuild,
     )
 
 
@@ -228,4 +241,12 @@ def spec_from_hf(model, arch: Optional[str] = None, attention: Optional[str] = N
     init_params = jax.tree.map(lambda x: _jnp.asarray(x, _jnp.float32), params)
     name = getattr(getattr(model, "config", None), "model_type", None) \
         or (arch or "hf_model")
-    return _dc.replace(base, init_fn=lambda rng: init_params, name=str(name))
+
+    def _rebuild(attention: Optional[str] = None,
+                 loss_tiles: int = 0) -> ModelSpec:
+        nb = base.builder(attention=attention, loss_tiles=loss_tiles)
+        return _dc.replace(nb, init_fn=lambda rng: init_params,
+                           name=str(name))
+
+    return _dc.replace(base, init_fn=lambda rng: init_params, name=str(name),
+                       builder=_rebuild)

@@ -186,6 +186,18 @@ class TensorParallelConfig:
 
 
 @dataclasses.dataclass
+class SequenceParallelConfig:
+    """AutoSP config hook (reference ``compile_autosp`` engine.py:1160 /
+    DeepCompile ``sp_compile`` pass): when ``auto`` is set the engine runs
+    the AutoSP planning pass (``sequence/auto_sp.py``) over the model spec at
+    initialize — mechanism (ulysses vs KV ring) chosen by feasibility + comm
+    cost on the mesh's 'seq' axis."""
+    auto: bool = False
+    # informational check: if set, must match the mesh 'seq' axis
+    size: int = 0
+
+
+@dataclasses.dataclass
 class PipelineSectionConfig:
     stages: int = 1
     micro_batches: Optional[int] = None
@@ -310,6 +322,8 @@ class DeepSpeedTPUConfig:
     data_types: DataTypesConfig = dataclasses.field(default_factory=DataTypesConfig)
     mesh: MeshSectionConfig = dataclasses.field(default_factory=MeshSectionConfig)
     tensor_parallel: TensorParallelConfig = dataclasses.field(default_factory=TensorParallelConfig)
+    sequence_parallel: SequenceParallelConfig = dataclasses.field(
+        default_factory=SequenceParallelConfig)
     pipeline: PipelineSectionConfig = dataclasses.field(default_factory=PipelineSectionConfig)
     seed: int = 1234
     zero_force_ds_cpu_optimizer: bool = False

@@ -42,6 +42,7 @@ from deepspeed_tpu.models.api import ModelSpec
 from deepspeed_tpu.ops.optimizer import TPUOptimizer, get_optimizer
 from deepspeed_tpu.parallel.partitioning import ShardingPolicy
 from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig, load_config
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
 from deepspeed_tpu.runtime.dataloader import (
     DeepSpeedTPUDataLoader,
     RepeatingLoader,
@@ -110,6 +111,23 @@ class DeepSpeedTPUEngine:
 
         self.zero_stage = self.config.zero_optimization.stage
         self.policy = ShardingPolicy(self.mesh, self.zero_stage)
+
+        # AutoSP: config-driven sequence-parallel pass over the spec
+        # (reference compile_autosp engine hook, engine.py:1160)
+        self.sp_plan = None
+        sp_cfg = self.config.sequence_parallel
+        if sp_cfg.size and sp_cfg.size != self.mesh_manager.axis_size("seq"):
+            raise DeepSpeedConfigError(
+                f"sequence_parallel.size {sp_cfg.size} != mesh seq axis "
+                f"{self.mesh_manager.axis_size('seq')}"
+                + ("" if sp_cfg.auto else
+                   " (note: sequence_parallel.size alone does not enable SP; "
+                   "set \"auto\": true and a mesh 'seq' axis)"))
+        if sp_cfg.auto:
+            from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+            model, self.sp_plan = auto_sp(model)
+            self.model_spec = model
 
         # precision
         self.precision = self.config.precision_dtype  # float32|float16|bfloat16

@@ -244,3 +244,210 @@ class TestAutoEPQwen2Moe:
         for _ in range(3):
             loss = engine.train_batch(it)
         assert float(loss) < l0
+
+
+class TestSPDetector:
+    def test_detect_zoo_config(self):
+        from deepspeed_tpu.models import transformer as T
+        from deepspeed_tpu.sequence.auto_sp import detect_sp_info
+
+        cfg = T.get_model_config("tiny", num_heads=4, num_kv_heads=2)
+        info = detect_sp_info(cfg)
+        assert info.num_heads == 4 and info.kv_heads == 2
+        assert info.arch == "zoo" and info.causal
+
+    def test_detect_hf_llama_schema(self):
+        from deepspeed_tpu.sequence.auto_sp import detect_sp_info
+
+        cfg = _FakeHFConfig(model_type="qwen2", num_attention_heads=16,
+                            num_key_value_heads=4, hidden_size=1024,
+                            max_position_embeddings=8192)
+        info = detect_sp_info(cfg)
+        assert info.num_heads == 16 and info.kv_heads == 4
+        assert info.head_dim == 64 and info.seq_len == 8192
+        assert info.arch == "qwen2"
+
+    def test_detect_multimodal_plans_text_trunk(self):
+        from deepspeed_tpu.sequence.auto_sp import detect_sp_info, plan_sp
+
+        text = _FakeHFConfig(model_type="llama", num_attention_heads=8,
+                             num_key_value_heads=8, hidden_size=512,
+                             max_position_embeddings=4096)
+        mm = _FakeHFConfig(model_type="llava", text_config=text)
+        info = detect_sp_info(mm)
+        assert info.vision_tower and info.num_heads == 8
+        plan = plan_sp(info=info, sp_size=2)
+        assert plan.enabled and "vision tower replicated" in plan.reason
+
+    def test_detect_unreadable_raises(self):
+        from deepspeed_tpu.sequence.auto_sp import detect_sp_info
+
+        with pytest.raises(ValueError, match="cannot detect"):
+            detect_sp_info(_FakeHFConfig(foo=1))
+
+
+class TestSPCostModel:
+    def test_mha_prefers_ulysses(self):
+        from deepspeed_tpu.sequence.auto_sp import SPSiteInfo, plan_sp
+
+        info = SPSiteInfo(num_heads=16, kv_heads=16, head_dim=128,
+                          seq_len=8192)
+        plan = plan_sp(info=info, sp_size=4)
+        assert plan.mechanism == "ulysses"
+
+    def test_mqa_long_seq_prefers_ring(self):
+        """MQA (1 KV head) at sp=8: the ring only rotates the tiny KV while
+        Ulysses must all-to-all q and replicated kv — ring wins the comm
+        model."""
+        from deepspeed_tpu.sequence.auto_sp import SPSiteInfo, plan_sp
+
+        info = SPSiteInfo(num_heads=32, kv_heads=1, head_dim=128,
+                          seq_len=8192)
+        plan = plan_sp(info=info, sp_size=8)
+        assert plan.mechanism == "ring"
+
+    def test_nothing_feasible(self):
+        from deepspeed_tpu.sequence.auto_sp import SPSiteInfo, plan_sp
+
+        info = SPSiteInfo(num_heads=6, kv_heads=6, head_dim=64, seq_len=102)
+        plan = plan_sp(info=info, sp_size=4)  # 6 % 4 != 0, 102 % 4 != 0
+        assert not plan.enabled and "neither" in plan.reason
+
+
+class TestConfigDrivenAutoSP:
+    def test_engine_applies_autosp_from_json(self):
+        """{"sequence_parallel": {"auto": true}} reshapes the model at
+        initialize — no library call needed (reference compile_autosp)."""
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=64,
+                                  num_layers=2, num_heads=4, max_seq_len=32)
+        config = {
+            "train_batch_size": 4, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 4, "seq": 2},
+            "sequence_parallel": {"auto": True},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine.sp_plan is not None and engine.sp_plan.enabled
+        assert engine.sp_plan.mechanism == "ulysses"
+        assert "autosp" in engine.model_spec.name
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(4, 32)).astype(np.int32)}
+        it = iter(lambda: batch, None)
+        l0 = float(engine.train_batch(it))
+        for _ in range(3):
+            loss = engine.train_batch(it)
+        assert float(loss) < l0
+
+    def test_size_mismatch_raises(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = {
+            "train_batch_size": 4, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 4, "seq": 2},
+            "sequence_parallel": {"auto": True, "size": 4},
+            "zero_optimization": {"stage": 1},
+        }
+        with pytest.raises(DeepSpeedConfigError, match="seq axis"):
+            dst.initialize(model=spec, config=config)
+
+
+class TestAutoSPSafety:
+    def test_lora_spec_survives_autosp(self):
+        """AutoSP must preserve spec customizations: a LoRA spec keeps its
+        trainable mask and adapter init through the rewrite."""
+        from deepspeed_tpu.linear.lora import LoRAConfig, lora_causal_lm_spec
+        from deepspeed_tpu.models import transformer as T
+        from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        cfg = T.get_model_config("tiny", num_heads=4, max_seq_len=32,
+                                 dtype="float32")
+        spec = lora_causal_lm_spec(cfg, LoRAConfig(lora_r=2))
+        new_spec, plan = auto_sp(spec)
+        assert plan.enabled
+        assert new_spec.trainable_fn is not None
+        mask = new_spec.trainable_fn()
+        assert mask["lora"]["blocks"]["wq_a"] is True
+        params = new_spec.init_fn(jax.random.PRNGKey(0))
+        assert "lora" in params and "base" in params
+
+    def test_hf_spec_keeps_weights_through_autosp(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        from deepspeed_tpu.models.api import spec_from_hf
+        from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        torch.manual_seed(5)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        spec = spec_from_hf(model, dtype="float32")
+        want = np.asarray(spec.init_fn(jax.random.PRNGKey(0))["tok_emb"])
+        new_spec, plan = auto_sp(spec)
+        assert plan.enabled
+        got = np.asarray(new_spec.init_fn(jax.random.PRNGKey(1))["tok_emb"])
+        np.testing.assert_array_equal(got, want)  # imported, not re-random
+
+    def test_unbuildable_spec_gets_disabled_plan(self):
+        """A custom ModelSpec without builder must not crash — disabled plan,
+        spec returned unchanged (the engine hook runs on any spec)."""
+        from deepspeed_tpu.models.api import ModelSpec
+        from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        from deepspeed_tpu.models import transformer as T
+
+        cfg = T.get_model_config("tiny", num_heads=4)
+        spec = ModelSpec(init_fn=lambda r: {}, loss_fn=lambda p, b: 0.0,
+                         axes_fn=lambda: {}, config=cfg)
+        out, plan = auto_sp(spec)
+        assert out is spec and not plan.enabled
+        assert "builder" in plan.reason
+
+    def test_undetectable_spec_gets_disabled_plan(self):
+        from deepspeed_tpu.models.api import ModelSpec
+        from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        spec = ModelSpec(init_fn=lambda r: {}, loss_fn=lambda p, b: 0.0,
+                         axes_fn=lambda: {})
+        out, plan = auto_sp(spec)
+        assert out is spec and not plan.enabled
+        assert "detection failed" in plan.reason
+
+    def test_seq_indivisible_disables_ulysses(self):
+        from deepspeed_tpu.sequence.auto_sp import SPSiteInfo, plan_sp
+
+        info = SPSiteInfo(num_heads=8, kv_heads=8, head_dim=64, seq_len=4097)
+        plan = plan_sp(info=info, sp_size=2)
+        assert not plan.enabled
+
+    def test_size_without_auto_still_validated(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = {
+            "train_batch_size": 4, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 4, "seq": 2},
+            "sequence_parallel": {"size": 4},  # no auto — still checked
+            "zero_optimization": {"stage": 1},
+        }
+        with pytest.raises(DeepSpeedConfigError, match="does not enable SP"):
+            dst.initialize(model=spec, config=config)

@@ -48,7 +48,8 @@ class InferenceEngine:
     def __init__(self, cfg: Union[str, T.TransformerConfig],
                  params: Optional[PyTree] = None,
                  dtype: Optional[str] = None, seed: int = 0,
-                 max_seq_len: Optional[int] = None, mesh=None, **overrides):
+                 max_seq_len: Optional[int] = None, mesh=None,
+                 quant=None, **overrides):
         if isinstance(cfg, str):
             cfg = T.get_model_config(cfg, **overrides)
         if dtype is not None:
@@ -67,6 +68,27 @@ class InferenceEngine:
             policy = ShardingPolicy(self.mesh, zero_stage=0)
             sh = policy.to_shardings(policy.tp_spec(T.param_logical_axes(cfg)))
             params = jax.tree.map(jax.device_put, params, sh)
+        # weight-only quantization (reference inference/quantization/):
+        # matched matmul weights become packed int4/int8/fp8 leaves; the model
+        # dequantizes per layer inside the scan (transformer._block_forward)
+        self.quant_stats = None
+        if quant is not None:
+            from deepspeed_tpu.inference.quantization import (WeightQuantConfig,
+                                                              quantize_params)
+
+            if isinstance(quant, WeightQuantConfig):
+                qcfg = quant
+            elif (isinstance(quant, dict) and quant and all(
+                    isinstance(v, WeightQuantConfig) for v in quant.values())):
+                qcfg = quant   # per-key configs (reference post_init_quant)
+            elif isinstance(quant, dict):
+                qcfg = WeightQuantConfig.from_ds_config({"quant": quant})
+            else:
+                raise ValueError(
+                    f"quant must be a WeightQuantConfig or a dict like "
+                    f"{{'num_bits': 8}}, got {quant!r}")
+            if qcfg is not None:
+                params, self.quant_stats = quantize_params(params, qcfg)
         self.params = params
         self._compiled: Dict[Any, Any] = {}
 
@@ -191,10 +213,21 @@ def init_inference(model: Any,
 
         model, params = import_hf_model(model, arch=config.pop("arch", None))
     dtype = config.pop("dtype", None)
-    max_seq_len = config.pop("max_out_tokens", None)
+    _msl = config.pop("max_seq_len", None)
+    _mot = config.pop("max_out_tokens", None)   # reference key name
+    max_seq_len = _msl or _mot
     config.pop("replace_with_kernel_inject", None)  # kernels are default here
     config.pop("tensor_parallel", None)             # TP comes from the mesh
+    # weight quantization: reference layout ({"weight_quantization":
+    # {"post_init_quant": {...}}}) or the flat {"quant": {...}} alias
+    quant = config.pop("quant", None)
+    wq = config.pop("weight_quantization", None)
+    if quant is None and wq is not None:
+        from deepspeed_tpu.inference.quantization import WeightQuantConfig
+
+        quant = WeightQuantConfig.from_ds_config(
+            {"weight_quantization": wq})
     engine = InferenceEngine(model, params=params, dtype=dtype,
-                             max_seq_len=max_seq_len, **config)
+                             max_seq_len=max_seq_len, quant=quant, **config)
     log_dist(f"inference engine up: model={getattr(model, 'name', model)}")
     return engine

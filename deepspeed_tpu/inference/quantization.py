@@ -1,0 +1,165 @@
+"""Weight-only inference quantization, wired into the generate path.
+
+Parity: reference ``deepspeed/inference/quantization/`` —
+``_init_group_wise_weight_quantization`` (``quantization.py:20``) applies
+group-wise asymmetric INT4/INT8 to modules matched by the
+``weight_quantization.post_init_quant`` config keys, wrapping Linear/
+Embedding with dequant-on-use layers (``layers.py:49``).
+
+TPU translation: the model is a param tree, so "replace the module" becomes
+"replace the weight leaf with a {"q"/"q4","scale","zero"} subtree"
+(``ops/quantization.py weight_quantize_groupwise``). The zoo dequantizes per
+layer inside the scan body (``models/transformer.py _block_forward``), so at
+most one layer of fp weights is ever materialized — the whole-model HBM
+footprint is the quantized one. An 'fp8' mode stores weights in native
+float8_e4m3fn with columnwise scales (``ops/fp_quantizer.py``), letting the
+MXU consume them directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantization import (is_quantized_weight,
+                                            weight_quantize_groupwise)
+from deepspeed_tpu.utils.logging import log_dist
+
+PyTree = Any
+
+# default leaf-name pattern: matmul weights (attention + FFN + shared experts
+# + router + LM head); norms/biases/embeddings stay fp (the reference's
+# default config keys target Linear modules the same way)
+DEFAULT_KEY_PATTERN = r"^(w[qkvo]|w_(up|down|gate)|sw_(up|down|gate)|gate_w|lm_head)$"
+
+
+@dataclasses.dataclass
+class WeightQuantConfig:
+    """Reference ``quantization/utils.py`` Quantizer config: num_bits 4|8
+    (asymmetric, group-wise) — plus 'fp8' (native float8 storage)."""
+    num_bits: int = 8           # 4 | 8; ignored when fp8=True
+    group_size: int = 64
+    fp8: bool = False
+    key_pattern: str = DEFAULT_KEY_PATTERN
+
+    @classmethod
+    def from_ds_config(cls, config: Dict) -> Optional["WeightQuantConfig"]:
+        """Accepts either the reference layout
+        {"weight_quantization": {"post_init_quant": {key: {"num_bits": N,
+        "group_size": G}}}} or the flat {"quant": {"num_bits": N, ...}}."""
+        if "quant" in config:
+            q = config["quant"] or {}
+            if q.get("enabled", True) is False:
+                return None
+            return cls(num_bits=int(q.get("num_bits", 8)),
+                       group_size=int(q.get("group_size", 64)),
+                       fp8=bool(q.get("fp8", False)),
+                       key_pattern=q.get("key_pattern", DEFAULT_KEY_PATTERN))
+        wq = (config.get("weight_quantization") or {}).get("post_init_quant")
+        if not wq:
+            return None
+        # reference: one sub-config PER module-name key — honored per key:
+        # each entry becomes its own config matching only that key
+        per_key = {
+            k: cls(num_bits=int(v.get("num_bits", 8)),
+                   group_size=int(v.get("group_size", 64)),
+                   fp8=bool(v.get("fp8", False)),
+                   key_pattern=re.escape(k))
+            for k, v in wq.items()
+        }
+        if len({(c.num_bits, c.group_size, c.fp8)
+                for c in per_key.values()}) == 1:
+            # uniform settings: collapse to one config over all keys
+            first = next(iter(per_key.values()))
+            return dataclasses.replace(
+                first, key_pattern="|".join(
+                    f"(?:{c.key_pattern})" for c in per_key.values()))
+        return per_key
+
+
+def _quantize_leaf(name: str, x, cfg: WeightQuantConfig):
+    if cfg.fp8:
+        # per-output-column scaling, generic over stacked leading dims
+        # ([L, in, out] / [L, E, in, out]) — reduce over the in-features axis
+        w = jnp.asarray(x).astype(jnp.float32)
+        fmt_max = 448.0  # float8_e4m3fn max
+        amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+        scale = jnp.where(amax > 0, fmt_max / amax, 1.0)
+        return {"q8f": (w * scale).astype(jnp.float8_e4m3fn),
+                "scale": (1.0 / scale)}
+    return weight_quantize_groupwise(jnp.asarray(x), num_bits=cfg.num_bits,
+                                     group_size=cfg.group_size)
+
+
+def quantize_params(params: PyTree,
+                    cfg: "WeightQuantConfig | Dict[str, WeightQuantConfig]"
+                    ) -> Tuple[PyTree, Dict[str, int]]:
+    """Quantize matching weight leaves; → (new tree, stats).
+
+    ``cfg`` is one config (leaf KEY matched against its ``key_pattern``) or a
+    per-key dict {leaf_key: config} (the reference's per-module sub-configs,
+    honored individually). A leaf must be a floating array whose last dim
+    divides the matched config's group_size. Stats report bytes before/after
+    for the matched set."""
+    if isinstance(cfg, dict):
+        matchers = [(re.compile(c.key_pattern), c) for c in cfg.values()]
+    else:
+        matchers = [(re.compile(cfg.key_pattern), cfg)]
+    stats = {"matched": 0, "bytes_fp": 0, "bytes_q": 0}
+
+    def config_for(name: str) -> Optional[WeightQuantConfig]:
+        for pat, c in matchers:
+            if pat.match(name):
+                return c
+        return None
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        x = node
+        c = config_for(name)
+        if (c is not None and hasattr(x, "dtype")
+                and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                and np.ndim(x) >= 2
+                and (c.fp8 or x.shape[-1] % c.group_size == 0)):
+            q = _quantize_leaf(name, x, c)
+            stats["matched"] += 1
+            stats["bytes_fp"] += int(np.prod(np.shape(x))) * 2  # vs bf16
+            stats["bytes_q"] += sum(
+                int(np.prod(np.shape(v))) * jnp.asarray(v).dtype.itemsize
+                for v in q.values())
+            return q
+        return node
+
+    out = walk(params)
+    if stats["matched"]:
+        ratio = stats["bytes_q"] / max(1, stats["bytes_fp"])
+        modes = {("fp8" if c.fp8 else f"int{c.num_bits}/g{c.group_size}")
+                 for _, c in matchers}
+        log_dist(f"weight quantization [{'+'.join(sorted(modes))}]: "
+                 f"{stats['matched']} tensors, "
+                 f"{stats['bytes_q']/2**20:.1f} MiB "
+                 f"({ratio:.2f}x of 16-bit)")
+    return out, stats
+
+
+def quantized_bytes(params: PyTree) -> int:
+    """Total bytes of a (possibly partially) quantized param tree."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(np.prod(np.shape(leaf))) * jnp.asarray(leaf).dtype.itemsize
+    return total
+
+
+def has_quantized_weights(params: PyTree) -> bool:
+    def walk(node):
+        if is_quantized_weight(node):
+            return True
+        if isinstance(node, dict):
+            return any(walk(v) for v in node.values())
+        return False
+    return walk(params)

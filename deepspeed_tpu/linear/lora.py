@@ -115,7 +115,9 @@ def lora_causal_lm_spec(cfg, lora: Optional[LoRAConfig] = None,
                 "lora": {"blocks": {k: True for k in keys}}}
 
     def _rebuild(attention=None, loss_tiles=0):
-        ov = dict(overrides, loss_tiles=loss_tiles)
+        # keep the stronger loss tiling of (original, requested)
+        orig = overrides.get("loss_tiles", 0)
+        ov = dict(overrides, loss_tiles=max(loss_tiles, orig))
         return lora_causal_lm_spec(cfg, lora=lora, attention=attention,
                                    seed=seed, **ov)
 

@@ -200,9 +200,15 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             activation_constraint=activation_constraint,
             loss_mask=_mask_of(batch), loss_scale=loss_scale)
 
+    user_attention_fn = attention_fn is not None and attention is None
+    orig_loss_tiles = loss_tiles
+
     def _rebuild(attention: Optional[str] = None,
                  loss_tiles: int = 0) -> "ModelSpec":
-        return causal_lm_spec(cfg, attention=attention, loss_tiles=loss_tiles,
+        # keep the stronger loss tiling of (original, requested) — AutoSP
+        # must not untile a loss the user tiled to avoid full logits
+        return causal_lm_spec(cfg, attention=attention,
+                              loss_tiles=max(loss_tiles, orig_loss_tiles),
                               activation_constraint=activation_constraint,
                               pipeline_schedule=pipeline_schedule)
 
@@ -216,7 +222,9 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         seq_len=cfg.max_seq_len,
         config=cfg,
         loss_and_grads_fn=loss_and_grads_fn,
-        builder=_rebuild,
+        # a hand-written attention_fn has semantics a rewrite can't preserve
+        # (sliding window, custom bias...) — no builder, so AutoSP declines
+        builder=None if user_attention_fn else _rebuild,
     )
 
 

@@ -311,6 +311,18 @@ def _norm(x: jax.Array, p: Dict[str, jax.Array], kind: str, eps: float) -> jax.A
     return out.astype(dtype)
 
 
+def _lm_head_of(params: PyTree, cfg: TransformerConfig) -> jax.Array:
+    """LM head matrix [H, V]; dequantizes a weight-only-quantized head."""
+    if cfg.tie_embeddings:
+        return params["tok_emb"].T
+    head = params["lm_head"]
+    if isinstance(head, dict):
+        from deepspeed_tpu.ops.quantization import dequantize_weight
+
+        return dequantize_weight(head, cfg.compute_dtype)
+    return head
+
+
 def _head_rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     """QK-norm (Qwen3): RMSNorm over the head dim of [B,S,N,D] q/k."""
     dtype = x.dtype
@@ -426,9 +438,16 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     Returns (output, moe aux loss — 0.0 for dense blocks).
 
     Sequential (GPT/Llama) or parallel (Falcon/NeoX/Phi: attn and FFN both
-    branch off the residual stream and are summed back)."""
+    branch off the residual stream and are summed back).
+
+    Weight-only-quantized params ({"q","scale","zero"} subtrees —
+    ``ops/quantization.py weight_quantize_groupwise``) dequantize HERE, per
+    layer inside the scan: at most one layer of fp weights is live."""
+    from deepspeed_tpu.ops.quantization import dequant_params
+
     B, S, H = x.shape
     dt = cfg.compute_dtype
+    lp = dequant_params(lp, dt)
 
     def proj(name, inp, shape):
         w = lp[f"w{name}"].astype(dt)
@@ -594,7 +613,7 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         aux_total = jnp.sum(a1) + jnp.sum(a2) + jnp.sum(a3)
 
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    head = _lm_head_of(params, cfg)
     return x, head, aux_total
 
 
@@ -696,7 +715,10 @@ def forward_decode(params: PyTree, tokens: jax.Array,
         return lax.dynamic_update_slice(c, new, (p, 0, 0))
 
     def body(x, scans):
+        from deepspeed_tpu.ops.quantization import dequant_params
+
         lp, kc, vc = scans
+        lp = dequant_params(lp, dt)   # weight-only quant: per-layer dequant
         h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
 
         def proj(name, shape):
@@ -735,7 +757,7 @@ def forward_decode(params: PyTree, tokens: jax.Array,
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
     x = _norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    head = _lm_head_of(params, cfg)
     logits = head_matmul(x, head.astype(x.dtype))
     if cfg.lm_head_bias:
         logits = logits + params["lm_head_b"].astype(jnp.float32)
@@ -787,7 +809,7 @@ def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     if cfg.pos_emb == "rope":
         cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
 
-    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    head = _lm_head_of(params, cfg)
     inputs = {"x": microbatch(x, M), "tokens": microbatch(tokens, M)}
     if loss_mask is not None:
         inputs["loss_mask"] = microbatch(loss_mask, M)

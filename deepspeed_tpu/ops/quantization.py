@@ -198,3 +198,89 @@ def onebit_allreduce(x: jax.Array, error: jax.Array,
                    in_specs=(P(axis_name, None), P(axis_name, None)),
                    out_specs=(P(None), P(axis_name, None)), check_vma=False)
     return fn(x, error)
+
+
+# --------------------------------------------------------------------------- #
+# group-wise weight-only quantization (inference)
+#
+# Parity: reference ``deepspeed/inference/quantization/utils.py`` (Quantizer:
+# asymmetric group-wise INT4/INT8 over a group dim; DeQuantizer) and the
+# post-init module wrappers (``quantization/layers.py``). Here a quantized
+# weight is a {"q","scale","zero"} subtree living where the fp array used to
+# be; the model dequantizes per layer inside the scan body
+# (``dequant_params``), so at most one layer of fp weights is live at a time.
+# --------------------------------------------------------------------------- #
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack uint4 values (0..15) pairwise along the last axis → uint8."""
+    lo = q[..., 0::2]
+    hi = q[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(p: jax.Array) -> jax.Array:
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    return jnp.stack([lo, hi], axis=-1).reshape(*p.shape[:-1], -1)
+
+
+def weight_quantize_groupwise(w, num_bits: int = 8, group_size: int = 64):
+    """Asymmetric group-wise quantization over the LAST axis.
+
+    → {"q"|"q4": uint8 [..., G, gs or gs/2], "scale": f32 [..., G, 1],
+       "zero": f32 [..., G, 1]} — the bit width is encoded in the KEY (a
+    scalar leaf would break lax.scan slicing). Leading dims match w, so a
+    stacked [L, ...] weight stays scannable (scan slices every leaf of the
+    subtree along L together).
+    """
+    if num_bits not in (4, 8):
+        raise ValueError("num_bits must be 4 or 8 (reference utils.py:47)")
+    w = jnp.asarray(w)
+    n = w.shape[-1]
+    if n % group_size:
+        raise ValueError(f"last dim {n} not divisible by group_size {group_size}")
+    g = w.reshape(*w.shape[:-1], n // group_size, group_size).astype(jnp.float32)
+    lo = jnp.min(g, axis=-1, keepdims=True)
+    hi = jnp.max(g, axis=-1, keepdims=True)
+    qmax = (1 << num_bits) - 1
+    scale = jnp.where(hi > lo, (hi - lo) / qmax, 1.0)
+    q = jnp.clip(jnp.round((g - lo) / scale), 0, qmax).astype(jnp.uint8)
+    if num_bits == 4:
+        return {"q4": pack_int4(q), "scale": scale, "zero": lo}
+    return {"q": q, "scale": scale, "zero": lo}
+
+
+def weight_dequantize_groupwise(d, dtype=jnp.bfloat16) -> jax.Array:
+    scale, zero = d["scale"], d["zero"]
+    q = unpack_int4(d["q4"]) if "q4" in d else d["q"]
+    g = q.astype(jnp.float32) * scale + zero
+    return g.reshape(*g.shape[:-2], -1).astype(dtype)
+
+
+def is_quantized_weight(node) -> bool:
+    """{"q"|"q4","scale","zero"} (groupwise int) or {"q8f","scale"}
+    (columnwise native fp8)."""
+    if not isinstance(node, dict):
+        return False
+    if "q8f" in node and "scale" in node:
+        return True
+    return ("q" in node or "q4" in node) and "scale" in node and "zero" in node
+
+
+def dequantize_weight(node, dtype=jnp.bfloat16) -> jax.Array:
+    if "q8f" in node:
+        return (node["q8f"].astype(jnp.float32) * node["scale"]).astype(dtype)
+    return weight_dequantize_groupwise(node, dtype)
+
+
+def dequant_params(tree, dtype=jnp.bfloat16):
+    """Replace quantized-weight subtrees with dequantized arrays; everything
+    else passes through. Called inside the per-layer scan body so only the
+    current layer's weights materialize in fp."""
+    def walk(node):
+        if is_quantized_weight(node):
+            return dequantize_weight(node, dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+    return walk(tree)

@@ -106,7 +106,10 @@ def quantize_params(params: PyTree,
     divides the matched config's group_size. Stats report bytes before/after
     for the matched set."""
     if isinstance(cfg, dict):
-        matchers = [(re.compile(c.key_pattern), c) for c in cfg.values()]
+        # the dict KEY names the leaf; the value's key_pattern is ignored so
+        # hand-built {"w_up": cfg4} dicts scope exactly as written
+        matchers = [(re.compile(re.escape(k) + r"$"), c)
+                    for k, c in cfg.items()]
     else:
         matchers = [(re.compile(cfg.key_pattern), cfg)]
     stats = {"matched": 0, "bytes_fp": 0, "bytes_q": 0}

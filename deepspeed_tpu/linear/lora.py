@@ -124,4 +124,7 @@ def lora_causal_lm_spec(cfg, lora: Optional[LoRAConfig] = None,
     return dataclasses.replace(
         base_spec, init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn,
         axes_fn=axes_fn, trainable_fn=trainable_fn,
-        name=f"{base_spec.name}-lora{lora.lora_r}", builder=_rebuild)
+        name=f"{base_spec.name}-lora{lora.lora_r}",
+        # a custom attention_fn (base builder None) can't be rewritten — keep
+        # declining AutoSP rather than crash in the rebuild
+        builder=None if base_spec.builder is None else _rebuild)

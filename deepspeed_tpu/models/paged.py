@@ -97,7 +97,10 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
     lengths = positions + 1
 
     def body(x, scans):
+        from deepspeed_tpu.ops.quantization import dequant_params
+
         lp, kl, vl = scans                                # kl/vl [NB, bs, K, D]
+        lp = dequant_params(lp, dt)   # weight-only quant: per-layer dequant
         h = T._norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
 
         def proj(name, shape):
@@ -111,6 +114,9 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
         q = proj("q", (Tn, cfg.num_heads, cfg.head_dim))
         k = proj("k", (Tn, cfg.kv_heads, cfg.head_dim))
         v = proj("v", (Tn, cfg.kv_heads, cfg.head_dim))
+        if cfg.qk_norm:
+            q = T._head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+            k = T._head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
         if cfg.pos_emb == "rope":
             q = T.apply_rope_at(q[None], cos_t, sin_t, positions[None])[0]
             k = T.apply_rope_at(k[None], cos_t, sin_t, positions[None])[0]
@@ -137,7 +143,7 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
     x, (new_k, new_v) = lax.scan(body, x,
                                  (params["blocks"], pool["k"], pool["v"]))
     x = T._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    head = params["lm_head"] if not cfg.tie_embeddings else params["tok_emb"].T
+    head = T._lm_head_of(params, cfg)
     logits = T.head_matmul(x, head.astype(x.dtype))
     if cfg.lm_head_bias:
         logits = logits + params["lm_head_b"].astype(jnp.float32)

@@ -72,18 +72,27 @@ def pad_to_block(x: jax.Array, block: int = DEFAULT_BLOCK) -> Tuple[jax.Array, i
 def quantized_reduce_scatter(x: jax.Array, mesh: Optional[Mesh] = None,
                              axis_name: str = DATA_AXIS,
                              block: int = DEFAULT_BLOCK,
-                             mean: bool = True) -> jax.Array:
+                             mean: bool = True,
+                             use_pallas: Optional[bool] = None) -> jax.Array:
     """Reduce-scatter per-rank contributions with int8 transport.
 
     Input: [world, N] sharded over ``axis_name`` on dim 0 — row r is rank r's
     contribution (e.g. its local grads). Output: [world, N/world] with row r =
     the r-th reduced shard (fp32 accumulation). ICI bytes: N int8 + N/block
     fp32 scales, vs N fp32 for the plain path.
+
+    ``use_pallas`` (default: on TPU) runs the quantize and the post-
+    all-to-all dequant+sum as Pallas kernels (``ops/pallas/quantization.py``
+    — the reference's ``swizzled_quantize.cu`` / ``quant_reduce.cu``):
+    single-pass VMEM quantization and a fused dequant-reduce that never
+    materializes the [world, chunk] fp32 intermediate.
     """
     m = mesh or get_mesh_manager().mesh
     world = m.shape.get(axis_name, 1)
     if world <= 1:
         return x
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     N = x.shape[1]
     if N % (world * block):
         raise ValueError(f"size {N} must divide world*block={world * block}")
@@ -92,14 +101,28 @@ def quantized_reduce_scatter(x: jax.Array, mesh: Optional[Mesh] = None,
     def local(xl):
         # xl: [1, N] local contribution → world chunks, quantize each,
         # all_to_all so rank r gathers everyone's chunk r, dequant + sum.
+        # The [world, chunk] reshape IS the comm-layout "swizzle".
         xc = xl[0].reshape(world, chunk)
-        q, s = jax.vmap(lambda c: quantize_int8(c, block))(xc)
+        if use_pallas:
+            from deepspeed_tpu.ops.pallas.quantization import \
+                quantize_int8_blocks
+
+            qf, sf = quantize_int8_blocks(xc.reshape(-1), block)
+            q = qf.reshape(world, chunk)
+            s = sf.reshape(world, chunk // block)
+        else:
+            q, s = jax.vmap(lambda c: quantize_int8(c, block))(xc)
         q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
         s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
-        deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
-        out = jnp.sum(deq, axis=0)
-        if mean:
-            out = out / world
+        if use_pallas:
+            from deepspeed_tpu.ops.pallas.quantization import dequant_reduce
+
+            out = dequant_reduce(q, s, block, mean=mean)
+        else:
+            deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
+            out = jnp.sum(deq, axis=0)
+            if mean:
+                out = out / world
         return out[None]
 
     spec = P(axis_name, None)

@@ -201,3 +201,49 @@ class TestReviewRegressions:
         batch = {"tokens": np.zeros((2, 64), np.int32)}
         p = rebuilt.init_fn(jax.random.PRNGKey(0))
         assert np.isfinite(float(rebuilt.loss_fn(p, batch)))
+
+    def test_handbuilt_per_key_dict_scopes_by_key(self):
+        """{'w_up': cfg4} must quantize ONLY w_up — the dict key scopes,
+        not the value's default key_pattern."""
+        params = T.init_params(_cfg(), jax.random.PRNGKey(0))
+        q, stats = quantize_params(
+            params, {"w_up": WeightQuantConfig(num_bits=4, group_size=32)})
+        assert isinstance(q["blocks"]["w_up"], dict)
+        assert not isinstance(q["blocks"]["wq"], dict)
+        assert stats["matched"] == 1
+
+    def test_lora_custom_attention_declines_autosp_too(self):
+        from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, \
+            reset_mesh
+        from deepspeed_tpu.linear.lora import LoRAConfig, lora_causal_lm_spec
+        from deepspeed_tpu.sequence.auto_sp import auto_sp
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, seq=2))
+        spec = lora_causal_lm_spec(
+            _cfg(), LoRAConfig(lora_r=2),
+            attention_fn=lambda q, k, v, **kw: v)
+        assert spec.builder is None
+        out, plan = auto_sp(spec)
+        assert out is spec and not plan.enabled
+
+    def test_paged_path_handles_quant_and_qknorm(self):
+        """FastGen paged forward: quantized weights dequant per layer and
+        QK-norm applies (prefill logits match the dense forward)."""
+        import dataclasses as dc
+
+        from deepspeed_tpu.inference.fastgen import FastGenEngine
+
+        cfg = dc.replace(_cfg(), qk_norm=True, num_kv_heads=2)
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        from deepspeed_tpu.inference.quantization import quantize_params as qp
+
+        qparams, _ = qp(params, WeightQuantConfig(num_bits=8, group_size=32))
+        kw = dict(n_blocks=32, block_size=16, max_blocks_per_seq=8,
+                  token_budget=32, temperature=0.0, seed=0)
+        eng_fp = FastGenEngine(cfg, params=params, **kw)
+        eng_q = FastGenEngine(cfg, params=qparams, **kw)
+        prompts = [[3, 1, 4, 1, 5], [2, 7, 9]]
+        out_fp = eng_fp.generate_all([1, 2], prompts, max_new_tokens=6)
+        out_q = eng_q.generate_all([1, 2], prompts, max_new_tokens=6)
+        assert out_q == out_fp

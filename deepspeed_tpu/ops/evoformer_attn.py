@@ -21,9 +21,52 @@ import jax
 import jax.numpy as jnp
 
 
+def _flash_dispatch(q, k, v, biases):
+    """Try the Pallas flash path (``ops/pallas/evoformer.py``): flatten
+    leading dims to one G axis and combine the biases into a single
+    [1 or G, N, S, S] array. Returns None when the shapes don't reduce to
+    the kernel's contract (caller falls back to the XLA path)."""
+    try:
+        if q.shape != k.shape or k.shape != v.shape:
+            return None    # rectangular attention → XLA path
+        *lead, S, N, D = q.shape
+        G = 1
+        for d in lead:
+            G *= d
+        combined = None
+        for b in biases:
+            if b is None:
+                continue
+            combined = b if combined is None else combined + b
+        if combined is None:
+            combined = jnp.zeros((1, N, S, S), jnp.float32)
+        # normalize to exactly [*, N, S, S] (right-aligned broadcast)
+        combined = jnp.broadcast_to(
+            combined, jnp.broadcast_shapes(combined.shape, (1, N, S, S)))
+        blead = combined.shape[:-3]
+        if all(d == 1 for d in blead):
+            # row-shared bias: keep Gb=1 — the kernel reads it tile-wise,
+            # never expand it G-fold in HBM
+            bias4 = combined.reshape(1, N, S, S)
+        else:
+            full = jnp.broadcast_to(combined, (*lead, N, S, S))
+            if full.shape[:-3] != tuple(lead):
+                return None
+            bias4 = full.reshape(G, N, S, S)
+    except (ValueError, TypeError):
+        return None
+
+    from deepspeed_tpu.ops.pallas.evoformer import evoformer_flash
+
+    out = evoformer_flash(q.reshape(G, S, N, D), k.reshape(G, S, N, D),
+                          v.reshape(G, S, N, D), bias4)
+    return out.reshape(*lead, S, N, D)
+
+
 def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         biases: Sequence[Optional[jax.Array]] = (),
-                        gate: Optional[jax.Array] = None) -> jax.Array:
+                        gate: Optional[jax.Array] = None,
+                        use_flash: Optional[bool] = None) -> jax.Array:
     """DS4Sci_EvoformerAttention analog.
 
     q/k/v: [..., S, N, D] (arbitrary leading batch dims — MSA rows/cols);
@@ -31,7 +74,26 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     [..., 1, 1, S_k] and pair bias [..., N, S_q, S_k]); gate: optional
     [..., S, N, D] sigmoid gate (the reference fuses it into the epilogue).
     fp32 softmax; output in q's dtype.
+
+    ``use_flash`` (default: auto — TPU backend only): route through the
+    Pallas flash kernel (``ops/pallas/evoformer.py`` — the CUTLASS-kernel
+    analog, [S,S] scores never hit HBM) when the bias shapes fit its
+    contract; the XLA path covers everything else. Off-TPU the kernel would
+    run in interpret mode, so auto keeps the fused XLA einsum; pass
+    ``use_flash=True`` to force it (tests).
     """
+    forced = use_flash is True
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash and q.ndim >= 3:
+        out = _flash_dispatch(q, k, v, biases)
+        if out is not None:
+            if gate is not None:
+                out = out * jax.nn.sigmoid(gate.astype(out.dtype))
+            return out
+        if forced:
+            raise ValueError("shapes do not fit the flash evoformer "
+                             "kernel; pass use_flash=False")
     D = q.shape[-1]
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum("...qnd,...knd->...nqk", q, k).astype(jnp.float32)

@@ -49,12 +49,12 @@ def quantize_int8_blocks(x: jax.Array, block: int = 2048
                          ) -> Tuple[jax.Array, jax.Array]:
     """Symmetric per-block int8 quantization of a flat array.
 
-    → (q int8 [N], scale fp32 [N/block]); N must divide ``block``.
+    → (q int8 [N], scale fp32 [N/block]); ``block`` must divide N.
     Same contract as the jnp ``ops.quantization.quantize_int8``.
     """
     N = x.shape[0]
     if N % block:
-        raise ValueError(f"size {N} must divide block={block}")
+        raise ValueError(f"size {N} must be a multiple of block={block}")
     rows = N // block
     tile = min(_ROW_TILE, rows)
     if rows % tile:
@@ -95,7 +95,7 @@ def dequant_reduce(q: jax.Array, scales: jax.Array, block: int = 2048,
     """
     W, C = q.shape
     if C % block:
-        raise ValueError(f"chunk {C} must divide block={block}")
+        raise ValueError(f"chunk {C} must be a multiple of block={block}")
     rows = C // block
     tile = min(_ROW_TILE, rows)
     if rows % tile:

@@ -67,6 +67,15 @@ class OffloadConfig:
     buffer_count: int = 5
     buffer_size: int = 100_000_000
     ratio: float = 1.0
+    # SuperOffload-class host execution (reference superoffload_stage3.py):
+    # run the optimizer update ON the host CPU backend with fp32 master +
+    # moments resident in host RAM; device keeps 16-bit params only.
+    host_step: bool = False
+    # ZenFlow overlap semantics for host_step: defer applying the host
+    # update by one step so it fully overlaps device compute. None = unset:
+    # zenflow.overlap_step decides when zenflow is enabled, else off. An
+    # explicit False always wins (no silent staleness).
+    overlap_step: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -105,6 +114,10 @@ class ZeroConfig:
     # ZenFlow importance-split updates (reference runtime/zenflow/)
     zenflow: "ZenFlowSectionConfig" = dataclasses.field(
         default_factory=lambda: ZenFlowSectionConfig())
+    # SuperOffload alias (reference superoffload/superoffload_stage3.py):
+    # equivalent to offload_optimizer={"device": "cpu", "host_step": true,
+    # "overlap_step": true}
+    super_offload: bool = False
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_quantized_nontrainable_weights: bool = False

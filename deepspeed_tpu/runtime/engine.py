@@ -176,8 +176,19 @@ class DeepSpeedTPUEngine:
         # (reference runtime/zero/offload_config.py + swap_tensor swappers;
         # the device↔host moves bracket the jitted step like the reference's
         # swap-in/step/swap-out flow, stage_1_and_2.py initialize/step)
+        if self.config.zero_optimization.super_offload:
+            # SuperOffload alias → host-executed optimizer with overlap
+            off = self.config.zero_optimization.offload_optimizer
+            off.device, off.host_step, off.overlap_step = "cpu", True, True
         offload_dev = self.config.zero_optimization.offload_optimizer.device
-        self._offload_opt = offload_dev == "cpu"
+        if (self.config.zero_optimization.offload_optimizer.host_step
+                and offload_dev != "cpu"):
+            raise DeepSpeedConfigError(
+                "offload_optimizer.host_step requires device='cpu' (got "
+                f"{offload_dev!r}) — the host CPU backend runs the update")
+        self._host_step = (offload_dev == "cpu" and
+                           self.config.zero_optimization.offload_optimizer.host_step)
+        self._offload_opt = offload_dev == "cpu" and not self._host_step
         # NVMe tier: optimizer state swapped to local disk around the step
         # (reference swap_tensor/partitioned_optimizer_swapper.py:27)
         self._offload_nvme = offload_dev == "nvme"
@@ -194,6 +205,15 @@ class DeepSpeedTPUEngine:
         self._compiled: Dict[Any, Any] = {}
         if self._offload_opt:
             self._opt_swap("out")
+        self._host_runner = None
+        if self._host_step:
+            from deepspeed_tpu.runtime.host_step import HostStepRunner
+
+            if self._compressed or self._onebit_wire:
+                raise DeepSpeedConfigError(
+                    "host_step cannot combine with compressed collectives")
+            self._host_runner = HostStepRunner(self)
+            self._host_runner.adopt_state()
 
         # eager-API accumulation
         self._grad_buffer: Optional[PyTree] = None
@@ -908,30 +928,35 @@ class DeepSpeedTPUEngine:
         stacked = jax.tree.map(stack, *micros)
         stacked = self._inject_data_efficiency(stacked, gas)
 
-        key = ("train_step", gas)
-        if key not in self._compiled:
-            if self._onebit_wire:
-                self._compiled[key] = self._build_train_step_onebit(gas)
-            elif self._compressed:
-                self._compiled[key] = self._build_train_step_qz(gas)
-            else:
-                self._compiled[key] = self._build_train_step(gas)
-        step_fn = self._compiled[key]
+        if self._host_runner is None:
+            key = ("train_step", gas)
+            if key not in self._compiled:
+                if self._onebit_wire:
+                    self._compiled[key] = self._build_train_step_onebit(gas)
+                elif self._compressed:
+                    self._compiled[key] = self._build_train_step_qz(gas)
+                else:
+                    self._compiled[key] = self._build_train_step(gas)
+            step_fn = self._compiled[key]
 
         batch = self._shard_batch(stacked, leading=True)
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        if self._offload_opt:
-            self._opt_swap("in")
-        if self._offload_nvme:
-            self._nvme_swapper().swap_in_optimizer()
-        with self.mesh:
-            self.state, metrics = step_fn(self.state, batch)
-        if self._offload_opt:
-            self._opt_swap("out")
-        if self._offload_nvme:
-            self._nvme_swapper().swap_out_optimizer()
+        if self._host_runner is not None:
+            # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
+            _, metrics = self._host_runner.train_batch(batch, gas)
+        else:
+            if self._offload_opt:
+                self._opt_swap("in")
+            if self._offload_nvme:
+                self._nvme_swapper().swap_in_optimizer()
+            with self.mesh:
+                self.state, metrics = step_fn(self.state, batch)
+            if self._offload_opt:
+                self._opt_swap("out")
+            if self._offload_nvme:
+                self._nvme_swapper().swap_out_optimizer()
         self.global_steps += 1
         self.micro_steps += gas
         self._after_step(metrics)
@@ -970,6 +995,10 @@ class DeepSpeedTPUEngine:
                 "the eager forward()/backward()/step() path is unavailable "
                 "with offload_optimizer.device='nvme' (moments are swapped "
                 "around the fused step) — use train_batch()")
+        if self._host_runner is not None:
+            raise NotImplementedError(
+                "the eager forward()/backward()/step() path is unavailable "
+                "with offload_optimizer.host_step — use train_batch()")
         if "fwd_bwd" not in self._compiled:
             def fwd_bwd(state, b):
                 scale = state["scaler"].scale if self.fp16_enabled else None
@@ -1041,6 +1070,15 @@ class DeepSpeedTPUEngine:
                              STEP_GLOBAL_TIMER])
 
     def eval_batch(self, batch: PyTree) -> jax.Array:
+        if self._host_runner is not None:
+            # host-step mode: evaluate on the device 16-bit params
+            self._host_runner._apply_pending()
+            if "eval" not in self._compiled:
+                self._compiled["eval"] = jax.jit(self.model_spec.loss_fn)
+            batch = self._shard_batch(batch)
+            with self.mesh:
+                return self._compiled["eval"](
+                    self._host_runner.device_params, batch)
         if "eval" not in self._compiled:
             def ev(state, b):
                 params = self._compute_params(state["master"])
@@ -1055,6 +1093,14 @@ class DeepSpeedTPUEngine:
         """Model outputs (logits) — the reference's module __call__ analog."""
         if self.model_spec.apply_fn is None:
             raise ValueError("model spec has no apply_fn")
+        if self._host_runner is not None:
+            self._host_runner._apply_pending()
+            if "predict" not in self._compiled:
+                self._compiled["predict"] = jax.jit(self.model_spec.apply_fn)
+            batch = self._shard_batch(batch)
+            with self.mesh:
+                return self._compiled["predict"](
+                    self._host_runner.device_params, batch)
         if "predict" not in self._compiled:
             def pr(state, b):
                 params = self._compute_params(state["master"])
@@ -1207,6 +1253,8 @@ class DeepSpeedTPUEngine:
             # restored moments. Re-swap-out: fresh files, consistent state,
             # HBM freed again.
             self._opt_swapper.swap_out_optimizer()
+        if self._host_runner is not None:
+            self._host_runner.adopt_state()   # re-home master/opt + params
         self.global_steps = int(client_state.get("global_steps", 0))
         self.micro_steps = int(client_state.get("micro_steps", 0))
         if load_lr_scheduler_states and self.lr_scheduler is not None and \

@@ -157,3 +157,109 @@ class TestNvmeSwap:
         want = np.asarray(jax.device_get(
             ref.state["opt"]["exp_avg"]["blocks"]["wq"]))
         np.testing.assert_array_equal(got, want)
+
+
+class TestHostStep:
+    """SuperOffload/ZenFlow host-executed optimizer (runtime/host_step.py)."""
+
+    def _config(self, offload, gas=1):
+        return {
+            "train_batch_size": 16 * gas, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0, "offload_optimizer": offload},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9,
+        }
+
+    @staticmethod
+    def _fixed_batch():
+        toks = np.random.default_rng(7).integers(
+            0, 512, (16, 32)).astype(np.int32)
+        return iter(lambda: {"tokens": toks}, None)
+
+    def _losses(self, config, steps=6):
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        engine, *_ = dst.initialize(model=spec, config=config)
+        data = self._fixed_batch()
+        return engine, [float(engine.train_batch(data)) for _ in range(steps)]
+
+    def test_sync_host_step_matches_device_path(self):
+        """host_step without overlap runs the same optimizer math — loss
+        trajectory matches the fused device step to fp32 tolerance."""
+        _, base = self._losses(self._config({"device": "none"}))
+        _, host = self._losses(self._config(
+            {"device": "cpu", "host_step": True}))
+        np.testing.assert_allclose(host, base, rtol=2e-4, atol=2e-4)
+
+    def test_overlap_one_step_staleness_converges(self):
+        eng, losses = self._losses(self._config(
+            {"device": "cpu", "host_step": True, "overlap_step": True}),
+            steps=10)
+        assert eng._host_runner.overlap
+        assert losses[-1] < losses[0] - 0.3  # stale updates still learn
+
+    def test_super_offload_alias(self):
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "none"})
+        config["zero_optimization"] = {"stage": 0, "super_offload": True}
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine._host_runner is not None and engine._host_runner.overlap
+        data = self._fixed_batch()
+        l0 = float(engine.train_batch(data))
+        for _ in range(5):
+            loss = engine.train_batch(data)
+        assert float(loss) < l0
+
+    def test_gas_and_eval_and_checkpoint(self, tmp_path):
+        engine, losses = self._losses(self._config(
+            {"device": "cpu", "host_step": True}, gas=2), steps=3)
+        ev = float(engine.eval_batch({"tokens": np.random.default_rng(9)
+                                      .integers(0, 512, (16, 32))
+                                      .astype(np.int32)}))
+        assert np.isfinite(ev)
+        engine.save_checkpoint(str(tmp_path))
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        eng2, *_ = dst.initialize(model=spec, config=self._config(
+            {"device": "cpu", "host_step": True}, gas=2))
+        eng2.load_checkpoint(str(tmp_path))
+        assert eng2.global_steps == 3
+        assert np.isfinite(float(eng2.train_batch(self._fixed_batch())))
+
+    def test_fp16_rejected(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "cpu", "host_step": True})
+        config["fp16"] = {"enabled": True}
+        with pytest.raises(DeepSpeedConfigError, match="host_step"):
+            dst.initialize(model=spec, config=config)
+
+    def test_zenflow_host_step_trains(self):
+        """ZenFlow importance split + host-executed update: the reference's
+        'CPU optimizer overlapped with compute' composition."""
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "cpu", "host_step": True})
+        config["zero_optimization"]["zenflow"] = {
+            "enabled": True, "topk_ratio": 0.05, "update_interval": 2}
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine._host_runner is not None and engine._host_runner.overlap
+        data = self._fixed_batch()
+        l0 = float(engine.train_batch(data))
+        for _ in range(7):
+            loss = engine.train_batch(data)
+        assert float(loss) < l0
+
+    def test_host_step_without_cpu_device_rejected(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "nvme", "host_step": True})
+        with pytest.raises(DeepSpeedConfigError, match="requires device"):
+            dst.initialize(model=spec, config=config)

@@ -243,11 +243,16 @@ def main():
                                "flash" if model != "tiny" else "xla")
     remat = os.environ.get("BENCH_REMAT", "full")
     loss_tiles = int(os.environ.get("BENCH_LOSS_TILES", 0))
+    # measured SLOWER on v5e at 125M (the per-layer concat inside the scan
+    # re-materializes 2304x768 bf16 per layer per step — bandwidth beats the
+    # one-matmul win); keep opt-in for big-hidden models where the ratio flips
+    fuse_qkv = os.environ.get("BENCH_FUSE_QKV", "0") != "0"
 
     headline = train_bench(
         model, zero_stage=1, precision="bf16", batch=batch_per_chip,
         seq_len=seq_len, gas=gas, steps=steps, attention=attention,
-        remat=remat, spec_kwargs={"loss_tiles": loss_tiles})
+        remat=remat, spec_kwargs={"loss_tiles": loss_tiles,
+                                  "fuse_qkv": fuse_qkv})
 
     baseline = 167_000.0  # est. A100 DeepSpeed tokens/s/GPU for 125M @ 40% MFU
     result = {

@@ -114,11 +114,17 @@ def lora_causal_lm_spec(cfg, lora: Optional[LoRAConfig] = None,
         return {"base": mask_like(base_spec.axes_fn(), False),
                 "lora": {"blocks": {k: True for k in keys}}}
 
-    def _rebuild(attention=None, loss_tiles=0):
-        # keep the stronger loss tiling of (original, requested)
+    _orig_attention = attention
+
+    def _rebuild(attention=None, loss_tiles=0, remat=None):
+        # keep the stronger loss tiling of (original, requested); an
+        # unspecified attention keeps the original named mechanism
         orig = overrides.get("loss_tiles", 0)
         ov = dict(overrides, loss_tiles=max(loss_tiles, orig))
-        return lora_causal_lm_spec(cfg, lora=lora, attention=attention,
+        if remat:
+            ov["remat"] = remat
+        return lora_causal_lm_spec(cfg, lora=lora,
+                                   attention=attention or _orig_attention,
                                    seed=seed, **ov)
 
     return dataclasses.replace(

@@ -202,12 +202,17 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
 
     user_attention_fn = attention_fn is not None and attention is None
     orig_loss_tiles = loss_tiles
+    orig_attention = attention
 
     def _rebuild(attention: Optional[str] = None,
-                 loss_tiles: int = 0) -> "ModelSpec":
+                 loss_tiles: int = 0,
+                 remat: Optional[str] = None) -> "ModelSpec":
         # keep the stronger loss tiling of (original, requested) — AutoSP
-        # must not untile a loss the user tiled to avoid full logits
-        return causal_lm_spec(cfg, attention=attention,
+        # must not untile a loss the user tiled to avoid full logits; an
+        # unspecified attention keeps the original named mechanism
+        cfg2 = dataclasses.replace(cfg, remat=remat) if remat else cfg
+        return causal_lm_spec(cfg2,
+                              attention=attention or orig_attention,
                               loss_tiles=max(loss_tiles, orig_loss_tiles),
                               activation_constraint=activation_constraint,
                               pipeline_schedule=pipeline_schedule)
@@ -251,8 +256,10 @@ def spec_from_hf(model, arch: Optional[str] = None, attention: Optional[str] = N
         or (arch or "hf_model")
 
     def _rebuild(attention: Optional[str] = None,
-                 loss_tiles: int = 0) -> ModelSpec:
-        nb = base.builder(attention=attention, loss_tiles=loss_tiles)
+                 loss_tiles: int = 0,
+                 remat: Optional[str] = None) -> ModelSpec:
+        nb = base.builder(attention=attention, loss_tiles=loss_tiles,
+                          remat=remat)
         return _dc.replace(nb, init_fn=lambda rng: init_params,
                            name=str(name))
 

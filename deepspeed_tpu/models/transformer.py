@@ -29,9 +29,42 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name as _ckpt_name
 
 PyTree = Any
 AttentionFn = Callable[..., jax.Array]
+
+# remat="selective": save ONLY the named expensive-to-recompute intermediates
+# (attention output, FFN activation) — residual stream + elementwise recompute
+# for free, the attention kernel and the big FFN matmul never re-run in bwd.
+# Storage per token per layer ≈ (heads·D + ffn) · 2 bytes, far below "none";
+# recompute far below "full".
+_SELECTIVE_POLICY = jax.checkpoint_policies.save_only_these_names(
+    "attn_out", "ffn_act")
+
+
+def _remat_wrap(body, remat: str):
+    """Apply the layer-scan remat policy; unknown names raise (a typo must
+    not silently disable remat)."""
+    if remat in ("none", None):
+        return body
+    if remat in ("full", "save_nothing"):
+        return jax.checkpoint(body)
+    if remat == "dots_saveable":
+        return jax.checkpoint(body, policy=jax.checkpoint_policies.dots_saveable)
+    if remat == "selective":
+        return jax.checkpoint(body, policy=_SELECTIVE_POLICY)
+    if remat == "offload_dots":
+        # ActivationCheckpointingConfig.policy="offload_dots": the selective
+        # saves live in pinned host memory instead of HBM
+        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_out", "ffn_act"],
+            offload_src="device", offload_dst="pinned_host")
+        return jax.checkpoint(body, policy=policy)
+    raise ValueError(
+        f"unknown remat policy {remat!r}; one of none|full|save_nothing|"
+        "dots_saveable|selective|offload_dots")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,7 +93,9 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     init_std: float = 0.02
     dtype: str = "bfloat16"             # compute dtype
-    remat: str = "none"                 # none | full | dots_saveable
+    remat: str = "none"   # none | full (= save_nothing) | dots_saveable |
+    #                         selective (save attn_out+ffn_act) |
+    #                         offload_dots (selective saves live on pinned host)
     causal: bool = True                 # False → bidirectional encoder (BERT)
     # MoE (reference deepspeed/moe/; 0 experts → dense FFN)
     n_experts: int = 0
@@ -77,6 +112,12 @@ class TransformerConfig:
     moe_route_scale: float = 1.0        # routed_scaling_factor (DeepSeek)
     qk_norm: bool = False               # RMSNorm on q/k head dim (Qwen3)
     attn_head_dim: Optional[int] = None  # explicit head dim (Qwen3 ≠ H/N)
+    # compute-time QKV fusion: one [H, q+k+v] matmul instead of three (the
+    # reference's fused-QKV transformer kernels, csrc/transformer
+    # attn_quantizer/transform kernels). Params stay separate (importers,
+    # TP axes unchanged); the concat happens per layer inside the step and
+    # XLA materializes it once per weight version.
+    fuse_qkv: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -457,9 +498,25 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         return out.reshape(shape)
 
     h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
-    q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
-    k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
-    v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
+    if cfg.fuse_qkv:
+        qdim = cfg.num_heads * cfg.head_dim
+        kvdim = cfg.kv_heads * cfg.head_dim
+        wqkv = jnp.concatenate(
+            [lp["wq"].astype(dt), lp["wk"].astype(dt), lp["wv"].astype(dt)],
+            axis=-1)
+        qkv = h @ wqkv
+        if cfg.attn_bias_enabled:
+            qkv = qkv + jnp.concatenate(
+                [lp["bq"], lp["bk"], lp["bv"]], axis=-1).astype(dt)
+        q = qkv[..., :qdim].reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = qkv[..., qdim:qdim + kvdim].reshape(
+            B, S, cfg.kv_heads, cfg.head_dim)
+        v = qkv[..., qdim + kvdim:].reshape(
+            B, S, cfg.kv_heads, cfg.head_dim)
+    else:
+        q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
+        k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
+        v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
     if cfg.qk_norm:
         q = _head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
         k = _head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
@@ -471,6 +528,7 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         attn_kwargs["bias"] = alibi_bias(cfg.num_heads, S) * cfg.alibi_bias_scale
     attn = attention_fn(q, k, v, causal=cfg.causal, **attn_kwargs)
     attn = attn.reshape(B, S, cfg.num_heads * cfg.head_dim)
+    attn = _ckpt_name(attn, "attn_out")
     attn_out = attn @ lp["wo"].astype(dt)
     if cfg.use_bias:
         attn_out = attn_out + lp["bo"].astype(dt)
@@ -514,6 +572,7 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
             act = jax.nn.relu(up)
         else:
             act = jax.nn.gelu(up, approximate=True)
+        act = _ckpt_name(act, "ffn_act")
         down = act @ lp["w_down"].astype(dt)
         if cfg.use_bias:
             down = down + lp["b_down"].astype(dt)
@@ -573,12 +632,7 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                 aux = keep * aux
             return constrain(y), aux
 
-        if cfg.remat == "full":
-            body = jax.checkpoint(body)
-        elif cfg.remat == "dots_saveable":
-            body = jax.checkpoint(
-                body, policy=jax.checkpoint_policies.dots_saveable)
-        return body
+        return _remat_wrap(body, cfg.remat)
 
     with_pld = pld_keep is not None
 
@@ -823,10 +877,7 @@ def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                                     attention_fn)
             return constrain(y), aux
 
-        if cfg.remat == "full":
-            body = jax.checkpoint(body)
-        elif cfg.remat == "dots_saveable":
-            body = jax.checkpoint(body, policy=jax.checkpoint_policies.dots_saveable)
+        body = _remat_wrap(body, cfg.remat)
         y, auxes = lax.scan(body, x_in, blocks_l)
         return y, jnp.sum(auxes)
 

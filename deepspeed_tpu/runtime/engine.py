@@ -129,6 +129,23 @@ class DeepSpeedTPUEngine:
             model, self.sp_plan = auto_sp(model)
             self.model_spec = model
 
+        # activation_checkpointing.policy → the spec's remat policy
+        # (reference runtime/activation_checkpointing config; also what the
+        # autotuner's remat dimension tunes). Applied via the spec's own
+        # builder so customizations survive.
+        ac_policy = self.config.activation_checkpointing.policy
+        if ac_policy and ac_policy != "none":
+            spec_cfg = getattr(model, "config", None)
+            if spec_cfg is not None and getattr(spec_cfg, "remat", None) == ac_policy:
+                pass   # already built with this policy
+            elif getattr(model, "builder", None) is not None:
+                model = model.builder(remat=ac_policy)
+                self.model_spec = model
+            else:
+                logger.warning(
+                    f"activation_checkpointing.policy={ac_policy!r} ignored: "
+                    "the model spec carries no builder to rebuild with")
+
         # precision
         self.precision = self.config.precision_dtype  # float32|float16|bfloat16
         self.fp16_enabled = self.precision == "float16"
@@ -177,9 +194,17 @@ class DeepSpeedTPUEngine:
         # the device↔host moves bracket the jitted step like the reference's
         # swap-in/step/swap-out flow, stage_1_and_2.py initialize/step)
         if self.config.zero_optimization.super_offload:
-            # SuperOffload alias → host-executed optimizer with overlap
+            # SuperOffload alias → host-executed optimizer with overlap.
+            # Explicit user settings win: an explicit overlap_step=False is
+            # honored (no silent staleness) and a conflicting device raises.
             off = self.config.zero_optimization.offload_optimizer
-            off.device, off.host_step, off.overlap_step = "cpu", True, True
+            if off.device not in ("none", "cpu"):
+                raise DeepSpeedConfigError(
+                    f"super_offload conflicts with offload_optimizer.device="
+                    f"{off.device!r}; it implies device='cpu'")
+            off.device, off.host_step = "cpu", True
+            if off.overlap_step is None:
+                off.overlap_step = True
         offload_dev = self.config.zero_optimization.offload_optimizer.device
         if (self.config.zero_optimization.offload_optimizer.host_step
                 and offload_dev != "cpu"):
@@ -587,6 +612,26 @@ class DeepSpeedTPUEngine:
             metrics["loss_scale"] = new_state["scaler"].scale
         return new_state, metrics
 
+    @staticmethod
+    def accumulate_microbatches(micro_fn, zeros, batch, gas,
+                                constrain=lambda x: x):
+        """Shared GAS loop: fp32-accumulate grads from ``micro_fn(mb) ->
+        (loss, grads)`` over the leading micro-batch dim (scan for gas>1).
+        Used by the fused step, the host-step runner, and available to
+        custom step builders — keep ONE copy of these semantics."""
+        def micro(acc, mb):
+            loss, grads = micro_fn(mb)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return constrain(acc), loss
+
+        if gas == 1:
+            squeezed = jax.tree.map(lambda x: x[0], batch)
+            grads_sum, loss = micro(zeros, squeezed)
+            return grads_sum, loss
+        grads_sum, losses = jax.lax.scan(micro, zeros, batch)
+        return grads_sum, jnp.mean(losses)
+
     def _build_train_step(self, gas: int):
         """Fused step: scan grad accumulation over [gas, ...] batch inside jit."""
 
@@ -596,18 +641,9 @@ class DeepSpeedTPUEngine:
                 lambda s: jnp.zeros(s.shape, jnp.float32), self._shapes)
             zeros = self._constrain_grads(zeros)
 
-            def micro(acc, mb):
-                loss, grads = self._loss_and_grads(state["master"], mb, scale)
-                acc = jax.tree.map(jnp.add, acc, grads)
-                return self._constrain_grads(acc), loss
-
-            if gas == 1:
-                squeezed = jax.tree.map(lambda x: x[0], batch)
-                grads_sum, loss = micro(zeros, squeezed)
-                mean_loss = loss
-            else:
-                grads_sum, losses = jax.lax.scan(micro, zeros, batch)
-                mean_loss = jnp.mean(losses)
+            grads_sum, mean_loss = self.accumulate_microbatches(
+                lambda mb: self._loss_and_grads(state["master"], mb, scale),
+                zeros, batch, gas, constrain=self._constrain_grads)
 
             grad_scale = jnp.float32(gas) * (scale if scale is not None else 1.0)
             lr_mult = None

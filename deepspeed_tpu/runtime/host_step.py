@@ -64,6 +64,16 @@ class HostStepRunner:
         if engine.mesh.shape.get("pipe", 1) > 1:
             raise DeepSpeedConfigError(
                 "host_step is not supported with pipeline parallelism")
+        if engine.zero_stage >= 1 or engine.mesh.shape.get("zshard", 1) > 1:
+            raise DeepSpeedConfigError(
+                "host_step keeps the FULL fp32 master + moments in host RAM "
+                "(the reference ZeRO-Offload/SuperOffload memory model) — "
+                "ZeRO sharding of optimizer state does not compose with it; "
+                "use zero_optimization.stage=0")
+        if jax.process_count() > 1:
+            raise DeepSpeedConfigError(
+                "host_step is single-host for now: the update runs on this "
+                "process's CPU backend and cannot address remote shards")
         self.engine = engine
         self.cpu = _cpu_device()
         zcfg = engine.config.zero_optimization
@@ -106,22 +116,10 @@ class HostStepRunner:
         def grad_step(params, batch):
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-            def micro(acc, mb):
-                loss, grads = jax.value_and_grad(eng.model_spec.loss_fn)(
-                    params, mb)
-                acc = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                return acc, loss
-
-            if gas == 1:
-                squeezed = jax.tree.map(lambda x: x[0], batch)
-                grads_sum, loss = micro(zeros, squeezed)
-                mean_loss = loss
-            else:
-                grads_sum, losses = jax.lax.scan(micro, zeros, batch)
-                mean_loss = jnp.mean(losses)
-            return grads_sum, mean_loss
+            return type(eng).accumulate_microbatches(
+                lambda mb: jax.value_and_grad(eng.model_spec.loss_fn)(
+                    params, mb),
+                zeros, batch, gas)
 
         return jax.jit(grad_step)
 

@@ -168,3 +168,45 @@ def test_eval_and_predict():
     assert np.isfinite(float(jax.device_get(loss)))
     logits = engine.predict(batch)
     assert logits.shape[-1] == 512
+
+
+class TestActivationCheckpointingConfig:
+    def test_policy_reaches_the_model(self):
+        """activation_checkpointing.policy rebuilds the spec with that remat
+        policy (previously a silent config no-op; also what the autotuner's
+        remat dimension tunes)."""
+        from deepspeed_tpu.comm import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        assert spec.config.remat == "none"
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}, "mesh": {"data": 8},
+            "activation_checkpointing": {"policy": "full"},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine.model_spec.config.remat == "full"
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(8, 32)).astype(np.int32)}
+        loss = engine.train_batch(iter([batch]))
+        assert np.isfinite(float(loss))
+
+    def test_unknown_policy_raises(self):
+        from deepspeed_tpu.comm import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}, "mesh": {"data": 8},
+            "activation_checkpointing": {"policy": "selectve"},  # typo
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 256, size=(8, 32)).astype(np.int32)}
+        with pytest.raises(ValueError, match="unknown remat"):
+            engine.train_batch(iter([batch]))

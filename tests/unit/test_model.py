@@ -87,3 +87,38 @@ def test_rope_rotation_identity():
     out = T.apply_rope(x, cos, sin)
     # position 0 is unrotated
     np.testing.assert_allclose(np.asarray(out[0, 0]), np.ones((2, 8)), rtol=1e-6)
+
+
+class TestComputeVariants:
+    """fuse_qkv and remat='selective' are numerics-neutral knobs."""
+
+    def test_fuse_qkv_forward_parity(self):
+        import dataclasses
+
+        for kw in (dict(),
+                   dict(num_kv_heads=2, qkv_bias=True, use_bias=False,
+                        norm="rmsnorm", activation="swiglu", pos_emb="rope")):
+            cfg = T.get_model_config("tiny", dtype="float32", max_seq_len=32,
+                                     **kw)
+            p = T.init_params(cfg, jax.random.PRNGKey(0))
+            toks = jnp.asarray(np.random.default_rng(0).integers(
+                0, 256, (2, 16), dtype=np.int32))
+            a = T.forward(p, toks, cfg)
+            b = T.forward(p, toks, dataclasses.replace(cfg, fuse_qkv=True))
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_selective_remat_grad_parity(self):
+        import deepspeed_tpu as dst
+
+        cfg_s = T.get_model_config("tiny", dtype="float32", max_seq_len=32,
+                                   remat="selective")
+        cfg_f = T.get_model_config("tiny", dtype="float32", max_seq_len=32,
+                                   remat="full")
+        p = T.init_params(cfg_s, jax.random.PRNGKey(0))
+        batch = {"tokens": jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, (2, 16), dtype=np.int32))}
+        ls, gs = jax.value_and_grad(dst.causal_lm_spec(cfg_s).loss_fn)(p, batch)
+        lf, gf = jax.value_and_grad(dst.causal_lm_spec(cfg_f).loss_fn)(p, batch)
+        assert float(ls) == float(lf)
+        for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gf)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -263,3 +263,36 @@ class TestHostStep:
         config = self._config({"device": "nvme", "host_step": True})
         with pytest.raises(DeepSpeedConfigError, match="requires device"):
             dst.initialize(model=spec, config=config)
+
+    def test_host_step_with_zero_sharding_rejected(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "cpu", "host_step": True})
+        config["zero_optimization"]["stage"] = 2
+        with pytest.raises(DeepSpeedConfigError, match="stage=0"):
+            dst.initialize(model=spec, config=config)
+
+    def test_super_offload_honors_explicit_no_overlap(self):
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "none"})
+        config["zero_optimization"] = {
+            "stage": 0, "super_offload": True,
+            "offload_optimizer": {"overlap_step": False}}
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine._host_runner is not None
+        assert not engine._host_runner.overlap  # explicit False wins
+
+    def test_super_offload_device_conflict_rejected(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+        config = self._config({"device": "none"})
+        config["zero_optimization"] = {
+            "stage": 0, "super_offload": True,
+            "offload_optimizer": {"device": "nvme"}}
+        with pytest.raises(DeepSpeedConfigError, match="conflicts"):
+            dst.initialize(model=spec, config=config)

@@ -301,6 +301,152 @@ def params_from_qwen3_moe(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 
 
 # --------------------------------------------------------------------------- #
+# DeepSeek V2/V3 (MLA attention + sigmoid/grouped routing + shared experts;
+# AutoEP presets module_inject/auto_ep_presets/deepseek_v{2,3}.py)
+# --------------------------------------------------------------------------- #
+
+def _reject_rope_scaling(hf_config, arch: str) -> None:
+    """Every released DeepSeek checkpoint sets rope_scaling (yarn + mscale),
+    which changes both the rope frequencies and the attention softmax scale —
+    silently ignoring it would produce wrong logits. Raise until yarn lands."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs:
+        raise NotImplementedError(
+            f"{arch}: rope_scaling={rs!r} (yarn/mscale) is not implemented; "
+            "remove rope_scaling from the config for short-context use or "
+            "wait for yarn support")
+
+
+def config_from_deepseek_v3(hf_config) -> TransformerConfig:
+    _reject_rope_scaling(hf_config, "deepseek_v3")
+    first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
+    if first_dense > 0:
+        raise NotImplementedError(
+            f"first_k_dense_replace={first_dense}: heterogeneous dense/MoE "
+            "stacks are not supported by the stacked-layer zoo")
+    shared = int(getattr(hf_config, "n_shared_experts", 0) or 0)
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="rmsnorm", activation="swiglu", use_bias=False,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=hf_config.rms_norm_eps, dtype="float32",
+        mla=True,
+        q_lora_rank=getattr(hf_config, "q_lora_rank", None),
+        kv_lora_rank=hf_config.kv_lora_rank,
+        qk_nope_head_dim=hf_config.qk_nope_head_dim,
+        qk_rope_head_dim=hf_config.qk_rope_head_dim,
+        v_head_dim=hf_config.v_head_dim,
+        rope_interleave=bool(getattr(hf_config, "rope_interleave", True)),
+        n_experts=hf_config.n_routed_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_ffn_size=hf_config.moe_intermediate_size,
+        moe_shared_size=shared * hf_config.moe_intermediate_size,
+        moe_score_func="sigmoid",
+        moe_route_norm=bool(hf_config.norm_topk_prob),
+        moe_route_scale=float(getattr(hf_config, "routed_scaling_factor", 1.0)),
+        moe_gate_bias=True,
+        moe_n_group=int(getattr(hf_config, "n_group", 1) or 1),
+        moe_topk_group=int(getattr(hf_config, "topk_group", 1) or 1),
+        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)))
+
+
+def config_from_deepseek_v2(hf_config) -> TransformerConfig:
+    """DeepSeek-V2/V2-Lite: same MLA; softmax routing, non-interleaved rope.
+    Only topk_method='greedy' (V2-Lite) maps onto the gate — V2-Chat's
+    max-based group_limited_greedy differs from V3's top2-sum grouping."""
+    _reject_rope_scaling(hf_config, "deepseek_v2")
+    method = getattr(hf_config, "topk_method", "greedy")
+    if method != "greedy":
+        raise NotImplementedError(
+            f"deepseek_v2 topk_method={method!r}: only 'greedy' routing is "
+            "supported (the group-limited variant scores groups by max, "
+            "unlike V3's top-2 sum)")
+    first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
+    if first_dense > 0:
+        raise NotImplementedError(
+            f"first_k_dense_replace={first_dense}: heterogeneous dense/MoE "
+            "stacks are not supported by the stacked-layer zoo")
+    shared = int(getattr(hf_config, "n_shared_experts", 0) or 0)
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        pos_emb="rope", norm="rmsnorm", activation="swiglu", use_bias=False,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=hf_config.rms_norm_eps, dtype="float32",
+        mla=True,
+        q_lora_rank=getattr(hf_config, "q_lora_rank", None),
+        kv_lora_rank=hf_config.kv_lora_rank,
+        qk_nope_head_dim=hf_config.qk_nope_head_dim,
+        qk_rope_head_dim=hf_config.qk_rope_head_dim,
+        v_head_dim=hf_config.v_head_dim,
+        rope_interleave=False,
+        n_experts=hf_config.n_routed_experts,
+        moe_top_k=hf_config.num_experts_per_tok,
+        moe_ffn_size=hf_config.moe_intermediate_size,
+        moe_shared_size=shared * hf_config.moe_intermediate_size,
+        moe_score_func="softmax",
+        moe_route_norm=bool(hf_config.norm_topk_prob),
+        moe_route_scale=float(getattr(hf_config, "routed_scaling_factor", 1.0)),
+        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)))
+
+
+def params_from_deepseek(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    """Shared V2/V3 weight mapping (V3 adds gate.e_score_correction_bias)."""
+    L, E = cfg.num_layers, cfg.n_experts
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    attn = lyr + "self_attn."
+    moe = lyr + "mlp."
+    blocks = {
+        "ln1": {"scale": _stack(sd, lyr + "input_layernorm.weight", L)},
+        "ln2": {"scale": _stack(sd, lyr + "post_attention_layernorm.weight", L)},
+        "wkv_a": _stack(sd, attn + "kv_a_proj_with_mqa.weight", L,
+                        transpose=True),
+        "kv_a_norm": _stack(sd, attn + "kv_a_layernorm.weight", L),
+        "wkv_b": _stack(sd, attn + "kv_b_proj.weight", L, transpose=True),
+        "wo": _stack(sd, attn + "o_proj.weight", L, transpose=True),
+        "gate_w": _stack(sd, moe + "gate.weight", L, transpose=True),
+    }
+    if cfg.moe_gate_bias:
+        blocks["gate_bias"] = _stack(
+            sd, moe + "gate.e_score_correction_bias", L)
+    if cfg.moe_shared_size > 0:
+        blocks["sw_gate"] = _stack(
+            sd, moe + "shared_experts.gate_proj.weight", L, transpose=True)
+        blocks["sw_up"] = _stack(
+            sd, moe + "shared_experts.up_proj.weight", L, transpose=True)
+        blocks["sw_down"] = _stack(
+            sd, moe + "shared_experts.down_proj.weight", L, transpose=True)
+    if cfg.q_lora_rank:
+        blocks["wq_a"] = _stack(sd, attn + "q_a_proj.weight", L, transpose=True)
+        blocks["q_a_norm"] = _stack(sd, attn + "q_a_layernorm.weight", L)
+        blocks["wq_b"] = _stack(sd, attn + "q_b_proj.weight", L, transpose=True)
+    else:
+        blocks["wq"] = _stack(sd, attn + "q_proj.weight", L, transpose=True)
+    blocks.update(_qwen_moe_experts(sd, moe, L, E))
+    params = {
+        "tok_emb": _np(sd[pre + "embed_tokens.weight"]),
+        "blocks": blocks,
+        "final_norm": {"scale": _np(sd[pre + "norm.weight"])},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    return params
+
+
+
+# --------------------------------------------------------------------------- #
 # Phi (phi-1/1.5/2: parallel block, shared norm, partial rotary, biased head)
 # --------------------------------------------------------------------------- #
 
@@ -691,6 +837,8 @@ _ARCH_TABLE = {
     "qwen2": (config_from_qwen2, params_from_qwen2),
     "qwen2_moe": (config_from_qwen2_moe, params_from_qwen2_moe),
     "qwen3_moe": (config_from_qwen3_moe, params_from_qwen3_moe),
+    "deepseek_v2": (config_from_deepseek_v2, params_from_deepseek),
+    "deepseek_v3": (config_from_deepseek_v3, params_from_deepseek),
     "phi": (config_from_phi, params_from_phi),
     "phi3": (config_from_phi3, params_from_phi3),
     "falcon": (config_from_falcon, params_from_falcon),

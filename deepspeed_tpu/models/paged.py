@@ -71,11 +71,19 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One SplitFuse tick over a flat token batch.
 
+    MLA (DeepSeek) models are not supported here yet — the paged pool is
+    laid out per (kv_head, head_dim); serve those through the v1
+    InferenceEngine (its latent-cache decode path handles MLA).
+
     tokens [T] int32, positions [T] int32, tables [T, MB] int32 (rows shared
     by tokens of the same sequence). Returns (logits [T, vocab] fp32,
     updated pool). Parity: the reference's model-implementation forward over
     a RaggedBatchWrapper (``inference/v2/model_implementations``).
     """
+    if cfg.mla:
+        raise NotImplementedError(
+            "MLA (DeepSeek) models are not supported by the paged/FastGen "
+            "path yet; use the v1 InferenceEngine (latent-cache decode)")
     attention_fn = attention_fn or paged_attention_reference
     dt = cfg.compute_dtype
     Tn = tokens.shape[0]

@@ -112,6 +112,20 @@ class TransformerConfig:
     moe_route_scale: float = 1.0        # routed_scaling_factor (DeepSeek)
     qk_norm: bool = False               # RMSNorm on q/k head dim (Qwen3)
     attn_head_dim: Optional[int] = None  # explicit head dim (Qwen3 ≠ H/N)
+    # MLA — Multi-head Latent Attention (DeepSeek V2/V3): queries and KV are
+    # projected through low-rank latents; only the latent c_kv (+ the shared
+    # rope key) is cached at decode — the 93%-smaller-KV-cache trick.
+    mla: bool = False
+    q_lora_rank: Optional[int] = None   # None → direct q projection (V2-lite)
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    rope_interleave: bool = True        # DeepSeek stores rope pairs interleaved
+    # DeepSeek-V3 router extras (moe/gating.py)
+    moe_gate_bias: bool = False         # e_score_correction_bias parameter
+    moe_n_group: int = 1                # node-limited routing groups
+    moe_topk_group: int = 1
     # compute-time QKV fusion: one [H, q+k+v] matmul instead of three (the
     # reference's fused-QKV transformer kernels, csrc/transformer
     # attn_quantizer/transform kernels). Params stay separate (importers,
@@ -164,15 +178,30 @@ class TransformerConfig:
 
     def num_params(self) -> int:
         h, f, v, l = self.hidden_size, self.ffn_size, self.vocab_size, self.num_layers
-        kv = self.kv_heads * self.head_dim
-        qdim = self.num_heads * self.head_dim
-        per_layer = h * qdim + 2 * h * kv + qdim * h  # q, k, v, o
+        if self.mla:
+            dn, dr, dv = (self.qk_nope_head_dim, self.qk_rope_head_dim,
+                          self.v_head_dim)
+            kvr, N = self.kv_lora_rank, self.num_heads
+            qout = N * (dn + dr)
+            if self.q_lora_rank:
+                per_layer = (h * self.q_lora_rank + self.q_lora_rank
+                             + self.q_lora_rank * qout)
+            else:
+                per_layer = h * qout
+            per_layer += (h * (kvr + dr) + kvr + kvr * N * (dn + dv)
+                          + N * dv * h)
+        else:
+            kv = self.kv_heads * self.head_dim
+            qdim = self.num_heads * self.head_dim
+            per_layer = h * qdim + 2 * h * kv + qdim * h  # q, k, v, o
         ffn_mats = 3 if self.activation == "swiglu" else 2
         if self.n_experts > 0:
             per_layer += self.n_experts * ffn_mats * h * self.moe_ffn + h * self.n_experts
             per_layer += ffn_mats * h * self.moe_shared_size  # shared expert
             if self.moe_shared_gate:
                 per_layer += h
+            if self.moe_gate_bias:
+                per_layer += self.n_experts
         else:
             per_layer += ffn_mats * h * f
         per_layer += (2 * h if self.has_ln2 else h)  # norms
@@ -210,13 +239,29 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
     def dense(key, shape, s):
         return jax.random.normal(key, shape, jnp.float32) * s
 
-    block = {
-        "ln1": norm_init((L, h)),
-        "wq": dense(keys[0], (L, h, qdim), std),
-        "wk": dense(keys[1], (L, h, kvdim), std),
-        "wv": dense(keys[2], (L, h, kvdim), std),
-        "wo": dense(keys[3], (L, qdim, h), out_std),
-    }
+    block = {"ln1": norm_init((L, h))}
+    if cfg.mla:
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        kvr, N = cfg.kv_lora_rank, cfg.num_heads
+        qout = N * (dn + dr)
+        if cfg.q_lora_rank:
+            block["wq_a"] = dense(keys[0], (L, h, cfg.q_lora_rank), std)
+            block["q_a_norm"] = jnp.ones((L, cfg.q_lora_rank), jnp.float32)
+            block["wq_b"] = dense(keys[15], (L, cfg.q_lora_rank, qout), std)
+        else:
+            block["wq"] = dense(keys[0], (L, h, qout), std)
+        block["wkv_a"] = dense(keys[1], (L, h, kvr + dr), std)
+        block["kv_a_norm"] = jnp.ones((L, kvr), jnp.float32)
+        block["wkv_b"] = dense(keys[2], (L, kvr, N * (dn + dv)), std)
+        block["wo"] = dense(keys[3], (L, N * dv, h), out_std)
+    else:
+        block.update({
+            "wq": dense(keys[0], (L, h, qdim), std),
+            "wk": dense(keys[1], (L, h, kvdim), std),
+            "wv": dense(keys[2], (L, h, kvdim), std),
+            "wo": dense(keys[3], (L, qdim, h), out_std),
+        })
     if cfg.has_ln2:
         block["ln2"] = norm_init((L, h))
     if cfg.qk_norm:
@@ -240,6 +285,8 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> PyTree:
                 block["sw_gate"] = dense(keys[13], (L, h, fs), std)
             if cfg.moe_shared_gate:
                 block["shared_gate_w"] = dense(keys[14], (L, h, 1), std)
+        if cfg.moe_gate_bias:
+            block["gate_bias"] = jnp.zeros((L, E), jnp.float32)
     else:
         block["w_up"] = dense(keys[4], (L, h, f), std)
         block["w_down"] = dense(keys[5], (L, f, h), out_std)
@@ -280,13 +327,27 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
         return p
 
     lyr = ("layers",)
-    block = {
-        "ln1": norm_axes(lyr),
-        "wq": lyr + ("embed", "heads"),
-        "wk": lyr + ("embed", "kv_heads"),
-        "wv": lyr + ("embed", "kv_heads"),
-        "wo": lyr + ("heads", "embed"),
-    }
+    block = {"ln1": norm_axes(lyr)}
+    if cfg.mla:
+        # latent projections: ranks are shared (replicated); the per-head
+        # output dims carry the 'heads' axis for TP
+        if cfg.q_lora_rank:
+            block["wq_a"] = lyr + ("embed", None)
+            block["q_a_norm"] = lyr + (None,)
+            block["wq_b"] = lyr + (None, "heads")
+        else:
+            block["wq"] = lyr + ("embed", "heads")
+        block["wkv_a"] = lyr + ("embed", None)
+        block["kv_a_norm"] = lyr + (None,)
+        block["wkv_b"] = lyr + (None, "heads")
+        block["wo"] = lyr + ("heads", "embed")
+    else:
+        block.update({
+            "wq": lyr + ("embed", "heads"),
+            "wk": lyr + ("embed", "kv_heads"),
+            "wv": lyr + ("embed", "kv_heads"),
+            "wo": lyr + ("heads", "embed"),
+        })
     if cfg.has_ln2:
         block["ln2"] = norm_axes(lyr)
     if cfg.qk_norm:
@@ -305,6 +366,8 @@ def param_logical_axes(cfg: TransformerConfig) -> PyTree:
                 block["sw_gate"] = lyr + ("embed", "mlp")
             if cfg.moe_shared_gate:
                 block["shared_gate_w"] = lyr + ("embed", None)
+        if cfg.moe_gate_bias:
+            block["gate_bias"] = lyr + (None,)
     else:
         block["w_up"] = lyr + ("embed", "mlp")
         block["w_down"] = lyr + ("mlp", "embed")
@@ -472,6 +535,51 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 
+def _rope_deinterleave(x: jax.Array) -> jax.Array:
+    """DeepSeek stores rope dims as interleaved (re,im) pairs; permute to the
+    half-split layout rotate_half rope expects (HF
+    ``apply_rotary_pos_emb_interleave``)."""
+    *lead, d = x.shape
+    return x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
+
+
+def _mla_qkv(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
+             rope_fn) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-head latent attention projections (DeepSeek V2/V3; HF
+    ``DeepseekV3Attention.forward``). h: [B, S, H] (normed). Returns
+    q/k: [B, S, N, dn+dr], v: [B, S, N, dv]. ``rope_fn(x)`` rotates
+    [B, S, *, dr] at the right positions (fwd vs decode)."""
+    B, S, _ = h.shape
+    dt = h.dtype
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    kvr, N = cfg.kv_lora_rank, cfg.num_heads
+
+    if cfg.q_lora_rank:
+        qa = h @ lp["wq_a"].astype(dt)
+        qa = _head_rmsnorm(qa, lp["q_a_norm"], cfg.norm_eps)
+        q = qa @ lp["wq_b"].astype(dt)
+    else:
+        q = h @ lp["wq"].astype(dt)
+    q = q.reshape(B, S, N, dn + dr)
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+
+    kv_a = h @ lp["wkv_a"].astype(dt)                 # [B, S, kvr+dr]
+    c_kv = _head_rmsnorm(kv_a[..., :kvr], lp["kv_a_norm"], cfg.norm_eps)
+    k_pe = kv_a[..., kvr:][:, :, None, :]             # [B, S, 1, dr] shared
+    kv = (c_kv @ lp["wkv_b"].astype(dt)).reshape(B, S, N, dn + dv)
+    k_nope, v = kv[..., :dn], kv[..., dn:]
+
+    if cfg.rope_interleave:
+        q_pe = _rope_deinterleave(q_pe)
+        k_pe = _rope_deinterleave(k_pe)
+    q_pe = rope_fn(q_pe)
+    k_pe = rope_fn(k_pe)
+    q = jnp.concatenate([q_nope, q_pe], axis=-1)
+    k = jnp.concatenate([k_nope, jnp.broadcast_to(
+        k_pe, (B, S, N, dr))], axis=-1)
+    return q, k, v
+
+
 def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
                    cos: Optional[jax.Array], sin: Optional[jax.Array],
                    attention_fn: AttentionFn) -> Tuple[jax.Array, jax.Array]:
@@ -498,6 +606,19 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         return out.reshape(shape)
 
     h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+    if cfg.mla:
+        q, k, v = _mla_qkv(h, lp, cfg,
+                           lambda t: apply_rope(t, cos, sin))
+        # flash kernels assume one head dim; MLA's split qk/v dims run on
+        # the XLA reference attention (scale = 1/sqrt(dn+dr) from q's D)
+        attn = dot_product_attention(q, k, v, causal=cfg.causal)
+        attn = attn.reshape(B, S, cfg.num_heads * cfg.v_head_dim)
+        attn = _ckpt_name(attn, "attn_out")
+        attn_out = attn @ lp["wo"].astype(dt)
+        x = x + attn_out
+        h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        down, aux = _ffn(h2, lp, cfg)
+        return x + down, aux
     if cfg.fuse_qkv:
         qdim = cfg.num_heads * cfg.head_dim
         kvdim = cfg.kv_heads * cfg.head_dim
@@ -560,7 +681,9 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
             k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
             min_capacity=cfg.moe_min_capacity,
             score_func=cfg.moe_score_func, route_norm=cfg.moe_route_norm,
-            route_scale=cfg.moe_route_scale, shared=shared or None)
+            route_scale=cfg.moe_route_scale, shared=shared or None,
+            gate_bias=lp.get("gate_bias"), n_group=cfg.moe_n_group,
+            topk_group=cfg.moe_topk_group)
     else:
         up = h @ lp["w_up"].astype(dt)
         if cfg.use_bias:
@@ -616,7 +739,8 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
 
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
+        rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
+        cos, sin = rope_table(S, rd, cfg.rope_theta)
 
     def make_body(cos_b, sin_b, with_pld: bool):
         def body(carry, xs):
@@ -709,6 +833,13 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     """Layer-stacked KV cache (the blocked-KV analog of the reference's
     ``inference/v2/ragged/kv_cache.py`` — slot-contiguous, length-masked)."""
     dt = dtype or cfg.compute_dtype
+    if cfg.mla:
+        # MLA caches the LATENT: c_kv [kvr] + shared rope key [dr] per token
+        # (the DeepSeek small-cache trick) — stored under the same "k"/"v"
+        # keys (head dim 1) so the decode scan plumbing is unchanged
+        L, B, M = cfg.num_layers, batch_size, max_len
+        return {"k": jnp.zeros((L, B, M, 1, cfg.kv_lora_rank), dt),
+                "v": jnp.zeros((L, B, M, 1, cfg.qk_rope_head_dim), dt)}
     shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -761,7 +892,8 @@ def forward_decode(params: PyTree, tokens: jax.Array,
 
     cos_t = sin_t = None
     if cfg.pos_emb == "rope":
-        cos_t, sin_t = rope_table(M, cfg.rope_dim, cfg.rope_theta)
+        rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
+        cos_t, sin_t = rope_table(M, rd, cfg.rope_theta)
     slopes = (alibi_slopes(cfg.num_heads) * cfg.alibi_bias_scale
               if cfg.pos_emb == "alibi" else None)
 
@@ -774,6 +906,48 @@ def forward_decode(params: PyTree, tokens: jax.Array,
         lp, kc, vc = scans
         lp = dequant_params(lp, dt)   # weight-only quant: per-layer dequant
         h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+
+        if cfg.mla:
+            # kc holds c_kv [B,M,1,kvr]; vc holds the post-rope shared key
+            # [B,M,1,dr]. Per step: write the new latents, re-expand k/v for
+            # the whole window from the latent (naive MLA decode; the
+            # weight-absorbed variant is a further optimization).
+            dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                          cfg.v_head_dim)
+            kvr, N = cfg.kv_lora_rank, cfg.num_heads
+
+            if cfg.q_lora_rank:
+                qa = _head_rmsnorm(h @ lp["wq_a"].astype(dt),
+                                   lp["q_a_norm"], cfg.norm_eps)
+                q = qa @ lp["wq_b"].astype(dt)
+            else:
+                q = h @ lp["wq"].astype(dt)
+            q = q.reshape(B, T, N, dn + dr)
+            q_nope, q_pe = q[..., :dn], q[..., dn:]
+            kv_a = h @ lp["wkv_a"].astype(dt)
+            c_kv = _head_rmsnorm(kv_a[..., :kvr], lp["kv_a_norm"],
+                                 cfg.norm_eps)
+            k_pe = kv_a[..., kvr:][:, :, None, :]
+            if cfg.rope_interleave:
+                q_pe = _rope_deinterleave(q_pe)
+                k_pe = _rope_deinterleave(k_pe)
+            q_pe = apply_rope_at(q_pe, cos_t, sin_t, positions)
+            k_pe = apply_rope_at(k_pe, cos_t, sin_t, positions)
+            kc = jax.vmap(write)(kc, c_kv[:, :, None, :].astype(kc.dtype), pos)
+            vc = jax.vmap(write)(vc, k_pe.astype(vc.dtype), pos)
+            kv = (kc[:, :, 0].astype(dt) @ lp["wkv_b"].astype(dt)
+                  ).reshape(B, M, N, dn + dv)
+            k_full = jnp.concatenate(
+                [kv[..., :dn],
+                 jnp.broadcast_to(vc.astype(dt), (B, M, N, dr))], axis=-1)
+            v_full = kv[..., dn:]
+            qf = jnp.concatenate([q_nope, q_pe], axis=-1)
+            attn = cached_attention(qf, k_full, v_full, positions)
+            attn = attn.reshape(B, T, N * dv)
+            x = x + attn @ lp["wo"].astype(dt)
+            h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+            down, _ = _ffn(h2, lp, cfg)
+            return x + down, (kc, vc)
 
         def proj(name, shape):
             w = lp[f"w{name}"].astype(dt)
@@ -861,7 +1035,8 @@ def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     x = embed(embp, tokens)
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_table(S, cfg.rope_dim, cfg.rope_theta)
+        rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
+        cos, sin = rope_table(S, rd, cfg.rope_theta)
 
     head = _lm_head_of(params, cfg)
     inputs = {"x": microbatch(x, M), "tokens": microbatch(tokens, M)}

@@ -36,18 +36,38 @@ def gate_capacity(num_tokens: int, num_experts: int, k: int,
     return max(min_capacity, cap)
 
 
+def _group_limited_mask(sel: jax.Array, n_group: int, topk_group: int
+                        ) -> jax.Array:
+    """DeepSeek-V3 node-limited routing (HF ``DeepseekV3TopkRouter.
+    get_topk_indices``): score each group by the sum of its top-2 selection
+    scores, keep the best ``topk_group`` groups, zero the rest."""
+    T, E = sel.shape
+    g = sel.reshape(T, n_group, E // n_group)
+    group_scores = jnp.sum(jax.lax.top_k(g, 2)[0], axis=-1)        # [T, G]
+    thresh = jax.lax.top_k(group_scores, topk_group)[0][:, -1:]     # [T, 1]
+    group_mask = (group_scores >= thresh).astype(sel.dtype)         # [T, G]
+    return (g * group_mask[:, :, None]).reshape(T, E)
+
+
 def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
                 min_capacity: int = 4,
                 rng: Optional[jax.Array] = None,
                 noise_std: float = 0.0,
                 normalize: bool = True,
-                score_func: str = "softmax") -> GateOutput:
+                score_func: str = "softmax",
+                select_bias: Optional[jax.Array] = None,
+                n_group: int = 1, topk_group: int = 1) -> GateOutput:
     """Generic top-k gate (k=1 → top1gating, k=2 → top2gating semantics).
 
     ``score_func``: 'softmax' (GShard/Mixtral/Qwen-MoE) or 'sigmoid'
     (DeepSeek-V3-style: per-expert sigmoid affinities; ``normalize``
     renormalizes the selected scores to sum 1). The aux loss always uses a
     distribution over experts (sigmoid scores are sum-normalized for it).
+
+    DeepSeek-V3 extras: ``select_bias`` [E] (e_score_correction_bias —
+    biases expert SELECTION only; combine weights stay the raw scores) and
+    ``n_group``/``topk_group`` node-limited routing (selection restricted to
+    the best groups).
     """
     T, E = logits.shape
     logits = logits.astype(jnp.float32)
@@ -63,10 +83,19 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
         raise ValueError(f"score_func must be softmax|sigmoid, got {score_func!r}")
     C = gate_capacity(T, E, k, capacity_factor, min_capacity)
 
+    # SELECTION scores: gate_source (+ bias) (+ group limitation); combine
+    # weights always come from the unbiased gate_source
     sel_logits = logits
+    if select_bias is not None or n_group > 1:
+        sel = gate_source
+        if select_bias is not None:
+            sel = sel + select_bias.astype(jnp.float32)[None, :]
+        if n_group > 1:
+            sel = _group_limited_mask(sel, n_group, topk_group)
+        sel_logits = sel
     if noise_std > 0.0 and rng is not None:
         # reference top1gating noisy_gate_policy='RSample' analog
-        sel_logits = logits + jax.random.normal(rng, logits.shape) * noise_std
+        sel_logits = sel_logits + jax.random.normal(rng, logits.shape) * noise_std
 
     combine = jnp.zeros((T, E, C), jnp.float32)
     counts_total = jnp.zeros((E,), jnp.int32)

@@ -59,7 +59,9 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],
             rng: Optional[jax.Array] = None, noise_std: float = 0.0,
             score_func: str = "softmax", route_norm: bool = True,
             route_scale: float = 1.0,
-            shared: Optional[Dict[str, jax.Array]] = None
+            shared: Optional[Dict[str, jax.Array]] = None,
+            gate_bias: Optional[jax.Array] = None,
+            n_group: int = 1, topk_group: int = 1
             ) -> Tuple[jax.Array, jax.Array]:
     """Mixture-of-experts FFN.
 
@@ -81,7 +83,8 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],
     gate: GateOutput = topk_gating(
         logits, k=k, capacity_factor=capacity_factor,
         min_capacity=min_capacity, rng=rng, noise_std=noise_std,
-        normalize=route_norm, score_func=score_func)
+        normalize=route_norm, score_func=score_func,
+        select_bias=gate_bias, n_group=n_group, topk_group=topk_group)
 
     # dispatch: [T,E,C] × [T,H] → [E,C,H]; GSPMD turns the resharding of the
     # token dim (data/expert-sharded) onto the expert dim into an all-to-all

@@ -88,22 +88,22 @@ PRESETS: Dict[str, MoEPreset] = {
         score_func="softmax", route_scale_attr="routed_scaling_factor",
         shared_size_attr="n_shared_experts",  # count ×moe_intermediate_size
         first_dense_attr="first_k_dense_replace",
-        importable=False,
+        importable=True,
         unsupported_note=(
-            "DeepSeek-V2 uses MLA (multi-head latent attention), which the "
-            "stacked zoo transformer does not implement; AutoEP detection and "
-            "routing-parity metadata only")),
+            "importable with MLA attention (models/transformer.py _mla_qkv); "
+            "constraints: first_k_dense_replace=0 and topk_method='greedy' "
+            "(the importer raises otherwise)")),
     "deepseek_v3": MoEPreset(
         name="deepseek_v3", hf_model_types=("deepseek_v3",),
         num_experts_attr="n_routed_experts", top_k_attr="num_experts_per_tok",
         score_func="sigmoid", route_scale_attr="routed_scaling_factor",
         shared_size_attr="n_shared_experts",
         first_dense_attr="first_k_dense_replace",
-        importable=False,
+        importable=True,
         unsupported_note=(
-            "DeepSeek-V3 uses MLA + aux-loss-free expert-bias balancing; the "
-            "sigmoid top-k routing IS implemented (moe_score_func='sigmoid') "
-            "but the attention stack is not importable")),
+            "importable with MLA attention + sigmoid grouped routing with "
+            "e_score_correction_bias; constraint: first_k_dense_replace=0 "
+            "(the importer raises otherwise)")),
 }
 
 

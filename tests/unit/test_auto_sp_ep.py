@@ -159,7 +159,7 @@ class TestMoEPresets:
         assert knobs["n_experts"] == 8 and knobs["shared_size"] == 40
         assert not knobs["route_norm"] and knobs["shared_gate"]
 
-    def test_deepseek_detection_and_unsupported_import(self):
+    def test_deepseek_detection_and_knobs(self):
         from deepspeed_tpu.moe.presets import resolve_preset
 
         cfg = _FakeHFConfig(model_type="deepseek_v3", n_routed_experts=64,
@@ -168,11 +168,29 @@ class TestMoEPresets:
         preset, knobs = resolve_preset(cfg)
         assert knobs["score_func"] == "sigmoid"
         assert knobs["route_scale"] == 2.5 and knobs["first_dense"] == 3
-        assert not preset.importable
+        assert preset.importable   # MLA landed; constraints in the note
+        assert "first_k_dense_replace" in preset.unsupported_note
         assert detect_moe(cfg) == (64, 8)
-        # auto_ep on an unimportable family raises the preset's note
-        with pytest.raises(NotImplementedError, match="MLA"):
-            auto_ep((object(), cfg), n_devices=8)
+
+    def test_auto_ep_imports_deepseek_v3(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        reset_mesh()
+        hf_cfg = transformers.DeepseekV3Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=2, n_routed_experts=4, num_experts_per_tok=2,
+            n_shared_experts=1, q_lora_rank=16, kv_lora_rank=8,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            first_k_dense_replace=0, n_group=1, topk_group=1,
+            max_position_embeddings=32, tie_word_embeddings=False)
+        torch.manual_seed(60)
+        model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+        spec, mesh_section, plan = auto_ep(model, n_devices=8, max_ep=4,
+                                           dtype="float32")
+        assert plan.preset == "deepseek_v3" and plan.ep_size == 4
+        assert spec.config.mla and spec.config.moe_score_func == "sigmoid"
 
 
 class TestEPTopology:

@@ -259,3 +259,23 @@ def test_fastgen_throughput_vs_slot_engine():
     # fastgen entry). This warm check is a regression guard only.
     assert t_fg_warm <= t_slot_warm * 3.5, (
         f"FastGen warm {t_fg_warm*1e3:.0f}ms vs slot {t_slot_warm*1e3:.0f}ms")
+
+
+def test_mla_rejected_with_clear_error():
+    """DeepSeek/MLA models must fail fast in the paged path (latent cache
+    layout differs) — serve them through the v1 InferenceEngine instead."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from deepspeed_tpu.models import paged as P
+    from deepspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        mla=True, kv_lora_rank=8, qk_nope_head_dim=8, qk_rope_head_dim=4,
+        v_head_dim=8, pos_emb="rope", norm="rmsnorm", activation="swiglu",
+        use_bias=False, dtype="float32", max_seq_len=32)
+    with _pytest.raises(NotImplementedError, match="MLA"):
+        P.forward_paged(None, None, None, None,
+                        {"k": jnp.zeros((1, 4, 8, 1, 8))}, cfg)

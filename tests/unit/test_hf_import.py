@@ -349,3 +349,150 @@ class TestQwen3MoeImport:
             params, jnp.asarray(tokens), cache, jnp.zeros((2,), jnp.int32), cfg)
         np.testing.assert_allclose(np.asarray(logits), full, rtol=2e-3,
                                    atol=2e-3)
+
+
+class TestDeepseekV3Import:
+    def _model(self, q_lora=16):
+        hf_cfg = transformers.DeepseekV3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=2, num_key_value_heads=2,
+            n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+            q_lora_rank=q_lora, kv_lora_rank=8, qk_nope_head_dim=8,
+            qk_rope_head_dim=4, v_head_dim=8, first_k_dense_replace=0,
+            n_group=2, topk_group=1, norm_topk_prob=True,
+            routed_scaling_factor=2.5, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        torch.manual_seed(40)
+        return transformers.DeepseekV3ForCausalLM(hf_cfg)
+
+    def test_logits_match_generous_capacity(self):
+        """DeepSeek-V3: MLA attention (latent q/kv projections, interleaved
+        rope on the decoupled key) + sigmoid grouped routing with
+        e_score_correction_bias + shared experts + routed scaling."""
+        model = self._model()
+        cfg, params = import_hf_model(model)
+        assert cfg.mla and cfg.kv_lora_rank == 8 and cfg.q_lora_rank == 16
+        assert cfg.moe_score_func == "sigmoid" and cfg.moe_route_scale == 2.5
+        assert cfg.moe_n_group == 2 and cfg.moe_gate_bias
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(40).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+    def test_nonzero_gate_bias_changes_selection_like_hf(self):
+        """e_score_correction_bias must steer SELECTION but not weights —
+        verified against HF with a non-zero bias."""
+        model = self._model()
+        # positive biases: selection stays among truly-kept experts (torch's
+        # tie-breaking among 0.0-masked entries is unspecified and not worth
+        # replicating — it only triggers when biased scores go negative)
+        with torch.no_grad():
+            for layer in model.model.layers:
+                layer.mlp.gate.e_score_correction_bias.add_(
+                    torch.tensor([0.3, 0.05, 0.2, 0.1]))
+        cfg, params = import_hf_model(model)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(41).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+    def test_decode_matches_forward(self):
+        """MLA latent KV cache (c_kv + shared rope key only) through the
+        decode path."""
+        model = self._model()
+        cfg, params = import_hf_model(model)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        params = jax.tree.map(jnp.asarray, params)
+        tokens = np.random.default_rng(42).integers(0, 128, (2, 8),
+                                                    dtype=np.int32)
+        full = np.asarray(T.forward(params, jnp.asarray(tokens), cfg))
+        cache = T.init_kv_cache(cfg, batch_size=2, max_len=16)
+        # the latent cache is the small one: kvr + dr vs N*(dn+dr+dv)
+        assert cache["k"].shape[-1] == 8 and cache["v"].shape[-1] == 4
+        logits, cache2 = T.forward_decode(
+            params, jnp.asarray(tokens), cache, jnp.zeros((2,), jnp.int32),
+            cfg)
+        np.testing.assert_allclose(np.asarray(logits), full, rtol=2e-3,
+                                   atol=2e-3)
+        nxt = np.random.default_rng(43).integers(0, 128, (2, 1),
+                                                 dtype=np.int32)
+        step_logits, _ = T.forward_decode(
+            params, jnp.asarray(nxt), cache2, jnp.full((2,), 8, jnp.int32),
+            cfg)
+        ext = np.concatenate([tokens, nxt], axis=1)
+        full_ext = np.asarray(T.forward(params, jnp.asarray(ext), cfg))
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   full_ext[:, -1], rtol=2e-3, atol=2e-3)
+
+    def test_first_k_dense_rejected(self):
+        hf_cfg = transformers.DeepseekV3Config(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, n_routed_experts=4,
+            q_lora_rank=16, kv_lora_rank=8, qk_nope_head_dim=8,
+            qk_rope_head_dim=4, v_head_dim=8, first_k_dense_replace=1)
+        torch.manual_seed(44)
+        model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+        with pytest.raises(NotImplementedError, match="first_k_dense"):
+            import_hf_model(model)
+
+
+class TestDeepseekV2Import:
+    def test_logits_match_generous_capacity(self):
+        """DeepSeek-V2-Lite: MLA with NON-interleaved rope + softmax greedy
+        routing + shared experts."""
+        hf_cfg = transformers.DeepseekV2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=2, n_routed_experts=4, num_experts_per_tok=2,
+            n_shared_experts=1, q_lora_rank=16, kv_lora_rank=8,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            first_k_dense_replace=0, topk_method="greedy",
+            routed_scaling_factor=1.0, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        torch.manual_seed(50)
+        model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.mla and not cfg.rope_interleave
+        assert cfg.moe_score_func == "softmax" and not cfg.moe_gate_bias
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(50).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+    def test_group_limited_greedy_rejected(self):
+        hf_cfg = transformers.DeepseekV2Config(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, n_routed_experts=4,
+            q_lora_rank=16, kv_lora_rank=8, qk_nope_head_dim=8,
+            qk_rope_head_dim=4, v_head_dim=8, first_k_dense_replace=0,
+            topk_method="group_limited_greedy", n_group=2, topk_group=1)
+        torch.manual_seed(51)
+        model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+        with pytest.raises(NotImplementedError, match="greedy"):
+            import_hf_model(model)
+
+    def test_rope_scaling_rejected(self):
+        """Released DeepSeek checkpoints set rope_scaling (yarn); silently
+        ignoring it would give wrong logits — must raise."""
+        hf_cfg = transformers.DeepseekV3Config(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, n_routed_experts=4, q_lora_rank=16,
+            kv_lora_rank=8, qk_nope_head_dim=8, qk_rope_head_dim=4,
+            v_head_dim=8, first_k_dense_replace=0,
+            rope_scaling={"rope_type": "yarn", "factor": 40.0,
+                          "beta_fast": 32, "beta_slow": 1,
+                          "mscale": 1.0, "mscale_all_dim": 1.0,
+                          "original_max_position_embeddings": 4096})
+        torch.manual_seed(52)
+        model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            import_hf_model(model)

@@ -41,6 +41,26 @@ def _stack(sd: Dict[str, Any], fmt: str, L: int, transpose: bool = False
     return np.stack(mats)
 
 
+def _canon_rope_scaling(hf_config) -> Optional[tuple]:
+    """HF rope_scaling dict → canonical hashable tuple for the frozen zoo
+    config; validates the type is one the zoo implements
+    (``transformer._scaled_inv_freq``: default/linear/llama3/yarn) by raising
+    the zoo's NotImplementedError for anything else — silently ignoring
+    scaling would mean wrong logits on every real Llama-3/DeepSeek
+    checkpoint."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if not rs:
+        return None
+    sc = {k: v for k, v in dict(rs).items() if v is not None}
+    # yarn falls back to the model's max positions when 'original_...' absent
+    sc.setdefault("max_position_embeddings",
+                  getattr(hf_config, "max_position_embeddings", 2048))
+    from deepspeed_tpu.models.transformer import _scaled_inv_freq
+
+    _scaled_inv_freq(64, 10000.0, sc)   # type/keys validation
+    return tuple(sorted(sc.items()))
+
+
 # --------------------------------------------------------------------------- #
 # GPT-2
 # --------------------------------------------------------------------------- #
@@ -105,6 +125,7 @@ def config_from_llama(hf_config) -> TransformerConfig:
         use_bias=False,
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rope_scaling=_canon_rope_scaling(hf_config),
         norm_eps=hf_config.rms_norm_eps, dtype="float32")
 
 
@@ -305,20 +326,7 @@ def params_from_qwen3_moe(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 # AutoEP presets module_inject/auto_ep_presets/deepseek_v{2,3}.py)
 # --------------------------------------------------------------------------- #
 
-def _reject_rope_scaling(hf_config, arch: str) -> None:
-    """Every released DeepSeek checkpoint sets rope_scaling (yarn + mscale),
-    which changes both the rope frequencies and the attention softmax scale —
-    silently ignoring it would produce wrong logits. Raise until yarn lands."""
-    rs = getattr(hf_config, "rope_scaling", None)
-    if rs:
-        raise NotImplementedError(
-            f"{arch}: rope_scaling={rs!r} (yarn/mscale) is not implemented; "
-            "remove rope_scaling from the config for short-context use or "
-            "wait for yarn support")
-
-
 def config_from_deepseek_v3(hf_config) -> TransformerConfig:
-    _reject_rope_scaling(hf_config, "deepseek_v3")
     first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
     if first_dense > 0:
         raise NotImplementedError(
@@ -336,6 +344,7 @@ def config_from_deepseek_v3(hf_config) -> TransformerConfig:
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         norm_eps=hf_config.rms_norm_eps, dtype="float32",
+        rope_scaling=_canon_rope_scaling(hf_config),
         mla=True,
         q_lora_rank=getattr(hf_config, "q_lora_rank", None),
         kv_lora_rank=hf_config.kv_lora_rank,
@@ -357,48 +366,26 @@ def config_from_deepseek_v3(hf_config) -> TransformerConfig:
 
 
 def config_from_deepseek_v2(hf_config) -> TransformerConfig:
-    """DeepSeek-V2/V2-Lite: same MLA; softmax routing, non-interleaved rope.
-    Only topk_method='greedy' (V2-Lite) maps onto the gate — V2-Chat's
-    max-based group_limited_greedy differs from V3's top2-sum grouping."""
-    _reject_rope_scaling(hf_config, "deepseek_v2")
+    """DeepSeek-V2/V2-Lite: same MLA as V3; softmax greedy routing,
+    non-interleaved rope, no gate bias. Derives from the V3 mapping and
+    overrides the family differences (codebase convention: qwen variants
+    derive from config_from_llama the same way)."""
+    scoring = getattr(hf_config, "scoring_func", "softmax") or "softmax"
+    if scoring != "softmax":
+        raise NotImplementedError(
+            f"deepseek_v2 scoring_func={scoring!r}: the V2 importer maps "
+            "softmax routing; sigmoid-scored configs belong to the "
+            "deepseek_v3 importer")
     method = getattr(hf_config, "topk_method", "greedy")
     if method != "greedy":
         raise NotImplementedError(
             f"deepseek_v2 topk_method={method!r}: only 'greedy' routing is "
             "supported (the group-limited variant scores groups by max, "
             "unlike V3's top-2 sum)")
-    first_dense = int(getattr(hf_config, "first_k_dense_replace", 0) or 0)
-    if first_dense > 0:
-        raise NotImplementedError(
-            f"first_k_dense_replace={first_dense}: heterogeneous dense/MoE "
-            "stacks are not supported by the stacked-layer zoo")
-    shared = int(getattr(hf_config, "n_shared_experts", 0) or 0)
-    return TransformerConfig(
-        vocab_size=hf_config.vocab_size,
-        hidden_size=hf_config.hidden_size,
-        num_layers=hf_config.num_hidden_layers,
-        num_heads=hf_config.num_attention_heads,
-        ffn_hidden_size=hf_config.intermediate_size,
-        max_seq_len=hf_config.max_position_embeddings,
-        pos_emb="rope", norm="rmsnorm", activation="swiglu", use_bias=False,
-        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
-        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
-        norm_eps=hf_config.rms_norm_eps, dtype="float32",
-        mla=True,
-        q_lora_rank=getattr(hf_config, "q_lora_rank", None),
-        kv_lora_rank=hf_config.kv_lora_rank,
-        qk_nope_head_dim=hf_config.qk_nope_head_dim,
-        qk_rope_head_dim=hf_config.qk_rope_head_dim,
-        v_head_dim=hf_config.v_head_dim,
-        rope_interleave=False,
-        n_experts=hf_config.n_routed_experts,
-        moe_top_k=hf_config.num_experts_per_tok,
-        moe_ffn_size=hf_config.moe_intermediate_size,
-        moe_shared_size=shared * hf_config.moe_intermediate_size,
-        moe_score_func="softmax",
-        moe_route_norm=bool(hf_config.norm_topk_prob),
-        moe_route_scale=float(getattr(hf_config, "routed_scaling_factor", 1.0)),
-        moe_aux_coef=float(getattr(hf_config, "router_aux_loss_coef", 0.001)))
+    cfg = config_from_deepseek_v3(hf_config)
+    return dataclasses.replace(
+        cfg, rope_interleave=False, moe_score_func="softmax",
+        moe_gate_bias=False, moe_n_group=1, moe_topk_group=1)
 
 
 def params_from_deepseek(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
@@ -453,6 +440,7 @@ def params_from_deepseek(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 def config_from_phi(hf_config) -> TransformerConfig:
     head_dim = hf_config.hidden_size // hf_config.num_attention_heads
     return TransformerConfig(
+        rope_scaling=_canon_rope_scaling(hf_config),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,
@@ -504,6 +492,7 @@ def params_from_phi(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 
 def config_from_phi3(hf_config) -> TransformerConfig:
     return TransformerConfig(
+        rope_scaling=_canon_rope_scaling(hf_config),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,
@@ -564,6 +553,7 @@ def config_from_falcon(hf_config) -> TransformerConfig:
         parallel = bool(getattr(hf_config, "parallel_attn", True))
         shared = parallel
     return TransformerConfig(
+        rope_scaling=_canon_rope_scaling(hf_config),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,
@@ -765,6 +755,7 @@ def params_from_bloom(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 
 def config_from_gpt_neox(hf_config) -> TransformerConfig:
     return TransformerConfig(
+        rope_scaling=_canon_rope_scaling(hf_config),
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,

@@ -98,7 +98,8 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
     max_pos = pool["k"].shape[1] * bs
     cos_t = sin_t = None
     if cfg.pos_emb == "rope":
-        cos_t, sin_t = T.rope_table(max_pos, cfg.rope_dim, cfg.rope_theta)
+        cos_t, sin_t = T.rope_table(max_pos, cfg.rope_dim, cfg.rope_theta,
+                                    cfg.rope_scaling_dict)
     block_idx = jnp.take_along_axis(
         tables, (positions // bs)[:, None], axis=1)[:, 0]  # [T]
     offsets = positions % bs

@@ -122,6 +122,9 @@ class TransformerConfig:
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
     rope_interleave: bool = True        # DeepSeek stores rope pairs interleaved
+    # HF rope_scaling dict, canonicalized to a sorted tuple of items so the
+    # frozen config stays hashable (None = unscaled)
+    rope_scaling: Optional[Tuple[Tuple[str, Any], ...]] = None
     # DeepSeek-V3 router extras (moe/gating.py)
     moe_gate_bias: bool = False         # e_score_correction_bias parameter
     moe_n_group: int = 1                # node-limited routing groups
@@ -165,6 +168,24 @@ class TransformerConfig:
     @property
     def attn_bias_enabled(self) -> bool:
         return self.use_bias or self.qkv_bias
+
+    @property
+    def rope_scaling_dict(self) -> Optional[Dict[str, Any]]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
+
+    @property
+    def mla_scale_mult(self) -> float:
+        """DeepSeek yarn: softmax scale gains mscale(factor, mscale_all_dim)²
+        on top of the cos/sin attention factor (HF DeepseekV3Attention)."""
+        sc = self.rope_scaling_dict
+        if not sc or not self.mla:
+            return 1.0
+        mall = sc.get("mscale_all_dim", 0)
+        factor = float(sc.get("factor", 1.0))
+        if mall and factor > 1:
+            m = 0.1 * float(mall) * math.log(factor) + 1.0
+            return m * m
+        return 1.0
 
     @property
     def rope_dim(self) -> int:
@@ -435,11 +456,84 @@ def _head_rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (x32 * lax.rsqrt(var + eps) * scale).astype(dtype)
 
 
-def rope_table(seq_len: int, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+def _scaled_inv_freq(head_dim: int, theta: float,
+                     scaling: Optional[Dict[str, Any]]):
+    """Inverse rope frequencies with HF-compatible scaling (numpy, trace-time
+    constants). Supports the types real checkpoints use: 'default',
+    'linear', 'llama3' (Llama-3.x piecewise wavelength scaling), 'yarn'
+    (NTK interpolation/extrapolation blend + attention factor — DeepSeek,
+    Qwen-long). Mirrors ``transformers/modeling_rope_utils.py``.
+
+    → (inv_freq [D/2] np.float32, attention_factor float — multiplies the
+    cos/sin tables, HF convention)."""
+    import numpy as _onp
+
+    inv = 1.0 / (theta ** (_onp.arange(0, head_dim, 2, dtype=_onp.float64)
+                           / head_dim))
+    if not scaling:
+        return inv.astype(_onp.float32), 1.0
+    sc = dict(scaling)
+    rtype = sc.get("rope_type", sc.get("type", "default"))
+    factor = float(sc.get("factor", 1.0))
+    if rtype == "default":
+        return inv.astype(_onp.float32), 1.0
+    if rtype == "linear":
+        return (inv / factor).astype(_onp.float32), 1.0
+    if rtype == "llama3":
+        low_f = float(sc["low_freq_factor"])
+        high_f = float(sc["high_freq_factor"])
+        old_ctx = float(sc["original_max_position_embeddings"])
+        wavelen = 2 * math.pi / inv
+        out = _onp.where(wavelen > old_ctx / low_f, inv / factor, inv)
+        smooth = (old_ctx / wavelen - low_f) / (high_f - low_f)
+        smoothed = (1 - smooth) * out / factor + smooth * out
+        medium = (wavelen >= old_ctx / high_f) & (wavelen <= old_ctx / low_f)
+        out = _onp.where(medium, smoothed, out)
+        return out.astype(_onp.float32), 1.0
+    if rtype == "yarn":
+        d2 = head_dim // 2
+        old_ctx = float(sc.get("original_max_position_embeddings") or 0) or None
+        max_pos = old_ctx if old_ctx else float(sc.get("max_position_embeddings", 2048))
+        mscale = sc.get("mscale")
+        mscale_all = sc.get("mscale_all_dim")
+
+        def get_mscale(scale, m=1.0):
+            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+        att = sc.get("attention_factor")
+        if att is None:
+            if mscale and mscale_all:
+                att = get_mscale(factor, mscale) / get_mscale(factor, mscale_all)
+            else:
+                att = get_mscale(factor)
+        beta_fast = float(sc.get("beta_fast") or 32)
+        beta_slow = float(sc.get("beta_slow") or 1)
+
+        def corr_dim(rot):
+            return (head_dim * math.log(max_pos / (rot * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+        if low == high:
+            high += 0.001
+        ramp = _onp.clip((_onp.arange(d2, dtype=_onp.float64) - low)
+                         / (high - low), 0, 1)
+        extrap_mask = 1 - ramp
+        out = (inv / factor) * (1 - extrap_mask) + inv * extrap_mask
+        return out.astype(_onp.float32), float(att)
+    raise NotImplementedError(
+        f"rope_scaling type {rtype!r} is not implemented "
+        "(supported: default, linear, llama3, yarn)")
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float,
+               scaling: Optional[Dict[str, Any]] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    inv_freq, att = _scaled_inv_freq(head_dim, theta, scaling)
     t = jnp.arange(seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)          # [S, D/2]
-    return jnp.cos(freqs), jnp.sin(freqs)
+    freqs = jnp.outer(t, jnp.asarray(inv_freq))          # [S, D/2]
+    return jnp.cos(freqs) * att, jnp.sin(freqs) * att
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -543,40 +637,61 @@ def _rope_deinterleave(x: jax.Array) -> jax.Array:
     return x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
 
 
-def _mla_qkv(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
-             rope_fn) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Multi-head latent attention projections (DeepSeek V2/V3; HF
-    ``DeepseekV3Attention.forward``). h: [B, S, H] (normed). Returns
-    q/k: [B, S, N, dn+dr], v: [B, S, N, dv]. ``rope_fn(x)`` rotates
-    [B, S, *, dr] at the right positions (fwd vs decode)."""
+def _mla_q(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
+           rope_fn) -> jax.Array:
+    """MLA query path: (optional) low-rank q projection + decoupled rope on
+    the pe dims → [B, S, N, dn+dr] (HF ``DeepseekV3Attention.forward``)."""
     B, S, _ = h.shape
     dt = h.dtype
-    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
-    kvr, N = cfg.kv_lora_rank, cfg.num_heads
-
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     if cfg.q_lora_rank:
         qa = h @ lp["wq_a"].astype(dt)
         qa = _head_rmsnorm(qa, lp["q_a_norm"], cfg.norm_eps)
         q = qa @ lp["wq_b"].astype(dt)
     else:
         q = h @ lp["wq"].astype(dt)
-    q = q.reshape(B, S, N, dn + dr)
+    q = q.reshape(B, S, cfg.num_heads, dn + dr)
     q_nope, q_pe = q[..., :dn], q[..., dn:]
+    if cfg.rope_interleave:
+        q_pe = _rope_deinterleave(q_pe)
+    return jnp.concatenate([q_nope, rope_fn(q_pe)], axis=-1)
 
+
+def _mla_latents(h: jax.Array, lp: Dict[str, jax.Array],
+                 cfg: TransformerConfig, rope_fn
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """MLA KV latents: normed c_kv [B, S, kvr] + post-rope shared key
+    [B, S, 1, dr] — exactly what the decode path caches."""
+    dt = h.dtype
+    kvr = cfg.kv_lora_rank
     kv_a = h @ lp["wkv_a"].astype(dt)                 # [B, S, kvr+dr]
     c_kv = _head_rmsnorm(kv_a[..., :kvr], lp["kv_a_norm"], cfg.norm_eps)
     k_pe = kv_a[..., kvr:][:, :, None, :]             # [B, S, 1, dr] shared
-    kv = (c_kv @ lp["wkv_b"].astype(dt)).reshape(B, S, N, dn + dv)
-    k_nope, v = kv[..., :dn], kv[..., dn:]
-
     if cfg.rope_interleave:
-        q_pe = _rope_deinterleave(q_pe)
         k_pe = _rope_deinterleave(k_pe)
-    q_pe = rope_fn(q_pe)
-    k_pe = rope_fn(k_pe)
-    q = jnp.concatenate([q_nope, q_pe], axis=-1)
-    k = jnp.concatenate([k_nope, jnp.broadcast_to(
-        k_pe, (B, S, N, dr))], axis=-1)
+    return c_kv, rope_fn(k_pe)
+
+
+def _mla_expand(c_kv: jax.Array, k_pe: jax.Array,
+                lp: Dict[str, jax.Array], cfg: TransformerConfig
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Latents → full per-head k [B, S, N, dn+dr] and v [B, S, N, dv]."""
+    dt = c_kv.dtype
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    B, S = c_kv.shape[:2]
+    N = cfg.num_heads
+    kv = (c_kv @ lp["wkv_b"].astype(dt)).reshape(B, S, N, dn + dv)
+    k = jnp.concatenate(
+        [kv[..., :dn], jnp.broadcast_to(k_pe, (B, S, N, dr))], axis=-1)
+    return k, kv[..., dn:]
+
+
+def _mla_qkv(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
+             rope_fn) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full MLA projections for the training/prefill path."""
+    q = _mla_q(h, lp, cfg, rope_fn)
+    c_kv, k_pe = _mla_latents(h, lp, cfg, rope_fn)
+    k, v = _mla_expand(c_kv, k_pe, lp, cfg)
     return q, k, v
 
 
@@ -609,6 +724,8 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg,
                            lambda t: apply_rope(t, cos, sin))
+        if cfg.mla_scale_mult != 1.0:
+            q = q * jnp.asarray(cfg.mla_scale_mult, q.dtype)
         # flash kernels assume one head dim; MLA's split qk/v dims run on
         # the XLA reference attention (scale = 1/sqrt(dn+dr) from q's D)
         attn = dot_product_attention(q, k, v, causal=cfg.causal)
@@ -740,7 +857,7 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     cos = sin = None
     if cfg.pos_emb == "rope":
         rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
-        cos, sin = rope_table(S, rd, cfg.rope_theta)
+        cos, sin = rope_table(S, rd, cfg.rope_theta, cfg.rope_scaling_dict)
 
     def make_body(cos_b, sin_b, with_pld: bool):
         def body(carry, xs):
@@ -893,7 +1010,7 @@ def forward_decode(params: PyTree, tokens: jax.Array,
     cos_t = sin_t = None
     if cfg.pos_emb == "rope":
         rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
-        cos_t, sin_t = rope_table(M, rd, cfg.rope_theta)
+        cos_t, sin_t = rope_table(M, rd, cfg.rope_theta, cfg.rope_scaling_dict)
     slopes = (alibi_slopes(cfg.num_heads) * cfg.alibi_bias_scale
               if cfg.pos_emb == "alibi" else None)
 
@@ -912,38 +1029,17 @@ def forward_decode(params: PyTree, tokens: jax.Array,
             # [B,M,1,dr]. Per step: write the new latents, re-expand k/v for
             # the whole window from the latent (naive MLA decode; the
             # weight-absorbed variant is a further optimization).
-            dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
-                          cfg.v_head_dim)
-            kvr, N = cfg.kv_lora_rank, cfg.num_heads
-
-            if cfg.q_lora_rank:
-                qa = _head_rmsnorm(h @ lp["wq_a"].astype(dt),
-                                   lp["q_a_norm"], cfg.norm_eps)
-                q = qa @ lp["wq_b"].astype(dt)
-            else:
-                q = h @ lp["wq"].astype(dt)
-            q = q.reshape(B, T, N, dn + dr)
-            q_nope, q_pe = q[..., :dn], q[..., dn:]
-            kv_a = h @ lp["wkv_a"].astype(dt)
-            c_kv = _head_rmsnorm(kv_a[..., :kvr], lp["kv_a_norm"],
-                                 cfg.norm_eps)
-            k_pe = kv_a[..., kvr:][:, :, None, :]
-            if cfg.rope_interleave:
-                q_pe = _rope_deinterleave(q_pe)
-                k_pe = _rope_deinterleave(k_pe)
-            q_pe = apply_rope_at(q_pe, cos_t, sin_t, positions)
-            k_pe = apply_rope_at(k_pe, cos_t, sin_t, positions)
+            rope_fn = lambda t: apply_rope_at(t, cos_t, sin_t, positions)
+            qf = _mla_q(h, lp, cfg, rope_fn)
+            c_kv, k_pe = _mla_latents(h, lp, cfg, rope_fn)
             kc = jax.vmap(write)(kc, c_kv[:, :, None, :].astype(kc.dtype), pos)
             vc = jax.vmap(write)(vc, k_pe.astype(vc.dtype), pos)
-            kv = (kc[:, :, 0].astype(dt) @ lp["wkv_b"].astype(dt)
-                  ).reshape(B, M, N, dn + dv)
-            k_full = jnp.concatenate(
-                [kv[..., :dn],
-                 jnp.broadcast_to(vc.astype(dt), (B, M, N, dr))], axis=-1)
-            v_full = kv[..., dn:]
-            qf = jnp.concatenate([q_nope, q_pe], axis=-1)
+            k_full, v_full = _mla_expand(
+                kc[:, :, 0].astype(dt), vc.astype(dt), lp, cfg)
+            if cfg.mla_scale_mult != 1.0:
+                qf = qf * jnp.asarray(cfg.mla_scale_mult, qf.dtype)
             attn = cached_attention(qf, k_full, v_full, positions)
-            attn = attn.reshape(B, T, N * dv)
+            attn = attn.reshape(B, T, cfg.num_heads * cfg.v_head_dim)
             x = x + attn @ lp["wo"].astype(dt)
             h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
             down, _ = _ffn(h2, lp, cfg)
@@ -1036,7 +1132,7 @@ def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     cos = sin = None
     if cfg.pos_emb == "rope":
         rd = cfg.qk_rope_head_dim if cfg.mla else cfg.rope_dim
-        cos, sin = rope_table(S, rd, cfg.rope_theta)
+        cos, sin = rope_table(S, rd, cfg.rope_theta, cfg.rope_scaling_dict)
 
     head = _lm_head_of(params, cfg)
     inputs = {"x": microbatch(x, M), "tokens": microbatch(tokens, M)}

@@ -264,10 +264,6 @@ def test_fastgen_throughput_vs_slot_engine():
 def test_mla_rejected_with_clear_error():
     """DeepSeek/MLA models must fail fast in the paged path (latent cache
     layout differs) — serve them through the v1 InferenceEngine instead."""
-    import dataclasses
-
-    import pytest as _pytest
-
     from deepspeed_tpu.models import paged as P
     from deepspeed_tpu.models import transformer as T
 
@@ -276,6 +272,6 @@ def test_mla_rejected_with_clear_error():
         mla=True, kv_lora_rank=8, qk_nope_head_dim=8, qk_rope_head_dim=4,
         v_head_dim=8, pos_emb="rope", norm="rmsnorm", activation="swiglu",
         use_bias=False, dtype="float32", max_seq_len=32)
-    with _pytest.raises(NotImplementedError, match="MLA"):
+    with pytest.raises(NotImplementedError, match="MLA"):
         P.forward_paged(None, None, None, None,
                         {"k": jnp.zeros((1, 4, 8, 1, 8))}, cfg)

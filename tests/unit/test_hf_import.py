@@ -480,19 +480,65 @@ class TestDeepseekV2Import:
         with pytest.raises(NotImplementedError, match="greedy"):
             import_hf_model(model)
 
-    def test_rope_scaling_rejected(self):
-        """Released DeepSeek checkpoints set rope_scaling (yarn); silently
-        ignoring it would give wrong logits — must raise."""
+    def test_yarn_rope_scaling_logits_match(self):
+        """Released DeepSeek checkpoints set rope_scaling (yarn + mscale):
+        scaled frequencies, cos/sin attention factor AND the mscale^2 softmax
+        scale must all match HF."""
         hf_cfg = transformers.DeepseekV3Config(
-            vocab_size=64, hidden_size=32, num_hidden_layers=1,
-            num_attention_heads=2, n_routed_experts=4, q_lora_rank=16,
-            kv_lora_rank=8, qk_nope_head_dim=8, qk_rope_head_dim=4,
-            v_head_dim=8, first_k_dense_replace=0,
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=2, n_routed_experts=4, num_experts_per_tok=2,
+            n_shared_experts=1, q_lora_rank=16, kv_lora_rank=8,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            first_k_dense_replace=0, n_group=1, topk_group=1,
+            max_position_embeddings=64, tie_word_embeddings=False,
             rope_scaling={"rope_type": "yarn", "factor": 40.0,
                           "beta_fast": 32, "beta_slow": 1,
                           "mscale": 1.0, "mscale_all_dim": 1.0,
-                          "original_max_position_embeddings": 4096})
+                          "original_max_position_embeddings": 16})
         torch.manual_seed(52)
         model = transformers.DeepseekV3ForCausalLM(hf_cfg)
-        with pytest.raises(NotImplementedError, match="rope_scaling"):
+        cfg, params = import_hf_model(model)
+        assert cfg.rope_scaling is not None and cfg.mla_scale_mult != 1.0
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=64.0)
+        tokens = np.random.default_rng(52).integers(0, 128, (2, 24),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=5e-4, atol=5e-4)
+
+
+class TestRopeScaling:
+    def test_llama3_scaling_logits_match(self):
+        """Llama-3.x checkpoints all set rope_scaling type 'llama3' — the
+        piecewise wavelength scaling must match HF (it changes logits at
+        EVERY length, not just long contexts)."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            tie_word_embeddings=False,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 32})
+        torch.manual_seed(60)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.rope_scaling is not None
+        tokens = np.random.default_rng(60).integers(0, 128, (2, 48),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=3e-4, atol=3e-4)
+
+    def test_unknown_scaling_type_rejected(self):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, max_position_embeddings=64,
+            rope_scaling={"rope_type": "longrope", "factor": 4.0,
+                          "long_factor": [1.0], "short_factor": [1.0]})
+        torch.manual_seed(61)
+        try:
+            model = transformers.LlamaForCausalLM(hf_cfg)
+        except Exception:
+            pytest.skip("transformers rejects this synthetic longrope config")
+        with pytest.raises(NotImplementedError, match="rope_scaling type"):
             import_hf_model(model)

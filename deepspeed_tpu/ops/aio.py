@@ -56,8 +56,22 @@ def _load() -> ctypes.CDLL:
             lib.aio_wait_all.argtypes = [ctypes.c_void_p]
             lib.aio_pending.restype = ctypes.c_int
             lib.aio_pending.argtypes = [ctypes.c_void_p]
+            lib.aio_handle_create_ex.restype = ctypes.c_void_p
+            lib.aio_handle_create_ex.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_long,
+                ctypes.c_int]
+            lib.aio_uring_supported.restype = ctypes.c_int
+            lib.aio_uring_supported.argtypes = []
             _lib = lib
     return _lib
+
+
+def uring_supported() -> bool:
+    """True when the kernel accepts io_uring_setup (DeepNVMe fast path)."""
+    try:
+        return bool(_load().aio_uring_supported())
+    except Exception:
+        return False
 
 
 class AsyncIOHandle:
@@ -66,9 +80,27 @@ class AsyncIOHandle:
     Buffers passed to async ops MUST stay alive until wait(); the handle keeps
     a reference until the op is waited on to enforce that."""
 
-    def __init__(self, n_threads: int = 4):
+    def __init__(self, n_threads: int = 4, engine: str = "auto",
+                 odirect: bool = False, block_bytes: int = 1 << 20,
+                 queue_depth: int = 32):
+        """``engine``: 'threads' (pread/pwrite pool), 'uring' (raw io_uring
+        chunked submission — the reference's libaio/io_uring engines), or
+        'auto' (uring when the kernel supports it; DSTPU_AIO_ENGINE env
+        overrides). ``odirect``/``block_bytes``/``queue_depth`` mirror the
+        reference aio config (block_size / queue_depth / overlap knobs)."""
         self._lib = _load()
-        self._h = self._lib.aio_handle_create(n_threads)
+        if engine == "auto":
+            # the env override applies ONLY to auto — an explicit engine
+            # argument (tuning sweeps, tests) is always honored
+            engine = os.environ.get("DSTPU_AIO_ENGINE", "auto")
+        if engine == "auto":
+            engine = "uring" if self._lib.aio_uring_supported() else "threads"
+        if engine not in ("threads", "uring"):
+            raise ValueError(f"engine must be auto|threads|uring, got {engine!r}")
+        self.engine = engine
+        self._h = self._lib.aio_handle_create_ex(
+            n_threads, 1 if engine == "uring" else 0, int(odirect),
+            block_bytes, queue_depth)
         self._live: Dict[int, np.ndarray] = {}
 
     def __del__(self):

@@ -296,3 +296,79 @@ class TestHostStep:
             "offload_optimizer": {"device": "nvme"}}
         with pytest.raises(DeepSpeedConfigError, match="conflicts"):
             dst.initialize(model=spec, config=config)
+
+
+class TestAioEngines:
+    """DeepNVMe engines (csrc/aio): raw-io_uring chunked submission +
+    O_DIRECT bounce buffers vs the thread-pool baseline."""
+
+    @pytest.mark.parametrize("engine,odirect", [
+        ("threads", False), ("uring", False), ("uring", True)])
+    def test_roundtrip_with_unaligned_tail(self, tmp_path, engine, odirect):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle, uring_supported
+
+        if engine == "uring" and not uring_supported():
+            pytest.skip("kernel without io_uring")
+        h = AsyncIOHandle(n_threads=2, engine=engine, odirect=odirect,
+                          block_bytes=1 << 20, queue_depth=8)
+        # 3 MB + unaligned tail: exercises chunking AND the buffered-tail
+        # path O_DIRECT cannot express
+        buf = np.random.default_rng(1).integers(
+            0, 255, size=3 * (1 << 20) + 999, dtype=np.uint8)
+        path = str(tmp_path / "t.bin")
+        assert h.sync_pwrite(buf, path) == buf.nbytes
+        out = np.empty_like(buf)
+        assert h.sync_pread(out, path) == buf.nbytes
+        np.testing.assert_array_equal(out, buf)
+
+    def test_offset_io_uring(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle, uring_supported
+
+        if not uring_supported():
+            pytest.skip("kernel without io_uring")
+        h = AsyncIOHandle(engine="uring", block_bytes=1 << 16)
+        a = np.arange(100000, dtype=np.int32)
+        b = np.arange(100000, 200000, dtype=np.int32)
+        path = str(tmp_path / "o.bin")
+        h.sync_pwrite(a, path, offset=0)
+        h.sync_pwrite(b, path, offset=a.nbytes)
+        out = np.empty_like(b)
+        h.sync_pread(out, path, offset=a.nbytes)
+        np.testing.assert_array_equal(out, b)
+
+    def test_auto_prefers_uring(self):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle, uring_supported
+
+        h = AsyncIOHandle(engine="auto")
+        if uring_supported():
+            assert h.engine == "uring"
+        else:
+            assert h.engine == "threads"
+
+    def test_uring_short_file_read_matches_threads_semantics(self, tmp_path):
+        """Reading a 4MB buffer from a 3MB file returns partial bytes (EOF),
+        exactly like the thread-pool engine — not an error."""
+        from deepspeed_tpu.ops.aio import AsyncIOHandle, uring_supported
+
+        if not uring_supported():
+            pytest.skip("kernel without io_uring")
+        data = np.random.default_rng(5).integers(
+            0, 255, size=3 * (1 << 20) + 77, dtype=np.uint8)
+        path = str(tmp_path / "short.bin")
+        AsyncIOHandle(engine="threads").sync_pwrite(data, path)
+        for engine in ("threads", "uring"):
+            h = AsyncIOHandle(engine=engine, block_bytes=1 << 20,
+                              queue_depth=8)
+            out = np.zeros(4 * (1 << 20), dtype=np.uint8)
+            n = h.sync_pread(out, path)
+            assert n == data.nbytes, (engine, n)
+            np.testing.assert_array_equal(out[:n], data)
+
+    def test_env_override_only_applies_to_auto(self, monkeypatch):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle, uring_supported
+
+        if not uring_supported():
+            pytest.skip("kernel without io_uring")
+        monkeypatch.setenv("DSTPU_AIO_ENGINE", "threads")
+        assert AsyncIOHandle(engine="auto").engine == "threads"
+        assert AsyncIOHandle(engine="uring").engine == "uring"  # explicit wins

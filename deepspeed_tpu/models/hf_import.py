@@ -292,6 +292,23 @@ def params_from_qwen2_moe(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
     return params
 
 
+def config_from_qwen3(hf_config) -> TransformerConfig:
+    """Qwen3 dense: llama schema + QK-norm + explicit head_dim, no qkv bias."""
+    cfg = config_from_llama(hf_config)
+    return dataclasses.replace(
+        cfg, qk_norm=True, attn_head_dim=getattr(hf_config, "head_dim", None))
+
+
+def params_from_qwen3(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    L = cfg.num_layers
+    params = params_from_llama(sd, cfg)
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lyr = pre + "layers.{}."
+    params["blocks"]["q_norm"] = _stack(sd, lyr + "self_attn.q_norm.weight", L)
+    params["blocks"]["k_norm"] = _stack(sd, lyr + "self_attn.k_norm.weight", L)
+    return params
+
+
 def config_from_qwen3_moe(hf_config) -> TransformerConfig:
     _assert_homogeneous_moe(hf_config)
     cfg = config_from_llama(hf_config)
@@ -826,6 +843,7 @@ _ARCH_TABLE = {
     "mistral": (config_from_llama, params_from_llama),
     "mixtral": (config_from_mixtral, params_from_mixtral),
     "qwen2": (config_from_qwen2, params_from_qwen2),
+    "qwen3": (config_from_qwen3, params_from_qwen3),
     "qwen2_moe": (config_from_qwen2_moe, params_from_qwen2_moe),
     "qwen3_moe": (config_from_qwen3_moe, params_from_qwen3_moe),
     "deepseek_v2": (config_from_deepseek_v2, params_from_deepseek),

@@ -542,3 +542,40 @@ class TestRopeScaling:
             pytest.skip("transformers rejects this synthetic longrope config")
         with pytest.raises(NotImplementedError, match="rope_scaling type"):
             import_hf_model(model)
+
+
+class TestQwen3Import:
+    def test_logits_match(self):
+        """Qwen3 dense: QK-norm + explicit head_dim (≠ hidden/heads)."""
+        hf_cfg = transformers.Qwen3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=1, head_dim=16,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(70)
+        model = transformers.Qwen3ForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+        assert cfg.qk_norm and cfg.head_dim == 16 and not cfg.qkv_bias
+        tokens = np.random.default_rng(70).integers(0, 128, (2, 16),
+                                                    dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params)
+
+    def test_generate_matches_hf(self):
+        hf_cfg = transformers.Qwen3Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=1, head_dim=16,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(71)
+        model = transformers.Qwen3ForCausalLM(hf_cfg)
+        cfg, params = import_hf_model(model)
+
+        from deepspeed_tpu.inference import InferenceEngine
+
+        eng = InferenceEngine(cfg, params=params, mesh=None)
+        ours = eng.generate([[3, 1, 4, 1, 5]], max_new_tokens=6)[0]
+        with torch.no_grad():
+            hf = model.generate(torch.tensor([[3, 1, 4, 1, 5]]),
+                                max_new_tokens=6, do_sample=False,
+                                use_cache=True)[0, 5:].tolist()
+        assert ours == hf

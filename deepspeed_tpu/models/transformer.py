@@ -695,6 +695,39 @@ def _mla_qkv(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
     return q, k, v
 
 
+def _mla_absorbed_attention(q: jax.Array, ckv: jax.Array, kpe: jax.Array,
+                            lp: Dict[str, jax.Array], cfg: TransformerConfig,
+                            positions: jax.Array, scale_mult: float
+                            ) -> jax.Array:
+    """Weight-absorbed MLA decode (the DeepSeek inference trick): fold
+    W_uk into the query and W_uv into the output so attention runs ENTIRELY
+    in the latent space — per step the cache is read once at width kvr+dr
+    and the O(M·N·(dn+dv)) k/v re-expansion never happens.
+
+    q: [B,T,N,dn+dr] (post-rope); ckv: [B,M,kvr] (normed latents);
+    kpe: [B,M,dr] (post-rope shared key); → [B,T,N,dv].
+    """
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    kvr, N = cfg.kv_lora_rank, cfg.num_heads
+    B, T = q.shape[:2]
+    M = ckv.shape[1]
+    dt = q.dtype
+    w_kv = lp["wkv_b"].astype(dt).reshape(kvr, N, dn + dv)
+    w_uk, w_uv = w_kv[..., :dn], w_kv[..., dn:]          # [kvr, N, dn/dv]
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    # absorb: q ↦ latent space (per head)
+    q_lat = jnp.einsum("btnd,knd->btnk", q_nope, w_uk)   # [B,T,N,kvr]
+    scale = scale_mult / math.sqrt(dn + dr)
+    scores = (jnp.einsum("btnk,bmk->bntm", q_lat, ckv)
+              + jnp.einsum("btnr,bmr->bntm", q_pe, kpe)
+              ).astype(jnp.float32) * scale
+    mask = jnp.arange(M)[None, None, None, :] <= positions[:, None, :, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    out_lat = jnp.einsum("bntm,bmk->btnk", probs, ckv)   # [B,T,N,kvr]
+    return jnp.einsum("btnk,knd->btnd", out_lat, w_uv)   # [B,T,N,dv]
+
+
 def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig,
                    cos: Optional[jax.Array], sin: Optional[jax.Array],
                    attention_fn: AttentionFn) -> Tuple[jax.Array, jax.Array]:
@@ -1026,19 +1059,27 @@ def forward_decode(params: PyTree, tokens: jax.Array,
 
         if cfg.mla:
             # kc holds c_kv [B,M,1,kvr]; vc holds the post-rope shared key
-            # [B,M,1,dr]. Per step: write the new latents, re-expand k/v for
-            # the whole window from the latent (naive MLA decode; the
-            # weight-absorbed variant is a further optimization).
+            # [B,M,1,dr]. Write the new latents, then: DECODE (T==1) runs
+            # WEIGHT-ABSORBED attention directly on the latent cache (W_uk
+            # folded into q, W_uv into the output — the per-step k/v
+            # re-expansion never happens); PREFILL (T>1) expands once and
+            # attends normally — absorbed scores cost O(T·M·N·kvr) which
+            # loses to the one-time O(M) expansion for long prompts.
             rope_fn = lambda t: apply_rope_at(t, cos_t, sin_t, positions)
             qf = _mla_q(h, lp, cfg, rope_fn)
             c_kv, k_pe = _mla_latents(h, lp, cfg, rope_fn)
             kc = jax.vmap(write)(kc, c_kv[:, :, None, :].astype(kc.dtype), pos)
             vc = jax.vmap(write)(vc, k_pe.astype(vc.dtype), pos)
-            k_full, v_full = _mla_expand(
-                kc[:, :, 0].astype(dt), vc.astype(dt), lp, cfg)
-            if cfg.mla_scale_mult != 1.0:
-                qf = qf * jnp.asarray(cfg.mla_scale_mult, qf.dtype)
-            attn = cached_attention(qf, k_full, v_full, positions)
+            if T == 1:
+                attn = _mla_absorbed_attention(
+                    qf, kc[:, :, 0].astype(dt), vc[:, :, 0].astype(dt), lp,
+                    cfg, positions, cfg.mla_scale_mult)
+            else:
+                k_full, v_full = _mla_expand(
+                    kc[:, :, 0].astype(dt), vc.astype(dt), lp, cfg)
+                if cfg.mla_scale_mult != 1.0:
+                    qf = qf * jnp.asarray(cfg.mla_scale_mult, qf.dtype)
+                attn = cached_attention(qf, k_full, v_full, positions)
             attn = attn.reshape(B, T, cfg.num_heads * cfg.v_head_dim)
             x = x + attn @ lp["wo"].astype(dt)
             h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)

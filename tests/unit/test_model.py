@@ -122,3 +122,29 @@ class TestComputeVariants:
         assert float(ls) == float(lf)
         for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gf)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestMLAAbsorbedDecode:
+    def test_absorbed_equals_expanded(self):
+        """Weight-absorbed latent attention == naive expand-then-attend
+        (the DeepSeek inference identity: W_uk into q, W_uv into out)."""
+        cfg = T.TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            mla=True, q_lora_rank=16, kv_lora_rank=8, qk_nope_head_dim=8,
+            qk_rope_head_dim=4, v_head_dim=8, pos_emb="rope",
+            norm="rmsnorm", activation="swiglu", use_bias=False,
+            dtype="float32", max_seq_len=32)
+        p = T.init_params(cfg, jax.random.PRNGKey(0))
+        lp = jax.tree.map(lambda x: x[0], p["blocks"])
+        B, Tq, M = 2, 3, 16
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (B, Tq, 2, 8 + 4))
+        ckv = jax.random.normal(ks[1], (B, M, 8))
+        kpe = jax.random.normal(ks[2], (B, M, 4))
+        positions = jnp.array([[4, 5, 6], [9, 10, 11]], jnp.int32)
+
+        got = T._mla_absorbed_attention(q, ckv, kpe, lp, cfg, positions, 1.0)
+        k_full, v_full = T._mla_expand(ckv, kpe[:, :, None, :], lp, cfg)
+        want = T.cached_attention(q, k_full, v_full, positions)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

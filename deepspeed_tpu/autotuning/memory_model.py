@@ -103,10 +103,12 @@ def activation_bytes_per_token(info: ModelInfo, remat: str,
 
     * ``none``      — every intermediate: norms, qkv, attn out, proj, ffn pre/post
     * ``dots_saveable`` — matmul outputs only (XLA recomputes elementwise)
+    * ``selective`` — only the named attn_out (h) + ffn_act (f) saves, plus
+      the layer-boundary carries
     * ``full`` / ``save_nothing`` — layer-boundary carries only, one layer
       recomputed at a time during backward
-    * ``offload_dots`` — like dots_saveable but residuals live on host: only
-      the double-buffered transfer window stays in HBM (~2 layers)
+    * ``offload_dots`` — the selective saves live on pinned host; HBM keeps
+      the boundary carries + a double-buffered transfer window
     """
     h, f, L = info.hidden_size, info.ffn_size, info.num_layers
     if h == 0:          # unknown architecture: fall back to a linear-in-params guess
@@ -114,12 +116,17 @@ def activation_bytes_per_token(info: ModelInfo, remat: str,
     ffn_mats = 3 if info.activation == "swiglu" else 2
     per_layer_full = (8 * h + ffn_mats * f)          # all intermediates
     per_layer_dots = (6 * h + (ffn_mats - 1) * f)    # matmul outputs
+    per_layer_sel = (h + f)                          # named attn_out + ffn_act
     if remat in ("full", "save_nothing"):
         elems = L * h + per_layer_full               # boundaries + 1 recompute
     elif remat == "dots_saveable":
         elems = L * per_layer_dots + per_layer_full
+    elif remat == "selective":
+        elems = L * (h + per_layer_sel) + per_layer_full
     elif remat == "offload_dots":
-        elems = 2 * per_layer_dots + per_layer_full  # transfer window
+        # selective saves live on pinned host; HBM holds the double-buffered
+        # transfer window + one layer's recompute
+        elems = L * h + 2 * per_layer_sel + per_layer_full
     else:                                            # "none"
         elems = L * per_layer_full
     return elems * bytes_per_el

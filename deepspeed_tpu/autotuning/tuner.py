@@ -130,8 +130,8 @@ class RandomTuner(GridSearchTuner):
 
 def _featurize(cand: Candidate) -> List[float]:
     """Numeric feature vector for the cost model."""
-    remat_ord = {"none": 0.0, "dots_saveable": 1.0, "offload_dots": 2.0,
-                 "full": 3.0, "save_nothing": 3.0}
+    remat_ord = {"none": 0.0, "dots_saveable": 1.0, "selective": 1.5,
+                 "offload_dots": 2.0, "full": 3.0, "save_nothing": 3.0}
     return [
         1.0,
         float(np.log2(max(1, cand.get("micro_batch", 1)))),

@@ -209,3 +209,18 @@ class TestAutotunerEndToEnd:
         assert best.throughput > 0
         assert best.estimated_hbm is not None and best.estimated_hbm < GiB
         assert len(tuner.results) <= 2
+
+
+class TestSelectiveRematEstimates:
+    def test_policy_ordering(self):
+        """Activation residency must order: none > dots_saveable >
+        selective > full; offload_dots below selective (host-resident)."""
+        info = llama7b_info()
+
+        def act(remat):
+            return estimate(info, zero_stage=3, dp_shards=64, micro_batch=1,
+                            remat=remat).activation_bytes
+
+        assert act("none") > act("dots_saveable") > act("selective") \
+            > act("full")
+        assert act("offload_dots") < act("selective")

@@ -40,7 +40,7 @@ AttentionFn = Callable[..., jax.Array]
 # Storage per token per layer ≈ (heads·D + ffn) · 2 bytes, far below "none";
 # recompute far below "full".
 _SELECTIVE_POLICY = jax.checkpoint_policies.save_only_these_names(
-    "attn_out", "ffn_act")
+    "attn_out", "ffn_act", "moe_gate")
 
 
 def _remat_wrap(body, remat: str):
@@ -58,7 +58,7 @@ def _remat_wrap(body, remat: str):
         # ActivationCheckpointingConfig.policy="offload_dots": the selective
         # saves live in pinned host memory instead of HBM
         policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[],
+            names_which_can_be_saved=["moe_gate"],  # tiny dispatch indices
             names_which_can_be_offloaded=["attn_out", "ffn_act"],
             offload_src="device", offload_dst="pinned_host")
         return jax.checkpoint(body, policy=policy)
@@ -103,6 +103,7 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_min_capacity: int = 4
     moe_aux_coef: float = 0.01
+    moe_dispatch: str = "auto"  # auto | ragged (dropless) | dense (GShard)
     # MoE routing/arch variants (AutoEP presets: mixtral/qwen-moe/deepseek)
     moe_ffn_size: Optional[int] = None  # routed-expert intermediate (≠ dense ffn)
     moe_shared_size: int = 0            # shared-expert intermediate; 0 = none
@@ -833,7 +834,7 @@ def _ffn(h: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfig
             score_func=cfg.moe_score_func, route_norm=cfg.moe_route_norm,
             route_scale=cfg.moe_route_scale, shared=shared or None,
             gate_bias=lp.get("gate_bias"), n_group=cfg.moe_n_group,
-            topk_group=cfg.moe_topk_group)
+            topk_group=cfg.moe_topk_group, dispatch=cfg.moe_dispatch)
     else:
         up = h @ lp["w_up"].astype(dt)
         if cfg.use_bias:

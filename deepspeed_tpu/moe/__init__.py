@@ -2,33 +2,46 @@
 
 * :mod:`gating` — top-1/2/k gates with capacity + load-balancing loss
   (``sharded_moe.py:184,291,375``).
-* :mod:`layer` — dense dispatch/combine einsums; the 'expert' mesh axis plays
-  the role of the reference's expert-parallel process groups
-  (``utils/groups.py:304``), with GSPMD emitting the dispatch all-to-all
-  (``sharded_moe.py:97 _AllToAll``).
+* :mod:`layer` — dropless ragged dispatch (sort + ``lax.ragged_dot`` grouped
+  matmul, fixed-capacity all-to-all under expert parallelism) with the dense
+  GShard dispatch/combine einsums as the reference-parity fallback; the
+  'expert' mesh axis plays the role of the reference's expert-parallel
+  process groups (``utils/groups.py:304``, ``sharded_moe.py:97 _AllToAll``).
 
 Model integration: set ``n_experts > 0`` on a ``TransformerConfig`` (e.g. the
 ``tiny_moe`` / ``mixtral_8x7b`` presets).
 """
 from deepspeed_tpu.moe.gating import (
     GateOutput,
+    IndexGateOutput,
     gate_capacity,
     top1_gating,
     top2_gating,
     topk_gating,
+    topk_gating_indices,
 )
-from deepspeed_tpu.moe.layer import moe_ffn
+from deepspeed_tpu.moe.layer import (
+    ep_shard_capacity,
+    moe_ffn,
+    ragged_expert_ffn,
+    resolve_dispatch,
+)
 from deepspeed_tpu.moe.presets import (EPTopology, MoEPreset, PRESETS,
                                        ep_topology, fold_group_tables,
                                        preset_for_model_type, resolve_preset)
 
 __all__ = [
     "GateOutput",
+    "IndexGateOutput",
     "gate_capacity",
     "top1_gating",
     "top2_gating",
     "topk_gating",
+    "topk_gating_indices",
     "moe_ffn",
+    "ragged_expert_ffn",
+    "ep_shard_capacity",
+    "resolve_dispatch",
     "MoEPreset",
     "PRESETS",
     "EPTopology",

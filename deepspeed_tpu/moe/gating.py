@@ -30,6 +30,15 @@ class GateOutput(NamedTuple):
     counts: jax.Array     # [E] int32 — tokens routed per expert (pre-capacity)
 
 
+class IndexGateOutput(NamedTuple):
+    """Index-form gate for the dropless (sort + ragged matmul) dispatch —
+    no [T,E,C] one-hot tensors, just who-goes-where and with what weight."""
+    weights: jax.Array    # [T, k] fp32 — combine weights per choice
+    experts: jax.Array    # [T, k] int32 — selected expert per choice
+    aux_loss: jax.Array   # scalar fp32 — load-balancing loss
+    probs: jax.Array      # [T, E] fp32 — gate probabilities
+
+
 def gate_capacity(num_tokens: int, num_experts: int, k: int,
                   capacity_factor: float, min_capacity: int = 4) -> int:
     cap = int(math.ceil(num_tokens * k * capacity_factor / num_experts))
@@ -47,6 +56,88 @@ def _group_limited_mask(sel: jax.Array, n_group: int, topk_group: int
     thresh = jax.lax.top_k(group_scores, topk_group)[0][:, -1:]     # [T, 1]
     group_mask = (group_scores >= thresh).astype(sel.dtype)         # [T, G]
     return (g * group_mask[:, :, None]).reshape(T, E)
+
+
+def _gate_scores(logits: jax.Array, score_func: str,
+                 select_bias: Optional[jax.Array], n_group: int,
+                 topk_group: int, rng: Optional[jax.Array],
+                 noise_std: float) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared gate math → (gate_source [T,E], probs [T,E], sel_logits [T,E]).
+
+    ``gate_source`` feeds combine weights; ``sel_logits`` feeds SELECTION only
+    (bias / group limitation / noise never leak into combine weights)."""
+    logits = logits.astype(jnp.float32)
+    if score_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+        probs = scores / jnp.maximum(
+            jnp.sum(scores, axis=-1, keepdims=True), 1e-9)
+        gate_source = scores
+    elif score_func == "softmax":
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_source = probs
+    else:
+        raise ValueError(f"score_func must be softmax|sigmoid, got {score_func!r}")
+    sel_logits = logits
+    if select_bias is not None or n_group > 1:
+        sel = gate_source
+        if select_bias is not None:
+            sel = sel + select_bias.astype(jnp.float32)[None, :]
+        if n_group > 1:
+            sel = _group_limited_mask(sel, n_group, topk_group)
+        sel_logits = sel
+    if noise_std > 0.0 and rng is not None:
+        # reference top1gating noisy_gate_policy='RSample' analog
+        sel_logits = sel_logits + jax.random.normal(rng, logits.shape) * noise_std
+    return gate_source, probs, sel_logits
+
+
+def _iter_topk(sel_logits: jax.Array, gate_source: jax.Array, k: int):
+    """Iterative argmax top-k (k small + static — unrolled).
+    Returns (gates_list: k×[T], idx_list: k×[T] int32, masks: k×[T,E])."""
+    masked = sel_logits
+    gates_list, idx_list, masks = [], [], []
+    E = sel_logits.shape[-1]
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                    # [T]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [T, E]
+        gates_list.append(jnp.sum(gate_source * mask, axis=-1))  # [T]
+        idx_list.append(idx.astype(jnp.int32))
+        masks.append(mask)
+        masked = jnp.where(mask.astype(bool), -jnp.inf, masked)
+    return gates_list, idx_list, masks
+
+
+def _aux_loss(probs: jax.Array, mask1: jax.Array) -> jax.Array:
+    """Switch/GShard l_aux over the FIRST choice (reference :269)."""
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    return jnp.sum(me * ce) * probs.shape[-1]
+
+
+def topk_gating_indices(logits: jax.Array, k: int = 2,
+                        rng: Optional[jax.Array] = None,
+                        noise_std: float = 0.0,
+                        normalize: bool = True,
+                        score_func: str = "softmax",
+                        select_bias: Optional[jax.Array] = None,
+                        n_group: int = 1, topk_group: int = 1
+                        ) -> IndexGateOutput:
+    """Index-form top-k gate for DROPLESS dispatch — identical selection math
+    to :func:`topk_gating` but no capacity and no [T,E,C] tensors.
+
+    Since nothing is dropped, ``normalize`` renormalizes the k selected scores
+    directly (same value the dense path produces when capacity is generous).
+    """
+    gate_source, probs, sel_logits = _gate_scores(
+        logits, score_func, select_bias, n_group, topk_group, rng, noise_std)
+    gates_list, idx_list, masks = _iter_topk(sel_logits, gate_source, k)
+    aux = _aux_loss(probs, masks[0])
+    gates = jnp.stack(gates_list, axis=1)                    # [T, k]
+    experts = jnp.stack(idx_list, axis=1)                    # [T, k]
+    if normalize:
+        gates = gates / jnp.maximum(
+            jnp.sum(gates, axis=1, keepdims=True), 1e-9)
+    return IndexGateOutput(gates, experts, aux, probs)
 
 
 def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
@@ -70,50 +161,14 @@ def topk_gating(logits: jax.Array, k: int = 2, capacity_factor: float = 1.25,
     the best groups).
     """
     T, E = logits.shape
-    logits = logits.astype(jnp.float32)
-    if score_func == "sigmoid":
-        scores = jax.nn.sigmoid(logits)
-        probs = scores / jnp.maximum(
-            jnp.sum(scores, axis=-1, keepdims=True), 1e-9)
-        gate_source = scores
-    elif score_func == "softmax":
-        probs = jax.nn.softmax(logits, axis=-1)
-        gate_source = probs
-    else:
-        raise ValueError(f"score_func must be softmax|sigmoid, got {score_func!r}")
     C = gate_capacity(T, E, k, capacity_factor, min_capacity)
-
-    # SELECTION scores: gate_source (+ bias) (+ group limitation); combine
-    # weights always come from the unbiased gate_source
-    sel_logits = logits
-    if select_bias is not None or n_group > 1:
-        sel = gate_source
-        if select_bias is not None:
-            sel = sel + select_bias.astype(jnp.float32)[None, :]
-        if n_group > 1:
-            sel = _group_limited_mask(sel, n_group, topk_group)
-        sel_logits = sel
-    if noise_std > 0.0 and rng is not None:
-        # reference top1gating noisy_gate_policy='RSample' analog
-        sel_logits = sel_logits + jax.random.normal(rng, logits.shape) * noise_std
+    gate_source, probs, sel_logits = _gate_scores(
+        logits, score_func, select_bias, n_group, topk_group, rng, noise_std)
 
     combine = jnp.zeros((T, E, C), jnp.float32)
     counts_total = jnp.zeros((E,), jnp.int32)
-    masked = sel_logits
-    gates_list = []
-    masks = []
-    # iterative argmax selection (k is small and static — unrolled)
-    for _ in range(k):
-        idx = jnp.argmax(masked, axis=-1)                    # [T]
-        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [T, E]
-        gates_list.append(jnp.sum(gate_source * mask, axis=-1))  # [T]
-        masks.append(mask)
-        masked = jnp.where(mask.astype(bool), -jnp.inf, masked)
-
-    # aux loss over first-choice assignment (reference :269)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(masks[0], axis=0)
-    aux = jnp.sum(me * ce) * E
+    gates_list, idx_list, masks = _iter_topk(sel_logits, gate_source, k)
+    aux = _aux_loss(probs, masks[0])
 
     # capacity assignment in choice-priority order (1st choices fill first)
     denom = jnp.zeros((T,), jnp.float32)

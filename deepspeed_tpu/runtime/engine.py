@@ -632,8 +632,9 @@ class DeepSpeedTPUEngine:
         grads_sum, losses = jax.lax.scan(micro, zeros, batch)
         return grads_sum, jnp.mean(losses)
 
-    def _build_train_step(self, gas: int):
-        """Fused step: scan grad accumulation over [gas, ...] batch inside jit."""
+    def _train_step_fn(self, gas: int):
+        """The raw (unjitted) fused-step body — shared by the single-step
+        jit and the multi-step ``lax.scan`` wrapper."""
 
         def train_step(state, batch):
             scale = state["scaler"].scale if self.fp16_enabled else None
@@ -654,11 +655,33 @@ class DeepSpeedTPUEngine:
             metrics["loss"] = mean_loss
             return new_state, metrics
 
+        return train_step
+
+    def _build_train_step(self, gas: int):
+        """Fused step: scan grad accumulation over [gas, ...] batch inside jit."""
         state_sh = self._state_shardings()
         # batch shardings are committed on the inputs by _shard_batch; jit honors
         # them without an explicit in_shardings entry.
-        return jax.jit(train_step,
+        return jax.jit(self._train_step_fn(gas),
                        out_shardings=(state_sh, None),
+                       donate_argnums=(0,))
+
+    def _build_train_multi(self, gas: int, n_steps: int):
+        """``n_steps`` fused steps in ONE dispatch: ``lax.scan`` over the
+        step body on a [n_steps, gas, ...] batch. On TPU each dispatch pays
+        host-side latency (dispatch gaps; two orders worse through a remote
+        tunnel) — pipelining steps device-side removes it. The LR schedule
+        advances inside the scan via ``state['step']``."""
+        step = self._train_step_fn(gas)
+
+        def multi(state, batches):
+            state, ms = jax.lax.scan(step, state, batches)
+            metrics = jax.tree.map(lambda x: x[-1], ms)
+            metrics["loss"] = jnp.mean(ms["loss"])
+            return state, metrics
+
+        state_sh = self._state_shardings()
+        return jax.jit(multi, out_shardings=(state_sh, None),
                        donate_argnums=(0,))
 
     # ------------------------------------------------------------------ #
@@ -834,16 +857,20 @@ class DeepSpeedTPUEngine:
         return jax.jit(train_step, out_shardings=(state_sh, None),
                        donate_argnums=(0,))
 
-    def _batch_shardings(self, leading: bool = False):
+    def _batch_shardings(self, leading: int = 0):
+        """``leading`` counts unsharded leading dims (1 = [gas, ...],
+        2 = [n_steps, gas, ...] for the fused multi-step path)."""
+        n = int(leading)
+
         def spec_for(ndim: int) -> NamedSharding:
-            if leading:
-                inner = self.policy.batch_spec(ndim - 1)
-                return NamedSharding(self.mesh, P(None, *inner))
+            if n:
+                inner = self.policy.batch_spec(ndim - n)
+                return NamedSharding(self.mesh, P(*([None] * n), *inner))
             return NamedSharding(self.mesh, self.policy.batch_spec(ndim))
 
         return spec_for
 
-    def _shard_batch(self, batch: PyTree, leading: bool = False) -> PyTree:
+    def _shard_batch(self, batch: PyTree, leading: int = 0) -> PyTree:
         spec_for = self._batch_shardings(leading)
         rep = NamedSharding(self.mesh, P())
 
@@ -947,11 +974,8 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     # fused train path
     # ------------------------------------------------------------------ #
-    def train_batch(self, data_iter: Iterator[PyTree]) -> jax.Array:
-        """Pull GAS micro-batches, run the fused jitted step. Returns mean loss."""
-        gas = self.gradient_accumulation_steps()
-        micros = [next(data_iter) for _ in range(gas)]
-
+    @staticmethod
+    def _stack_micros(micros: list) -> PyTree:
         def stack(*xs):
             arrs = [np.asarray(x) for x in xs]
             if len({a.shape for a in arrs}) > 1:
@@ -961,8 +985,17 @@ class DeepSpeedTPUEngine:
                     "budget batching requires gradient_accumulation_steps=1")
             return np.stack(arrs)
 
-        stacked = jax.tree.map(stack, *micros)
+        return jax.tree.map(stack, *micros)
+
+    def train_batch(self, data_iter: Iterator[PyTree]) -> jax.Array:
+        """Pull GAS micro-batches, run the fused jitted step. Returns mean loss."""
+        gas = self.gradient_accumulation_steps()
+        stacked = self._stack_micros([next(data_iter) for _ in range(gas)])
         stacked = self._inject_data_efficiency(stacked, gas)
+        return self._dispatch_train_step(stacked, gas)
+
+    def _dispatch_train_step(self, stacked: PyTree, gas: int) -> jax.Array:
+        """Run ONE fused step on an already-stacked [gas, ...] window."""
 
         if self._host_runner is None:
             key = ("train_step", gas)
@@ -1001,8 +1034,56 @@ class DeepSpeedTPUEngine:
             self.timers.log([TRAIN_BATCH_TIMER])
         return metrics["loss"]
 
-    def _after_step(self, metrics: Dict[str, jax.Array]) -> None:
-        self.tput_timer.stop(global_step=True)
+    def train_batches(self, data_iter: Iterator[PyTree],
+                      n_steps: int) -> jax.Array:
+        """Run ``n_steps`` optimizer steps in ONE device dispatch.
+
+        A TPU dispatch pays fixed host latency (Python + runtime transport;
+        ~100 ms through a remote-tunnel runtime) regardless of step cost —
+        ``lax.scan`` over the fused step amortizes it to once per call.
+        Beyond the reference engine API (its ``train_batch`` is per-step);
+        falls back to a per-step loop for variants with host-side phases
+        (host-runner, 1-bit wire, compressed collectives, offload swappers).
+        Returns the mean loss over the ``n_steps`` steps.
+        """
+        if n_steps <= 1:
+            return self.train_batch(data_iter)
+        if (self._host_runner is not None or self._onebit_wire
+                or self._compressed or self._offload_opt
+                or self._offload_nvme or self._ltd is not None
+                or self._pld is not None or self._curriculum is not None):
+            # host-side per-step phases (or step-indexed host schedules):
+            # the per-step path keeps their semantics exact
+            losses = [self.train_batch(data_iter) for _ in range(n_steps)]
+            return jnp.mean(jnp.stack(losses))  # same mean-loss contract
+        gas = self.gradient_accumulation_steps()
+        steps = []
+        for _ in range(n_steps):
+            stacked = self._stack_micros(
+                [next(data_iter) for _ in range(gas)])
+            steps.append(self._inject_data_efficiency(stacked, gas))
+        try:
+            big = jax.tree.map(lambda *xs: np.stack(xs), *steps)
+        except ValueError:
+            # variable shapes across steps (token-budget batching at gas=1):
+            # run the already-built windows through the per-step path
+            losses = [self._dispatch_train_step(s, gas) for s in steps]
+            return jnp.mean(jnp.stack(losses))
+        key = ("train_multi", gas, n_steps)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_train_multi(gas, n_steps)
+        batch = self._shard_batch(big, leading=2)
+        self.tput_timer.start()
+        with self.mesh:
+            self.state, metrics = self._compiled[key](self.state, batch)
+        self.global_steps += n_steps
+        self.micro_steps += gas * n_steps
+        self._after_step(metrics, n_steps=n_steps)
+        return metrics["loss"]
+
+    def _after_step(self, metrics: Dict[str, jax.Array],
+                    n_steps: int = 1) -> None:
+        self.tput_timer.stop(global_step=True, steps=n_steps)
         self._last_metrics_dev = metrics  # lazy: no host sync off the print path
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)

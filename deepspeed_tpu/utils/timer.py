@@ -150,9 +150,14 @@ class ThroughputTimer:
     def update_epoch_count(self) -> None:
         self.local_step_count = 0
 
-    def _should_report(self) -> bool:
-        return bool(self.steps_per_output) and \
-            self.global_step_count % self.steps_per_output == 0
+    def _should_report(self, steps: int = 1) -> bool:
+        """True when the last ``steps`` increment crossed a report boundary
+        (a fused multi-step stop may jump OVER the exact multiple)."""
+        spo = self.steps_per_output
+        if not spo:
+            return False
+        return (self.global_step_count // spo) > \
+            ((self.global_step_count - steps) // spo)
 
     def start(self) -> None:
         self.started = True
@@ -161,17 +166,20 @@ class ThroughputTimer:
             self._window_start = time.perf_counter()
             self._window_steps = 0
 
-    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+    def stop(self, global_step: bool = True, report_speed: bool = True,
+             steps: int = 1) -> None:
+        """``steps`` > 1 credits one fused multi-step dispatch
+        (engine.train_batches) with all the optimizer steps it ran."""
         if not self.started:
             return
         self.started = False
-        self.local_step_count += 1
+        self.local_step_count += steps
         if global_step:
-            self.global_step_count += 1
+            self.global_step_count += steps
         if self._window_start is None or not global_step:
             return
-        self._window_steps += 1
-        if self._should_report():
+        self._window_steps += steps
+        if self._should_report(steps):
             duration, steps = self._close_window()
             if report_speed and steps:
                 self.logging(

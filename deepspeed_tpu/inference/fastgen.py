@@ -127,6 +127,13 @@ class FastGenEngine:
             use_pallas_kernel = jax.default_backend() == "tpu"
         self._use_kernel = use_pallas_kernel
 
+    def _mb_tier(self, mb_need: int) -> int:
+        """Two table-width tiers — ONE rule for every compile-cache key
+        (step / decode-scan / planned-serve must agree or the small-grid
+        property of the caches breaks)."""
+        quarter = max(2, self.max_blocks_per_seq // 4)
+        return quarter if mb_need <= quarter else self.max_blocks_per_seq
+
     def _bucket(self, need: int) -> int:
         """Two tick-size tiers (small for decode-heavy ticks, full budget
         otherwise) — each tier is one compiled program; admission
@@ -152,6 +159,126 @@ class FastGenEngine:
             return sampled, pool
 
         return jax.jit(tick, donate_argnums=(1,))
+
+    def _build_decode_scan(self, n_ticks: int):
+        """``n_ticks`` pure-decode ticks in ONE dispatch.
+
+        Per-dispatch host latency (~100 ms through a remote-tunnel runtime,
+        ~ms locally) dwarfs a decode tick's device time, so the tick-per-
+        dispatch loop serializes at host speed — the round-trip the round-2
+        profile flagged. Decode growth is deterministic (one token/seq/tick)
+        so the host pre-allocates KV blocks for all ``n_ticks`` and the
+        whole loop — forward, paged KV writes, SAMPLING — runs on device in
+        a ``lax.scan``; one bulk [n, B] token fetch replaces n round trips.
+        Reference bar: ``inference/v2/engine_v2.py:107-242`` (whose CUDA
+        host loop is cheap per step; on TPU the scan is the idiomatic
+        equivalent).
+        """
+        cfg = self.cfg
+        if self._use_kernel:
+            from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
+            attn = paged_attention
+        else:
+            attn = PG.paged_attention_reference
+
+        def decode_n(params, pool, tokens, positions, tables, rng):
+            def body(carry, _):
+                pool, toks, pos, rng = carry
+                rng, sub = jax.random.split(rng)
+                logits, pool = PG.forward_paged(
+                    params, toks, pos, tables, pool, cfg, attention_fn=attn)
+                sampled = sample_logits(
+                    logits, sub, self.temperature, self.top_k,
+                    self.top_p).astype(jnp.int32)
+                return (pool, sampled, pos + 1, rng), sampled
+
+            (pool, _, _, _), out = jax.lax.scan(
+                body, (pool, tokens, positions, rng), None, length=n_ticks)
+            return out, pool                         # out [n_ticks, B]
+
+        return jax.jit(decode_n, donate_argnums=(1,))
+
+    def _blocks_needed(self, seq: _Seq, upto_pos: int) -> int:
+        return max(0, upto_pos // self.block_size + 1 - len(seq.blocks))
+
+    #: fused-decode scan lengths — a FIXED short ladder so the compile
+    #: cache stays a small grid however max_new/EOS shrink the remaining work
+    DECODE_TIERS = (64, 32, 8)
+
+    def decode_steps(self, max_ticks: int,
+                     allow_overshoot: bool = False) -> Dict[int, List[int]]:
+        """Fused multi-tick decode for an all-decode state. Returns
+        {uid: [tokens]} (EOS/max-len trimmed). Returns {} — caller falls
+        back to :meth:`step` — when any live sequence still needs prefill
+        or the pool/length headroom allows no ladder rung.
+
+        ``allow_overshoot``: run the smallest ladder rung even when it
+        exceeds ``max_ticks`` — callers with a fixed total budget
+        (generate_all) trim the extras; servers keeping admission latency
+        bounded leave it False.
+        """
+        live = [self.seqs[u] for u in self._admit_order
+                if u in self.seqs and not self.seqs[u].done]
+        if not live or any(s.prefill_remaining > 0 or s.last_tok is None
+                           for s in live):
+            return {}
+        if max_ticks < 1:
+            return {}
+        headroom = min(self.max_len - 1 - s.pos for s in live)
+        cap = max_ticks if not allow_overshoot else \
+            max(max_ticks, self.DECODE_TIERS[-1])
+        cap = min(cap, headroom)
+        n = 0
+        for tier in self.DECODE_TIERS:
+            if tier <= cap and sum(
+                    self._blocks_needed(s, s.pos + tier - 1)
+                    for s in live) <= self.allocator.free_blocks:
+                n = tier
+                break
+        if n < 1:
+            return {}
+        for s in live:
+            self._ensure_blocks(s, s.pos + n - 1)
+
+        B = len(live)
+        Bt = 4
+        while Bt < B:
+            Bt *= 2
+        tokens = np.zeros((Bt,), np.int32)
+        positions = np.zeros((Bt,), np.int32)
+        tables = np.zeros((Bt, self.max_blocks_per_seq), np.int32)
+        for i, s in enumerate(live):
+            tokens[i] = s.last_tok
+            positions[i] = s.pos
+            tables[i] = s.table                     # pad rows → trash block 0
+
+        mb_need = (max(s.pos for s in live) + n - 1) // self.block_size + 1
+        mb = self._mb_tier(mb_need)
+
+        key = ("dec", Bt, n, mb)
+        if key not in self._ticks:
+            self._ticks[key] = self._build_decode_scan(n)
+        self._rng, sub = jax.random.split(self._rng)
+        out, self.pool = self._ticks[key](
+            self.params, self.pool, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(tables[:, :mb]), sub)
+        out = np.asarray(jax.device_get(out))       # [n, Bt]
+
+        result: Dict[int, List[int]] = {}
+        for i, s in enumerate(live):
+            got: List[int] = []
+            for t in range(n):
+                tok = int(out[t, i])
+                s.pos += 1          # this tick's input token entered the cache
+                s.last_tok = tok
+                before = len(s.generated)
+                self._note_token(s, tok)
+                if len(s.generated) > before:
+                    got.append(tok)
+                if s.done:
+                    break           # post-EOS rows are garbage — discard
+            result[s.uid] = got
+        return result
 
     # ------------------------------------------------------------------ #
     def can_schedule(self) -> bool:
@@ -258,8 +385,7 @@ class FastGenEngine:
         # a compiled program): short-context ticks gather/walk a quarter of
         # max_blocks_per_seq, long ones the full table
         mb_need = int(positions[:row].max()) // self.block_size + 1
-        quarter = max(2, self.max_blocks_per_seq // 4)
-        mb = quarter if mb_need <= quarter else self.max_blocks_per_seq
+        mb = self._mb_tier(mb_need)
 
         key = (Tn, mb)
         if key not in self._ticks:
@@ -311,22 +437,271 @@ class FastGenEngine:
                 if uid in self._admit_order:
                     self._admit_order.remove(uid)
 
-    def generate_all(self, uids, prompts, max_new_tokens: int = 32):
-        """Convenience driver: put + step until everyone has max_new tokens."""
+    # ------------------------------------------------------------------ #
+    # planned (offline) serving — the whole SplitFuse schedule in ONE scan
+    # ------------------------------------------------------------------ #
+    def _plan_schedule(self, max_new_tokens: int,
+                       until_prefilled: bool = True):
+        """Precompute SplitFuse ticks for the CURRENT admission set.
+
+        ``until_prefilled`` stops the plan once no live sequence still has
+        prompt tokens to write — mixed ticks (interleaved decode rows of
+        early-finished prompts) are planned at full width, but the pure-
+        decode phase is left to the decode-scan tiers whose ticks are
+        live-sequences wide instead of token-budget wide (a 256-row pad per
+        16-row decode tick would waste the fused dispatch's win).
+
+        With admissions fixed, the scheduler is deterministic: prefill
+        chunking, block growth, and decode row placement depend only on
+        prompt lengths — never on the sampled values (EOS can't stop a
+        planned serve early; extras are trimmed host-side). Each planned
+        tick is (tokens [T] with -1 ⇒ "read the carry's last sampled token
+        for this slot", slots [T], positions [T], tables [T, MB], heads
+        [T] bool). Mutates real seq/allocator state — the device executes
+        exactly this plan. Returns None when the pool can't cover the full
+        plan (caller falls back to the dynamic tick loop's backpressure).
+        """
+        order = [u for u in self._admit_order
+                 if u in self.seqs and not self.seqs[u].done]
+        slot_of = {u: i for i, u in enumerate(order)}
+        ticks = []
+        planned_gen = {u: len(self.seqs[u].generated) for u in order}
+        guard = 0
+        while True:
+            live = [self.seqs[u] for u in order
+                    if not self.seqs[u].done
+                    and planned_gen[self.seqs[u].uid] < max_new_tokens]
+            if not live:
+                break
+            if until_prefilled and all(s.prefill_remaining == 0
+                                       for s in live):
+                break
+            guard += 1
+            if guard > 8 * max_new_tokens + sum(
+                    len(s.prompt) for s in live) // max(1, self.token_budget // 2):
+                return None  # defensive: schedule failed to converge
+            need = sum(1 for s in live if s.prefill_remaining == 0) \
+                + sum(s.prefill_remaining for s in live)
+            Tn = self._bucket(need)
+            tokens = np.full((Tn,), 0, np.int32)
+            kind = np.zeros((Tn,), np.int32)      # 1 ⇒ carry-fed decode row
+            slots = np.zeros((Tn,), np.int32)
+            positions = np.zeros((Tn,), np.int32)
+            tables = np.zeros((Tn, self.max_blocks_per_seq), np.int32)
+            heads = np.zeros((Tn,), bool)
+            row = 0
+            for s in live:                         # decode rows first
+                if s.prefill_remaining > 0 or row >= Tn:
+                    continue
+                if not self._ensure_blocks(s, s.pos):
+                    return None                    # pool can't cover the plan
+                kind[row] = 1
+                slots[row] = slot_of[s.uid]
+                positions[row] = s.pos
+                tables[row] = s.table
+                heads[row] = True
+                planned_gen[s.uid] += 1
+                s.pos += 1
+                if s.pos + 1 >= self.max_len:
+                    planned_gen[s.uid] = max_new_tokens  # hits max-len cap
+                row += 1
+            for s in live:                         # then prefill chunks
+                if s.prefill_remaining == 0 or row >= Tn:
+                    continue
+                chunk = min(s.prefill_remaining, Tn - row)
+                if not self._ensure_blocks(s, s.pos + chunk - 1):
+                    return None
+                lo = s.prefilled
+                tokens[row:row + chunk] = s.prompt[lo:lo + chunk]
+                slots[row:row + chunk] = slot_of[s.uid]
+                positions[row:row + chunk] = np.arange(s.pos, s.pos + chunk)
+                tables[row:row + chunk] = s.table
+                row += chunk
+                s.prefilled += chunk
+                s.pos += chunk
+                if s.prefill_remaining == 0:
+                    heads[row - 1] = True
+                    planned_gen[s.uid] += 1
+                    if s.pos + 1 >= self.max_len:
+                        # same max-len stop the dynamic path applies in
+                        # _note_token: the prefill head's token is the last
+                        planned_gen[s.uid] = max_new_tokens
+            if row == 0:
+                return None
+            ticks.append((tokens, kind, slots, positions, tables, heads))
+        return order, ticks
+
+    def _build_planned_fn(self):
+        # every shape is derived from the inputs; the cache key in
+        # serve_planned is what distinguishes compiled variants
+        cfg = self.cfg
+        if self._use_kernel:
+            from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
+            attn = paged_attention
+        else:
+            attn = PG.paged_attention_reference
+        def serve(params, pool, toks, kind, slots, positions, tables, heads,
+                  rng, last0):
+            def body(carry, tick):
+                pool, last, rng = carry
+                tok_s, kind_s, slot_s, pos_s, tab_s, head_s = tick
+                rng, sub = jax.random.split(rng)
+                inputs = jnp.where(kind_s == 1, last[slot_s], tok_s)
+                logits, pool = PG.forward_paged(
+                    params, inputs, pos_s, tab_s, pool, cfg,
+                    attention_fn=attn)
+                sampled = sample_logits(
+                    logits, sub, self.temperature, self.top_k,
+                    self.top_p).astype(jnp.int32)
+                # exactly one head row per sequence per tick writes back;
+                # non-head rows scatter to the OOB sentinel and are dropped
+                ns = last.shape[0]
+                idx = jnp.where(head_s, slot_s, ns)
+                last = last.at[idx].set(sampled, mode="drop")
+                return (pool, last, rng), sampled
+
+            (pool, _, _), out = jax.lax.scan(
+                body, (pool, last0, rng),
+                (toks, kind, slots, positions, tables, heads))
+            return out, pool
+
+        return jax.jit(serve, donate_argnums=(1,))
+
+    def serve_planned(self, max_new_tokens: int,
+                      until_prefilled: bool = True) -> bool:
+        """Run the precomputed SplitFuse schedule in ONE device dispatch
+        (a scan; by default the prefill/mixed phase — see _plan_schedule).
+
+        Returns False — with all host state rolled back — when the plan is
+        infeasible (pool too small for the full run); the caller then uses
+        the dynamic tick loop, whose per-tick backpressure handles it.
+        EOS can't cut a planned serve short: post-EOS samples are computed
+        and trimmed host-side (the pool holds every seq's full-length
+        blocks for the plan's duration — that's the memory-for-dispatches
+        trade the planner makes).
+        """
+        snap = {u: (s.prefilled, s.pos, list(s.blocks), s.table.copy(),
+                    list(s.generated), s.last_tok, s.done)
+                for u, s in self.seqs.items()}
+        free_snap = list(self.allocator._free)
+        plan = self._plan_schedule(max_new_tokens, until_prefilled)
+        if plan is None:
+            for u, st in snap.items():
+                s = self.seqs[u]
+                s.prefilled, s.pos = st[0], st[1]
+                s.blocks, s.table = st[2], st[3]
+                s.generated, s.last_tok, s.done = st[4], st[5], st[6]
+            self.allocator._free = free_snap
+            return False
+        order, ticks = plan
+        if not ticks:
+            return True
+        # pad the tick count to a pow2 tier and every tick to the same
+        # (Tn, mb) so the compile cache stays a small grid; pad rows/ticks
+        # write into trash block 0 like any pad
+        n = len(ticks)
+        n_pad = max(4, -(-n // 4) * 4)   # multiple of 4: ≤3 wasted pad
+        #                                  ticks (pow2 wasted up to n-1)
+        Tn = max(t[0].shape[0] for t in ticks)
+        max_pos = max(int(t[3].max()) for t in ticks)
+        mb_need = max_pos // self.block_size + 1
+        mb = self._mb_tier(mb_need)
+
+        def padded(j):
+            rows = [np.pad(t[j], [(0, Tn - t[j].shape[0])] +
+                           [(0, 0)] * (t[j].ndim - 1)) for t in ticks]
+            rows += [np.zeros_like(rows[0])] * (n_pad - n)
+            return np.stack(rows)
+
+        toks, kind, slots = padded(0), padded(1), padded(2)
+        positions, tables, heads = padded(3), padded(4)[:, :, :mb], padded(5)
+
+        ns = 4                                     # slot-count tier (pow2):
+        while ns < len(order):                     # admission count must not
+            ns *= 2                                # change the program shape
+        key = ("plan", n_pad, Tn, mb, ns)
+        if key not in self._ticks:
+            self._ticks[key] = self._build_planned_fn()
+        last0 = np.zeros((ns,), np.int32)
+        for i, u in enumerate(order):
+            if self.seqs[u].last_tok is not None:
+                last0[i] = self.seqs[u].last_tok
+        self._rng, sub = jax.random.split(self._rng)
+        out, self.pool = self._ticks[key](
+            self.params, self.pool, jnp.asarray(toks), jnp.asarray(kind),
+            jnp.asarray(slots), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(heads), sub, jnp.asarray(last0))
+        out = np.asarray(jax.device_get(out))      # [n_pad, Tn]
+
+        eos_hit = set()
+        for t, (_, _, slot_arr, _, _, head_arr) in enumerate(ticks):
+            for r in np.nonzero(head_arr)[0]:
+                u = order[int(slot_arr[r])]
+                s = self.seqs[u]
+                tok = int(out[t, r])
+                s.last_tok = tok
+                if u in eos_hit or s.done:
+                    continue
+                if self.eos_token_id is not None \
+                        and tok == self.eos_token_id:
+                    eos_hit.add(u)
+                    self._finish(s)
+                    continue
+                if len(s.generated) < max_new_tokens:
+                    s.generated.append(tok)
+        for u in order:                            # planner ran to max_new
+            s = self.seqs[u]
+            if not s.done and (len(s.generated) >= max_new_tokens
+                               or s.pos + 1 >= self.max_len):
+                self._finish(s)
+        return True
+
+    def generate_all(self, uids, prompts, max_new_tokens: int = 32,
+                     planned: Optional[bool] = None):
+        """Convenience driver: put + serve. A feasible plan runs the whole
+        workload in one dispatch (serve_planned); otherwise SplitFuse ticks
+        stream prefill and the fused decode scan covers pure-decode phases.
+
+        ``planned`` None → auto: planned serving pays per-token compute for
+        pad rows/ticks to eliminate per-tick dispatches — a win where
+        dispatch latency dominates (TPU, especially via a remote tunnel)
+        and where the Pallas kernel skips out-of-length blocks; the CPU
+        reference attention is rectangular, so dynamic ticks stay cheaper
+        there.
+        """
         self.put(uids, prompts)
+        if planned is None:
+            planned = self._use_kernel
+        if planned:
+            # best-effort fused prefill/mixed phase (rolls back if the pool
+            # can't cover it); the dynamic loop's fused decode tiers serve
+            # whatever remains either way
+            self.serve_planned(max_new_tokens)
+        self._generate_dynamic(uids, max_new_tokens)
+        out = {u: self.query(u)[1][:max_new_tokens] for u in uids}
+        self.flush(uids)
+        return out
+
+    def _generate_dynamic(self, uids, max_new_tokens: int) -> None:
         while True:
             for u in uids:
                 s = self.seqs.get(u)
                 if s and not s.done and len(s.generated) >= max_new_tokens:
                     self._finish(s)
-            if not any(u in self.seqs and not self.seqs[u].done
-                       for u in uids):
+            live = [self.seqs[u] for u in uids
+                    if u in self.seqs and not self.seqs[u].done]
+            if not live:
                 break
+            # max (not min) remaining: sequences that hit max_new mid-scan
+            # keep decoding into their own blocks and get trimmed at the
+            # loop top — fewer, larger fused dispatches win over exactness
+            remaining = max(max_new_tokens - len(s.generated) for s in live)
+            got = self.decode_steps(remaining, allow_overshoot=True) \
+                if remaining > 0 else {}
+            if got:
+                continue
             out = self.step()
             if not out and not any(
                     s.prefill_remaining > 0 and not s.done
                     for s in self.seqs.values()):
                 break  # stalled: no tokens and nothing left to prefill
-        out = {u: self.query(u)[1][:max_new_tokens] for u in uids}
-        self.flush(uids)
-        return out

@@ -455,9 +455,11 @@ class FastGenEngine:
         chunking, block growth, and decode row placement depend only on
         prompt lengths — never on the sampled values (EOS can't stop a
         planned serve early; extras are trimmed host-side). Each planned
-        tick is (tokens [T] with -1 ⇒ "read the carry's last sampled token
-        for this slot", slots [T], positions [T], tables [T, MB], heads
-        [T] bool). Mutates real seq/allocator state — the device executes
+        tick is (tokens [T] — prompt tokens; kind [T] — 1 marks a decode
+        row that reads the carry's last sampled token for its slot, its
+        tokens entry being ignored; slots [T]; positions [T]; tables
+        [T, MB]; heads [T] bool). Mutates real seq/allocator state — the
+        device executes
         exactly this plan. Returns None when the pool can't cover the full
         plan (caller falls back to the dynamic tick loop's backpressure).
         """

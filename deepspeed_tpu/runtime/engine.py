@@ -219,8 +219,48 @@ class DeepSpeedTPUEngine:
         self._offload_nvme = offload_dev == "nvme"
         self._opt_swapper = None   # built lazily (needs self.state)
 
+        # ZeRO-Infinity PARAMETER tier (reference swap_tensor/
+        # partitioned_param_swapper.py:37 AsyncPartitionedParameterSwapper +
+        # zero/offload_config.py:19-41): at stage 3 the fp32 master shards
+        # are PINNED-HOST resident — the jitted step's layer scan streams
+        # each layer's slice H2D on use and the update writes back to host,
+        # so HBM holds only the transient 16-bit working copies (verified
+        # via compiled memory_analysis: device argument bytes for the
+        # master drop to 0). The NVMe variant additionally round-trips the
+        # host master through TensorSwapper files between steps.
+        pcfg = self.config.zero_optimization.offload_param
+        self._offload_param = False
+        self._offload_param_nvme = False
+        if pcfg.device not in ("none", None):
+            if pcfg.device not in ("cpu", "nvme"):
+                raise DeepSpeedConfigError(
+                    f"offload_param.device must be none|cpu|nvme, got "
+                    f"{pcfg.device!r}")
+            if self.zero_stage < 3:
+                logger.warning(
+                    "offload_param is a ZeRO-3 tier (reference "
+                    "zero/offload_config.py) but zero_optimization.stage="
+                    f"{self.zero_stage} — parameter offload is DISABLED. "
+                    "Set stage: 3 to enable it.")
+            else:
+                self._offload_param = True
+                self._offload_param_nvme = pcfg.device == "nvme"
+        self._param_swapper = None  # built lazily (NVMe variant)
+        # In-step H2D streaming (host-resident master INPUTS + in-program
+        # device_put per use) needs XLA memories support in the SPMD
+        # partitioner — present on the TPU backend, absent on CPU (both
+        # host-input and device-output placement annotations fail to
+        # partition there). CPU falls back to jit-boundary swaps: master
+        # parked pinned-host between steps, moved whole to device around
+        # the step (the ZeRO-Offload pattern _opt_swap also uses).
         # ZeRO++ compressed collectives (qwZ/qgZ) + 1-bit optimizer transport
         self._resolve_compressed_modes(zcfg)
+        # the compressed/1-bit step builders are not host-input aware
+        # (their shard_map state layouts assume device memory) — those
+        # combos use the boundary-swap mode
+        self._offload_param_stream = (
+            self._offload_param and jax.default_backend() == "tpu"
+            and not self._compressed and not self._onebit_wire)
 
         # data-efficiency features (reference runtime/data_pipeline/ +
         # progressive_layer_drop.py — config-driven, engine-injected)
@@ -512,18 +552,80 @@ class DeepSpeedTPUEngine:
             state["skips"] = jnp.zeros((), jnp.int32)
         return state
 
+    def _master_host_shardings(self) -> Any:
+        """The offload_param storage tier: master layout, pinned host."""
+        return self._to_host_shardings(
+            self.policy.to_shardings(self.master_spec))
+
+    def _park_master(self) -> None:
+        """Move the master to its pinned-host tier (offload_param).
+
+        Runs at the JIT BOUNDARY: in-program pinned-host OUTPUT annotations
+        don't partition under SPMD ("side-effect ops cannot be
+        replicated"), while host-resident INPUTS do — so each step takes
+        the host master in (the model streams layer slices H2D inside its
+        layer scan), produces the updated master on device, and this moves
+        it back out."""
+        self.state["master"] = jax.device_put(self.state["master"],
+                                              self._master_host_shardings())
+
+    def _unpark_master(self) -> None:
+        """Boundary-swap mode (no in-step streaming): move the parked
+        master onto device before the step."""
+        self.state["master"] = jax.device_put(
+            self.state["master"],
+            self.policy.to_shardings(self.master_spec))
+
+    def _materialize_master(self) -> None:
+        """Direct-use paths (eval/predict/eager forward/step, fp32
+        consolidation) read ``state['master']`` as a plain device tree —
+        restore it from whichever offload tier currently holds it
+        (NVMe files and/or pinned host)."""
+        if self._offload_param_nvme and self._param_swapper is not None:
+            self._param_swapper.swap_in_params()
+        if self._offload_param:
+            from deepspeed_tpu.utils.memory import is_host_resident
+
+            leaves = jax.tree.leaves(self.state["master"])
+            if leaves and is_host_resident(leaves[0]):
+                self._unpark_master()
+
+    def _ensure_master_tier_for_step(self) -> None:
+        """Put the master where the compiled step expects it: pinned host
+        for the streaming step (whose in_shardings declare host inputs —
+        a direct-use path may have materialized it on device), device for
+        boundary-swap mode."""
+        if not self._offload_param:
+            return
+        if self._offload_param_stream:
+            from deepspeed_tpu.utils.memory import is_host_resident
+
+            leaves = jax.tree.leaves(self.state["master"])
+            if leaves and not is_host_resident(leaves[0]):
+                self._park_master()
+        else:
+            self._unpark_master()
+
     def _init_state(self) -> Dict[str, Any]:
         shardings = self._state_shardings()
         init = jax.jit(self._make_state, out_shardings=shardings)
         with self.mesh:
-            return init(self._init_rng)
+            state = init(self._init_rng)
+        if self._offload_param:
+            state["master"] = jax.device_put(state["master"],
+                                             self._master_host_shardings())
+        return state
 
     # ------------------------------------------------------------------ #
     # jitted step builders
     # ------------------------------------------------------------------ #
     def _compute_params(self, master: PyTree) -> PyTree:
         """Cast fp32 master → compute dtype, constrained to the param sharding
-        (stage 3: sharded → XLA gathers per use; else replicated over data)."""
+        (stage 3: sharded → XLA gathers per use; else replicated over data).
+
+        offload_param: by the time this runs, the engine has already
+        streamed the host master onto device in the sharded layout
+        (``_loss_and_grads``), so the normal cast/constrain applies."""
         dtype = jnp.dtype(self.precision)
         param_sh = self.policy.to_shardings(self.param_spec)
 
@@ -537,6 +639,18 @@ class DeepSpeedTPUEngine:
         return jax.tree.map(jax.lax.with_sharding_constraint, grads, grad_sh)
 
     def _loss_and_grads(self, master: PyTree, batch: PyTree, scale) -> Tuple[jax.Array, PyTree]:
+        if self._offload_param:
+            # H2D stream OUTSIDE the autodiff: differentiating w.r.t. the
+            # host-resident master would put every cotangent in host space
+            # (the device_put VJP transposes to D2H) and drag the whole
+            # backward into host memory. Streaming first keeps grads on
+            # device; the stream lands in the ZeRO-3 SHARDED layout (f32
+            # master never replicates), and the updated master is parked
+            # back to pinned host at the jit boundary (_park_master).
+            from deepspeed_tpu.utils.memory import stream_to_shardings
+
+            master = stream_to_shardings(
+                master, self.policy.to_shardings(self.master_spec))
         # schedules with an explicit backward (1F1B pipeline) return grads
         # directly — autodiff over the loss would rebuild the O(M)-memory
         # GPipe reverse wavefront
@@ -583,13 +697,23 @@ class DeepSpeedTPUEngine:
         if self.config.gradient_clipping > 0:
             grads = clip_by_global_norm(grads, self.config.gradient_clipping, norm)
 
+        def _stream_master(master):
+            if not self._offload_param:
+                return master
+            from deepspeed_tpu.utils.memory import stream_to_shardings
+
+            return stream_to_shardings(
+                master, self.policy.to_shardings(self.master_spec))
+
         def do_update(operand):
             master, opt, g = operand
-            return self.optimizer.update(g, opt, master, lr=lr)
+            return self.optimizer.update(g, opt, _stream_master(master),
+                                         lr=lr)
 
         def skip_update(operand):
             master, opt, _ = operand
-            return master, opt
+            # both lax.cond branches must produce the same memory space
+            return _stream_master(master), opt
 
         if self.fp16_enabled:
             overflow = jnp.logical_not(jnp.isfinite(norm))
@@ -657,14 +781,30 @@ class DeepSpeedTPUEngine:
 
         return train_step
 
+    def _in_state_shardings(self) -> Dict[str, Any]:
+        """Input-side state shardings: offload_param parks the master in
+        pinned host BETWEEN steps, so the step's jit must be told its
+        master inputs are host-resident EXPLICITLY — trace-time memory-
+        space detection (is_host_resident → in-program H2D streams) only
+        sees spaces declared via in_shardings, not ones inferred from
+        committed arrays."""
+        sh = self._state_shardings()
+        if self._offload_param_stream:
+            sh = dict(sh, master=self._master_host_shardings())
+        return sh
+
     def _build_train_step(self, gas: int):
         """Fused step: scan grad accumulation over [gas, ...] batch inside jit."""
         state_sh = self._state_shardings()
         # batch shardings are committed on the inputs by _shard_batch; jit honors
         # them without an explicit in_shardings entry.
+        # streaming offload: the host-resident master input cannot alias
+        # the device-resident master output — skip donation
+        donate = () if self._offload_param_stream else (0,)
         return jax.jit(self._train_step_fn(gas),
+                       in_shardings=(self._in_state_shardings(), None),
                        out_shardings=(state_sh, None),
-                       donate_argnums=(0,))
+                       donate_argnums=donate)
 
     def _build_train_multi(self, gas: int, n_steps: int):
         """``n_steps`` fused steps in ONE dispatch: ``lax.scan`` over the
@@ -681,8 +821,11 @@ class DeepSpeedTPUEngine:
             return state, metrics
 
         state_sh = self._state_shardings()
-        return jax.jit(multi, out_shardings=(state_sh, None),
-                       donate_argnums=(0,))
+        donate = () if self._offload_param_stream else (0,)
+        return jax.jit(multi,
+                       in_shardings=(self._in_state_shardings(), None),
+                       out_shardings=(state_sh, None),
+                       donate_argnums=donate)
 
     # ------------------------------------------------------------------ #
     # compressed-collective step builders
@@ -946,6 +1089,18 @@ class DeepSpeedTPUEngine:
                      f"{self._opt_swapper.swapper.swap_dir}")
         return self._opt_swapper
 
+    def _param_nvme_swapper(self):
+        """Lazy NVMe parameter swapper (reference
+        ``swap_tensor/partitioned_param_swapper.py:37``; config path
+        ``offload_param.device == "nvme"`` at stage 3)."""
+        if self._param_swapper is None:
+            from deepspeed_tpu.runtime.swap_tensor import ParamSwapper
+
+            self._param_swapper = ParamSwapper(self)
+            log_dist("NVMe parameter offload active: "
+                     f"{self._param_swapper.swapper.swap_dir}")
+        return self._param_swapper
+
     # ------------------------------------------------------------------ #
     # offload_states / reload_states (reference engine.py:5573/:5603)
     # ------------------------------------------------------------------ #
@@ -1020,12 +1175,19 @@ class DeepSpeedTPUEngine:
                 self._opt_swap("in")
             if self._offload_nvme:
                 self._nvme_swapper().swap_in_optimizer()
+            if self._offload_param_nvme:
+                self._param_nvme_swapper().swap_in_params()
+            self._ensure_master_tier_for_step()
             with self.mesh:
                 self.state, metrics = step_fn(self.state, batch)
             if self._offload_opt:
                 self._opt_swap("out")
             if self._offload_nvme:
                 self._nvme_swapper().swap_out_optimizer()
+            if self._offload_param:
+                self._park_master()
+            if self._offload_param_nvme:
+                self._param_nvme_swapper().swap_out_params()
         self.global_steps += 1
         self.micro_steps += gas
         self._after_step(metrics)
@@ -1050,7 +1212,8 @@ class DeepSpeedTPUEngine:
             return self.train_batch(data_iter)
         if (self._host_runner is not None or self._onebit_wire
                 or self._compressed or self._offload_opt
-                or self._offload_nvme or self._ltd is not None
+                or self._offload_nvme or self._offload_param_nvme
+                or self._ltd is not None
                 or self._pld is not None or self._curriculum is not None):
             # host-side per-step phases (or step-indexed host schedules):
             # the per-step path keeps their semantics exact
@@ -1074,8 +1237,11 @@ class DeepSpeedTPUEngine:
             self._compiled[key] = self._build_train_multi(gas, n_steps)
         batch = self._shard_batch(big, leading=2)
         self.tput_timer.start()
+        self._ensure_master_tier_for_step()
         with self.mesh:
             self.state, metrics = self._compiled[key](self.state, batch)
+        if self._offload_param:
+            self._park_master()
         self.global_steps += n_steps
         self.micro_steps += gas * n_steps
         self._after_step(metrics, n_steps=n_steps)
@@ -1116,6 +1282,7 @@ class DeepSpeedTPUEngine:
             raise NotImplementedError(
                 "the eager forward()/backward()/step() path is unavailable "
                 "with offload_optimizer.host_step — use train_batch()")
+        self._materialize_master()
         if "fwd_bwd" not in self._compiled:
             def fwd_bwd(state, b):
                 scale = state["scaler"].scale if self.fp16_enabled else None
@@ -1174,10 +1341,15 @@ class DeepSpeedTPUEngine:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self._offload_opt:
             self._opt_swap("in")
+        self._materialize_master()
         with self.mesh:
             self.state, metrics = self._compiled["apply"](self.state, self._grad_buffer)
         if self._offload_opt:
             self._opt_swap("out")
+        if self._offload_param:
+            self._park_master()
+        if self._offload_param_nvme:
+            self._param_nvme_swapper().swap_out_params()
         self._grad_buffer = None
         self.global_steps += 1
         self._after_step(metrics)
@@ -1196,6 +1368,7 @@ class DeepSpeedTPUEngine:
             with self.mesh:
                 return self._compiled["eval"](
                     self._host_runner.device_params, batch)
+        self._materialize_master()
         if "eval" not in self._compiled:
             def ev(state, b):
                 params = self._compute_params(state["master"])
@@ -1218,6 +1391,7 @@ class DeepSpeedTPUEngine:
             with self.mesh:
                 return self._compiled["predict"](
                     self._host_runner.device_params, batch)
+        self._materialize_master()
         if "predict" not in self._compiled:
             def pr(state, b):
                 params = self._compute_params(state["master"])
@@ -1294,6 +1468,8 @@ class DeepSpeedTPUEngine:
 
         if self._offload_nvme:
             self._nvme_swapper().swap_in_optimizer()
+        if self._offload_param_nvme and self._param_swapper is not None:
+            self._param_swapper.swap_in_params()
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -1370,6 +1546,12 @@ class DeepSpeedTPUEngine:
             # restored moments. Re-swap-out: fresh files, consistent state,
             # HBM freed again.
             self._opt_swapper.swap_out_optimizer()
+        if self._offload_param:
+            self._park_master()   # restored master → pinned-host tier
+        if self._offload_param_nvme and self._param_swapper is not None:
+            # same reload-clobber hazard as the optimizer swapper: the
+            # restored master must supersede the stale swap files
+            self._param_swapper.swap_out_params()
         if self._host_runner is not None:
             self._host_runner.adopt_state()   # re-home master/opt + params
         self.global_steps = int(client_state.get("global_steps", 0))
@@ -1395,6 +1577,7 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     def get_fp32_params(self) -> PyTree:
         """Gathered fp32 master params (the zero_to_fp32 consolidation analog)."""
+        self._materialize_master()
         rep = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), self._shapes)
         with self.mesh:
             return jax.jit(lambda m: m, out_shardings=rep)(self.state["master"])

@@ -89,37 +89,83 @@ class TensorSwapper:
         self.handle.wait_all()
 
 
-class OptimizerSwapper:
-    """Engine-facing NVMe optimizer-state swapper (reference
-    ``PartitionedOptimizerSwapper``): ``swap_out_optimizer(engine)`` after the
-    step frees HBM; ``swap_in_optimizer(engine)`` restores it before the next."""
+class _StateSwapper:
+    """Engine-facing NVMe swapper for ONE tier of ``engine.state``: the
+    swap_out/template/swap_in/_swapped protocol is shared; subclasses pick
+    the state key, restore shardings, and config section.
 
-    def __init__(self, engine, swap_dir: Optional[str] = None, n_threads: int = 4):
-        cfg = engine.config.zero_optimization.offload_optimizer
-        swap_dir = swap_dir or cfg.nvme_path or "/tmp/dstpu_swap"
+    While swapped out the state slot holds ShapeDtypeStructs — memory is
+    actually freed, matching the reference swappers' release; restore
+    before anything that reads that tier (next step, checkpoint save)."""
+
+    state_key: str
+    subdir: str
+
+    def __init__(self, engine, swap_dir: Optional[str] = None,
+                 n_threads: int = 4):
+        swap_dir = swap_dir or self._config(engine).nvme_path \
+            or "/tmp/dstpu_swap"
         self.engine = engine
-        self.swapper = TensorSwapper(os.path.join(swap_dir, "optimizer"),
+        self.swapper = TensorSwapper(os.path.join(swap_dir, self.subdir),
                                      n_threads)
         self._swapped = False
         self._template = None
 
-    def swap_out_optimizer(self, wait: bool = True) -> None:
-        """Write moments to NVMe and DROP the device buffers (the engine's
-        ``state['opt']`` holds ShapeDtypeStructs while swapped — HBM is
-        actually freed, matching the reference swapper's release). Call
-        ``swap_in_optimizer`` before anything that reads optimizer state
-        (next step, checkpoint save)."""
-        opt = self.engine.state["opt"]
+    def _config(self, engine):
+        raise NotImplementedError
+
+    def _restore_shardings(self):
+        raise NotImplementedError
+
+    def _swap_out(self, wait: bool = True) -> None:
+        tree = self.engine.state[self.state_key]
         self._template = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), opt)
-        self.swapper.swap_out(opt, wait=wait)
-        self.engine.state["opt"] = self._template
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+        self.swapper.swap_out(tree, wait=wait)
+        self.engine.state[self.state_key] = self._template
         self._swapped = True
 
-    def swap_in_optimizer(self) -> None:
+    def _swap_in(self) -> None:
         if not self._swapped:
             return
-        shardings = self.engine._state_shardings()["opt"]
-        self.engine.state["opt"] = self.swapper.swap_in(
-            self._template, shardings)
+        self.engine.state[self.state_key] = self.swapper.swap_in(
+            self._template, self._restore_shardings())
         self._swapped = False
+
+
+class OptimizerSwapper(_StateSwapper):
+    """NVMe optimizer-state swapper (reference
+    ``PartitionedOptimizerSwapper`` ``partitioned_optimizer_swapper.py:27``;
+    config ``offload_optimizer.device == "nvme"``)."""
+
+    state_key = "opt"
+    subdir = "optimizer"
+
+    def _config(self, engine):
+        return engine.config.zero_optimization.offload_optimizer
+
+    def _restore_shardings(self):
+        return self.engine._state_shardings()["opt"]
+
+    swap_out_optimizer = _StateSwapper._swap_out
+    swap_in_optimizer = _StateSwapper._swap_in
+
+
+class ParamSwapper(_StateSwapper):
+    """NVMe PARAMETER swapper (reference
+    ``AsyncPartitionedParameterSwapper`` ``partitioned_param_swapper.py:37``;
+    config ``offload_param.device == "nvme"`` at stage 3). Restores straight
+    to the pinned-host tier — the step streams/unparks from there; landing
+    on device first would spike HBM."""
+
+    state_key = "master"
+    subdir = "param"
+
+    def _config(self, engine):
+        return engine.config.zero_optimization.offload_param
+
+    def _restore_shardings(self):
+        return self.engine._master_host_shardings()
+
+    swap_out_params = _StateSwapper._swap_out
+    swap_in_params = _StateSwapper._swap_in

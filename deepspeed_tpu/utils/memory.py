@@ -1,0 +1,57 @@
+"""Memory-space streaming utilities (ZeRO-Infinity parameter tier).
+
+The engine parks stage-3 master shards in pinned host memory
+(``offload_param``, reference ``swap_tensor/partitioned_param_swapper.py:37``);
+model code calls :func:`stream_to_device` on whatever params it is about to
+use. For device-resident params it is a no-op (trace-time check — nothing is
+added to the program); host-resident leaves get a ``device_put`` onto device
+memory, which XLA's latency-hiding scheduler overlaps with compute when the
+call sits inside a layer scan. The ``device_put`` transposes to the reverse
+transfer (+ reduce-scatter for sharded hosts) in the backward pass.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+PyTree = Any
+
+
+def is_host_resident(x: Any) -> bool:
+    """Trace-time test: does this (possibly traced) array live in host
+    memory space? Works on concrete arrays and tracers (sharding-in-types
+    carries the memory space on the aval)."""
+    aval = getattr(x, "aval", x)
+    space = getattr(aval, "memory_space", None)
+    return space is not None and "host" in str(space).lower()
+
+
+def stream_to_shardings(tree: PyTree, shardings: PyTree) -> PyTree:
+    """Move host-resident leaves onto device memory in a GIVEN layout
+    (e.g. the ZeRO-3 sharded master spec — replicating would undo the
+    sharding). Device-resident leaves pass through."""
+    return jax.tree.map(
+        lambda a, sh: jax.device_put(a, sh) if is_host_resident(a) else a,
+        tree, shardings)
+
+
+def stream_to_device(tree: PyTree) -> PyTree:
+    """Move host-resident leaves onto device memory, replicated — the
+    ZeRO-3 "all-gather the params per use" applied as an H2D stream.
+    Device-resident leaves pass through untouched (so this is safe to call
+    unconditionally — under TP nothing gets force-replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.comm.mesh import get_mesh_manager
+
+    if not any(is_host_resident(leaf) for leaf in jax.tree.leaves(tree)):
+        return tree
+    try:
+        mesh = get_mesh_manager().mesh
+    except Exception:
+        return tree
+    dev = NamedSharding(mesh, P(), memory_kind="device")
+    return jax.tree.map(
+        lambda a: jax.device_put(a, dev) if is_host_resident(a) else a,
+        tree)

@@ -179,6 +179,75 @@ def fastgen_bench(model="gpt2_125m", n_seqs=16, max_new=64):
     }
 
 
+PIPE_BENCH_SNIPPET = r'''
+import json, time, itertools
+import jax
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+def run(mesh_cfg, batch, steps=4, n_micro=None):
+    mesh_mod.reset_mesh()
+    spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=4,
+                              hidden_size=128, num_heads=4, max_seq_len=128,
+                              pipeline_micro_batches=n_micro)
+    dp = mesh_cfg.get("data", 1)
+    config = {"train_batch_size": batch, "train_micro_batch_size_per_gpu":
+              batch // dp, "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+              "zero_optimization": {"stage": 0}, "mesh": mesh_cfg,
+              "steps_per_print": 10 ** 9}
+    engine, *_ = dst.initialize(model=spec, config=config)
+    data = itertools.repeat(next(synthetic_lm_data(batch, 128, 512, seed=0)))
+    loss = engine.train_batch(data)          # compile
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(data)
+    float(jax.device_get(loss))
+    return steps * batch * 128 / (time.perf_counter() - t0)
+
+# sweep the schedule's microbatch count (bubble (P-1)/(M+P-1) vs per-tick
+# overhead trade) and report the best — the autotuner's job, done inline
+best_m, best_tps = None, 0.0
+for m in (2, 4):
+    tps = run({"pipe": 2, "data": 4}, 64, n_micro=m)
+    if tps > best_tps:
+        best_m, best_tps = m, tps
+tps_flat = run({"data": 8}, 64)
+print(json.dumps({"pipe2xdata4_tokens_per_sec": round(best_tps, 1),
+                  "best_n_micro": best_m,
+                  "data8_tokens_per_sec": round(tps_flat, 1),
+                  "overhead_factor": round(tps_flat / best_tps, 2)}))
+'''
+
+
+def pipeline_bench():
+    """1F1B pipeline cost vs the flat-data-parallel step, measured on the
+    8-virtual-device CPU mesh (a single real chip can't host a pipe axis).
+    ``overhead_factor`` = flat tok/s ÷ pipe tok/s — it bundles the fill/
+    drain bubble ((P-1)/(M+P-1) ideal), the wavefront's garbage ticks, and
+    schedule bookkeeping. Absolute CPU-mesh tok/s are NOT chip numbers."""
+    import json as _json
+    import subprocess
+    import sys
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", DSTPU_ACCELERATOR="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"),
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", PIPE_BENCH_SNIPPET],
+                         capture_output=True, text=True, env=env,
+                         timeout=1200)
+    if out.returncode != 0 or not out.stdout.strip():
+        return {"error": (out.stderr or "no output")[-400:]}
+    try:
+        return _json.loads(out.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return {"error": (out.stderr or out.stdout)[-400:]}
+
+
 def comm_bw_bench():
     from deepspeed_tpu.utils.comm_bench import bench_collectives
 
@@ -205,6 +274,7 @@ SUITE_ENTRIES = {
         "moe_350m", zero_stage=2, precision="bf16",
         batch=8, seq_len=1024, gas=2, steps=4,
         attention="ulysses_flash"),
+    "pipeline_1f1b_cpu_mesh": lambda: pipeline_bench(),
 }
 
 

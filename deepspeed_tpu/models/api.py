@@ -126,6 +126,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    attention: Optional[str] = None,
                    loss_tiles: int = 0,
                    pipeline_schedule: str = "1f1b",
+                   pipeline_micro_batches: Optional[int] = None,
                    **overrides) -> ModelSpec:
     """Build a ModelSpec for a causal-LM transformer preset or config.
 
@@ -135,7 +136,9 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
     ``pipeline_schedule``: '1f1b' (explicit backward, O(stages) activation
     memory — reference ``runtime/pipe/schedule.py:189``) or 'gpipe'
     (autodiff-reversed wavefront, O(microbatches)); only used when the mesh
-    has a 'pipe' axis > 1."""
+    has a 'pipe' axis > 1. ``pipeline_micro_batches`` sets the schedule's
+    microbatch count M (reference ``pipeline.micro_batches``): the fill/
+    drain bubble is (P-1)/(M+P-1), so M ≫ P amortizes it; default M = P."""
     if attention_fn is not None and attention is not None:
         raise ValueError("pass either attention_fn or attention=, not both")
     if attention_fn is None:
@@ -162,7 +165,8 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             loss, aux = T.pipelined_lm_loss(
                 params, tokens, cfg, attention_fn=attention_fn,
                 activation_constraint=activation_constraint,
-                loss_mask=_mask_of(batch))
+                loss_mask=_mask_of(batch),
+                n_micro=pipeline_micro_batches)
             if cfg.n_experts > 0:
                 loss = loss + cfg.moe_aux_coef * aux
             return loss
@@ -198,7 +202,8 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         return T.pipelined_lm_loss_and_grads(
             params, _tokens_of(batch), cfg, attention_fn=attention_fn,
             activation_constraint=activation_constraint,
-            loss_mask=_mask_of(batch), loss_scale=loss_scale)
+            loss_mask=_mask_of(batch), loss_scale=loss_scale,
+            n_micro=pipeline_micro_batches)
 
     user_attention_fn = attention_fn is not None and attention is None
     orig_loss_tiles = loss_tiles

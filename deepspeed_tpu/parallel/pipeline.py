@@ -203,36 +203,32 @@ def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
             x_saved = store[jnp.clip(m_b, 0, None) % buf_n]
             micro_b = micro_of(m_b)
 
-            def last_stage_bwd(operand):
-                x_s, _gy = operand
+            # ONE vjp for every stage role. An is_last lax.cond over two
+            # separate vjps lowers — because the predicate varies over the
+            # manual pipe axis — to BOTH branches executed then selected,
+            # i.e. two full stage recomputations + backwards per tick. One
+            # function with role-routed cotangent seeds does it once:
+            #   last stage:  loss seeded (scale), yy seeded 0
+            #   mid stages:  loss seeded 0,       yy seeded the recv'd gy
+            # The loss input is masked by is_last so mid stages evaluate the
+            # loss head on zeros (benign finite values, and the where blocks
+            # any gradient path from yy into it) instead of garbage
+            # intermediate activations.
+            def stage_and_loss(x, bl, ex):
+                yy, aux = stage_fn(x, bl, ex)
+                yy_for_loss = jnp.where(is_last, yy, jnp.zeros_like(yy))
+                loss = finalize_fn(yy_for_loss, micro_b, ex)
+                return yy, loss, aux
 
-                def stage_loss(x, bl, ex):
-                    yy, aux = stage_fn(x, bl, ex)
-                    loss = finalize_fn(yy, micro_b, ex)
-                    return loss, aux
-
-                (loss_m, aux_m), vjp = jax.vjp(stage_loss, x_s, blocks_l,
-                                               extra_l, has_aux=False)
-                seed = jnp.float32(1.0) if loss_scale is None else loss_scale
-                aseed = jnp.float32(0.0) if aux_seed is None else aux_seed
-                dx, dbl, dex = vjp((seed.astype(loss_m.dtype),
-                                    aseed.astype(loss_m.dtype)))
-                return loss_m, dx, dbl, dex
-
-            def mid_stage_bwd(operand):
-                x_s, gy = operand
-
-                def stage_out(x, bl, ex):
-                    yy, aux = stage_fn(x, bl, ex)
-                    return yy, aux
-
-                (_, _), vjp = jax.vjp(stage_out, x_s, blocks_l, extra_l)
-                aseed = jnp.float32(0.0) if aux_seed is None else aux_seed
-                dx, dbl, dex = vjp((gy, aseed.astype(jnp.float32)))
-                return jnp.float32(0.0), dx, dbl, dex
-
-            loss_m, dx, dbl, dex = lax.cond(
-                is_last, last_stage_bwd, mid_stage_bwd, (x_saved, bwd_recv))
+            (_, loss_m, _), vjp = jax.vjp(stage_and_loss, x_saved,
+                                          blocks_l, extra_l)
+            seed = jnp.float32(1.0) if loss_scale is None else loss_scale
+            aseed = jnp.float32(0.0) if aux_seed is None else aux_seed
+            gy_seed = jnp.where(is_last, jnp.zeros_like(bwd_recv), bwd_recv)
+            loss_seed = jnp.where(is_last, seed.astype(loss_m.dtype),
+                                  jnp.zeros_like(loss_m))
+            dx, dbl, dex = vjp((gy_seed, loss_seed,
+                                aseed.astype(loss_m.dtype)))
 
             keep = valid_b.astype(jnp.float32)
             gblocks = jax.tree.map(

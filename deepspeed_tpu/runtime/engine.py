@@ -798,8 +798,12 @@ class DeepSpeedTPUEngine:
         state_sh = self._state_shardings()
         # batch shardings are committed on the inputs by _shard_batch; jit honors
         # them without an explicit in_shardings entry.
-        # streaming offload: the host-resident master input cannot alias
-        # the device-resident master output — skip donation
+        # streaming offload: donation would alias the pinned-host master
+        # input to the device-resident master output (XLA rejects the
+        # cross-memory-kind alias). Cost: the moments lose donation too
+        # (state donates whole) — transiently double moment buffers; when
+        # that matters, compose with offload_optimizer, whose tier moves
+        # them off-device entirely.
         donate = () if self._offload_param_stream else (0,)
         return jax.jit(self._train_step_fn(gas),
                        in_shardings=(self._in_state_shardings(), None),
@@ -815,6 +819,16 @@ class DeepSpeedTPUEngine:
         step = self._train_step_fn(gas)
 
         def multi(state, batches):
+            if self._offload_param_stream:
+                # the scan carry must keep ONE memory space: stream the
+                # pinned-host master onto device before the scan (it stays
+                # device-resident for the whole fused window — the between-
+                # step host parking only happens at the call boundary)
+                from deepspeed_tpu.utils.memory import stream_to_shardings
+
+                state = dict(state, master=stream_to_shardings(
+                    state["master"],
+                    self.policy.to_shardings(self.master_spec)))
             state, ms = jax.lax.scan(step, state, batches)
             metrics = jax.tree.map(lambda x: x[-1], ms)
             metrics["loss"] = jnp.mean(ms["loss"])

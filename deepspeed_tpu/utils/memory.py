@@ -1,13 +1,14 @@
 """Memory-space streaming utilities (ZeRO-Infinity parameter tier).
 
 The engine parks stage-3 master shards in pinned host memory
-(``offload_param``, reference ``swap_tensor/partitioned_param_swapper.py:37``);
-model code calls :func:`stream_to_device` on whatever params it is about to
-use. For device-resident params it is a no-op (trace-time check — nothing is
-added to the program); host-resident leaves get a ``device_put`` onto device
-memory, which XLA's latency-hiding scheduler overlaps with compute when the
-call sits inside a layer scan. The ``device_put`` transposes to the reverse
-transfer (+ reduce-scatter for sharded hosts) in the backward pass.
+(``offload_param``, reference ``swap_tensor/partitioned_param_swapper.py:37``)
+and, on backends with in-program memories support, streams them H2D inside
+the compiled step via :func:`stream_to_shardings` — always into the SHARDED
+device layout (replicating the fp32 master would undo ZeRO-3), and always
+OUTSIDE the autodiff (a device_put under ``grad`` transposes its cotangent
+into host space). :func:`is_host_resident` is the trace-time test both the
+engine's tier bookkeeping and the stream no-op check use — it only sees
+memory spaces declared via explicit ``in_shardings``.
 """
 from __future__ import annotations
 
@@ -36,22 +37,3 @@ def stream_to_shardings(tree: PyTree, shardings: PyTree) -> PyTree:
         tree, shardings)
 
 
-def stream_to_device(tree: PyTree) -> PyTree:
-    """Move host-resident leaves onto device memory, replicated — the
-    ZeRO-3 "all-gather the params per use" applied as an H2D stream.
-    Device-resident leaves pass through untouched (so this is safe to call
-    unconditionally — under TP nothing gets force-replicated)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deepspeed_tpu.comm.mesh import get_mesh_manager
-
-    if not any(is_host_resident(leaf) for leaf in jax.tree.leaves(tree)):
-        return tree
-    try:
-        mesh = get_mesh_manager().mesh
-    except Exception:
-        return tree
-    dev = NamedSharding(mesh, P(), memory_kind="device")
-    return jax.tree.map(
-        lambda a: jax.device_put(a, dev) if is_host_resident(a) else a,
-        tree)

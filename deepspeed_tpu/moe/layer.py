@@ -217,11 +217,27 @@ def _ragged_dispatch_local(xt: jax.Array, weights: jax.Array, idx: jax.Array,
     return permute_rows(y_s, inv, order).reshape(T, k, H).sum(axis=1)
 
 
+def _already_manual_axes() -> set:
+    """Axes manualized by an ENCLOSING shard_map at trace time (e.g. the
+    engine's compressed-collective step is manual over data/zshard; the
+    pipeline over 'pipe') — our shard_map must not re-manualize them, and
+    inside that context the tokens are already per-shard on those axes."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        return {n for n, t in zip(am.axis_names, am.axis_types)
+                if "Manual" in str(t)}
+    except Exception:
+        return set()
+
+
 def _token_axes(mesh) -> Tuple[Tuple[str, ...], Optional[str]]:
-    """Mesh axes that shard the token stream: (batch axes, seq axis)."""
+    """Mesh axes that shard the token stream: (batch axes, seq axis) —
+    excluding axes an enclosing shard_map already made manual."""
+    manual = _already_manual_axes()
     batch = tuple(a for a in (DATA_AXIS, ZSHARD_AXIS, EXPERT_AXIS)
-                  if mesh.shape.get(a, 1) > 1)
-    seq = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
+                  if mesh.shape.get(a, 1) > 1 and a not in manual)
+    seq = SEQ_AXIS if (mesh.shape.get(SEQ_AXIS, 1) > 1
+                       and SEQ_AXIS not in manual) else None
     return batch, seq
 
 
@@ -237,9 +253,11 @@ def ragged_mesh_plan(mesh, B: int, S: Optional[int], E: int):
     """
     if mesh is None:
         return "local", None
+    manual = _already_manual_axes()
     batch_axes, seq_ax = _token_axes(mesh)
-    ep = mesh.shape.get(EXPERT_AXIS, 1)
-    tp = TENSOR_AXIS if mesh.shape.get(TENSOR_AXIS, 1) > 1 else None
+    ep = mesh.shape.get(EXPERT_AXIS, 1) if EXPERT_AXIS not in manual else 1
+    tp = TENSOR_AXIS if (mesh.shape.get(TENSOR_AXIS, 1) > 1
+                         and TENSOR_AXIS not in manual) else None
     if not (batch_axes or seq_ax or tp or ep > 1):
         return "local", None
     bshards = 1
@@ -493,13 +511,19 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
     # and makes eager calls legal (partial-manual out_specs are only
     # accepted under jit); it's cached so eager callers don't recompile
     # per invocation (jit caches on function identity).
-    cache_key = (mesh, k, activation, score_func, route_norm, n_group,
+    # under an ENCLOSING shard_map (compressed step manual over data/zshard,
+    # pipeline manual over 'pipe') the nested shard_map must be built on the
+    # context's abstract mesh — its axis_types record what is already manual
+    sm_mesh = mesh
+    if _already_manual_axes():
+        sm_mesh = jax.sharding.get_abstract_mesh()
+    cache_key = (sm_mesh, k, activation, score_func, route_norm, n_group,
                  topk_group, x.shape, str(x.dtype), gate_w.shape,
                  tuple(sorted((kk, v.shape, str(v.dtype))
                               for kk, v in experts.items())))
     fn = _SHARDED_FN_CACHE.get(cache_key)
     if fn is None:
-        fn = jax.jit(shard_map(local_fn, mesh=mesh,
+        fn = jax.jit(shard_map(local_fn, mesh=sm_mesh,
                                in_specs=(bspec, P(None, None), espec,
                                          P(None)),
                                out_specs=(bspec, P()), check_vma=False,

@@ -90,17 +90,39 @@ def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
     return jnp.sum(deq, axis=0)[:n]
 
 
+def _q_allreduce(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
+    """int8-wire allreduce (sum): quantized all-gather + local dequant-sum.
+    The hpZ trio's second hop — replica axes the parameter is NOT sharded
+    over still contribute gradients."""
+    return jnp.sum(_q_allgather(flat, axes, block), axis=0)
+
+
 def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
                                out_dtype, quant_weights: bool,
                                quant_grads: bool,
-                               block: int = DEFAULT_BLOCK):
+                               block: int = DEFAULT_BLOCK,
+                               gather_axes: Optional[AxesT] = None,
+                               gather_world: Optional[int] = None):
     """Build the straight-through gather for one parameter leaf.
 
-    ``dim`` — the dimension sharded over ``axes`` (None → leaf is replicated:
-    forward is a cast, backward is an exact psum-mean — too small to quantize).
-    Forward: local shard → full parameter in ``out_dtype``.
-    Backward: full cotangent → local shard of the MEAN-reduced gradient.
+    ``dim`` — the dimension sharded over ``gather_axes`` (None → leaf is
+    replicated: forward is a cast, backward is an exact psum-mean — too
+    small to quantize). Forward: local shard → full parameter in
+    ``out_dtype``. Backward: full cotangent → local shard of the
+    MEAN-reduced gradient over ALL of ``axes``.
+
+    hpZ/MiCS composition (reference ``zero/config.py:309-330`` — the ZeRO++
+    trio is precisely hpZ + qwZ + qgZ together): the leaf may be sharded
+    over a SUBGROUP ``gather_axes ⊂ axes`` (the 'zshard' secondary
+    partition) while replicated over the rest. Forward gathers over the
+    subgroup only (the hpZ win: the heavy all-gather stays intra-group);
+    backward reduce-scatters over the subgroup and then allreduces the
+    shard over the replica axes — both hops int8 when ``quant_grads``.
     """
+    gather_axes = tuple(gather_axes) if gather_axes is not None else axes
+    gather_world = gather_world if gather_world is not None else world
+    replica_axes = tuple(a for a in axes if a not in gather_axes)
+
     if dim is None:
         @jax.custom_vjp
         def rep(x):
@@ -121,10 +143,11 @@ def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
         m = jnp.moveaxis(x_local, dim, 0)
         flat = m.reshape(-1)
         if quant_weights:
-            rows = _q_allgather(flat, axes, block)              # [world, n]
+            rows = _q_allgather(flat, gather_axes, block)       # [gworld, n]
         else:
-            rows = lax.all_gather(flat.astype(out_dtype), axes, tiled=False)
-        full_m = rows.reshape((world * m.shape[0],) + m.shape[1:])
+            rows = lax.all_gather(flat.astype(out_dtype), gather_axes,
+                                  tiled=False)
+        full_m = rows.reshape((gather_world * m.shape[0],) + m.shape[1:])
         return jnp.moveaxis(full_m, 0, dim).astype(out_dtype)
 
     def gather_fwd(x_local):
@@ -133,12 +156,17 @@ def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
     def gather_bwd(x_local, g):
         local_shape, in_dtype = x_local.shape, x_local.dtype
         gm = jnp.moveaxis(g, dim, 0)
-        rows = gm.reshape(world, -1).astype(jnp.float32)        # [world, n_loc]
+        rows = gm.reshape(gather_world, -1).astype(jnp.float32)  # [gw, n_loc]
         if quant_grads:
-            mine = _q_reduce_scatter(rows, axes, world, block)
+            mine = _q_reduce_scatter(rows, gather_axes, gather_world, block)
         else:
-            mine = lax.psum_scatter(rows, axes, scatter_dimension=0,
+            mine = lax.psum_scatter(rows, gather_axes, scatter_dimension=0,
                                     tiled=False)
+        if replica_axes:
+            if quant_grads:
+                mine = _q_allreduce(mine, replica_axes, block)
+            else:
+                mine = lax.psum(mine, replica_axes)
         mine = mine / world                                     # mean over DP
         m_shape = (local_shape[dim],) + tuple(
             s for i, s in enumerate(local_shape) if i != dim)
@@ -147,6 +175,76 @@ def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
 
     gather.defvjp(gather_fwd, gather_bwd)
     return gather
+
+
+def loco_reduce_leaf(g: jax.Array, err: jax.Array, spec: P,
+                     manual_axes: AxesT, world: int, axis_sizes: dict,
+                     block: int = DEFAULT_BLOCK
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """LoCo error-compensated quantized gradient reduce for one leaf
+    (reference ``runtime/comm/coalesced_collectives.py:81``
+    ``all_to_all_loco_quant_reduce``).
+
+    Per-rank error feedback: send ``q(g + e)``, keep ``e' = (g + e) −
+    deq(q(g + e))`` — the quantization residual re-enters the NEXT round's
+    send, so the time-averaged wire value is unbiased and convergence
+    tracks the exact reduce far closer than memoryless qgZ.
+
+    ``g`` — this rank's FULL (unreduced) gradient; ``err`` — same shape.
+    Returns (my MEAN-reduced local shard, new error). Replicated leaves
+    reduce exactly (too small to quantize) and carry zero error; under hpZ
+    the subgroup hop carries the feedback and the replica-axis hop is an
+    exact psum (one error buffer compensates one quantizer).
+    """
+    dim = sharded_dim(spec, manual_axes)
+    if dim is None:
+        red = lax.psum(g.astype(jnp.float32), manual_axes) / world
+        return red.astype(g.dtype), jnp.zeros_like(err)
+    gaxes = leaf_gather_axes(spec, dim, manual_axes)
+    gworld = 1
+    for a in gaxes:
+        gworld *= axis_sizes.get(a, 1)
+    replica_axes = tuple(a for a in manual_axes if a not in gaxes)
+
+    m = jnp.moveaxis(g, dim, 0).astype(jnp.float32)
+    rows = m.reshape(gworld, -1)                          # [gw, n_loc]
+    comp = rows + err.astype(jnp.float32).reshape(rows.shape)
+    n = comp.shape[1]
+    pad = (-n) % block
+    cp = jnp.pad(comp, ((0, 0), (0, pad)))
+    q, s = jax.vmap(lambda r: quantize_int8(r, block))(cp)
+    sent = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
+    new_err = (cp - sent)[:, :n].reshape(err.shape).astype(err.dtype)
+    qr = lax.all_to_all(q, gaxes, split_axis=0, concat_axis=0, tiled=True)
+    sr = lax.all_to_all(s, gaxes, split_axis=0, concat_axis=0, tiled=True)
+    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qr, sr)
+    mine = jnp.sum(deq, axis=0)[:n]
+    if replica_axes:
+        mine = lax.psum(mine, replica_axes)
+    mine = mine / world
+    m_shape = (g.shape[dim] // gworld,) + tuple(
+        s_ for i, s_ in enumerate(g.shape) if i != dim)
+    dx = jnp.moveaxis(mine.reshape(m_shape), 0, dim)
+    return dx.astype(g.dtype), new_err
+
+
+def loco_reduce_tree(gfull_tree: PyTree, err_tree: PyTree,
+                     spec_tree: PyTree, manual_axes: AxesT, world: int,
+                     axis_sizes: dict, block: int = DEFAULT_BLOCK
+                     ) -> Tuple[PyTree, PyTree]:
+    """Tree-level :func:`loco_reduce_leaf`. Returns (shard grads, new err)."""
+    # map over spec_tree first: P is a tuple subclass, so it must be the
+    # structure-defining tree with an explicit is_leaf
+    pairs = jax.tree.map(
+        lambda spec, g, e: loco_reduce_leaf(g, e, spec, manual_axes, world,
+                                            axis_sizes, block),
+        spec_tree, gfull_tree, err_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    grads = jax.tree.map(lambda p: p[0], pairs,
+                         is_leaf=lambda x: isinstance(x, tuple))
+    errs = jax.tree.map(lambda p: p[1], pairs,
+                        is_leaf=lambda x: isinstance(x, tuple))
+    return grads, errs
 
 
 def manual_spec(spec: P, manual_axes: AxesT) -> P:
@@ -174,17 +272,42 @@ def sharded_dim(spec: P, manual_axes: AxesT) -> Optional[int]:
     return None
 
 
+def leaf_gather_axes(spec: P, dim: Optional[int], manual_axes: AxesT
+                     ) -> AxesT:
+    """The manual axes the leaf's ``dim`` is actually sharded over (hpZ:
+    a 'zshard'-only subgroup of the full (data, zshard) reduce set)."""
+    if dim is None:
+        return manual_axes
+    entry = spec[dim]
+    names = entry if isinstance(entry, tuple) else (entry,)
+    return tuple(a for a in manual_axes if a in names)
+
+
 def gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
                    out_dtype, quant_weights: bool, quant_grads: bool,
-                   block: int = DEFAULT_BLOCK):
+                   block: int = DEFAULT_BLOCK,
+                   axis_sizes: Optional[dict] = None):
     """Tree-level gather: local master shards → full compute params, with the
     compressed VJP per leaf. Returns f(master_local_tree) for use inside
-    shard_map."""
-    gathers = jax.tree.map(
-        lambda spec: gather_with_compressed_vjp(
-            sharded_dim(spec, manual_axes), manual_axes, world, out_dtype,
-            quant_weights, quant_grads, block),
-        spec_tree, is_leaf=lambda x: isinstance(x, P))
+    shard_map. ``axis_sizes`` (mesh axis → size) enables the hpZ subgroup
+    math; omitted → every leaf gathers over all ``manual_axes``."""
+    def build(spec):
+        dim = sharded_dim(spec, manual_axes)
+        if axis_sizes is not None and dim is not None:
+            gaxes = leaf_gather_axes(spec, dim, manual_axes)
+            gworld = 1
+            for a in gaxes:
+                gworld *= axis_sizes.get(a, 1)
+        else:
+            # documented fallback: without axis sizes the subgroup math is
+            # impossible — gather over ALL manual axes (pre-hpZ behavior)
+            gaxes, gworld = manual_axes, world
+        return gather_with_compressed_vjp(
+            dim, manual_axes, world, out_dtype, quant_weights, quant_grads,
+            block, gather_axes=gaxes, gather_world=gworld)
+
+    gathers = jax.tree.map(build, spec_tree,
+                           is_leaf=lambda x: isinstance(x, P))
 
     def gather_tree(master_local):
         return jax.tree.map(lambda fn, x: fn(x), gathers, master_local,

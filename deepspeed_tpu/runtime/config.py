@@ -121,6 +121,12 @@ class ZeroConfig:
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_quantized_nontrainable_weights: bool = False
+    # LoCo error feedback for the quantized gradient reduce (reference
+    # runtime/comm/coalesced_collectives.py:81 all_to_all_loco_quant_reduce):
+    # per-rank residual re-enters the next round's send. Requires
+    # zero_quantized_gradients; costs one full-gradient-sized fp32 buffer
+    # per rank.
+    loco_error_feedback: bool = False
     round_robin_gradients: bool = False
     ignore_unused_parameters: bool = True
 

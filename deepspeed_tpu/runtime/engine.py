@@ -323,8 +323,12 @@ class DeepSpeedTPUEngine:
             a for a in (_D, _Z) if shape.get(a, 1) >= 1)
         self._dp_manual_world = int(
             np.prod([shape.get(a, 1) for a in self._dp_manual_axes]))
+        # expert>1 is allowed: the MoE batch/weight shardings over 'expert'
+        # stay GSPMD-auto inside the dp-manual shard_map (the reference's
+        # loudest qgZ win is exactly MoE gradients, BASELINE.md #9); hpZ
+        # (zshard>1) composes via per-leaf subgroup gathers — the full
+        # ZeRO++ trio (zero/config.py:309-330)
         eligible = (self._dp_manual_world > 1
-                    and shape.get("expert", 1) == 1
                     and shape.get("seq", 1) == 1
                     and shape.get("pipe", 1) == 1)
 
@@ -337,24 +341,26 @@ class DeepSpeedTPUEngine:
                 logger.warning(
                     "zero_quantized_weights/gradients require ZeRO stage >= 1 "
                     f"(got stage {self.zero_stage}) — exact collectives used")
-            elif shape.get(_Z, 1) > 1:
-                # MiCS/hpZ: master shards over 'zshard' only (replicated
-                # across 'data') — the compressed gather would reconstruct
-                # over both axes and produce data×-oversized parameters
-                logger.warning(
-                    "zero_quantized_weights/gradients are not supported "
-                    "together with MiCS/hpZ subgroup sharding (zshard > 1) — "
-                    "exact collectives used")
             elif not eligible:
                 logger.warning(
                     "zero_quantized_weights/gradients need data-parallel width "
-                    "> 1 and expert=seq=pipe=1 in the mesh — exact collectives "
+                    "> 1 and seq=pipe=1 in the mesh — exact collectives "
                     f"used (mesh={dict(shape)})")
             else:
                 self._compressed = {"quant_weights": quant_w,
                                     "quant_grads": quant_g}
                 log_dist(f"ZeRO++ compressed collectives active: qwZ={quant_w} "
                          f"qgZ={quant_g} over axes {self._dp_manual_axes}")
+        if zcfg.loco_error_feedback:
+            if self._compressed is not None \
+                    and self._compressed["quant_grads"]:
+                self._compressed["loco"] = True
+                log_dist("LoCo error feedback active for the qgZ reduce "
+                         "(reference coalesced_collectives.py:81)")
+            else:
+                logger.warning(
+                    "loco_error_feedback requires an ACTIVE "
+                    "zero_quantized_gradients path — ignored")
 
         opt_type = (self.config.optimizer.type if self.config.optimizer
                     else "").lower().replace("_", "")
@@ -523,6 +529,13 @@ class DeepSpeedTPUEngine:
             rep = NamedSharding(self.mesh, P())
             sh["scaler"] = jax.tree.map(lambda _: rep, self.scaler.init_state())
             sh["skips"] = rep
+        if self._compressed is not None and self._compressed.get("loco"):
+            axes = self._dp_manual_axes
+            row = axes if len(axes) > 1 else axes[0]
+            sh["loco_err"] = jax.tree.map(
+                lambda s: NamedSharding(
+                    self.mesh, P(row, *([None] * len(s.shape)))),
+                self._shapes)
         return sh
 
     @staticmethod
@@ -550,6 +563,14 @@ class DeepSpeedTPUEngine:
         if self.fp16_enabled:
             state["scaler"] = self.scaler.init_state()
             state["skips"] = jnp.zeros((), jnp.int32)
+        if self._compressed is not None and self._compressed.get("loco"):
+            # per-rank LoCo residuals: leading sharded world dim (same
+            # pattern as the 1-bit worker_error buffers); full-gradient
+            # shape per rank, fp32
+            state["loco_err"] = jax.tree.map(
+                lambda s: jnp.zeros(
+                    (self._dp_manual_world,) + s.shape, jnp.float32),
+                self._shapes)
         return state
 
     def _master_host_shardings(self) -> Any:
@@ -738,23 +759,40 @@ class DeepSpeedTPUEngine:
 
     @staticmethod
     def accumulate_microbatches(micro_fn, zeros, batch, gas,
-                                constrain=lambda x: x):
+                                constrain=lambda x: x, extra0=None):
         """Shared GAS loop: fp32-accumulate grads from ``micro_fn(mb) ->
         (loss, grads)`` over the leading micro-batch dim (scan for gas>1).
         Used by the fused step, the host-step runner, and available to
-        custom step builders — keep ONE copy of these semantics."""
-        def micro(acc, mb):
-            loss, grads = micro_fn(mb)
+        custom step builders — keep ONE copy of these semantics.
+
+        ``extra0``: optional extra carry threaded through the micros (LoCo
+        residuals); micro_fn is then called as ``micro_fn(mb, extra) ->
+        (loss, grads, extra)`` and the return gains the final extra."""
+        with_extra = extra0 is not None
+
+        def micro(carry, mb):
+            if with_extra:
+                acc, extra = carry
+                loss, grads, extra = micro_fn(mb, extra)
+            else:
+                acc = carry
+                loss, grads = micro_fn(mb)
             acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), acc, grads)
-            return constrain(acc), loss
+            acc = constrain(acc)
+            return ((acc, extra) if with_extra else acc), loss
 
+        carry0 = (zeros, extra0) if with_extra else zeros
         if gas == 1:
             squeezed = jax.tree.map(lambda x: x[0], batch)
-            grads_sum, loss = micro(zeros, squeezed)
-            return grads_sum, loss
-        grads_sum, losses = jax.lax.scan(micro, zeros, batch)
-        return grads_sum, jnp.mean(losses)
+            carry, loss = micro(carry0, squeezed)
+        else:
+            carry, losses = jax.lax.scan(micro, carry0, batch)
+            loss = jnp.mean(losses)
+        if with_extra:
+            (grads_sum, extra) = carry
+            return grads_sum, loss, extra
+        return carry, loss
 
     def _train_step_fn(self, gas: int):
         """The raw (unjitted) fused-step body — shared by the single-step
@@ -849,6 +887,89 @@ class DeepSpeedTPUEngine:
         row = axes if len(axes) > 1 else axes[0]
         return P(None, row, *([None] * (ndim - 2)))
 
+    def _build_train_step_loco(self, gas: int):
+        """qgZ with LoCo error feedback (reference
+        ``coalesced_collectives.py:81 all_to_all_loco_quant_reduce``).
+
+        The residual must persist across reduces, which the straight-
+        through-vjp formulation can't thread — so this step differentiates
+        w.r.t. the FULL gathered params (no collective inside autodiff)
+        and runs the wire reduce OUTSIDE the vjp, with the per-rank error
+        buffers carried through the micro scan and the engine state.
+        Memory: a transient full-gradient tree per rank (stage-2-like)
+        plus the fp32 residual buffers."""
+        from jax import shard_map
+
+        from deepspeed_tpu.parallel import compressed as C
+
+        axes = self._dp_manual_axes
+        world = self._dp_manual_world
+        dtype = jnp.dtype(self.precision)
+        mode = self._compressed
+        sizes = dict(self.mesh.shape)
+        gather_tree = C.gather_tree_fn(
+            self.master_spec, axes, world, dtype,
+            quant_weights=mode["quant_weights"], quant_grads=False,
+            axis_sizes=sizes)   # bwd unused: grads are taken w.r.t. FULL params
+        master_manual = jax.tree.map(
+            lambda s: C.manual_spec(s, axes), self.master_spec,
+            is_leaf=lambda x: isinstance(x, P))
+        row = axes if len(axes) > 1 else axes[0]
+
+        def local(master_local, err_local, batch_local, scale):
+            err0 = jax.tree.map(lambda e: e[0], err_local)   # drop world row
+            zeros = jax.tree.map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), master_local)
+            # loop-invariant: ONE (possibly quantized) param gather per
+            # step, not per micro — its VJP is unused here
+            params = gather_tree(master_local)
+
+            def full_loss(pf, b):
+                return self.model_spec.loss_fn(pf, b) * scale
+
+            def micro(b, err):
+                loss, gfull = jax.value_and_grad(full_loss)(params, b)
+                gl, err = C.loco_reduce_tree(
+                    gfull, err, self.master_spec, axes, world, sizes)
+                return loss, gl, err
+
+            grads_sum, losses_mean, err = self.accumulate_microbatches(
+                micro, zeros, batch_local, gas, extra0=err0)
+            mean_loss = jax.lax.pmean(losses_mean, axes) / scale
+            err_out = jax.tree.map(lambda e: e[None], err)
+            return grads_sum, err_out, mean_loss
+
+        def train_step(state, batch):
+            scale = state["scaler"].scale if self.fp16_enabled \
+                else jnp.float32(1.0)
+            b_specs = jax.tree.map(
+                lambda x: self._manual_batch_spec(x.ndim), batch)
+            err_specs = jax.tree.map(
+                lambda s: P(row, *([None] * len(s.shape))), self._shapes)
+            fn = shard_map(
+                local, mesh=self.mesh,
+                in_specs=(master_manual, err_specs, b_specs, P()),
+                out_specs=(master_manual, err_specs, P()),
+                axis_names=set(axes), check_vma=False)
+            grads_sum, new_err, mean_loss = fn(
+                state["master"], state["loco_err"], batch, scale)
+            grad_scale = jnp.float32(gas) * scale
+            new_state, metrics = self._apply_update(state, grads_sum,
+                                                    grad_scale)
+            # fp16 overflow: _apply_update skips the weight update, and the
+            # residuals computed from inf/NaN gradients must not poison the
+            # persistent state — reset them so recovery matches plain qgZ
+            overflow = metrics["overflow"] > 0
+            new_state["loco_err"] = jax.tree.map(
+                lambda n: jnp.where(overflow, jnp.zeros_like(n), n),
+                new_err)
+            metrics["loss"] = mean_loss
+            return new_state, metrics
+
+        state_sh = self._state_shardings()
+        return jax.jit(train_step, out_shardings=(state_sh, None),
+                       donate_argnums=(0,))
+
     def _build_train_step_qz(self, gas: int):
         """ZeRO++ qwZ/qgZ step: shard_map manual over the ZeRO axes; the
         parameter all-gather (fwd) and gradient reduce-scatter (bwd) are one
@@ -858,6 +979,9 @@ class DeepSpeedTPUEngine:
 
         from deepspeed_tpu.parallel import compressed as C
 
+        if self._compressed.get("loco"):
+            return self._build_train_step_loco(gas)
+
         axes = self._dp_manual_axes
         world = self._dp_manual_world
         dtype = jnp.dtype(self.precision)
@@ -865,7 +989,8 @@ class DeepSpeedTPUEngine:
         gather_tree = C.gather_tree_fn(
             self.master_spec, axes, world, dtype,
             quant_weights=mode["quant_weights"],
-            quant_grads=mode["quant_grads"])
+            quant_grads=mode["quant_grads"],
+            axis_sizes=dict(self.mesh.shape))
         master_manual = jax.tree.map(
             lambda s: C.manual_spec(s, axes), self.master_spec,
             is_leaf=lambda x: isinstance(x, P))

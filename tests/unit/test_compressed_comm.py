@@ -195,17 +195,141 @@ def test_onebit_wire_eager_path_raises():
         engine.forward(np.zeros((16, 64), np.int32))
 
 
-def test_qz_mics_warns_and_falls_back():
-    """MiCS subgroup sharding (zshard > 1) is incompatible with the
-    compressed gather — must fall back to exact collectives."""
-    from deepspeed_tpu.comm.mesh import reset_mesh
+def test_loco_reduce_error_feedback_property():
+    """The defining LoCo property (reference ``coalesced_collectives.py:81``):
+    the residual of round t re-enters round t+1's send, so the SUM of two
+    compensated reduces of the same vector is closer to the exact sum than
+    two memoryless quantized reduces."""
+    from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, reset_mesh
+    from deepspeed_tpu.parallel.compressed import loco_reduce_leaf
 
     reset_mesh()
-    config = _base_config(
-        zero_optimization={"stage": 3, "mics_shard_size": 2,
-                           "zero_quantized_gradients": True})
-    engine, *_ = dst.initialize(model=_spec(), config=config)
+    mm = initialize_mesh(MeshConfig(data=8))
+    mesh = mm.mesh
+    world = 8
+    n = 512
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((world, n)), jnp.float32)  # per-rank
+    spec = P("data")
+
+    def local(x_l):
+        g = x_l[0]                       # my full "gradient" [n]
+        e = jnp.zeros_like(g)
+        outs = []
+        for _ in range(2):
+            mine, e = loco_reduce_leaf(g, e, spec, ("data",), world,
+                                       {"data": world})
+            outs.append(mine)
+        return outs[0] + outs[1], e
+
+    fn = shard_map(local, mesh=mesh, in_specs=P("data"),
+                   out_specs=(P("data"), P("data")), check_vma=False)
+    with mesh:
+        two_rounds, err = jax.jit(fn)(x)
+    exact_mean = np.asarray(jnp.mean(x, axis=0))   # mean over ranks
+    got = np.asarray(two_rounds).reshape(world, -1)  # per-rank shard concat
+    want2 = 2 * exact_mean.reshape(world, -1)
+    # compensated 2-round sum is very close to 2x the exact mean
+    np.testing.assert_allclose(got, want2, rtol=0, atol=2e-2)
+    # single memoryless round's error, doubled, is strictly worse than the
+    # compensated pair (quantization residual cancels across rounds)
+    def local1(x_l):
+        g = x_l[0]
+        e = jnp.zeros_like(g)
+        mine, _ = loco_reduce_leaf(g, e, spec, ("data",), world,
+                                   {"data": world})
+        return mine
+    with mesh:
+        one = jax.jit(shard_map(local1, mesh=mesh, in_specs=P("data"),
+                                out_specs=P("data"), check_vma=False))(x)
+    memoryless = 2 * np.asarray(one).reshape(world, -1)
+    err_loco = np.abs(got - want2).sum()
+    err_memless = np.abs(memoryless - want2).sum()
+    assert err_loco < err_memless * 0.75, (err_loco, err_memless)
+    reset_mesh()
+
+
+def test_loco_qgz_trains_and_keeps_error_state():
+    """Config-driven LoCo: trains, carries nonzero residual buffers in the
+    engine state, and tracks the exact curve at least as closely as plain
+    qgZ."""
+    _, exact = _train(_base_config())
+    _, plain = _train(_base_config(
+        zero_optimization={"stage": 2, "zero_quantized_gradients": True}))
+    engine, loco = _train(_base_config(
+        zero_optimization={"stage": 2, "zero_quantized_gradients": True,
+                           "loco_error_feedback": True}))
+    assert engine._compressed.get("loco") is True
+    assert "loco_err" in engine.state
+    err_norm = sum(float(jnp.sum(jnp.abs(e)))
+                   for e in jax.tree.leaves(engine.state["loco_err"]))
+    assert err_norm > 0.0, "residual buffers never populated"
+    assert loco[-1] < loco[0] - 1.5, loco
+    dev_loco = sum(abs(e - q) for e, q in zip(exact, loco))
+    dev_plain = sum(abs(e - q) for e, q in zip(exact, plain))
+    assert dev_loco <= dev_plain * 1.1, (dev_loco, dev_plain)
+
+
+def test_loco_without_qgz_warns(caplog):
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    ds_logger.addHandler(caplog.handler)
+    try:
+        engine, _ = _train(_base_config(
+            zero_optimization={"stage": 2, "loco_error_feedback": True}),
+            steps=1)
+    finally:
+        ds_logger.removeHandler(caplog.handler)
     assert engine._compressed is None
+    assert any("loco_error_feedback" in r.message for r in caplog.records)
+
+
+def test_zeropp_trio_hpz_qwz_qgz():
+    """The FULL ZeRO++ trio (reference ``zero/config.py:309-330``): hpZ
+    subgroup sharding (zshard=2) + quantized weight gather + quantized
+    gradient reduce — params gather over the small 'zshard' subgroup only,
+    gradients reduce-scatter over it then int8-allreduce over the 'data'
+    replicas. Loss must track the exact hpZ run closely."""
+    mics = {"stage": 3, "mics_shard_size": 2}
+    _, exact = _train(_base_config(zero_optimization=dict(mics)))
+    engine, quant = _train(_base_config(zero_optimization=dict(
+        mics, zero_quantized_weights=True, zero_quantized_gradients=True)))
+    assert engine._compressed == {"quant_weights": True, "quant_grads": True}
+    assert engine.mesh.shape["zshard"] == 2
+    assert quant[-1] < quant[0] - 1.5, quant
+    for e, q in zip(exact, quant):
+        assert abs(e - q) < 0.5, f"diverged: exact={exact} quant={quant}"
+
+
+def test_qgz_moe_expert_parallel():
+    """qgZ over MoE gradients with an expert axis in the mesh (the
+    reference's marquee comm win — BASELINE.md #9 MoE allreduce)."""
+    from deepspeed_tpu.comm.mesh import reset_mesh
+
+    def train(extra):
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny_moe", dtype="float32",
+                                  max_seq_len=64)
+        config = _base_config(
+            mesh={"data": 2, "expert": 4},
+            zero_optimization=dict({"stage": 2}, **extra))
+        engine, *_ = dst.initialize(model=spec, config=config)
+        rng = np.random.default_rng(5)
+        batch = rng.integers(0, 512, (16, 64))
+
+        def it():
+            while True:
+                yield batch
+
+        losses = [float(engine.train_batch(it())) for _ in range(10)]
+        return engine, losses
+
+    _, exact = train({})
+    engine, quant = train({"zero_quantized_gradients": True})
+    assert engine._compressed == {"quant_weights": False, "quant_grads": True}
+    assert quant[-1] < quant[0] - 0.5, quant
+    for e, q in zip(exact, quant):
+        assert abs(e - q) < 0.5, f"diverged: exact={exact} quant={quant}"
 
 
 def test_qz_flags_warn_when_inapplicable(caplog):

@@ -95,11 +95,6 @@ class FastGenEngine:
                  use_pallas_kernel: Optional[bool] = None, **overrides):
         if isinstance(cfg, str):
             cfg = T.get_model_config(cfg, **overrides)
-        if cfg.pos_emb == "alibi":
-            raise NotImplementedError(
-                "FastGenEngine does not support ALiBi position bias yet — "
-                "use the v1 slot engine (inference/ragged.py) for "
-                "bloom/falcon-alibi models")
         self.cfg = cfg
         if params is None:
             params = T.init_params(cfg, jax.random.PRNGKey(seed))

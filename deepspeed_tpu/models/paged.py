@@ -28,14 +28,27 @@ PyTree = Any
 
 def init_paged_kv(cfg: T.TransformerConfig, n_blocks: int, block_size: int,
                   dtype=None) -> Dict[str, jax.Array]:
-    """Block pool per layer. Block 0 is the trash block for pad writes."""
+    """Block pool per layer. Block 0 is the trash block for pad writes.
+
+    MLA models (DeepSeek) pool the LATENTS instead of per-head K/V —
+    c_kv [.., kv_lora_rank] + shared post-rope key [.., qk_rope_head_dim]
+    per slot (reference ``ragged/kv_cache.py`` + the v2 engine's DeepSeek
+    containers). That tiny row width (kvr+dr vs 2·K·D) is exactly where
+    paged KV pays off."""
     dt = dtype or cfg.compute_dtype
-    shape = (cfg.num_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    L = cfg.num_layers
+    if cfg.mla:
+        return {"ckv": jnp.zeros((L, n_blocks, block_size,
+                                  cfg.kv_lora_rank), dt),
+                "kpe": jnp.zeros((L, n_blocks, block_size,
+                                  cfg.qk_rope_head_dim), dt)}
+    shape = (L, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
 def paged_attention_reference(q: jax.Array, kpool: jax.Array, vpool: jax.Array,
-                              tables: jax.Array, lengths: jax.Array
+                              tables: jax.Array, lengths: jax.Array,
+                              alibi: Optional[jax.Array] = None
                               ) -> jax.Array:
     """Pure-XLA paged attention (the CPU/fallback path; the Pallas kernel in
     ``ops/pallas/paged_attention.py`` computes the same thing without
@@ -43,6 +56,8 @@ def paged_attention_reference(q: jax.Array, kpool: jax.Array, vpool: jax.Array,
 
     q [T, N, D]; pools [NB, bs, K, D]; tables [T, MB]; lengths [T] (= pos+1).
     Token t attends to its sequence's first ``lengths[t]`` cache slots.
+    ``alibi``: [N] slopes — cache slot c IS absolute position c, so the
+    bias is ``slope · (c − (lengths−1))`` (matches ``cached_attention``).
     """
     Tn, N, D = q.shape
     bs = kpool.shape[1]
@@ -58,10 +73,50 @@ def paged_attention_reference(q: jax.Array, kpool: jax.Array, vpool: jax.Array,
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
     s = jnp.einsum("tnd,tcnd->tnc", q.astype(jnp.float32),
                    kg.astype(jnp.float32)) * scale       # [T, N, ctx]
+    if alibi is not None:
+        rel = (jnp.arange(MB * bs)[None, :]
+               - (lengths[:, None] - 1)).astype(jnp.float32)  # [T, ctx]
+        s = s + alibi.astype(jnp.float32)[None, :, None] * rel[:, None, :]
     mask = jnp.arange(MB * bs)[None, None, :] < lengths[:, None, None]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("tnc,tcnd->tnd", p, vg.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_mla_attention_reference(q: jax.Array, ckv_pool: jax.Array,
+                                  kpe_pool: jax.Array, tables: jax.Array,
+                                  lengths: jax.Array, w_kv_b: jax.Array,
+                                  cfg: T.TransformerConfig) -> jax.Array:
+    """Weight-absorbed MLA attention over the paged LATENT pool (the
+    DeepSeek decode trick of ``transformer._mla_absorbed_attention``, paged):
+    W_uk folds into the query and W_uv into the output, so each cache slot
+    is read ONCE at width kvr+dr and k/v are never re-expanded.
+
+    q [T, N, dn+dr] (post-rope); ckv_pool [NBf, bs, kvr];
+    kpe_pool [NBf, bs, dr]; tables [T, MB]; → [T, N, dv].
+    """
+    import math
+
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    kvr, N = cfg.kv_lora_rank, cfg.num_heads
+    Tn = q.shape[0]
+    bs = ckv_pool.shape[1]
+    MB = tables.shape[1]
+    dt = q.dtype
+    ckv = ckv_pool[tables].reshape(Tn, MB * bs, kvr)
+    kpe = kpe_pool[tables].reshape(Tn, MB * bs, dr)
+    w_kv = w_kv_b.astype(dt).reshape(kvr, N, dn + dv)
+    w_uk, w_uv = w_kv[..., :dn], w_kv[..., dn:]
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    q_lat = jnp.einsum("tnd,knd->tnk", q_nope, w_uk)     # [T, N, kvr]
+    scale = cfg.mla_scale_mult / math.sqrt(dn + dr)
+    s = (jnp.einsum("tnk,tck->tnc", q_lat, ckv)
+         + jnp.einsum("tnr,tcr->tnc", q_pe, kpe)).astype(jnp.float32) * scale
+    mask = jnp.arange(MB * bs)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    out_lat = jnp.einsum("tnc,tck->tnk", p, ckv)         # [T, N, kvr]
+    return jnp.einsum("tnk,knd->tnd", out_lat, w_uv)     # [T, N, dv]
 
 
 def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
@@ -71,20 +126,25 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One SplitFuse tick over a flat token batch.
 
-    MLA (DeepSeek) models are not supported here yet — the paged pool is
-    laid out per (kv_head, head_dim); serve those through the v1
-    InferenceEngine (its latent-cache decode path handles MLA).
-
     tokens [T] int32, positions [T] int32, tables [T, MB] int32 (rows shared
     by tokens of the same sequence). Returns (logits [T, vocab] fp32,
     updated pool). Parity: the reference's model-implementation forward over
     a RaggedBatchWrapper (``inference/v2/model_implementations``).
+
+    MLA (DeepSeek) models pool latents and attend weight-absorbed
+    (:func:`paged_mla_attention_reference`); ALiBi models (BLOOM/Falcon)
+    bias the paged scores by head slope × relative position.
     """
     if cfg.mla:
-        raise NotImplementedError(
-            "MLA (DeepSeek) models are not supported by the paged/FastGen "
-            "path yet; use the v1 InferenceEngine (latent-cache decode)")
+        return _forward_paged_mla(params, tokens, positions, tables, pool,
+                                  cfg)
     attention_fn = attention_fn or paged_attention_reference
+    alibi = None
+    if cfg.pos_emb == "alibi":
+        # the Pallas kernel has no bias input yet — ALiBi ticks use the
+        # XLA reference path (correct, rectangular-gather cost)
+        attention_fn = paged_attention_reference
+        alibi = T.alibi_slopes(cfg.num_heads) * cfg.alibi_bias_scale
     dt = cfg.compute_dtype
     Tn = tokens.shape[0]
     bs = pool["k"].shape[2]
@@ -148,7 +208,11 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
         pv = pv.at[base + block_idx, offsets].set(v.astype(pv.dtype),
                                                   mode="drop")
 
-        attn = attention_fn(q, pk, pv, tables + base, lengths)  # [T, N, D]
+        if alibi is not None:
+            attn = attention_fn(q, pk, pv, tables + base, lengths,
+                                alibi=alibi)                    # [T, N, D]
+        else:
+            attn = attention_fn(q, pk, pv, tables + base, lengths)
         attn = attn.reshape(Tn, cfg.num_heads * cfg.head_dim)
         attn_out = attn @ lp["wo"].astype(dt)
         if cfg.use_bias:
@@ -174,3 +238,72 @@ def forward_paged(params: PyTree, tokens: jax.Array, positions: jax.Array,
     if cfg.lm_head_bias:
         logits = logits + params["lm_head_b"].astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
+
+
+def _forward_paged_mla(params: PyTree, tokens: jax.Array,
+                       positions: jax.Array, tables: jax.Array,
+                       pool: Dict[str, jax.Array], cfg: T.TransformerConfig
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """MLA SplitFuse tick: write c_kv/k_pe LATENTS into the paged pool and
+    attend weight-absorbed (same flat in-place pool carry as the dense
+    path; same math as the v1 engine's latent-cache decode)."""
+    dt = cfg.compute_dtype
+    Tn = tokens.shape[0]
+    bs = pool["ckv"].shape[2]
+
+    x = params["tok_emb"].astype(dt)[tokens]
+    if cfg.emb_norm:
+        x = T._norm(x, params["emb_norm"], cfg.norm, cfg.norm_eps)
+
+    max_pos = pool["ckv"].shape[1] * bs
+    cos_t, sin_t = T.rope_table(max_pos, cfg.qk_rope_head_dim,
+                                cfg.rope_theta, cfg.rope_scaling_dict)
+
+    def rope_fn(v):                                   # v [T, 1, n, dr]
+        return T.apply_rope_at(v, cos_t, sin_t, positions[:, None])
+
+    block_idx = jnp.take_along_axis(
+        tables, (positions // bs)[:, None], axis=1)[:, 0]
+    offsets = positions % bs
+    lengths = positions + 1
+    L, NB = pool["ckv"].shape[0], pool["ckv"].shape[1]
+    fck = (L * NB,) + pool["ckv"].shape[2:]
+    fkp = (L * NB,) + pool["kpe"].shape[2:]
+
+    def body(carry, lp):
+        from deepspeed_tpu.ops.quantization import dequant_params
+
+        x, pck, pkp, li = carry
+        lp = dequant_params(lp, dt)
+        h = T._norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+        hB = h[:, None, :]                            # [T, 1, H]
+        q = T._mla_q(hB, lp, cfg, rope_fn)[:, 0]      # [T, N, dn+dr]
+        c_kv, k_pe = T._mla_latents(hB, lp, cfg, rope_fn)
+        ckv_t = c_kv[:, 0]                            # [T, kvr]
+        kpe_t = k_pe[:, 0, 0]                         # [T, dr]
+
+        base = li * NB
+        pck = pck.at[base + block_idx, offsets].set(
+            ckv_t.astype(pck.dtype), mode="drop")
+        pkp = pkp.at[base + block_idx, offsets].set(
+            kpe_t.astype(pkp.dtype), mode="drop")
+
+        attn = paged_mla_attention_reference(
+            q, pck, pkp, tables + base, lengths, lp["wkv_b"], cfg)
+        attn = attn.reshape(Tn, cfg.num_heads * cfg.v_head_dim)
+        attn_out = attn @ lp["wo"].astype(dt)
+        x = x + attn_out
+        h2 = T._norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        down, _ = T._ffn(h2, lp, cfg)
+        return (x + down, pck, pkp, li + 1), None
+
+    carry0 = (x, pool["ckv"].reshape(fck), pool["kpe"].reshape(fkp),
+              jnp.int32(0))
+    (x, new_ck, new_kp, _), _ = lax.scan(body, carry0, params["blocks"])
+    x = T._norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    head = T._lm_head_of(params, cfg)
+    logits = T.head_matmul(x, head.astype(x.dtype))
+    if cfg.lm_head_bias:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    return logits, {"ckv": new_ck.reshape(pool["ckv"].shape),
+                    "kpe": new_kp.reshape(pool["kpe"].shape)}

@@ -76,18 +76,28 @@ def _q_allgather(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
 
 
 def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
-                      block: int) -> jax.Array:
+                      block: int, return_sent: bool = False):
     """int8-wire reduce-scatter: rows [world, n] per-rank contributions →
     my reduced row [n] (sum). all_to_all int8 blocks, dequant-sum locally —
-    the qgZ quant_reduce flow."""
+    the qgZ quant_reduce flow. ``return_sent`` additionally returns the
+    locally-dequantized send rows [world, n] (what the wire actually
+    carried — the LoCo error term needs it); ONE copy of the wire
+    protocol serves both the plain and error-compensated paths."""
     n = rows.shape[1]
     pad = (-n) % block
     rp = jnp.pad(rows.astype(jnp.float32), ((0, 0), (0, pad)))
     q, s = jax.vmap(lambda r: quantize_int8(r, block))(rp)      # [world, n_pad]
-    q = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
-    s = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
-    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
-    return jnp.sum(deq, axis=0)[:n]
+    sent = None
+    if return_sent:
+        sent = jax.vmap(
+            lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)[:, :n]
+    qr = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    sr = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
+    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qr, sr)
+    mine = jnp.sum(deq, axis=0)[:n]
+    if return_sent:
+        return mine, sent
+    return mine
 
 
 def _q_allreduce(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
@@ -209,16 +219,9 @@ def loco_reduce_leaf(g: jax.Array, err: jax.Array, spec: P,
     m = jnp.moveaxis(g, dim, 0).astype(jnp.float32)
     rows = m.reshape(gworld, -1)                          # [gw, n_loc]
     comp = rows + err.astype(jnp.float32).reshape(rows.shape)
-    n = comp.shape[1]
-    pad = (-n) % block
-    cp = jnp.pad(comp, ((0, 0), (0, pad)))
-    q, s = jax.vmap(lambda r: quantize_int8(r, block))(cp)
-    sent = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)
-    new_err = (cp - sent)[:, :n].reshape(err.shape).astype(err.dtype)
-    qr = lax.all_to_all(q, gaxes, split_axis=0, concat_axis=0, tiled=True)
-    sr = lax.all_to_all(s, gaxes, split_axis=0, concat_axis=0, tiled=True)
-    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qr, sr)
-    mine = jnp.sum(deq, axis=0)[:n]
+    mine, sent = _q_reduce_scatter(comp, gaxes, gworld, block,
+                                   return_sent=True)
+    new_err = (comp - sent).reshape(err.shape).astype(err.dtype)
     if replica_axes:
         mine = lax.psum(mine, replica_axes)
     mine = mine / world

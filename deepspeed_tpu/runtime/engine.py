@@ -378,8 +378,11 @@ class DeepSpeedTPUEngine:
         elif opt_type.startswith("onebit"):
             # fp16 excluded: the overflow skip decision would be taken on
             # per-rank (unreduced) grad norms — divergent control flow around
-            # the transport collectives
-            if self.zero_stage == 0 and eligible and not self.fp16_enabled \
+            # the transport collectives. expert=1 stays required HERE (qgZ
+            # composes with MoE; the 1-bit momentum transport's per-rank
+            # error buffers under expert sharding are untested territory).
+            onebit_ok = eligible and shape.get("expert", 1) == 1
+            if self.zero_stage == 0 and onebit_ok and not self.fp16_enabled \
                     and hasattr(self.optimizer, "transport"):
                 self._onebit_wire = True
                 log_dist("1-bit optimizer wire transport active: packed-sign "

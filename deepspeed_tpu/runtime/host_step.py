@@ -64,18 +64,21 @@ class HostStepRunner:
         if engine.mesh.shape.get("pipe", 1) > 1:
             raise DeepSpeedConfigError(
                 "host_step is not supported with pipeline parallelism")
-        if engine.zero_stage >= 1 or engine.mesh.shape.get("zshard", 1) > 1:
-            raise DeepSpeedConfigError(
-                "host_step keeps the FULL fp32 master + moments in host RAM "
-                "(the reference ZeRO-Offload/SuperOffload memory model) — "
-                "ZeRO sharding of optimizer state does not compose with it; "
-                "use zero_optimization.stage=0")
         if jax.process_count() > 1:
             raise DeepSpeedConfigError(
                 "host_step is single-host for now: the update runs on this "
                 "process's CPU backend and cannot address remote shards")
         self.engine = engine
         self.cpu = _cpu_device()
+        # HOST-SHARDED state (reference SuperOffload is a STAGE-3 optimizer,
+        # superoffload_stage3.py:27): the fp32 master + moments shard across
+        # the host backend's devices — each holds 1/H of the state and the
+        # update runs SPMD over the host mesh. One CPU device (production
+        # TPU host) degenerates to the full-resident model; the 8-virtual-
+        # device test env exercises real host sharding. Device-side 16-bit
+        # params keep the engine's param_spec (stage-3 sharded on device),
+        # so ZeRO stages now compose with host_step.
+        self.host_mesh, self._host_shardings = self._build_host_placement()
         zcfg = engine.config.zero_optimization
         explicit = zcfg.offload_optimizer.overlap_step
         if explicit is not None:
@@ -91,15 +94,45 @@ class HostStepRunner:
                  "fp32 master + moments on host, 16-bit params on device")
 
     # ------------------------------------------------------------- state
+    def _build_host_placement(self):
+        """Host mesh over the CPU backend's local devices + per-leaf
+        shardings: each fp32 leaf shards its largest H-divisible dim over
+        the 'host' axis (replicated when none divides — tiny leaves)."""
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.local_devices(backend="cpu")
+        mesh = Mesh(np.array(devs), ("host",))
+        H = len(devs)
+
+        def spec_of(shape):
+            for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
+                if shape[i] % H == 0 and shape[i] >= H:
+                    parts = [None] * len(shape)
+                    parts[i] = "host"
+                    return P(*parts)
+            return P()
+
+        def shardings_like(tree):
+            return jax.tree.map(
+                lambda x: NamedSharding(mesh, spec_of(tuple(x.shape))), tree)
+
+        return mesh, shardings_like
+
     def adopt_state(self) -> None:
-        """Move master/opt of ``engine.state`` to the host CPU backend and
-        (re)build the device 16-bit params. Called at init and after
+        """Move master/opt of ``engine.state`` onto the host mesh (sharded)
+        and (re)build the device 16-bit params. Called at init and after
         checkpoint restore."""
         eng = self.engine
         st = eng.state
-        st["master"] = jax.device_put(st["master"], self.cpu)
-        st["opt"] = jax.device_put(st["opt"], self.cpu)
-        st["step"] = jax.device_put(st["step"], self.cpu)
+        st["master"] = jax.device_put(st["master"],
+                                      self._host_shardings(st["master"]))
+        st["opt"] = jax.device_put(st["opt"],
+                                   self._host_shardings(st["opt"]))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        st["step"] = jax.device_put(
+            st["step"], NamedSharding(self.host_mesh, P()))
         # jnp.array (copy=True): the cast is a no-op when master is already
         # fp32 on this device (CPU tests) and the update jit DONATES master —
         # device_params must never alias it
@@ -183,14 +216,19 @@ class HostStepRunner:
             # update k-1; land it now (one-step staleness, full overlap)
             self._apply_pending()
 
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         lr_mult = jnp.float32(1.0)
         if isinstance(batch, dict) and "lr_scale" in batch:
             lr_mult = jnp.mean(batch["lr_scale"].astype(jnp.float32))
-        gh = jax.device_put(grads, self.cpu)    # async D2H stream
+        # async D2H stream, SCATTERED: each host shard receives only its
+        # slice of the gradients
+        gh = jax.device_put(grads, self._host_shardings(grads))
         st = eng.state
+        rep = NamedSharding(self.host_mesh, P())
         new_master, new_opt, compute16, m = self._update_jit(
             st["master"], st["opt"], gh, st["step"],
-            jnp.float32(gas), jax.device_put(lr_mult, self.cpu))
+            jnp.float32(gas), jax.device_put(lr_mult, rep))
         eng.state = {"step": st["step"] + 1, "master": new_master,
                      "opt": new_opt}
         self._pending16 = compute16

@@ -283,9 +283,26 @@ def test_fastgen_generate_all_frees_blocks_of_done_seqs():
     assert fg.allocator.free_blocks == 7
 
 
-def test_fastgen_alibi_rejected():
-    with pytest.raises(NotImplementedError, match="ALiBi"):
-        FastGenEngine("tiny", **dict(CFG, pos_emb="alibi"))
+def test_fastgen_alibi_greedy_matches_slot_engine():
+    """BLOOM-style ALiBi models serve on the paged engine: head-slope
+    relative-position bias in the paged scores reproduces the v1 slot
+    engine's greedy stream exactly (both planned and dynamic serving)."""
+    cfg = dict(CFG, pos_emb="alibi")
+    rng = np.random.default_rng(9)
+    prompts = _prompts(rng, [5, 18, 31])
+    uids = [1, 2, 3]
+    new = 10
+    slot = RaggedInferenceEngine("tiny", max_slots=4, max_len=128,
+                                 temperature=0.0, seed=0, **cfg)
+    want = slot.generate_all(uids, prompts, max_new_tokens=new)
+    for planned in (False, True):
+        fg = FastGenEngine("tiny", n_blocks=32, block_size=16,
+                           max_blocks_per_seq=8, token_budget=32,
+                           temperature=0.0, seed=0, **cfg)
+        got = fg.generate_all(uids, prompts, max_new_tokens=new,
+                              planned=planned)
+        for u in uids:
+            assert got[u] == want[u], (planned, u, got[u], want[u])
 
 
 def test_fastgen_prompt_longer_than_budget():
@@ -370,17 +387,32 @@ def test_fastgen_throughput_vs_slot_engine():
         f"FastGen warm {t_fg_warm*1e3:.0f}ms vs slot {t_slot_warm*1e3:.0f}ms")
 
 
-def test_mla_rejected_with_clear_error():
-    """DeepSeek/MLA models must fail fast in the paged path (latent cache
-    layout differs) — serve them through the v1 InferenceEngine instead."""
-    from deepspeed_tpu.models import paged as P
+def test_fastgen_mla_greedy_matches_slot_engine():
+    """DeepSeek-style MLA serves on the paged engine: the pool holds the
+    LATENTS (c_kv + shared post-rope key — the tiny row paged KV is made
+    for) and attention runs weight-absorbed. Greedy parity with the v1
+    engine's latent-cache decode, planned and dynamic."""
     from deepspeed_tpu.models import transformer as T
 
     cfg = T.TransformerConfig(
-        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
-        mla=True, kv_lora_rank=8, qk_nope_head_dim=8, qk_rope_head_dim=4,
-        v_head_dim=8, pos_emb="rope", norm="rmsnorm", activation="swiglu",
-        use_bias=False, dtype="float32", max_seq_len=32)
-    with pytest.raises(NotImplementedError, match="MLA"):
-        P.forward_paged(None, None, None, None,
-                        {"k": jnp.zeros((1, 4, 8, 1, 8))}, cfg)
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        mla=True, kv_lora_rank=16, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16, q_lora_rank=0, pos_emb="rope", norm="rmsnorm",
+        activation="swiglu", use_bias=False, dtype="float32",
+        max_seq_len=128)
+    rng = np.random.default_rng(10)
+    prompts = _prompts(rng, [6, 21, 34])
+    uids = [1, 2, 3]
+    new = 10
+    slot = RaggedInferenceEngine(cfg, max_slots=4, max_len=128,
+                                 temperature=0.0, seed=0)
+    want = slot.generate_all(uids, prompts, max_new_tokens=new)
+    for planned in (False, True):
+        fg = FastGenEngine(cfg, n_blocks=32, block_size=16,
+                           max_blocks_per_seq=8, token_budget=32,
+                           temperature=0.0, seed=0)
+        assert set(fg.pool) == {"ckv", "kpe"}   # latent pool layout
+        got = fg.generate_all(uids, prompts, max_new_tokens=new,
+                              planned=planned)
+        for u in uids:
+            assert got[u] == want[u], (planned, u, got[u], want[u])

@@ -1194,16 +1194,22 @@ def _pipeline_parts(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         y, auxes = lax.scan(body, x_in, blocks_l)
         return y, jnp.sum(auxes)
 
-    def finalize_fn(y, micro, ex):
+    def logits_fn(y, ex):
+        """ONE head implementation for every pipeline schedule (training
+        loss and forward-only inference must agree). Plain dot (not the
+        custom-vjp head_matmul): inside the pipe shard_map the replicated
+        head's cotangent needs the automatic varying->replicated psum,
+        which a custom_vjp would bypass."""
         h = _norm(y, ex["final_norm"], cfg.norm, cfg.norm_eps)
-        # plain dot (not the custom-vjp head_matmul): inside the pipe
-        # shard_map the replicated head's cotangent needs the automatic
-        # varying->replicated psum, which a custom_vjp would bypass
-        logits = jnp.matmul(h, ex["head"].astype(h.dtype),
-                            preferred_element_type=jnp.float32)
-        return causal_lm_loss(logits, micro["tokens"], micro.get("loss_mask"))
+        return jnp.matmul(h, ex["head"].astype(h.dtype),
+                          preferred_element_type=jnp.float32)
 
-    return mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn
+    def finalize_fn(y, micro, ex):
+        return causal_lm_loss(logits_fn(y, ex), micro["tokens"],
+                              micro.get("loss_mask"))
+
+    return mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn, \
+        logits_fn
 
 
 def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
@@ -1219,11 +1225,33 @@ def pipelined_lm_loss(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     """
     from deepspeed_tpu.parallel.pipeline import pipelined_apply
 
-    mesh, M, _, _, inputs, extra, stage_fn, finalize_fn = _pipeline_parts(
+    mesh, M, _, _, inputs, extra, stage_fn, finalize_fn, _ = _pipeline_parts(
         params, tokens, cfg, mesh, n_micro, attention_fn,
         activation_constraint, loss_mask)
     return pipelined_apply(inputs, params["blocks"], extra, stage_fn,
                            finalize_fn, mesh)
+
+
+def pipelined_lm_logits(params: PyTree, tokens: jax.Array,
+                        cfg: TransformerConfig, mesh=None,
+                        n_micro: Optional[int] = None,
+                        attention_fn: Optional[AttentionFn] = None,
+                        activation_constraint: Optional[Callable] = None
+                        ) -> jax.Array:
+    """Forward-only pipelined logits (reference ``runtime/pipe/schedule.py:135
+    InferenceSchedule``): batched inference across the 'pipe' mesh axis —
+    fill wavefront only, no backward machinery. Returns [B, S, vocab] fp32.
+    """
+    from deepspeed_tpu.parallel.pipeline import pipelined_infer
+
+    mesh, M, _, _, inputs, extra, stage_fn, _, logits_fn = _pipeline_parts(
+        params, tokens, cfg, mesh, n_micro, attention_fn,
+        activation_constraint, None)
+
+    out = pipelined_infer(inputs, params["blocks"], extra, stage_fn,
+                          logits_fn, mesh)                # [M, B/M, S, V]
+    B, S = tokens.shape
+    return out.reshape(B, S, -1)
 
 
 def pipelined_lm_loss_and_grads(params: PyTree, tokens: jax.Array,
@@ -1242,7 +1270,7 @@ def pipelined_lm_loss_and_grads(params: PyTree, tokens: jax.Array,
     GPipe path)."""
     from deepspeed_tpu.parallel.pipeline import pipelined_train_1f1b
 
-    mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn = \
+    mesh, M, embed, embp, inputs, extra, stage_fn, finalize_fn, _ = \
         _pipeline_parts(params, tokens, cfg, mesh, n_micro, attention_fn,
                         activation_constraint, loss_mask)
     dt = cfg.compute_dtype

@@ -111,6 +111,58 @@ def pipelined_apply(inputs: Dict[str, jax.Array], blocks: PyTree, extra: PyTree,
     return fn(inputs, blocks, extra)
 
 
+def pipelined_infer(inputs: Dict[str, jax.Array], blocks: PyTree,
+                    extra: PyTree, stage_fn: Callable, head_fn: Callable,
+                    mesh: Mesh, axis_name: str = PIPE_AXIS) -> jax.Array:
+    """Forward-only pipeline schedule (reference ``runtime/pipe/schedule.py:135
+    InferenceSchedule``): the fill wavefront only — ``M + P - 1`` ticks, no
+    backward pass, no loss. The LAST stage applies ``head_fn(y, extra) ->
+    per-micro outputs`` and the stacked [M, ...] result is returned
+    replicated (non-last stages contribute zeros; one psum collects).
+    """
+    n_stages = mesh.shape[axis_name]
+    M = jax.tree.leaves(inputs)[0].shape[0]
+    T = M + n_stages - 1
+
+    def local(inputs_l, blocks_l, extra_l):
+        stage = lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        xm = inputs_l["x"]
+        recv0 = jnp.zeros(xm.shape[1:], xm.dtype)
+        out_shape = jax.eval_shape(head_fn, jax.ShapeDtypeStruct(
+            xm.shape[1:], xm.dtype), extra_l)
+        outbuf0 = jnp.zeros((M,) + out_shape.shape, out_shape.dtype)
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            m_in = t - stage
+            x_in = jnp.where(is_first, xm[jnp.clip(m_in, 0, M - 1)], recv)
+            y, _aux = stage_fn(x_in, blocks_l, extra_l)
+            out_idx = t - (n_stages - 1)
+            valid = (out_idx >= 0) & (out_idx < M) & is_last
+            idx = jnp.clip(out_idx, 0, M - 1)
+            cur = outbuf[idx]
+            new = jnp.where(valid, head_fn(y, extra_l).astype(cur.dtype),
+                            cur)
+            outbuf = lax.dynamic_update_index_in_dim(outbuf, new, idx, 0)
+            send = lax.ppermute(y, axis_name, stage_perm(n_stages))
+            return (send, outbuf), None
+
+        carry0 = jax.tree.map(
+            lambda a: lax.pcast(a, (axis_name,), to="varying"),
+            (recv0, outbuf0))
+        (_, outbuf), _ = lax.scan(tick, carry0, jnp.arange(T))
+        return lax.psum(outbuf, axis_name)   # only the last stage wrote
+
+    in_specs = (_replicated_specs(inputs),
+                _stage_sharded_specs(blocks, axis_name),
+                _replicated_specs(extra))
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                   axis_names={axis_name}, check_vma=False)
+    return fn(inputs, blocks, extra)
+
+
 def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
     """[B, ...] → [M, B/M, ...]."""
     B = x.shape[0]

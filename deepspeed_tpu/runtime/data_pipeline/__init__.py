@@ -1,8 +1,13 @@
 """Data efficiency (reference ``runtime/data_pipeline/``): curriculum
-learning + random-LTD."""
+learning + random-LTD + the offline difficulty analyzer."""
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
     CurriculumScheduler,
     curriculum_dataloader,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalysis,
+    DataAnalyzer,
+    curriculum_sample_dataloader,
 )
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
     RandomLTDScheduler,
@@ -14,6 +19,9 @@ from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
 __all__ = [
     "CurriculumScheduler",
     "curriculum_dataloader",
+    "DataAnalyzer",
+    "DataAnalysis",
+    "curriculum_sample_dataloader",
     "RandomLTDScheduler",
     "gather_tokens",
     "random_token_select",

@@ -1,0 +1,126 @@
+"""Offline data analysis feeding curriculum learning.
+
+Parity: reference ``runtime/data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer`` — maps every sample to a difficulty metric, writes index
+files, and the curriculum consumes difficulty→sample maps) and
+``data_sampling/indexed_dataset`` (the persisted index). The repo's
+curriculum scheduler previously consumed a difficulty SCHEDULE but nothing
+produced per-sample difficulty indices — this closes that loop.
+
+TPU note: analysis is a host-side, offline pass (numpy); nothing here runs
+under jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+MANIFEST = "data_analysis.json"
+
+
+def _seqlen_metric(sample: np.ndarray, pad_token_id: int) -> float:
+    """Non-pad token count (the reference's seqlen curriculum metric)."""
+    return float(np.sum(np.asarray(sample) != pad_token_id))
+
+
+def _vocab_rarity_metric(sample: np.ndarray, pad_token_id: int) -> float:
+    """Mean token id as a cheap rarity proxy (BPE ids are roughly
+    frequency-ranked — the reference's vocabularyrarity metric uses the
+    same observation)."""
+    s = np.asarray(sample)
+    s = s[s != pad_token_id]
+    return float(np.mean(s)) if s.size else 0.0
+
+
+METRICS: Dict[str, Callable[[np.ndarray, int], float]] = {
+    "seqlen": _seqlen_metric,
+    "vocab_rarity": _vocab_rarity_metric,
+}
+
+
+@dataclasses.dataclass
+class DataAnalysis:
+    """Per-sample difficulty index (the analyzer's output artifact)."""
+
+    metric: str
+    difficulties: np.ndarray           # [N] float — difficulty per sample
+
+    def sample_map(self, max_difficulty: float) -> np.ndarray:
+        """Indices of samples at or below a difficulty threshold — what the
+        curriculum draws from at its current difficulty (reference
+        curriculum data-sampling semantics)."""
+        return np.nonzero(self.difficulties <= max_difficulty)[0]
+
+    def sorted_indices(self) -> np.ndarray:
+        """Sample indices easiest-first (stable)."""
+        return np.argsort(self.difficulties, kind="stable")
+
+    def save(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        np.save(os.path.join(out_dir, "difficulties.npy"), self.difficulties)
+        with open(os.path.join(out_dir, MANIFEST), "w") as f:
+            json.dump({"metric": self.metric,
+                       "n_samples": int(self.difficulties.shape[0]),
+                       "min": float(self.difficulties.min()),
+                       "max": float(self.difficulties.max())}, f)
+
+    @classmethod
+    def load(cls, out_dir: str) -> "DataAnalysis":
+        with open(os.path.join(out_dir, MANIFEST)) as f:
+            meta = json.load(f)
+        diffs = np.load(os.path.join(out_dir, "difficulties.npy"))
+        return cls(metric=meta["metric"], difficulties=diffs)
+
+
+class DataAnalyzer:
+    """Offline pass over a dataset producing a :class:`DataAnalysis`.
+
+    ``metric``: a key of :data:`METRICS` or a callable
+    ``fn(sample) -> float`` (e.g. a model-loss scorer).
+    """
+
+    def __init__(self, metric: Any = "seqlen", pad_token_id: int = 0):
+        if callable(metric):
+            self._fn = lambda s, _pad: float(metric(s))
+            self.metric_name = getattr(metric, "__name__", "custom")
+        else:
+            if metric not in METRICS:
+                raise ValueError(
+                    f"unknown metric {metric!r}; one of {sorted(METRICS)} "
+                    "or a callable")
+            self._fn = METRICS[metric]
+            self.metric_name = metric
+        self.pad_token_id = pad_token_id
+
+    def run(self, samples: Iterable[np.ndarray]) -> DataAnalysis:
+        diffs = np.asarray(
+            [self._fn(np.asarray(s), self.pad_token_id) for s in samples],
+            np.float32)
+        if diffs.size == 0:
+            raise ValueError("empty dataset")
+        return DataAnalysis(metric=self.metric_name, difficulties=diffs)
+
+
+def curriculum_sample_dataloader(samples, analysis: DataAnalysis,
+                                 scheduler, step_fn,
+                                 batch_size: int,
+                                 seed: int = 0) -> Iterator[np.ndarray]:
+    """Difficulty-SAMPLED curriculum batches: each batch is drawn only from
+    samples whose analyzed difficulty ≤ the scheduler's current difficulty
+    (the reference's data-map consumption — complements the existing
+    sequence-truncation ``curriculum_dataloader``). Samples must share a
+    shape (pad beforehand)."""
+    rng = np.random.default_rng(seed)
+    arr = np.asarray(samples)
+    while True:
+        d = scheduler.update_difficulty(step_fn())
+        pool = analysis.sample_map(d)
+        if pool.size == 0:
+            # always have something to train on: fall back to the easiest
+            pool = analysis.sorted_indices()[:max(1, batch_size)]
+        idx = rng.choice(pool, size=batch_size, replace=pool.size < batch_size)
+        yield arr[idx]

@@ -178,3 +178,63 @@ class TestCompression:
         losses = [float(engine.train_batch(data)) for _ in range(8)]
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0] - 0.05
+
+
+class TestDataAnalyzer:
+    """Offline difficulty analyzer (reference
+    ``data_sampling/data_analyzer.py``) + curriculum data-map consumption."""
+
+    def _samples(self):
+        rng = np.random.default_rng(0)
+        out = []
+        for n in (4, 8, 16, 24, 32):
+            s = np.zeros(32, np.int32)
+            s[:n] = rng.integers(1, 500, n)
+            out.append(s)
+        return out
+
+    def test_seqlen_metric_and_sample_map(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            DataAnalysis, DataAnalyzer)
+
+        analysis = DataAnalyzer(metric="seqlen").run(self._samples())
+        np.testing.assert_array_equal(analysis.difficulties,
+                                      [4, 8, 16, 24, 32])
+        np.testing.assert_array_equal(analysis.sample_map(16), [0, 1, 2])
+        np.testing.assert_array_equal(analysis.sorted_indices(),
+                                      [0, 1, 2, 3, 4])
+        analysis.save(str(tmp_path))
+        back = DataAnalysis.load(str(tmp_path))
+        assert back.metric == "seqlen"
+        np.testing.assert_array_equal(back.difficulties,
+                                      analysis.difficulties)
+
+    def test_custom_metric_callable(self):
+        from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+
+        analysis = DataAnalyzer(metric=lambda s: float(s.max())).run(
+            [np.array([1, 5]), np.array([9, 2])])
+        np.testing.assert_array_equal(analysis.difficulties, [5, 9])
+
+    def test_curriculum_consumes_difficulty_map(self):
+        """The scheduler's ramp gates which samples the loader draws — the
+        analyzer→curriculum loop the reference builds with data maps."""
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler, DataAnalyzer, curriculum_sample_dataloader)
+
+        samples = self._samples()
+        analysis = DataAnalyzer(metric="seqlen").run(samples)
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 32, "schedule_type": "fixed_linear",
+            "total_curriculum_step": 10, "difficulty_step": 8})
+        step = {"n": 0}
+        it = curriculum_sample_dataloader(
+            samples, analysis, sched, lambda: step["n"], batch_size=4)
+        early = next(it)                       # difficulty 8 → samples 0-1
+        assert set(np.sum(early != 0, axis=1)) <= {4, 8}
+        step["n"] = 100                        # ramp done → everything
+        seen = set()
+        for _ in range(8):
+            seen |= set(np.sum(next(it) != 0, axis=1).tolist())
+        assert 32 in seen and 24 in seen

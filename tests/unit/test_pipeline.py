@@ -272,3 +272,26 @@ class Test1F1B:
         data = it()
         losses = [float(engine.train_batch(data)) for _ in range(15)]
         assert losses[-1] < losses[0] - 1.5, losses
+
+
+def test_pipelined_infer_matches_single_device_logits():
+    """Forward-only InferenceSchedule analog (reference
+    ``runtime/pipe/schedule.py:135``): pipelined logits == the plain
+    forward's logits, with no backward machinery in the program."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh
+    from deepspeed_tpu.models import transformer as T
+
+    mesh_mod.reset_mesh()
+    mm = initialize_mesh(MeshConfig(pipe=2, data=4))
+    cfg = T.get_model_config("tiny", dtype="float32", max_seq_len=64)
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, 512)
+
+    want = T.forward(params, tokens, cfg)
+    with mm.mesh:
+        got = jax.jit(lambda p, t: T.pipelined_lm_logits(
+            p, t, cfg, mesh=mm.mesh, n_micro=4))(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    mesh_mod.reset_mesh()

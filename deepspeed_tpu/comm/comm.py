@@ -381,7 +381,11 @@ def broadcast(tensor, src: int = 0, group: AxisSpec = None):
 
     if _is_traced(tensor):
         return f(tensor)
-    # Eager SPMD: every process holds the same value already; return as-is.
+    # Eager single-process SPMD: every caller holds the value already. With
+    # multiple PROCESSES host values can genuinely diverge (the case
+    # broadcast exists for) — route through the real host broadcast.
+    if jax.process_count() > 1:
+        return jnp.asarray(host_broadcast(np.asarray(tensor), src=src))
     return jnp.asarray(tensor)
 
 

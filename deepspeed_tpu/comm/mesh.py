@@ -174,3 +174,15 @@ def mesh_is_initialized() -> bool:
 def reset_mesh() -> None:
     global _GLOBAL_MESH
     _GLOBAL_MESH = None
+    for hook in _RESET_HOOKS:
+        hook()
+
+
+# callbacks run on reset_mesh() — lets mesh-keyed caches elsewhere (e.g.
+# moe.layer._SHARDED_FN_CACHE's compiled shard_map programs) die with the
+# mesh instead of leaking across re-initializations
+_RESET_HOOKS = []
+
+
+def on_reset_mesh(hook) -> None:
+    _RESET_HOOKS.append(hook)

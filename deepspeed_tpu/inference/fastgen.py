@@ -581,15 +581,31 @@ class FastGenEngine:
                     list(s.generated), s.last_tok, s.done)
                 for u, s in self.seqs.items()}
         free_snap = list(self.allocator._free)
-        plan = self._plan_schedule(max_new_tokens, until_prefilled)
-        if plan is None:
+
+        def restore():
             for u, st in snap.items():
                 s = self.seqs[u]
                 s.prefilled, s.pos = st[0], st[1]
                 s.blocks, s.table = st[2], st[3]
                 s.generated, s.last_tok, s.done = st[4], st[5], st[6]
             self.allocator._free = free_snap
-            return False
+
+        # any failure between planning (which advances seq positions /
+        # allocator state) and the device call landing (compile error,
+        # device OOM, interrupt) must roll the host bookkeeping back —
+        # otherwise positions stay advanced with no tokens recorded and the
+        # engine is permanently corrupted
+        try:
+            plan = self._plan_schedule(max_new_tokens, until_prefilled)
+            if plan is None:
+                restore()
+                return False
+            return self._serve_planned_device(plan, max_new_tokens)
+        except Exception:
+            restore()
+            raise
+
+    def _serve_planned_device(self, plan, max_new_tokens: int) -> bool:
         order, ticks = plan
         if not ticks:
             return True

@@ -10,7 +10,8 @@ jax.distributed, run the script. Multi-host fan-out itself is the platform's
 job (GKE/xpk/gcloud), matching how TPU pods are actually operated.
 
 CLI:
-    python -m deepspeed_tpu.launcher.runner [--bind_cores] script.py [args...]
+    python -m deepspeed_tpu.launcher.runner [--bind_cores_to_rank] \
+        script.py [args...]
 """
 from __future__ import annotations
 
@@ -34,6 +35,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="process count for multi-host bring-up")
     p.add_argument("--node_rank", type=int, default=None,
                    help="this process's index for multi-host bring-up")
+    p.add_argument("--bind_cores_to_rank", action="store_true",
+                   help="pin this process to an equal slice of host cores "
+                        "by local rank (reference bin/deepspeed "
+                        "--bind_cores_to_rank; one process per TPU host ⇒ "
+                        "the slice is usually all cores, but under "
+                        "multi-process-per-host CPU lanes it partitions)")
+    p.add_argument("--bind_core_list", default=None,
+                   help="explicit comma/range core list to bind (e.g. "
+                        "'0-7,16-23'); implies --bind_cores_to_rank")
     p.add_argument("--module", action="store_true",
                    help="run the target as a python module (python -m)")
     p.add_argument("script", help="training script (or module with --module)")
@@ -62,8 +72,45 @@ def maybe_init_distributed(args: argparse.Namespace) -> None:
             f"process {jax.process_index()}/{jax.process_count()}")
 
 
+def parse_core_list(spec: str) -> List[int]:
+    """'0-3,8,10-11' → [0,1,2,3,8,10,11]."""
+    cores: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-")
+            cores.extend(range(int(lo), int(hi) + 1))
+        else:
+            cores.append(int(part))
+    return cores
+
+
+def bind_cores(args: argparse.Namespace) -> None:
+    """Pin the process to its core slice (reference launcher/launch.py
+    ``--bind_cores_to_rank``: numactl per local rank). One process per TPU
+    host normally owns every core; when several processes share a host
+    (CPU lanes, tests) each gets an equal contiguous slice by local rank."""
+    if not (args.bind_cores_to_rank or args.bind_core_list):
+        return
+    avail = sorted(os.sched_getaffinity(0))
+    pool = avail
+    if args.bind_core_list:
+        pool = [c for c in parse_core_list(args.bind_core_list)
+                if c in avail] or avail
+    local_rank = int(os.environ.get("LOCAL_RANK", 0) or 0)
+    local_size = int(os.environ.get("LOCAL_WORLD_SIZE", 1) or 1)
+    per = max(1, len(pool) // max(1, local_size))
+    want = pool[local_rank * per:(local_rank + 1) * per] or pool
+    os.sched_setaffinity(0, want)
+    os.environ.setdefault("OMP_NUM_THREADS", str(len(want)))
+    logger.info(f"bound to {len(want)} host cores: {want[0]}-{want[-1]}")
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
+    bind_cores(args)
     maybe_init_distributed(args)
     sys.argv = [args.script] + args.script_args
     if args.module:

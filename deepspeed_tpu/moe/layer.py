@@ -17,7 +17,10 @@ Two dispatch modes (``dispatch=`` / ``TransformerConfig.moe_dispatch``):
   (a global argsort would gather the batch); under expert parallelism a
   fixed-capacity all-to-all moves packed token buffers between expert
   shards (capacity is per expert-SHARD — E/ep coarser than per-expert, so
-  drops are far rarer than the dense path at equal capacity_factor).
+  drops are far rarer than the dense path at equal capacity_factor; i.e.
+  ragged is only fully dropless OFF expert-parallel meshes — under EP a
+  skewed router can still overflow the buffer, observable via
+  :func:`set_drop_monitor` / the engine's periodic drop warning).
 * ``dense`` — capacity-factor GShard dispatch/combine einsums: tokens →
   [E, C, H] buffers, expert FFNs as one batched einsum over the (sharded)
   E dim. Static shapes everywhere; drops beyond capacity. Kept as the
@@ -40,6 +43,7 @@ from deepspeed_tpu.comm.mesh import (
     TENSOR_AXIS,
     ZSHARD_AXIS,
     get_mesh_manager,
+    on_reset_mesh,
 )
 from deepspeed_tpu.moe.gating import (
     GateOutput,
@@ -51,8 +55,28 @@ from deepspeed_tpu.moe.gating import (
 PyTree = Any
 
 # jitted shard_map programs keyed on (mesh, static config, shapes) — eager
-# callers would otherwise rebuild + retrace the program every invocation
+# callers would otherwise rebuild + retrace the program every invocation.
+# Cleared when the global mesh is torn down: stale Mesh keys would pin the
+# old mesh + its compiled programs for the life of the process.
 _SHARDED_FN_CACHE: Dict[Any, Any] = {}
+
+on_reset_mesh(_SHARDED_FN_CACHE.clear)
+
+# Installed observer for EP-dispatch buffer overflows (None → no callback is
+# traced, zero cost). Under expert parallelism the 'dropless' path is only
+# dropless per destination SHARD: a skewed router can overflow the fixed
+# all-to-all buffer and the overflowed choices silently fall through to the
+# residual. The engine installs a monitor so that degradation is visible.
+_DROP_MONITOR = None
+
+
+def set_drop_monitor(fn) -> None:
+    """``fn(dropped_frac: float)`` called (async, via jax.debug.callback)
+    with the global fraction of token-choices dropped by the EP buffer on
+    each dispatch. Pass None to uninstall. Trace-time gated: install BEFORE
+    the step is compiled."""
+    global _DROP_MONITOR
+    _DROP_MONITOR = fn
 
 
 def _expert_constraint(x: jax.Array, n_lead: int = 1) -> jax.Array:
@@ -435,7 +459,7 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
                                        activation)
             if tp is not None:
                 y = lax.psum(y, tp)
-            return y.reshape(b, s, H), _global_aux(gate)
+            return y.reshape(b, s, H), _global_aux(gate), jnp.float32(0.0)
     else:
         if E % ep:
             raise ValueError(f"n_experts={E} not divisible by expert mesh axis {ep}")
@@ -462,6 +486,18 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
                                       dest[:, None], 1)[:, 0]
             slot = _ckpt_name(jnp.where(pos < Cs, dest * Cs + pos,
                                         ep * Cs).astype(jnp.int32), "moe_gate")
+            if monitored:
+                # global dropped-choice fraction across every source shard —
+                # returned from the shard_map and reported via an async host
+                # callback OUTSIDE it (debug callbacks don't lower inside a
+                # partial-manual shard_map)
+                ax = tuple(dict.fromkeys(
+                    list(batch_axes) + ([seq_ax] if seq_ax else [])
+                    + [EXPERT_AXIS]))
+                drop_frac = (lax.psum(jnp.sum((slot == ep * Cs).astype(
+                    jnp.float32)), ax) / lax.psum(jnp.float32(tk), ax))
+            else:
+                drop_frac = jnp.float32(0.0)
             # slot2row inverts slot (sentinel tk = empty buffer slot): both
             # buffer directions become pure gathers via buffer_exchange
             slot2row = _ckpt_name(
@@ -503,7 +539,7 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
             contrib = buffer_exchange(y_back, slot, slot2row) * \
                 w.reshape(tk)[:, None].astype(dt)
             y = contrib.reshape(t, k, H).sum(axis=1)
-            return y.reshape(b, s, H), _global_aux(gate)
+            return y.reshape(b, s, H), _global_aux(gate), drop_frac
 
     # manualize only the axes we use — nests under the pipeline's
     # axis_names={'pipe'} shard_map and leaves other axes to GSPMD. The
@@ -517,8 +553,15 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
     sm_mesh = mesh
     if _already_manual_axes():
         sm_mesh = jax.sharding.get_abstract_mesh()
+    # trace-time: drop reporting is active only when a monitor is installed
+    # AND we're not under an enclosing manual context (where the callback
+    # can't lower) — gate BOTH the psums and the callback on it so the
+    # unmonitored trace stays the zero-cost constant path
+    monitored = (_DROP_MONITOR is not None and ep > 1
+                 and not _already_manual_axes())
     cache_key = (sm_mesh, k, activation, score_func, route_norm, n_group,
                  topk_group, x.shape, str(x.dtype), gate_w.shape,
+                 monitored,
                  tuple(sorted((kk, v.shape, str(v.dtype))
                               for kk, v in experts.items())))
     fn = _SHARDED_FN_CACHE.get(cache_key)
@@ -526,12 +569,18 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
         fn = jax.jit(shard_map(local_fn, mesh=sm_mesh,
                                in_specs=(bspec, P(None, None), espec,
                                          P(None)),
-                               out_specs=(bspec, P()), check_vma=False,
+                               out_specs=(bspec, P(), P()), check_vma=False,
                                axis_names=used_axes))
         if len(_SHARDED_FN_CACHE) >= 32:
             _SHARDED_FN_CACHE.pop(next(iter(_SHARDED_FN_CACHE)))
         _SHARDED_FN_CACHE[cache_key] = fn
-    return fn(x, gate_w, experts, gb)
+    y, aux, drop_frac = fn(x, gate_w, experts, gb)
+    if monitored:
+        # async host report. Outside our shard_map; skipped under an
+        # ENCLOSING manual context (compressed-collective step) where debug
+        # callbacks can't lower — those runs still have routing_drop_stats.
+        jax.debug.callback(_DROP_MONITOR, drop_frac)
+    return y, aux
 
 
 def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: Dict[str, jax.Array],

@@ -295,6 +295,27 @@ class DeepSpeedTPUEngine:
         self._last_metrics_dev: Dict[str, jax.Array] = {}
         self.monitor = None  # attached by initialize() when configured
 
+        # EP-dispatch drop visibility: under an 'expert' mesh axis the ragged
+        # MoE path can overflow its fixed all-to-all buffer on router skew;
+        # the overflowed choices silently fall through to the residual, so a
+        # degrading router would otherwise hurt training quality invisibly.
+        self._moe_drop_frac = 0.0
+        if self.mesh_manager.axis_size("expert") > 1:
+            import weakref
+
+            from deepspeed_tpu.moe.layer import set_drop_monitor
+
+            # weakref: the module-global monitor must not pin a dead engine
+            # (params + compiled steps) for the life of the process
+            ref = weakref.ref(self)
+
+            def _sink(frac):
+                eng = ref()
+                if eng is not None:
+                    eng._record_moe_drops(frac)
+
+            set_drop_monitor(_sink)
+
         n_params = model.num_params
         log_dist(
             f"engine up: model={model.name} params={n_params or '?'} "
@@ -1389,6 +1410,11 @@ class DeepSpeedTPUEngine:
         self._after_step(metrics, n_steps=n_steps)
         return metrics["loss"]
 
+    def _record_moe_drops(self, frac) -> None:
+        """Async jax.debug.callback sink (moe.layer.set_drop_monitor) — keeps
+        the worst dropped-choice fraction seen since the last print window."""
+        self._moe_drop_frac = max(self._moe_drop_frac, float(frac))
+
     def _after_step(self, metrics: Dict[str, jax.Array],
                     n_steps: int = 1) -> None:
         self.tput_timer.stop(global_step=True, steps=n_steps)
@@ -1397,6 +1423,15 @@ class DeepSpeedTPUEngine:
             self.lr_scheduler.step(self.global_steps)
         if self.global_steps % max(1, self.config.steps_per_print) == 0:
             host = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+            if self._moe_drop_frac > 0:
+                logger.warning(
+                    f"MoE expert-parallel dispatch dropped "
+                    f"{self._moe_drop_frac:.2%} of token-choices (EP buffer "
+                    "overflow — router skew); dropped choices fall through "
+                    "to the residual. Consider a larger capacity headroom "
+                    "or rebalancing (aux loss weight).")
+                host["moe_drop_frac"] = self._moe_drop_frac
+                self._moe_drop_frac = 0.0
             log_dist(
                 f"step={self.global_steps} loss={host.get('loss', float('nan')):.4f} "
                 f"lr={host.get('lr', 0):.3e} grad_norm={host.get('grad_norm', 0):.3f}"

@@ -1,0 +1,45 @@
+"""Launcher CLI: core binding + arg parsing.
+
+Parity: reference ``launcher/launch.py`` ``--bind_cores_to_rank`` (numactl
+per local rank) — here ``os.sched_setaffinity`` slices by LOCAL_RANK.
+"""
+import os
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import bind_cores, parse_args, parse_core_list
+
+
+def test_parse_core_list():
+    assert parse_core_list("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert parse_core_list("5") == [5]
+    assert parse_core_list("") == []
+
+
+def test_parse_args_bind_flags():
+    a = parse_args(["--bind_cores_to_rank", "train.py", "--x", "1"])
+    assert a.bind_cores_to_rank and a.script == "train.py"
+    assert a.script_args == ["--x", "1"]
+    a = parse_args(["--bind_core_list", "0-1", "train.py"])
+    assert a.bind_core_list == "0-1"
+
+
+def test_bind_cores_slices_by_local_rank(monkeypatch):
+    avail = sorted(os.sched_getaffinity(0))
+    if len(avail) < 2:
+        pytest.skip("needs >=2 cores")
+    monkeypatch.setenv("LOCAL_RANK", "1")
+    monkeypatch.setenv("LOCAL_WORLD_SIZE", "2")
+    try:
+        bind_cores(parse_args(["--bind_cores_to_rank", "x.py"]))
+        bound = sorted(os.sched_getaffinity(0))
+        per = len(avail) // 2
+        assert bound == avail[per:2 * per]
+    finally:
+        os.sched_setaffinity(0, avail)
+
+
+def test_bind_cores_noop_without_flag():
+    avail = sorted(os.sched_getaffinity(0))
+    bind_cores(parse_args(["x.py"]))
+    assert sorted(os.sched_getaffinity(0)) == avail

@@ -383,8 +383,17 @@ def broadcast(tensor, src: int = 0, group: AxisSpec = None):
         return f(tensor)
     # Eager single-process SPMD: every caller holds the value already. With
     # multiple PROCESSES host values can genuinely diverge (the case
-    # broadcast exists for) — route through the real host broadcast.
+    # broadcast exists for) — route through the real host broadcast. Only
+    # the default (whole-world) group maps onto processes: for a subgroup,
+    # ``src`` is a group rank and each group would need its own exchange —
+    # refuse loudly rather than deliver process src's value to every group.
     if jax.process_count() > 1:
+        if group is not None:
+            raise NotImplementedError(
+                "eager broadcast over a subgroup with process_count > 1 is "
+                "not supported (host values can diverge per process, but "
+                "host_broadcast only exchanges whole-world). Broadcast "
+                "inside a traced step, or use group=None.")
         return jnp.asarray(host_broadcast(np.asarray(tensor), src=src))
     return jnp.asarray(tensor)
 

@@ -116,11 +116,32 @@ class FastGenEngine:
         self.seqs: Dict[int, _Seq] = {}
         self._admit_order: List[int] = []
         self._decode_rr = 0
-        self._rng = jax.random.PRNGKey(seed)
+        # HOST-side key stream: deriving per-call subkeys with an eager
+        # jax.random.split is a whole device dispatch (~100 ms through a
+        # remote-tunnel runtime) for an 8-byte op. Any uint32[2] is a valid
+        # raw threefry key, so a host PCG stream supplies them; in-program
+        # splits (inside the fused scans) stay jax.random.
+        self._host_rng = np.random.default_rng(seed)
         self._ticks: Dict[int, Any] = {}   # bucketed by tick token count
         if use_pallas_kernel is None:
             use_pallas_kernel = jax.default_backend() == "tpu"
         self._use_kernel = use_pallas_kernel
+
+    def _next_key(self) -> jax.Array:
+        """Raw uint32[2] threefry key from the host PCG stream (no device
+        dispatch — see ``_host_rng``)."""
+        return jnp.asarray(self._host_rng.integers(
+            0, 2 ** 32, 2, dtype=np.uint32))
+
+    @staticmethod
+    def _slot_tier(n_slots: int) -> int:
+        """Pow2 slot-count tier (min 4) — ONE rule shared by the grouped
+        plan layout (decode-row region) and the serve fn's carry shapes;
+        they must agree or decode rows map to wrong slots."""
+        ns = 4
+        while ns < n_slots:
+            ns *= 2
+        return ns
 
     def _mb_tier(self, mb_need: int) -> int:
         """Two table-width tiers — ONE rule for every compile-cache key
@@ -220,16 +241,30 @@ class FastGenEngine:
         if max_ticks < 1:
             return {}
         headroom = min(self.max_len - 1 - s.pos for s in live)
-        cap = max_ticks if not allow_overshoot else \
-            max(max_ticks, self.DECODE_TIERS[-1])
-        cap = min(cap, headroom)
+
+        def fits(tier):
+            return tier <= headroom and sum(
+                self._blocks_needed(s, s.pos + tier - 1)
+                for s in live) <= self.allocator.free_blocks
+
         n = 0
-        for tier in self.DECODE_TIERS:
-            if tier <= cap and sum(
-                    self._blocks_needed(s, s.pos + tier - 1)
-                    for s in live) <= self.allocator.free_blocks:
-                n = tier
-                break
+        if allow_overshoot:
+            # round UP to the smallest tier covering the remaining work —
+            # one overshooting window (extras trimmed by the caller) beats
+            # a cascade of smaller windows each paying dispatch latency
+            # (measured ~100 ms/dispatch through a remote tunnel vs
+            # ~1.8 ms/tick device time)
+            for tier in reversed(self.DECODE_TIERS):
+                if tier >= max_ticks and fits(tier):
+                    n = tier
+                    break
+        if n < 1:
+            cap = min(max_ticks if not allow_overshoot
+                      else max(max_ticks, self.DECODE_TIERS[-1]), headroom)
+            for tier in self.DECODE_TIERS:
+                if tier <= cap and fits(tier):
+                    n = tier
+                    break
         if n < 1:
             return {}
         for s in live:
@@ -253,7 +288,7 @@ class FastGenEngine:
         key = ("dec", Bt, n, mb)
         if key not in self._ticks:
             self._ticks[key] = self._build_decode_scan(n)
-        self._rng, sub = jax.random.split(self._rng)
+        sub = self._next_key()
         out, self.pool = self._ticks[key](
             self.params, self.pool, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(tables[:, :mb]), sub)
@@ -385,7 +420,7 @@ class FastGenEngine:
         key = (Tn, mb)
         if key not in self._ticks:
             self._ticks[key] = self._build_tick()
-        self._rng, sub = jax.random.split(self._rng)
+        sub = self._next_key()
         sampled, self.pool = self._ticks[key](
             self.params, self.pool, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(tables[:, :mb]), sub)
@@ -435,6 +470,22 @@ class FastGenEngine:
     # ------------------------------------------------------------------ #
     # planned (offline) serving — the whole SplitFuse schedule in ONE scan
     # ------------------------------------------------------------------ #
+    def _plan_layout(self, n_slots: int):
+        """Static row layout of a GROUPED planned tick: ``(Cd, C, G)`` —
+        ``Cd`` decode rows (slot tier), then ``G`` prefill groups of ``C``
+        rows each, every group owned by ONE sequence so its rows share a
+        block table (what :func:`models.paged.grouped_prefill_attention`
+        exploits). None → fall back to the per-token-attention layout
+        (MLA pools latents — no grouped path — and tiny budgets)."""
+        if self.cfg.mla:
+            return None
+        ns = self._slot_tier(n_slots)
+        C = max(16, min(64, self.token_budget // 4))
+        G = (self.token_budget - ns) // C
+        if G < 1:
+            return None
+        return ns, C, G
+
     def _plan_schedule(self, max_new_tokens: int,
                        until_prefilled: bool = True):
         """Precompute SplitFuse ticks for the CURRENT admission set.
@@ -453,14 +504,18 @@ class FastGenEngine:
         tick is (tokens [T] — prompt tokens; kind [T] — 1 marks a decode
         row that reads the carry's last sampled token for its slot, its
         tokens entry being ignored; slots [T]; positions [T]; tables
-        [T, MB]; heads [T] bool). Mutates real seq/allocator state — the
-        device executes
+        [T, MB]; heads [T] bool; group_tables [G, MB] — zero-row [0, MB]
+        under the ungrouped layout). Under the grouped layout decode rows
+        live in [0, Cd) and prefill rows are group-aligned (group = one
+        sequence; leftover rows padded) — slightly more ticks, each ~10×
+        cheaper. Mutates real seq/allocator state — the device executes
         exactly this plan. Returns None when the pool can't cover the full
         plan (caller falls back to the dynamic tick loop's backpressure).
         """
         order = [u for u in self._admit_order
                  if u in self.seqs and not self.seqs[u].done]
         slot_of = {u: i for i, u in enumerate(order)}
+        layout = self._plan_layout(len(order))
         ticks = []
         planned_gen = {u: len(self.seqs[u].generated) for u in order}
         guard = 0
@@ -477,18 +532,25 @@ class FastGenEngine:
             if guard > 8 * max_new_tokens + sum(
                     len(s.prompt) for s in live) // max(1, self.token_budget // 2):
                 return None  # defensive: schedule failed to converge
-            need = sum(1 for s in live if s.prefill_remaining == 0) \
-                + sum(s.prefill_remaining for s in live)
-            Tn = self._bucket(need)
+            if layout is None:
+                need = sum(1 for s in live if s.prefill_remaining == 0) \
+                    + sum(s.prefill_remaining for s in live)
+                Tn = self._bucket(need)
+                Cd, C, G = Tn, 1, 0       # decode rows anywhere; no groups
+            else:
+                Cd, C, G = layout
+                Tn = Cd + G * C
             tokens = np.full((Tn,), 0, np.int32)
             kind = np.zeros((Tn,), np.int32)      # 1 ⇒ carry-fed decode row
             slots = np.zeros((Tn,), np.int32)
             positions = np.zeros((Tn,), np.int32)
             tables = np.zeros((Tn, self.max_blocks_per_seq), np.int32)
+            gtables = np.zeros((max(G, 1), self.max_blocks_per_seq), np.int32)
             heads = np.zeros((Tn,), bool)
+            packed = 0
             row = 0
             for s in live:                         # decode rows first
-                if s.prefill_remaining > 0 or row >= Tn:
+                if s.prefill_remaining > 0 or row >= Cd:
                     continue
                 if not self._ensure_blocks(s, s.pos):
                     return None                    # pool can't cover the plan
@@ -502,51 +564,80 @@ class FastGenEngine:
                 if s.pos + 1 >= self.max_len:
                     planned_gen[s.uid] = max_new_tokens  # hits max-len cap
                 row += 1
+                packed += 1
+            row = Cd if layout is not None else row
             for s in live:                         # then prefill chunks
                 if s.prefill_remaining == 0 or row >= Tn:
                     continue
-                chunk = min(s.prefill_remaining, Tn - row)
-                if not self._ensure_blocks(s, s.pos + chunk - 1):
-                    return None
-                lo = s.prefilled
-                tokens[row:row + chunk] = s.prompt[lo:lo + chunk]
-                slots[row:row + chunk] = slot_of[s.uid]
-                positions[row:row + chunk] = np.arange(s.pos, s.pos + chunk)
-                tables[row:row + chunk] = s.table
-                row += chunk
-                s.prefilled += chunk
-                s.pos += chunk
-                if s.prefill_remaining == 0:
-                    heads[row - 1] = True
-                    planned_gen[s.uid] += 1
-                    if s.pos + 1 >= self.max_len:
-                        # same max-len stop the dynamic path applies in
-                        # _note_token: the prefill head's token is the last
-                        planned_gen[s.uid] = max_new_tokens
-            if row == 0:
+                while s.prefill_remaining > 0 and row < Tn:
+                    if layout is not None:
+                        # stay inside the current group; a group hosts ONE
+                        # sequence (pad rows close it out)
+                        room = C - ((row - Cd) % C)
+                    else:
+                        room = Tn - row
+                    chunk = min(s.prefill_remaining, room, Tn - row)
+                    if not self._ensure_blocks(s, s.pos + chunk - 1):
+                        return None
+                    if layout is not None:
+                        gtables[(row - Cd) // C] = s.table
+                    lo = s.prefilled
+                    tokens[row:row + chunk] = s.prompt[lo:lo + chunk]
+                    slots[row:row + chunk] = slot_of[s.uid]
+                    positions[row:row + chunk] = np.arange(
+                        s.pos, s.pos + chunk)
+                    tables[row:row + chunk] = s.table
+                    row += chunk
+                    packed += chunk
+                    s.prefilled += chunk
+                    s.pos += chunk
+                    if s.prefill_remaining == 0:
+                        heads[row - 1] = True
+                        planned_gen[s.uid] += 1
+                        if s.pos + 1 >= self.max_len:
+                            # same max-len stop the dynamic path applies in
+                            # _note_token: the prefill head's token is the
+                            # last
+                            planned_gen[s.uid] = max_new_tokens
+                        if layout is not None and (row - Cd) % C:
+                            row += C - ((row - Cd) % C)   # pad to boundary
+                        break
+            if packed == 0:
                 return None
-            ticks.append((tokens, kind, slots, positions, tables, heads))
-        return order, ticks
+            ticks.append((tokens, kind, slots, positions, tables, heads,
+                          gtables))
+        return order, ticks, layout
 
-    def _build_planned_fn(self):
+    def _build_planned_fn(self, n_decode: int = 0, decode_ticks: int = 0):
         # every shape is derived from the inputs; the cache key in
-        # serve_planned is what distinguishes compiled variants
+        # serve_planned is what distinguishes compiled variants.
+        # ``n_decode`` > 0 ⇒ grouped layout: rows [0, n_decode) are decode
+        # rows, the rest group-aligned prefill (grouped_prefill_attention).
+        # ``decode_ticks`` > 0 ⇒ the pure-decode tail runs INSIDE the same
+        # dispatch: after the planned scan, a decode scan of that many
+        # ticks over the per-slot carry — the whole mixed workload becomes
+        # ONE device call (the host loop between phases was worth ~2 more
+        # dispatch round-trips).
         cfg = self.cfg
         if self._use_kernel:
             from deepspeed_tpu.ops.pallas.paged_attention import paged_attention
             attn = paged_attention
         else:
             attn = PG.paged_attention_reference
-        def serve(params, pool, toks, kind, slots, positions, tables, heads,
-                  rng, last0):
+        grouped = n_decode > 0
+
+        def serve(params, pool, toks, kind, slots, positions, tables, gtabs,
+                  heads, rng, last0, dec_pos, dec_tabs):
             def body(carry, tick):
                 pool, last, rng = carry
-                tok_s, kind_s, slot_s, pos_s, tab_s, head_s = tick
+                tok_s, kind_s, slot_s, pos_s, tab_s, gtab_s, head_s = tick
                 rng, sub = jax.random.split(rng)
                 inputs = jnp.where(kind_s == 1, last[slot_s], tok_s)
                 logits, pool = PG.forward_paged(
                     params, inputs, pos_s, tab_s, pool, cfg,
-                    attention_fn=attn)
+                    attention_fn=attn,
+                    group_tables=gtab_s if grouped else None,
+                    n_decode=n_decode if grouped else 0)
                 sampled = sample_logits(
                     logits, sub, self.temperature, self.top_k,
                     self.top_p).astype(jnp.int32)
@@ -557,15 +648,33 @@ class FastGenEngine:
                 last = last.at[idx].set(sampled, mode="drop")
                 return (pool, last, rng), sampled
 
-            (pool, _, _), out = jax.lax.scan(
+            (pool, last, rng), out = jax.lax.scan(
                 body, (pool, last0, rng),
-                (toks, kind, slots, positions, tables, heads))
-            return out, pool
+                (toks, kind, slots, positions, tables, gtabs, heads))
+            if not decode_ticks:
+                return out, pool
+
+            def dbody(carry, _):
+                pool, toks_d, pos, rng = carry
+                rng, sub = jax.random.split(rng)
+                logits, pool = PG.forward_paged(
+                    params, toks_d, pos, dec_tabs, pool, cfg,
+                    attention_fn=attn)
+                sampled = sample_logits(
+                    logits, sub, self.temperature, self.top_k,
+                    self.top_p).astype(jnp.int32)
+                return (pool, sampled, pos + 1, rng), sampled
+
+            (pool, _, _, _), out2 = jax.lax.scan(
+                dbody, (pool, last, dec_pos, rng), None,
+                length=decode_ticks)                # out2 [decode_ticks, ns]
+            return (out, out2), pool
 
         return jax.jit(serve, donate_argnums=(1,))
 
     def serve_planned(self, max_new_tokens: int,
-                      until_prefilled: bool = True) -> bool:
+                      until_prefilled: bool = True,
+                      fuse_decode_tail: bool = False) -> bool:
         """Run the precomputed SplitFuse schedule in ONE device dispatch
         (a scan; by default the prefill/mixed phase — see _plan_schedule).
 
@@ -600,13 +709,62 @@ class FastGenEngine:
             if plan is None:
                 restore()
                 return False
-            return self._serve_planned_device(plan, max_new_tokens)
-        except Exception:
+            nd = 0
+            if fuse_decode_tail and until_prefilled:
+                # append the pure-decode tail to the SAME dispatch when the
+                # pool/length headroom covers it (0 → the caller's decode-
+                # scan windows take over with per-window backpressure)
+                nd = self._plan_decode_tail(plan[0], plan[1], max_new_tokens)
+            return self._serve_planned_device(plan, max_new_tokens,
+                                              decode_ticks=nd)
+        except BaseException:     # incl. KeyboardInterrupt mid-dispatch
             restore()
             raise
 
-    def _serve_planned_device(self, plan, max_new_tokens: int) -> bool:
-        order, ticks = plan
+    def _plan_decode_tail(self, order, ticks, max_new_tokens: int) -> int:
+        """How many fused decode ticks to append to the planned dispatch:
+        the max per-sequence remainder after the plan's own heads, rounded
+        up to a pow2 tier (compile cache). 0 when nothing remains or when
+        block/length headroom can't cover the tail (callers then run the
+        separate decode-scan phase with its per-window backpressure)."""
+        planned_heads = {u: 0 for u in order}
+        slot_arr = {i: u for i, u in enumerate(order)}
+        for t in ticks:
+            for r in np.nonzero(t[5])[0]:
+                planned_heads[slot_arr[int(t[2][r])]] += 1
+        live = [self.seqs[u] for u in order if not self.seqs[u].done]
+        if not live:
+            return 0
+        rem = 0
+        for u in order:
+            s = self.seqs[u]
+            if s.done:
+                continue
+            want = max_new_tokens - len(s.generated) - planned_heads[u]
+            want = min(want, self.max_len - 1 - s.pos)
+            rem = max(rem, want)
+        if rem <= 0:
+            return 0
+        # every slot runs every tail tick (the scan is rectangular), so the
+        # tail must fit the TIGHTEST sequence's block-table/length headroom
+        headroom = min(self.max_len - 1 - s.pos for s in live)
+        nd = 8
+        while nd < rem:
+            nd *= 2
+        if nd > headroom:
+            nd = min(rem, headroom)   # exact, rarely-cached tier — still 1 dispatch
+        if nd <= 0:
+            return 0
+        if sum(self._blocks_needed(s, s.pos + nd - 1)
+               for s in live) > self.allocator.free_blocks:
+            return 0
+        for s in live:
+            self._ensure_blocks(s, s.pos + nd - 1)
+        return nd
+
+    def _serve_planned_device(self, plan, max_new_tokens: int,
+                              decode_ticks: int = 0) -> bool:
+        order, ticks, layout = plan
         if not ticks:
             return True
         # pad the tick count to a pow2 tier and every tick to the same
@@ -618,6 +776,12 @@ class FastGenEngine:
         Tn = max(t[0].shape[0] for t in ticks)
         max_pos = max(int(t[3].max()) for t in ticks)
         mb_need = max_pos // self.block_size + 1
+        if decode_ticks:
+            live_pos = [self.seqs[u].pos for u in order
+                        if not self.seqs[u].done]
+            if live_pos:
+                mb_need = max(mb_need, (max(live_pos) + decode_ticks - 1)
+                              // self.block_size + 1)
         mb = self._mb_tier(mb_need)
 
         def padded(j):
@@ -628,26 +792,45 @@ class FastGenEngine:
 
         toks, kind, slots = padded(0), padded(1), padded(2)
         positions, tables, heads = padded(3), padded(4)[:, :, :mb], padded(5)
+        # group tables: [G, MB] per tick — G is already constant across
+        # ticks (the static layout), only the tick count needs padding
+        g_rows = [t[6] for t in ticks] + \
+            [np.zeros_like(ticks[0][6])] * (n_pad - n)
+        gtabs = np.stack(g_rows)[:, :, :mb]
+        n_dec = layout[0] if layout is not None else 0
 
-        ns = 4                                     # slot-count tier (pow2):
-        while ns < len(order):                     # admission count must not
-            ns *= 2                                # change the program shape
-        key = ("plan", n_pad, Tn, mb, ns)
+        # admission count must not change the program shape
+        ns = self._slot_tier(len(order))
+        key = ("plan", n_pad, Tn, mb, ns, n_dec, decode_ticks)
         if key not in self._ticks:
-            self._ticks[key] = self._build_planned_fn()
+            self._ticks[key] = self._build_planned_fn(
+                n_decode=n_dec, decode_ticks=decode_ticks)
         last0 = np.zeros((ns,), np.int32)
+        dec_pos = np.zeros((ns,), np.int32)
+        dec_tabs = np.zeros((ns, mb), np.int32)
         for i, u in enumerate(order):
-            if self.seqs[u].last_tok is not None:
-                last0[i] = self.seqs[u].last_tok
-        self._rng, sub = jax.random.split(self._rng)
+            s = self.seqs[u]
+            if s.last_tok is not None:
+                last0[i] = s.last_tok
+            if decode_ticks and not s.done:
+                dec_pos[i] = s.pos          # post-plan position
+                dec_tabs[i] = s.table[:mb]  # tail blocks pre-allocated
+        sub = self._next_key()
         out, self.pool = self._ticks[key](
             self.params, self.pool, jnp.asarray(toks), jnp.asarray(kind),
             jnp.asarray(slots), jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(heads), sub, jnp.asarray(last0))
-        out = np.asarray(jax.device_get(out))      # [n_pad, Tn]
+            jnp.asarray(gtabs), jnp.asarray(heads), sub, jnp.asarray(last0),
+            jnp.asarray(dec_pos), jnp.asarray(dec_tabs))
+        out2 = None
+        if decode_ticks:
+            out, out2 = jax.device_get(out)        # ONE host fetch for both
+            out2 = np.asarray(out2)                # [decode_ticks, ns]
+            out = np.asarray(out)
+        else:
+            out = np.asarray(jax.device_get(out))  # [n_pad, Tn]
 
         eos_hit = set()
-        for t, (_, _, slot_arr, _, _, head_arr) in enumerate(ticks):
+        for t, (_, _, slot_arr, _, _, head_arr, _) in enumerate(ticks):
             for r in np.nonzero(head_arr)[0]:
                 u = order[int(slot_arr[r])]
                 s = self.seqs[u]
@@ -662,6 +845,17 @@ class FastGenEngine:
                     continue
                 if len(s.generated) < max_new_tokens:
                     s.generated.append(tok)
+        if out2 is not None:                       # fused decode tail
+            for t in range(out2.shape[0]):
+                for i, u in enumerate(order):
+                    s = self.seqs[u]
+                    if s.done:
+                        continue
+                    tok = int(out2[t, i])
+                    s.pos += 1      # this tick's input token entered cache
+                    s.last_tok = tok
+                    if len(s.generated) < max_new_tokens:
+                        self._note_token(s, tok)
         for u in order:                            # planner ran to max_new
             s = self.seqs[u]
             if not s.done and (len(s.generated) >= max_new_tokens
@@ -686,10 +880,10 @@ class FastGenEngine:
         if planned is None:
             planned = self._use_kernel
         if planned:
-            # best-effort fused prefill/mixed phase (rolls back if the pool
-            # can't cover it); the dynamic loop's fused decode tiers serve
-            # whatever remains either way
-            self.serve_planned(max_new_tokens)
+            # best-effort fused prefill/mixed phase + decode tail, ONE
+            # dispatch (rolls back if the pool can't cover it); the dynamic
+            # loop's fused decode tiers serve whatever remains either way
+            self.serve_planned(max_new_tokens, fuse_decode_tail=True)
         self._generate_dynamic(uids, max_new_tokens)
         out = {u: self.query(u)[1][:max_new_tokens] for u in uids}
         self.flush(uids)

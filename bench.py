@@ -215,8 +215,9 @@ def fastgen_bench(model="gpt2_125m", n_seqs=16, max_new=64):
         cyc = [(1000 if timed else 100) + u for u in uids]
         t0 = time.perf_counter()
         fg.put(cyc, prompts)
-        fg.serve_planned(max_new, until_prefilled=True,
-                         fuse_decode_tail=False)
+        assert fg.serve_planned(max_new, until_prefilled=True,
+                                fuse_decode_tail=False), \
+            "plan infeasible — phase split would time the wrong phases"
         jax.block_until_ready(jax.tree.leaves(fg.pool)[0])
         t_prefill = time.perf_counter() - t0
         gen_planned = sum(len(fg.seqs[u].generated) for u in cyc)

@@ -64,7 +64,19 @@ def convert_to_universal(checkpoint_dir: str, out_dir: str,
     if tag is None:
         raise FileNotFoundError(f"no 'latest' tag in {checkpoint_dir}")
     state_path = os.path.abspath(os.path.join(checkpoint_dir, tag, "state"))
-    state = ocp.PyTreeCheckpointer().restore(state_path)
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        state = ckptr.restore(state_path)
+    except ValueError:
+        # checkpoints written by a MULTI-PROCESS run carry distributed
+        # array metadata; restoring on one host needs an explicit
+        # "just give me numpy" per leaf
+        import jax
+
+        tree = dict(ckptr.metadata(state_path).item_metadata)
+        args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree)
+        state = ckptr.restore(state_path, restore_args=args)
 
     os.makedirs(out_dir, exist_ok=True)
     master_flat = _flatten(state["master"])

@@ -271,9 +271,7 @@ class FastGenEngine:
             self._ensure_blocks(s, s.pos + n - 1)
 
         B = len(live)
-        Bt = 4
-        while Bt < B:
-            Bt *= 2
+        Bt = self._slot_tier(B)
         tokens = np.zeros((Bt,), np.int32)
         positions = np.zeros((Bt,), np.int32)
         tables = np.zeros((Bt, self.max_blocks_per_seq), np.int32)

@@ -115,21 +115,70 @@ def _expert_act(up: jax.Array, gate: Optional[jax.Array], activation: str
     return jax.nn.relu(up)
 
 
+def _pick_tile(dim: int, prefer: int) -> Optional[int]:
+    """Tile for one gmm axis: the whole dim when it fits ``prefer`` (e.g.
+    K=768 untiled — measured fastest), else the largest pow2 ≤ ``prefer``
+    dividing ``dim``; None when nothing divides (caller falls back to
+    lax.ragged_dot)."""
+    if 0 < dim <= prefer:
+        return dim
+    t = prefer
+    while t >= 128:
+        if dim % t == 0:
+            return t
+        t //= 2
+    return None
+
+
+def grouped_dot(x: jax.Array, w: jax.Array, group_sizes: jax.Array
+                ) -> jax.Array:
+    """Grouped GEMM ``x[rows of group e] @ w[e]`` → [M, N].
+
+    On TPU this is the Pallas megablocks kernel (``megablox.gmm``, custom
+    VJP with ``tgmm`` weight grads) with explicitly-tuned tiles — measured
+    1.6× faster fwd+bwd than ``lax.ragged_dot``'s default lowering on the
+    bench shapes ([16k, 768] × [4, 768, 3072] on v5e). Elsewhere (and for
+    shapes the tile ladder can't divide) ``lax.ragged_dot``.
+
+    NOTE: rows past ``sum(group_sizes)`` are zeros under ragged_dot but
+    UNDEFINED under gmm — callers must not read them (the EP path never
+    gathers them back; the local path has no tail rows).
+    """
+    M, K = x.shape
+    N = w.shape[-1]
+    if jax.default_backend() == "tpu":
+        tm = _pick_tile(M, 512)
+        tk = _pick_tile(K, 1024)
+        tn = _pick_tile(N, 1024)
+        if tm and tk and tn:
+            from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+            return gmm(x, w, group_sizes, x.dtype, (tm, tk, tn))
+    return lax.ragged_dot(x, w, group_sizes)
+
+
 def ragged_expert_ffn(x_sorted: jax.Array, group_sizes: jax.Array,
                       experts: Dict[str, jax.Array], activation: str
                       ) -> jax.Array:
     """Grouped expert FFN on expert-sorted tokens.
 
     x_sorted [M, H] — rows grouped contiguously by expert; group_sizes [E]
-    int32 summing to M. Each weight application is ONE ``lax.ragged_dot``
-    (Mosaic grouped GEMM) instead of E small matmuls or a [T,E,C] einsum.
+    int32 summing to M. Each weight application is ONE grouped GEMM
+    (:func:`grouped_dot`) instead of E small matmuls or a [T,E,C] einsum.
     """
     dt = x_sorted.dtype
-    up = lax.ragged_dot(x_sorted, experts["w_up"].astype(dt), group_sizes)
-    g = (lax.ragged_dot(x_sorted, experts["w_gate"].astype(dt), group_sizes)
-         if "w_gate" in experts else None)
-    act = _expert_act(up, g, activation)
-    return lax.ragged_dot(act, experts["w_down"].astype(dt), group_sizes)
+    # named so remat="moe_selective" can store up/act (backward then never
+    # re-runs the grouped GEMMs); measured slower than recompute on v5e at
+    # the bench shapes, kept for bigger-expert configs where the trade flips
+    up = _ckpt_name(
+        grouped_dot(x_sorted, experts["w_up"].astype(dt), group_sizes),
+        "moe_up")
+    g = (_ckpt_name(
+        grouped_dot(x_sorted, experts["w_gate"].astype(dt), group_sizes),
+        "moe_up")
+        if "w_gate" in experts else None)
+    act = _ckpt_name(_expert_act(up, g, activation), "moe_act")
+    return grouped_dot(act, experts["w_down"].astype(dt), group_sizes)
 
 
 def expert_sort(flat: jax.Array, E: int

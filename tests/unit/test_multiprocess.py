@@ -1,0 +1,172 @@
+"""Real multi-process lane: 2 processes × 4 virtual CPU devices each.
+
+Parity: the reference's whole test strategy is real multi-process
+collectives (``tests/unit/common.py`` ``DistributedExec`` /
+``DistributedFixture`` — daemonic per-rank processes + rendezvous); here
+the rendezvous is ``jax.distributed.initialize`` on a localhost
+coordinator, and the 8-device mesh spans two OS processes, so
+cross-process XLA collectives, per-process batch sharding
+(``make_array_from_process_local_data``), process-0-gated writes,
+host_allgather/broadcast, checkpoint save/load and the launcher CLI all
+run the way a real TPU pod runs them (one process per host).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank = int(sys.argv[1]); port = sys.argv[2]; workdir = sys.argv[3]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=rank)
+assert jax.process_count() == 2 and jax.device_count() == 8
+
+import numpy as np
+import jax.numpy as jnp
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import comm
+
+# --- host-value helpers across REAL processes -------------------------
+got = comm.host_allgather(np.int32(rank + 7))
+assert got.tolist() == [7, 8], got
+hb = comm.host_broadcast(np.int32(rank * 3 + 1), src=1)
+assert int(hb) == 4, hb
+# eager broadcast: host values genuinely diverge per process; src wins
+t = comm.broadcast(np.full((2,), float(rank), np.float32), src=0)
+assert np.allclose(np.asarray(t), 0.0), t
+
+# --- engine: data-parallel over 8 devices spanning both processes -----
+from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+config = {
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
+    "zero_optimization": {"stage": 2}, "mesh": {"data": 8},
+    "steps_per_print": 10 ** 9,
+}
+spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+engine, *_ = dst.initialize(model=spec, config=config)
+assert engine.dp_world_size == 8
+
+# per-PROCESS half batches (4 rows each), different content per process —
+# shard_host_batch assembles the global [8] batch from the local halves
+def local_data():
+    it = synthetic_lm_data(batch_size=4, seq_len=32, vocab_size=512,
+                           seed=100 + rank)
+    batch = next(it)
+    while True:
+        yield batch
+
+data = local_data()
+losses = [float(engine.train_batch(data)) for _ in range(6)]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], losses
+# the psum'd loss must agree bit-for-bit across processes
+agree = comm.host_allgather(np.float32(losses[-1]))
+assert agree[0] == agree[1], agree
+
+# --- checkpoint save + resume with both processes participating -------
+engine.save_checkpoint(workdir, tag="mp")
+engine2, *_ = dst.initialize(model=spec, config=config)
+engine2.load_checkpoint(workdir, tag="mp")
+assert engine2.global_steps == 6
+l2 = float(engine2.train_batch(data))
+assert np.isfinite(l2)
+
+print(json.dumps({"rank": rank, "loss0": losses[0], "lossN": losses[-1],
+                  "resumed": l2}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mp_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DSTPU_ACCELERATOR"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_train_checkpoint(tmp_path):
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(r), str(port), str(tmp_path)],
+        env=_mp_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for r in (0, 1)]
+    outs = [p.communicate(timeout=540) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
+    import json
+
+    rows = [json.loads(out.strip().splitlines()[-1]) for out, _ in outs]
+    assert {r["rank"] for r in rows} == {0, 1}
+    # SPMD: both processes computed the identical global step
+    assert rows[0]["lossN"] == rows[1]["lossN"]
+    assert rows[0]["resumed"] == rows[1]["resumed"]
+
+    # UCP across PROCESS COUNTS: the 2-process run's checkpoint converts to
+    # universal atoms and loads into THIS single-process 8-device engine
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.checkpoint.universal import convert_to_universal
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    uni = convert_to_universal(str(tmp_path), str(tmp_path / "universal"),
+                               tag="mp")
+    spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+    config = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 3}, "mesh": {"data": 4, "tensor": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    engine.load_universal_checkpoint(uni)
+    assert engine.global_steps == 6
+
+
+LAUNCH_TARGET = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8
+print("LAUNCHED", jax.process_index(), flush=True)
+"""
+
+
+def test_launcher_cli_multihost_bringup(tmp_path):
+    """bin/dstpu-style launcher brings up jax.distributed from CLI flags
+    (reference launcher/runner.py multi-node rendezvous)."""
+    script = tmp_path / "target.py"
+    script.write_text(LAUNCH_TARGET)
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--master_addr", f"localhost:{port}", "--num_nodes", "2",
+         "--node_rank", str(r), str(script)],
+        env=_mp_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for r in (0, 1)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"launcher failed:\n{out}\n{err[-2000:]}"
+        assert "LAUNCHED" in out

@@ -125,6 +125,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    attention_fn=None, activation_constraint=None,
                    attention: Optional[str] = None,
                    loss_tiles: int = 0,
+                   loss_impl: str = "fused",
                    pipeline_schedule: str = "1f1b",
                    pipeline_micro_batches: Optional[int] = None,
                    **overrides) -> ModelSpec:
@@ -141,6 +142,10 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
     drain bubble is (P-1)/(M+P-1), so M ≫ P amortizes it; default M = P."""
     if attention_fn is not None and attention is not None:
         raise ValueError("pass either attention_fn or attention=, not both")
+    if loss_impl not in ("fused", "exact"):
+        raise ValueError(f"unknown loss_impl {loss_impl!r}; one of "
+                         "fused|exact (a typo must not silently change the "
+                         "loss numerics/perf class)")
     if attention_fn is None:
         attention_fn = resolve_attention(attention)
     if isinstance(cfg, str):
@@ -184,6 +189,12 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
 
             loss = tiled_lm_loss(hidden, head, tokens, _mask_of(batch),
                                  num_tiles=loss_tiles)
+        elif loss_impl == "fused":
+            # default training loss: bf16 logits + fp32 softmax stats with
+            # a bandwidth-tuned custom VJP (torch-autocast CE semantics —
+            # the exact-fp32-logits path stays under loss_impl="exact";
+            # inference/apply_fn logits are always exact fp32)
+            loss = T.fused_lm_loss(hidden, head, tokens, _mask_of(batch))
         else:
             logits = T.head_matmul(hidden, head.astype(hidden.dtype))
             loss = T.causal_lm_loss(logits, tokens, _mask_of(batch))
@@ -219,6 +230,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         return causal_lm_spec(cfg2,
                               attention=attention or orig_attention,
                               loss_tiles=max(loss_tiles, orig_loss_tiles),
+                              loss_impl=loss_impl,
                               activation_constraint=activation_constraint,
                               pipeline_schedule=pipeline_schedule)
 

@@ -69,6 +69,13 @@ def _remat_wrap(body, remat: str):
         return jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if remat in ("attn_block", "ffn_block"):
+        # structural sub-block checkpoint — applied INSIDE _block_forward
+        # around one sub-block; the scan body itself is not rematted, so
+        # the other sub-block's activations are saved by ordinary AD and
+        # XLA's scan fusion stays intact (the names-policy selective remat
+        # measurably disrupts it, PROFILE.md round-2 sweep)
+        return body
     if remat == "selective":
         return jax.checkpoint(body, policy=_SELECTIVE_POLICY)
     if remat == "moe_selective":
@@ -83,7 +90,8 @@ def _remat_wrap(body, remat: str):
         return jax.checkpoint(body, policy=policy)
     raise ValueError(
         f"unknown remat policy {remat!r}; one of none|full|save_nothing|"
-        "dots_saveable|dots_no_batch|selective|moe_selective|offload_dots")
+        "dots_saveable|dots_no_batch|selective|moe_selective|offload_dots|"
+        "attn_block|ffn_block")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -773,6 +781,13 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
             out = out + lp[f"b{name}"].astype(dt)
         return out.reshape(shape)
 
+    structural = cfg.remat in ("attn_block", "ffn_block")
+    if structural and (cfg.mla or cfg.parallel_block):
+        raise ValueError(
+            f"remat={cfg.remat!r} (structural sub-block checkpoint) supports "
+            "the sequential non-MLA block only; use full/selective for "
+            "MLA/parallel-block models")
+
     h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg,
@@ -789,49 +804,75 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
         down, aux = _ffn(h2, lp, cfg)
         return x + down, aux
-    if cfg.fuse_qkv:
-        qdim = cfg.num_heads * cfg.head_dim
-        kvdim = cfg.kv_heads * cfg.head_dim
-        wqkv = jnp.concatenate(
-            [lp["wq"].astype(dt), lp["wk"].astype(dt), lp["wv"].astype(dt)],
-            axis=-1)
-        qkv = h @ wqkv
-        if cfg.attn_bias_enabled:
-            qkv = qkv + jnp.concatenate(
-                [lp["bq"], lp["bk"], lp["bv"]], axis=-1).astype(dt)
-        q = qkv[..., :qdim].reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = qkv[..., qdim:qdim + kvdim].reshape(
-            B, S, cfg.kv_heads, cfg.head_dim)
-        v = qkv[..., qdim + kvdim:].reshape(
-            B, S, cfg.kv_heads, cfg.head_dim)
+    def _attn_from_norm(h):
+        if cfg.fuse_qkv:
+            qdim = cfg.num_heads * cfg.head_dim
+            kvdim = cfg.kv_heads * cfg.head_dim
+            wqkv = jnp.concatenate(
+                [lp["wq"].astype(dt), lp["wk"].astype(dt), lp["wv"].astype(dt)],
+                axis=-1)
+            qkv = h @ wqkv
+            if cfg.attn_bias_enabled:
+                qkv = qkv + jnp.concatenate(
+                    [lp["bq"], lp["bk"], lp["bv"]], axis=-1).astype(dt)
+            q = qkv[..., :qdim].reshape(B, S, cfg.num_heads, cfg.head_dim)
+            k = qkv[..., qdim:qdim + kvdim].reshape(
+                B, S, cfg.kv_heads, cfg.head_dim)
+            v = qkv[..., qdim + kvdim:].reshape(
+                B, S, cfg.kv_heads, cfg.head_dim)
+        else:
+            q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
+            k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
+            v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
+        if cfg.qk_norm:
+            q = _head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
+            k = _head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        attn_kwargs = {}
+        if cfg.pos_emb == "alibi":
+            attn_kwargs["bias"] = \
+                alibi_bias(cfg.num_heads, S) * cfg.alibi_bias_scale
+        attn = attention_fn(q, k, v, causal=cfg.causal, **attn_kwargs)
+        attn = attn.reshape(B, S, cfg.num_heads * cfg.head_dim)
+        attn = _ckpt_name(attn, "attn_out")
+        attn_out = attn @ lp["wo"].astype(dt)
+        if cfg.use_bias:
+            attn_out = attn_out + lp["bo"].astype(dt)
+        return attn_out
+
+    if cfg.remat == "attn_block":
+        # structural remat: bwd recomputes ONLY norm1 → attention → wo
+        # (~37% of layer FLOPs at 4h² vs FFN's 8h²); every FFN intermediate
+        # stays saved by the scan's AD — no names policy, so XLA's scan
+        # fusion is untouched. Memory ≈ 10·B·S·H bf16 per layer.
+        attn_out = jax.checkpoint(
+            lambda xin: _attn_from_norm(
+                _norm(xin, lp["ln1"], cfg.norm, cfg.norm_eps)))(x)
     else:
-        q = proj("q", h, (B, S, cfg.num_heads, cfg.head_dim))
-        k = proj("k", h, (B, S, cfg.kv_heads, cfg.head_dim))
-        v = proj("v", h, (B, S, cfg.kv_heads, cfg.head_dim))
-    if cfg.qk_norm:
-        q = _head_rmsnorm(q, lp["q_norm"], cfg.norm_eps)
-        k = _head_rmsnorm(k, lp["k_norm"], cfg.norm_eps)
-    if cfg.pos_emb == "rope":
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-    attn_kwargs = {}
-    if cfg.pos_emb == "alibi":
-        attn_kwargs["bias"] = alibi_bias(cfg.num_heads, S) * cfg.alibi_bias_scale
-    attn = attention_fn(q, k, v, causal=cfg.causal, **attn_kwargs)
-    attn = attn.reshape(B, S, cfg.num_heads * cfg.head_dim)
-    attn = _ckpt_name(attn, "attn_out")
-    attn_out = attn @ lp["wo"].astype(dt)
-    if cfg.use_bias:
-        attn_out = attn_out + lp["bo"].astype(dt)
+        attn_out = _attn_from_norm(h)
 
     if cfg.parallel_block:
-        h2 = h if cfg.shared_parallel_norm else             _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        h2 = h if cfg.shared_parallel_norm else \
+            _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
         down, aux = _ffn(h2, lp, cfg)
         return x + attn_out + down, aux
 
     x = x + attn_out
-    h = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
-    down, aux = _ffn(h, lp, cfg)
+
+    def _ffn_delta(xr):
+        h2 = _norm(xr, lp["ln2"], cfg.norm, cfg.norm_eps)
+        return _ffn(h2, lp, cfg)
+
+    if cfg.remat == "ffn_block":
+        # converse structural remat: bwd recomputes norm2 → FFN (~63% of
+        # layer FLOPs); attention residuals (q/k/v/out + flash lse) stay
+        # saved. Memory ≈ 6·B·S·H bf16 per layer — the cheaper-storage,
+        # smaller-win sibling of attn_block.
+        down, aux = jax.checkpoint(_ffn_delta)(x)
+    else:
+        down, aux = _ffn_delta(x)
     return x + down, aux
 
 
@@ -1333,6 +1374,69 @@ def pipelined_lm_loss_and_grads(params: PyTree, tokens: jax.Array,
             f"pipelined grads missing for param groups {sorted(missing)}")
     grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
     return loss, grads
+
+
+def fused_lm_loss(hidden: jax.Array, head: jax.Array, tokens: jax.Array,
+                  loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Head projection + next-token CE with a custom VJP tuned for HBM.
+
+    torch-autocast semantics (the reference's fp16/bf16 engines compute
+    logits in the low-precision dtype and CE upcasts for the softmax —
+    ``torch.nn.CrossEntropyLoss`` under ``autocast``): logits live in the
+    COMPUTE dtype (bf16), softmax statistics accumulate in fp32. vs the
+    exact-fp32-logits path (``head_matmul`` + ``causal_lm_loss``) this
+    halves every [B,S,V] buffer and the custom backward materializes ONE
+    bf16 grad-logits array (softmax − onehot fused into its producing
+    pass) instead of AD's fp32 grad + scatter-add + convert chain —
+    measured ~40 GB → ~18 GB of vocab-axis traffic per micro-batch at
+    GPT-2-125M B32 (the loss was ~10%% of step time, PROFILE.md).
+    Loss delta vs the exact path is the bf16 logit rounding (~1e-3),
+    identical in class to the r2 ``head_matmul`` bf16-cotangent change."""
+    B, S, H = hidden.shape
+    mask = (jnp.ones((B, S), jnp.float32) if loss_mask is None
+            else loss_mask.astype(jnp.float32))
+
+    @jax.custom_vjp
+    def _loss(x, w):
+        return _fwd(x, w)[0]
+
+    def _fwd(x, w):
+        dt = x.dtype
+        wc = w.astype(dt)
+        xs = x[:, :-1]
+        tgt = tokens[:, 1:]
+        # one bf16 [B,S-1,V] buffer; the f32-accumulated matmul casts in
+        # its epilogue, logsumexp upconverts in its reduce
+        logits = jnp.matmul(xs, wc,
+                            preferred_element_type=jnp.float32).astype(dt)
+        lf = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(lf, axis=-1)
+        picked = jnp.take_along_axis(lf, tgt[..., None], axis=-1)[..., 0]
+        m = mask[:, 1:]
+        cnt = jnp.maximum(jnp.sum(m), 1.0)
+        loss = jnp.sum((logz - picked) * m) / cnt
+        return loss, (logits, logz, xs, wc, tgt, m, cnt)
+
+    def _bwd(res, g):
+        logits, logz, xs, wc, tgt, m, cnt = res
+        dt = xs.dtype
+        coef = (m * (g / cnt))[..., None]
+        one = (lax.broadcasted_iota(jnp.int32, logits.shape, 2)
+               == tgt[..., None])
+        # single fused pass: read bf16 logits, exp, subtract onehot, scale,
+        # write bf16 grad-logits — feeds both backward matmuls
+        gl = ((jnp.exp(logits.astype(jnp.float32) - logz[..., None])
+               - one.astype(jnp.float32)) * coef).astype(dt)
+        dx = jnp.matmul(gl, wc.T, preferred_element_type=jnp.float32) \
+            .astype(dt)
+        dw = jnp.matmul(xs.reshape(-1, xs.shape[-1]).T,
+                        gl.reshape(-1, gl.shape[-1]),
+                        preferred_element_type=jnp.float32)
+        dx = jnp.pad(dx, ((0, 0), (0, 1), (0, 0)))
+        return dx, dw.astype(head.dtype)
+
+    _loss.defvjp(_fwd, _bwd)
+    return _loss(hidden, head)
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array,

@@ -566,7 +566,11 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
                                     0, 0, tiled=True).reshape(ep * Cs)
 
             # counting sort by local expert; empties (sentinel E_l) land
-            # past sum(group_sizes) where ragged_dot writes zeros
+            # past sum(group_sizes) — those rows are ZEROS under
+            # lax.ragged_dot but UNDEFINED under the gmm path
+            # (grouped_dot's contract): nothing below may read them — the
+            # combine gathers strictly by `slot` (buffer_exchange), whose
+            # sentinel hits the zero pad row, never a tail row of y_r
             ro, rinv, rc = expert_sort(recv_e, E_l + 1)
             ro = _ckpt_name(ro, "moe_gate")
             rinv = _ckpt_name(rinv, "moe_gate")

@@ -123,6 +123,60 @@ class TestComputeVariants:
         for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gf)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_structural_remat_grad_parity(self):
+        """attn_block / ffn_block (sub-block checkpoint, no names policy)
+        must match remat='none' grads to float tolerance."""
+        import dataclasses
+
+        cfg0 = T.get_model_config("tiny", dtype="float32", max_seq_len=32,
+                                  remat="none")
+        p = T.init_params(cfg0, jax.random.PRNGKey(0))
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, (2, 16), dtype=np.int32))
+
+        def loss_of(cfg):
+            def f(p):
+                return T.causal_lm_loss(T.forward(p, toks, cfg), toks)
+            return jax.value_and_grad(f)(p)
+
+        l0, g0 = loss_of(cfg0)
+        for remat in ("attn_block", "ffn_block"):
+            l, g = loss_of(dataclasses.replace(cfg0, remat=remat))
+            assert abs(float(l) - float(l0)) < 1e-6
+            for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g0)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=1e-6)
+
+    def test_structural_remat_rejects_mla_parallel(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            T.get_model_config("tiny", max_seq_len=32, remat="attn_block"),
+            parallel_block=True)
+        p = T.init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="structural"):
+            T.forward(p, toks, cfg)
+
+    def test_fused_lm_loss_matches_exact(self):
+        """fused_lm_loss (bf16-logit autocast CE, custom VJP) == the
+        head_matmul+causal_lm_loss path in fp32; grads to 1e-4."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)
+        t = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+        mask = jnp.asarray(rng.integers(0, 2, (2, 16)), jnp.float32)
+
+        def exact(x, w):
+            return T.causal_lm_loss(T.head_matmul(x, w), t, mask)
+
+        le, (gxe, gwe) = jax.value_and_grad(exact, argnums=(0, 1))(x, w)
+        lf, (gxf, gwf) = jax.value_and_grad(
+            T.fused_lm_loss, argnums=(0, 1))(x, w, t, mask)
+        assert abs(float(le) - float(lf)) < 1e-5
+        np.testing.assert_allclose(np.asarray(gxe), np.asarray(gxf), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gwe), np.asarray(gwf), atol=1e-4)
+
 
 class TestMLAAbsorbedDecode:
     def test_absorbed_equals_expanded(self):

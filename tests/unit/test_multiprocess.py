@@ -111,7 +111,14 @@ def test_two_process_train_checkpoint(tmp_path):
         [sys.executable, "-c", WORKER, str(r), str(port), str(tmp_path)],
         env=_mp_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for r in (0, 1)]
-    outs = [p.communicate(timeout=540) for p in procs]
+    try:
+        outs = [p.communicate(timeout=540) for p in procs]
+    finally:
+        # a worker deadlocked in a collective must not outlive the test
+        # holding the coordinator port / pipes open
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
     import json
@@ -166,7 +173,12 @@ def test_launcher_cli_multihost_bringup(tmp_path):
          "--node_rank", str(r), str(script)],
         env=_mp_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for r in (0, 1)]
-    outs = [p.communicate(timeout=240) for p in procs]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"launcher failed:\n{out}\n{err[-2000:]}"
         assert "LAUNCHED" in out

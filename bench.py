@@ -504,7 +504,7 @@ SUITE_ENTRIES = {
     "fastgen_paged_splitfuse_gpt2": lambda: fastgen_bench(),
     "moe_ulysses_moe_350m_bf16": lambda: train_bench(
         "moe_350m", zero_stage=2, precision="bf16",
-        batch=8, seq_len=1024, gas=4, steps=8,
+        batch=16, seq_len=1024, gas=4, steps=8,
         attention="ulysses_flash", remat="selective"),
     "pipeline_1f1b_cpu_mesh": lambda: pipeline_bench(),
     "autotune_smoke": lambda: autotune_smoke(),

@@ -147,9 +147,24 @@ def grouped_dot(x: jax.Array, w: jax.Array, group_sizes: jax.Array
     M, K = x.shape
     N = w.shape[-1]
     if jax.default_backend() == "tpu":
-        tm = _pick_tile(M, 512)
-        tk = _pick_tile(K, 1024)
-        tn = _pick_tile(N, 1024)
+        import os
+
+        tiles, explicit = [], False
+        for env, dim, default in (("DSTPU_GMM_TM", M, 512),
+                                  ("DSTPU_GMM_TK", K, 1024),
+                                  ("DSTPU_GMM_TN", N, 1024)):
+            val = os.environ.get(env)
+            explicit |= val is not None
+            tiles.append(_pick_tile(dim, int(val) if val else default))
+        tm, tk, tn = tiles
+        if explicit and not (tm and tk and tn):
+            import warnings
+
+            warnings.warn(
+                f"DSTPU_GMM_* tiles unusable for gmm shape [{M},{K}]x[E,{K},"
+                f"{N}] (no pow2 ladder value divides the dim) — falling back "
+                "to lax.ragged_dot, typically ~1.6x slower fwd+bwd; the "
+                "number you measure will NOT be the tile's performance")
         if tm and tk and tn:
             from jax.experimental.pallas.ops.tpu.megablox import gmm
 
@@ -260,15 +275,102 @@ def _buffer_exchange_bwd(bwd_idx, g):
 buffer_exchange.defvjp(_buffer_exchange_fwd, _buffer_exchange_bwd)
 
 
+@jax.custom_vjp
+def buffer_exchange_kdup(x: jax.Array, fwd_rows: jax.Array,
+                         bwd_idx2d: jax.Array) -> jax.Array:
+    """:func:`buffer_exchange` with the k-duplication folded into the index
+    map (the EP-path sibling of :func:`dispatch_gather`): ``out[j] =
+    x[fwd_rows[j]]`` where ``fwd_rows = slot2row // k`` — the one-past
+    sentinel ``t*k`` divides to exactly ``t``, the zero pad row — so the
+    [t*k, H] broadcast of x is never materialized. Transpose:
+    ``dx[t] = Σ_c zero-padded g[bwd_idx2d[t, c]]`` — pure gathers.
+    """
+    return _take_pad_zero(x, fwd_rows)
+
+
+def _buffer_exchange_kdup_fwd(x, fwd_rows, bwd_idx2d):
+    return _take_pad_zero(x, fwd_rows), bwd_idx2d
+
+
+def _buffer_exchange_kdup_bwd(bwd_idx2d, g):
+    t, k = bwd_idx2d.shape
+    dx = _take_pad_zero(g, bwd_idx2d.reshape(t * k)) \
+        .reshape(t, k, g.shape[-1]).sum(axis=1)
+    return dx, None, None
+
+
+buffer_exchange_kdup.defvjp(_buffer_exchange_kdup_fwd,
+                            _buffer_exchange_kdup_bwd)
+
+
+@jax.custom_vjp
+def dispatch_gather(x: jax.Array, order: jax.Array, inv2d: jax.Array
+                    ) -> jax.Array:
+    """Expert-sorted token rows WITHOUT materializing the k-duplicated
+    [T*k, H] intermediate: ``out[j] = x[order[j] // k]`` in one gather.
+
+    ``inv2d`` [T, k] is the inverse map (sorted slot of token t's c-th
+    choice); the transpose is then also pure gathers:
+    ``dx[t] = Σ_c g[inv2d[t, c]]`` — never a TPU scatter-add.
+    """
+    k = inv2d.shape[-1]
+    return jnp.take(x, order // k, axis=0)
+
+
+def _dispatch_gather_fwd(x, order, inv2d):
+    return dispatch_gather(x, order, inv2d), inv2d
+
+
+def _dispatch_gather_bwd(inv2d, g):
+    return jnp.take(g, inv2d, axis=0).sum(axis=1), None, None
+
+
+dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def combine_gather(y_s: jax.Array, weights: jax.Array, order: jax.Array,
+                   inv2d: jax.Array) -> jax.Array:
+    """Weighted combine straight from the expert-sorted rows:
+    ``out[t] = Σ_c weights[t, c] · y_s[inv2d[t, c]]`` — the gate-weight
+    multiply and the k-way reduction fuse into the un-sort gather, skipping
+    two [T*k, H] materializations (the weighted rows and the un-sorted
+    rows). Backward is pure gathers: ``dy_s[j] = w[j] · g[order[j] // k]``
+    and ``dw[t, c] = ⟨y_s[inv2d[t, c]], g[t]⟩``.
+    """
+    w = weights.astype(y_s.dtype)
+    return (jnp.take(y_s, inv2d, axis=0) * w[..., None]).sum(axis=1)
+
+
+def _combine_gather_fwd(y_s, weights, order, inv2d):
+    return combine_gather(y_s, weights, order, inv2d), \
+        (y_s, weights, order, inv2d)
+
+
+def _combine_gather_bwd(res, g):
+    y_s, weights, order, inv2d = res
+    k = inv2d.shape[-1]
+    w_s = jnp.take(weights.reshape(-1), order).astype(y_s.dtype)
+    dy = jnp.take(g, order // k, axis=0) * w_s[:, None]
+    dw = jnp.einsum("tkh,th->tk", jnp.take(y_s, inv2d, axis=0), g,
+                    preferred_element_type=jnp.float32).astype(weights.dtype)
+    return dy, dw, None, None
+
+
+combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
+
+
 def _ragged_dispatch_local(xt: jax.Array, weights: jax.Array, idx: jax.Array,
                            experts: Dict[str, jax.Array], activation: str
                            ) -> jax.Array:
     """Dropless dispatch on local tokens: sort → ragged matmul → un-sort.
 
-    xt [T, H]; weights/idx [T, k]. Dispatch = broadcast over k (VJP: cheap
-    reduce) then :func:`permute_rows` (VJP: gather); combine = the inverse
-    permutation (the counting sort hands back both directions) — no
-    [T*k, H] scatter-add in forward OR backward.
+    xt [T, H]; weights/idx [T, k]. Dispatch = :func:`dispatch_gather`
+    (one gather straight from [T, H], k-duplication folded into the index
+    map); combine = :func:`combine_gather` (gate weights + k-reduction
+    fused into the inverse gather) — no [T*k, H] broadcast, weighted copy
+    or un-sorted copy is ever materialized, and no direction is a TPU
+    scatter-add.
     """
     T, H = xt.shape
     k = idx.shape[-1]
@@ -279,15 +381,12 @@ def _ragged_dispatch_local(xt: jax.Array, weights: jax.Array, idx: jax.Array,
     # tiny [Tk] ints + [T,k] weights: named so the selective remat policy
     # STORES them — bwd then skips re-running the whole gate + counting sort
     order = _ckpt_name(order, "moe_gate")
-    inv = _ckpt_name(inv, "moe_gate")
+    inv2d = _ckpt_name(inv.reshape(T, k), "moe_gate")
     group_sizes = _ckpt_name(group_sizes, "moe_gate")
     weights = _ckpt_name(weights, "moe_gate")
-    x_rep = jnp.broadcast_to(xt[:, None, :], (T, k, H)).reshape(Tk, H)
-    x_s = permute_rows(x_rep, order, inv)
+    x_s = dispatch_gather(xt, order, inv2d)
     y_s = ragged_expert_ffn(x_s, group_sizes, experts, activation)
-    w_s = jnp.take(weights.reshape(Tk), order).astype(xt.dtype)
-    y_s = y_s * w_s[:, None]
-    return permute_rows(y_s, inv, order).reshape(T, k, H).sum(axis=1)
+    return combine_gather(y_s, weights.astype(xt.dtype), order, inv2d)
 
 
 def _already_manual_axes() -> set:
@@ -552,9 +651,11 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
             slot2row = _ckpt_name(
                 jnp.full((ep * Cs,), tk, jnp.int32).at[slot].set(
                     jnp.arange(tk, dtype=jnp.int32), mode="drop"), "moe_gate")
-            x_rep = jnp.broadcast_to(
-                xt[:, None, :], (t, k, H)).reshape(tk, H)
-            send_x = buffer_exchange(x_rep, slot2row, slot)
+            # k-duplication folded into the gather index (slot2row // k;
+            # sentinel tk divides to t = xt's zero pad row) — the [tk, H]
+            # broadcast copy is never materialized
+            send_x = buffer_exchange_kdup(xt, slot2row // k,
+                                          slot.reshape(t, k))
             send_e = jnp.where(
                 slot2row < tk,
                 jnp.take(flat_e % E_l, jnp.minimum(slot2row, tk - 1)),

@@ -209,6 +209,8 @@ def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
     ``aux_seed`` seeds each stage's aux output (MoE aux-loss coefficient,
     already including the scale; None → aux ignored).
     """
+    import os
+
     n_stages = mesh.shape[axis_name]
     M = jax.tree.leaves(inputs)[0].shape[0]
     P_ = n_stages
@@ -216,6 +218,12 @@ def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
     buf_n = 2 * P_
     fwd_perm = stage_perm(n_stages)
     bwd_perm = [(d, s) for (s, d) in fwd_perm]
+    # scan unroll over ticks: lets XLA fuse across tick boundaries and halve
+    # the while-loop iteration overhead (a real cost on the CPU mesh where
+    # each iteration pays per-op thread dispatch; near-free on TPU)
+    unroll = int(os.environ.get("DSTPU_PIPE_UNROLL", 1))
+    if unroll < 1 or T % unroll != 0:
+        unroll = 1
 
     def local(inputs_l, blocks_l, extra_l):
         stage = lax.axis_index(axis_name)
@@ -306,7 +314,7 @@ def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
              jnp.zeros((buf_n,) + b_shape, dt),
              gblocks0, gextra0, gemb0, jnp.float32(0.0), jnp.float32(0.0)))
         (_, _, _, gblocks, gextra, gemb, loss_sum, aux_sum), _ = lax.scan(
-            tick, carry0, jnp.arange(T))
+            tick, carry0, jnp.arange(T), unroll=unroll)
 
         loss = lax.psum(loss_sum, axis_name) / M
         aux = lax.psum(aux_sum, axis_name) / M

@@ -9,8 +9,14 @@ file runs, so we both set the env vars AND update jax.config directly.
 """
 import os
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # 8 device threads can time-slice a single core on small runners: the
+    # default 20s/40s collective-rendezvous deadlines then abort long fused
+    # programs spuriously (F rendezvous.cc:127) — raise them well clear
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
 

@@ -202,6 +202,93 @@ def test_decode_steps_matches_per_tick_steps():
     assert c.decode_steps(4) == {}
 
 
+def test_decode_stream_matches_decode_steps():
+    """decode_stream (double-buffered windows chained on device) produces
+    the same greedy tokens and host bookkeeping as synchronous
+    decode_steps windows, including after an EARLY BREAK (the in-flight
+    window must fold into engine state, not vanish)."""
+    rng = np.random.default_rng(5)
+    prompts = _prompts(rng, [7, 21, 13])
+    uids = [1, 2, 3]
+
+    def mk():
+        return FastGenEngine("tiny", n_blocks=64, block_size=16,
+                             max_blocks_per_seq=8, token_budget=32,
+                             temperature=0.0, seed=0, **CFG)
+
+    a, b, c = mk(), mk(), mk()
+    for eng in (a, b, c):
+        eng.put(uids, prompts)
+        while any(eng.seqs[u].prefill_remaining > 0 for u in uids):
+            eng.step()
+
+    for _ in range(3):
+        a.decode_steps(8)
+
+    base = {u: len(b.seqs[u].generated) for u in uids}  # prefill-emitted
+    served = []
+    for emitted in b.decode_stream(window=8):
+        served.append(emitted)
+        if len(served) == 3:
+            break
+    # yielded windows + in-flight drain must equal engine state
+    for u in uids:
+        assert a.seqs[u].generated[:24] == b.seqs[u].generated[:24], u
+    yielded = {u: sum((e.get(u, []) for e in served), []) for u in uids}
+    for u in uids:
+        # engine state may be AHEAD of what was yielded (the closed
+        # stream's in-flight window) but never behind; yielded tokens
+        # follow the prefill-emitted ones
+        got = b.seqs[u].generated[base[u]:]
+        assert got[:len(yielded[u])] == yielded[u]
+        assert len(got) >= len(yielded[u])
+
+    # run-to-exhaustion (no break) matches too, via repeated re-entry
+    for _ in range(3):
+        for emitted in c.decode_stream(window=8):
+            pass
+        if all(len(c.seqs[u].generated) >= 24 for u in uids):
+            break
+    for u in uids:
+        assert a.seqs[u].generated[:24] == c.seqs[u].generated[:24], u
+
+
+def test_decode_stream_max_len_tail_matches_sync():
+    """Sequences approaching max_len: the stream drain must apply the
+    length cutoff at TICK-TIME positions (s.pos runs 1-2 windows ahead of
+    the drain) — equal FINAL lengths with the sync path, not just a common
+    prefix (the prefix check masks tail truncation)."""
+    rng = np.random.default_rng(6)
+    # max_len 128, window 8: prompts ≡ 7 (mod 8) land pos EXACTLY on
+    # max_len-1 after whole windows, so the length cutoff fires on the
+    # final drained tick (the case the tick-time position check protects)
+    prompts = _prompts(rng, [103, 95])
+    uids = [1, 2]
+
+    def mk():
+        return FastGenEngine("tiny", n_blocks=64, block_size=16,
+                             max_blocks_per_seq=8, token_budget=128,
+                             temperature=0.0, seed=0, **CFG)
+
+    a, b = mk(), mk()
+    for eng in (a, b):
+        eng.put(uids, prompts)
+        while any(eng.seqs[u].prefill_remaining > 0 for u in uids):
+            eng.step()
+    while a.decode_steps(8):        # sync: run to the max_len wall
+        pass
+    for _ in range(8):              # stream: re-enter until exhausted
+        served = False
+        for _e in b.decode_stream(window=8):
+            served = True
+        if not served:
+            break
+    for u in uids:
+        assert len(a.seqs[u].generated) == len(b.seqs[u].generated), u
+        assert a.seqs[u].generated == b.seqs[u].generated, u
+        assert a.seqs[u].done == b.seqs[u].done, u
+
+
 def test_fastgen_no_recompile_on_admission():
     """Admission with NEW prompt lengths must not trigger new compiles —
     the round-1 slot engine compiled one prefill per length bucket."""

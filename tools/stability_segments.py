@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""2,000-step stability artifact runner (STABILITY_r04.json).
+
+Runs each exotic-engine lane for 2,000 optimizer steps in FOUR 500-step
+SEGMENTS, each in a fresh subprocess resuming from the previous segment's
+checkpoint. Segmentation is a deliberate workaround for an XLA:CPU runtime
+defect observed on the 8-virtual-device single-core mesh: after ~1,000
+executions of collective-heavy programs (the qgZ per-leaf quantized
+all-gathers), one device thread permanently fails to join the next
+cross-module rendezvous — 7 of 8 arrive, and the terminate deadline fires
+even at 1,200 s on an idle core (rendezvous.cc:127). Fresh processes reset
+the runtime well below that horizon; the checkpoint/resume between segments
+additionally exercises persistent-state carry (Adam moments, LoCo error
+residuals, curriculum step) across restarts — the reference's
+nightly-convergence-suite concern (SURVEY §4).
+
+Usage: python tools/stability_segments.py  (writes STABILITY_r04.json)
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEGMENT = r'''
+import itertools, json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deepspeed_tpu as dst
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+zero_cfg = json.loads(sys.argv[1])
+ckpt_dir = sys.argv[2]
+steps, window = int(sys.argv[3]), 100
+
+mesh_mod.reset_mesh()
+spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                          max_seq_len=64)
+config = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+          "zero_optimization": zero_cfg, "steps_per_print": 10 ** 9}
+engine, *_ = dst.initialize(model=spec, config=config)
+import os
+if os.path.exists(os.path.join(ckpt_dir, "latest")):
+    engine.load_checkpoint(ckpt_dir)
+corpus = [b for b, _ in zip(synthetic_lm_data(8, 64, 512, seed=0),
+                            range(16))]
+losses = []
+for _ in range(steps // window):
+    loss = engine.train_batches(itertools.cycle(corpus), window)
+    losses.append(round(float(loss), 4))
+engine.save_checkpoint(ckpt_dir)
+print("SEGMENT_RESULT " + json.dumps(
+    {"losses": losses, "step": int(engine.global_steps)}))
+'''
+
+RUNS = {
+    "zero3_offload_param": {"stage": 3, "offload_param": {"device": "cpu"}},
+    "zero2_qgz_loco": {"stage": 2, "zero_quantized_gradients": True,
+                       "loco_error_feedback": True},
+    "exact_zero2": {"stage": 2},
+}
+
+
+def main(total_steps=2000, seg_steps=500, only=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DSTPU_ACCELERATOR="cpu",
+               PYTHONPATH=REPO,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          + " --xla_cpu_collective_call_warn_stuck_timeout_"
+                            "seconds=300"
+                          + " --xla_cpu_collective_call_terminate_timeout_"
+                            "seconds=1200"))
+    prior_path = os.path.join(REPO, "STABILITY_r04.json")
+    out = {}
+    if only and os.path.exists(prior_path):
+        with open(prior_path) as f:
+            out = {k: v for k, v in json.load(f).items()
+                   if k in RUNS and isinstance(v, dict) and "error" not in v}
+    for name, zc in RUNS.items():
+        if only and name != only or name in out:
+            continue
+        ckpt = tempfile.mkdtemp(prefix=f"stab_{name}_")
+        losses = []
+        for seg in range(total_steps // seg_steps):
+            # the XLA:CPU thread-loss is flaky and can strike any segment:
+            # a crashed attempt left no checkpoint for its steps, so a
+            # retry simply resumes from the last good segment boundary
+            for attempt in range(3):
+                p = subprocess.run(
+                    [sys.executable, "-c", SEGMENT, json.dumps(zc), ckpt,
+                     str(seg_steps)],
+                    capture_output=True, text=True, env=env, timeout=3000)
+                line = [ln for ln in p.stdout.splitlines()
+                        if ln.startswith("SEGMENT_RESULT ")]
+                if p.returncode == 0 and line:
+                    break
+                print(f"{name} segment {seg} attempt {attempt} failed rc="
+                      f"{p.returncode}", flush=True)
+            else:
+                out[name] = {"error": (p.stderr or "no output")[-400:],
+                             "failed_segment": seg}
+                break
+            res = json.loads(line[-1].split(" ", 1)[1])
+            losses.extend(res["losses"])
+            print(f"{name} segment {seg}: step {res['step']} "
+                  f"loss {res['losses'][-1]}", flush=True)
+        else:
+            out[name] = {"first": losses[0], "last": losses[-1],
+                         "min": min(losses), "max": max(losses),
+                         "finite": all(x == x and abs(x) < 1e30
+                                       for x in losses),
+                         "monotone_trend": losses[-1] < losses[0] - 1.0,
+                         "curve_every_100": losses}
+    if all("error" not in v for v in out.values()) and len(out) == len(RUNS):
+        ex = out["exact_zero2"]["last"]
+        out["final_loss_max_abs_dev_vs_exact"] = round(max(
+            abs(out["zero3_offload_param"]["last"] - ex),
+            abs(out["zero2_qgz_loco"]["last"] - ex)), 4)
+    out["steps"] = total_steps
+    out["method"] = ("4x500-step segments, fresh process + checkpoint "
+                     "resume per segment (XLA:CPU rendezvous thread-loss "
+                     "workaround past ~1k collective-heavy executions; "
+                     "resume also exercises Adam/LoCo state carry)")
+    with open(os.path.join(REPO, "STABILITY_r04.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print("WROTE STABILITY_r04.json")
+
+
+if __name__ == "__main__":
+    main(only=sys.argv[1] if len(sys.argv) > 1 else None)

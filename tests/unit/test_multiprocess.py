@@ -83,8 +83,28 @@ assert engine2.global_steps == 6
 l2 = float(engine2.train_batch(data))
 assert np.isfinite(l2)
 
+# --- compressed wire ACROSS PROCESS BOUNDARIES: qgZ int8 + LoCo -------
+# the int8 quantized gradient collectives + persistent error-feedback
+# residuals run in a shard_map manual over a data axis that SPANS the two
+# OS processes — the wire format crossing a real process boundary, not
+# just virtual devices inside one runtime
+config_q = dict(config, zero_optimization={
+    "stage": 2, "zero_quantized_gradients": True,
+    "loco_error_feedback": True})
+engine3, *_ = dst.initialize(model=spec, config=config_q)
+# the engine downgrades to exact collectives with only a warning when
+# eligibility fails — assert the compressed path is genuinely ACTIVE or
+# this segment silently stops covering the wire format
+assert engine3._compressed and engine3._compressed["quant_grads"] \
+    and engine3._compressed.get("loco"), engine3._compressed
+ql = [float(engine3.train_batch(data)) for _ in range(6)]
+assert all(np.isfinite(ql)), ql
+assert ql[-1] < ql[0], ql
+qagree = comm.host_allgather(np.float32(ql[-1]))
+assert qagree[0] == qagree[1], qagree
+
 print(json.dumps({"rank": rank, "loss0": losses[0], "lossN": losses[-1],
-                  "resumed": l2}), flush=True)
+                  "resumed": l2, "qgz_lossN": ql[-1]}), flush=True)
 """
 
 
@@ -112,7 +132,9 @@ def test_two_process_train_checkpoint(tmp_path):
         env=_mp_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for r in (0, 1)]
     try:
-        outs = [p.communicate(timeout=540) for p in procs]
+        # budget: three engine builds + three jit compiles (incl. the
+        # quantized shard_map path) + 13 cross-process train steps
+        outs = [p.communicate(timeout=900) for p in procs]
     finally:
         # a worker deadlocked in a collective must not outlive the test
         # holding the coordinator port / pipes open
@@ -128,6 +150,7 @@ def test_two_process_train_checkpoint(tmp_path):
     # SPMD: both processes computed the identical global step
     assert rows[0]["lossN"] == rows[1]["lossN"]
     assert rows[0]["resumed"] == rows[1]["resumed"]
+    assert rows[0]["qgz_lossN"] == rows[1]["qgz_lossN"]
 
     # UCP across PROCESS COUNTS: the 2-process run's checkpoint converts to
     # universal atoms and loads into THIS single-process 8-device engine

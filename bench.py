@@ -98,7 +98,7 @@ def _hardware_flops_per_token(cfg, n_params, seq_len, remat):
     return model + 2 * body + attn_fwd
 
 
-def measure_matmul_ceiling(n=8192, iters=30) -> float:
+def measure_matmul_ceiling(n=8192, iters=100) -> float:
     """MEASURED pure-matmul ceiling for this chip through this runtime
     (tunnel transport included): chained bf16 [n,n]x[n,n] dots in one
     dispatch. This is the number ``vs_ceiling`` is checked against — the
@@ -119,10 +119,12 @@ def measure_matmul_ceiling(n=8192, iters=30) -> float:
             jnp.float32))
 
     float(loop(x, w))                                   # compile + warm
-    t0 = time.perf_counter()
-    float(loop(x, w))
-    dt = time.perf_counter() - t0
-    return 2 * n ** 3 * iters / dt / 1e12
+    best = float("inf")
+    for _ in range(5):                 # best-of-N least-disturbed sample,
+        t0 = time.perf_counter()       # like the headline's best-of-3
+        float(loop(x, w))              # (5 here: each trial is ~0.8s cheap
+        best = min(best, time.perf_counter() - t0)  # vs a ~8s train window)
+    return 2 * n ** 3 * iters / best / 1e12
 
 
 def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
@@ -165,10 +167,16 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     float(loss)
     loss = engine.train_batches(data, steps)   # settle allocator/transport
     float(loss)
-    t0 = time.perf_counter()
-    loss = engine.train_batches(data, steps)
-    float(loss)
-    dt = time.perf_counter() - t0
+    # best of 3 timed windows: the remote-execution tunnel adds run-to-run
+    # variance (~±3%) unrelated to the program; the best window is the
+    # least-disturbed measurement (all samples emitted for transparency)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        loss = engine.train_batches(data, steps)
+        float(loss)
+        samples.append(time.perf_counter() - t0)
+    dt = min(samples)
     tokens = steps * gas * batch * n_chips * seq_len
     tps_chip = tokens / dt / n_chips
     achieved = _flops_per_token(cfg, spec.num_params, seq_len) * tps_chip / 1e12
@@ -183,6 +191,8 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
         "hardware_tflops_per_sec_chip": round(hw, 1),
         "mfu": round(achieved / peak, 3),
         "loss": round(float(loss), 4),
+        "window_samples_tokens_per_sec": [
+            round(tokens / s / n_chips, 1) for s in samples],
     }
     if note:
         out["note"] = note
@@ -866,6 +876,8 @@ def main():
         "vs_ceiling_hardware":
             (round(headline["hardware_tflops_per_sec_chip"] / ceiling, 3)
              if ceiling else None),
+        "window_samples_tokens_per_sec":
+            headline.get("window_samples_tokens_per_sec"),
         "n_chips": n_chips,
     }
 

@@ -780,10 +780,15 @@ def _run_entry_subprocess(name: str):
     deliberately-HBM-tight config can't take the headline JSON down with it."""
     import subprocess
 
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--entry", name],
-        capture_output=True, text=True,
-        timeout=ENTRY_TIMEOUTS.get(name, 1200))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--entry", name],
+            capture_output=True, text=True,
+            timeout=ENTRY_TIMEOUTS.get(name, 1200))
+    except subprocess.TimeoutExpired:
+        # a slow entry must cost ITS row, not the whole headline JSON line
+        return {"error": f"entry timed out after "
+                         f"{ENTRY_TIMEOUTS.get(name, 1200)}s"}
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             return json.loads(line)
@@ -793,9 +798,22 @@ def _run_entry_subprocess(name: str):
     return {"error": f"rc={proc.returncode}: {tail[0][:180]}"}
 
 
+def _logs_to_stderr():
+    """The driver contract is ONE JSON line on stdout; the framework logger
+    streams INFO to stdout (reference behavior) — rehome it for the bench."""
+    import logging
+
+    import deepspeed_tpu.utils.logging  # noqa: F401 — creates the handler
+
+    for h in logging.getLogger("deepspeed_tpu").handlers:
+        if getattr(h, "stream", None) is sys.stdout:
+            h.setStream(sys.stderr)
+
+
 def main():
     import jax
 
+    _logs_to_stderr()
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
         name = sys.argv[2]
         try:

@@ -183,6 +183,124 @@ class FusedAdagrad(TPUOptimizer):
 
 
 @dataclasses.dataclass
+class Adafactor(TPUOptimizer):
+    """Adafactor (Shazeer & Stern 2018) — factored second moment, no master.
+
+    Not in the reference's ops/ family (its memory answer is ZeRO-Offload,
+    CUDA+PCIe); on TPU the idiomatic single-chip memory answer is the one
+    the TPU lineage (T5, PaLM) actually used: O(n+m) optimizer state per
+    n×m matrix instead of 2nm fp32 moments. With ``bf16.fp32_master=false``
+    this trains a 3B-param model in 16G HBM where Adam's 14 bytes/param
+    needs 42G. Constant-lr variant: external LR schedule, β2 fixed,
+    update-RMS clipping at ``clip_threshold`` (paper §6 d=1).
+
+    State per leaf: matrices (ndim≥2, factored over the LAST TWO axes;
+    leading axes — e.g. the stacked-layer L dim — are batch) carry
+    ``{"adafac_r","adafac_c"}`` row/col EMAs; vectors carry ``{"adafac_v"}``
+    full (key names are collision-proof vs model param dict keys — the
+    factor tree is mapped first with an is_leaf on these keys). The tree
+    does NOT mirror the param tree and takes the engine's replicated-aux
+    sharding path (factors are O(n+m) — replication is noise)."""
+
+    beta2: float = 0.999
+    eps1: float = 1e-30          # inside-sqrt regulariser on g²
+    clip_threshold: float = 1.0  # max RMS of the unscaled update
+    # leaves whose last-two dims are both below this stay UN-factored (full
+    # v): stacked norm scales (L, h) would otherwise couple all layers'
+    # statistics through one rank-1 fit, and the memory win is negligible
+    # there (optax/T5x use the same 128 guard)
+    min_dim_size_to_factor: int = 128
+    # bf16 params without an fp32 master cannot absorb updates smaller than
+    # bf16's 8-bit mantissa step (~0.4% of the param's magnitude) — they
+    # round to zero and training stalls. Stochastic rounding makes the
+    # EXPECTED update exact: round up with probability proportional to the
+    # residual. Applied only when the param dtype is bf16.
+    stochastic_rounding: bool = True
+    moment_names: Tuple[str, ...] = ("fac",)
+
+    @staticmethod
+    def _is_factor(x) -> bool:
+        return isinstance(x, dict) and ("adafac_r" in x or "adafac_v" in x)
+
+    @staticmethod
+    def _stoch_round_bf16(x32: jax.Array, step: jax.Array,
+                          leaf_id: int = 0) -> jax.Array:
+        """fp32 → bf16 with stochastic rounding: add uniform noise in the
+        truncated mantissa bits, then truncate. Counter-based randomness
+        (threefry on the step counter folded with a per-leaf id, so equal-
+        shaped leaves draw independent noise) keeps the update a pure
+        function of (state, grads) — same-step replays are bit-identical."""
+        bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0x5eed), leaf_id), step)
+        noise = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+        return jax.lax.bitcast_convert_type(
+            (bits + noise) & jnp.uint32(0xFFFF0000), jnp.float32
+        ).astype(jnp.bfloat16)
+
+    def _factorable(self, p) -> bool:
+        return (p.ndim >= 2
+                and p.shape[-1] >= self.min_dim_size_to_factor
+                and p.shape[-2] >= self.min_dim_size_to_factor)
+
+    def init(self, params: PyTree) -> Dict[str, Any]:
+        def leaf(p):
+            if self._factorable(p):
+                return {"adafac_r": jnp.zeros(p.shape[:-1], jnp.float32),
+                        "adafac_c": jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                              jnp.float32)}
+            return {"adafac_v": jnp.zeros(p.shape, jnp.float32)}
+        return {"fac": _tmap(leaf, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b2 = self.beta2
+
+        leaf_counter = [0]
+
+        def leaf(f, p, g):
+            leaf_id = leaf_counter[0]   # trace-time constant per leaf
+            leaf_counter[0] += 1
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            g2 = jnp.square(g) + self.eps1
+            if "adafac_r" in f:
+                vr = b2 * f["adafac_r"] + (1 - b2) * jnp.mean(g2, axis=-1)
+                vc = b2 * f["adafac_c"] + (1 - b2) * jnp.mean(g2, axis=-2)
+                # V ≈ (vr ⊗ vc) / mean(vr): the rank-1 fit whose row/col
+                # sums match the EMAs (paper eq. 4, means-normalised).
+                # Normalise vr FIRST: vr·vc can underflow fp32 (g²~1e-33
+                # early in training → product 1e-66 → 0 → rsqrt=inf→NaN);
+                # vr/mean(vr) is O(1) so the product stays in range.
+                vr_n = vr / jnp.mean(vr, axis=-1, keepdims=True)
+                denom = vr_n[..., :, None] * vc[..., None, :]
+                f_new = {"adafac_r": vr, "adafac_c": vc}
+            else:
+                denom = b2 * f["adafac_v"] + (1 - b2) * g2
+                f_new = {"adafac_v": denom}
+            u = g * jax.lax.rsqrt(denom)
+            rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms / self.clip_threshold)
+            if self.weight_decay:
+                u = u + self.weight_decay * p32
+            new32 = p32 - lr * u
+            if self.stochastic_rounding and p.dtype == jnp.bfloat16:
+                return (self._stoch_round_bf16(new32, state["step"], leaf_id),
+                        f_new)
+            return new32.astype(p.dtype), f_new
+
+        # factor tree FIRST: its is_leaf-truncated treedef lets params/grads
+        # flatten_up_to their array leaves at the factor-dict positions
+        out = _tmap(leaf, state["fac"], params, grads,
+                    is_leaf=self._is_factor)
+        istup = lambda x: isinstance(x, tuple)  # noqa: E731
+        new_params = _tmap(lambda o: o[0], out, is_leaf=istup)
+        new_f = _tmap(lambda o: o[1], out, is_leaf=istup)
+        return new_params, {"fac": new_f, "step": state["step"] + 1}
+
+
+@dataclasses.dataclass
 class SGD(TPUOptimizer):
     momentum: float = 0.0
     nesterov: bool = False
@@ -333,6 +451,7 @@ _OPTIMIZERS = {
     "lamb": FusedLamb,
     "fusedlamb": FusedLamb,
     "adagrad": FusedAdagrad,
+    "adafactor": Adafactor,
     "sgd": SGD,
     "muon": Muon,
 }

@@ -44,6 +44,13 @@ class BF16Config:
     enabled: bool = False
     # bf16 grad accumulation dtype (reference bf16 section + data_types)
     immediate_grad_update: bool = True
+    # False drops the fp32 master copy: params live in bf16, each optimizer
+    # leaf computes its update in fp32 on the fly (no materialized fp32
+    # tree). Not a reference option (its bf16_optimizer always keeps an
+    # fp32 flat master, runtime/bf16_optimizer.py) — the TPU memory answer
+    # for fitting multi-B-param models in one chip's HBM, paired with
+    # optimizer="adafactor" (ops/optimizer.py).
+    fp32_master: bool = True
 
 
 @dataclasses.dataclass

@@ -171,6 +171,16 @@ class DeepSpeedTPUEngine:
             optimizer = MaskedOptimizer(inner=optimizer,
                                         mask=self._trainable_mask)
         self.optimizer = optimizer
+        if (self.precision == "bfloat16"
+                and not self.config.bf16.fp32_master
+                and not getattr(optimizer, "stochastic_rounding", False)):
+            # without an fp32 master, updates below bf16's 8-bit-mantissa
+            # step (~0.4% relative) round to zero and training silently
+            # stalls — only stochastic-rounding optimizers can absorb them
+            raise ValueError(
+                "bf16.fp32_master=false requires a stochastic-rounding "
+                "optimizer (adafactor); "
+                f"{type(optimizer).__name__} would silently stall")
         if lr_scheduler is None and self.config.scheduler and self.config.scheduler.type:
             lr_scheduler = get_lr_schedule(
                 self.config.scheduler.type, self.config.scheduler.params,
@@ -571,6 +581,13 @@ class DeepSpeedTPUEngine:
 
     def _make_state(self, rng) -> Dict[str, Any]:
         master = self.model_spec.init_fn(rng)
+        if self.precision == "bfloat16" and not self.config.bf16.fp32_master:
+            # no-fp32-master mode: the "master" IS the bf16 compute tree;
+            # optimizer updates still compute in fp32 per-leaf (cast inside
+            # the fused update — nothing fp32 is materialized tree-wide)
+            master = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, master)
         state = {
             "step": jnp.zeros((), jnp.int32),
             "master": master,

@@ -1487,7 +1487,7 @@ PRESETS: Dict[str, TransformerConfig] = {
     "moe_350m": TransformerConfig(vocab_size=32000, hidden_size=768,
                                   num_layers=12, num_heads=12, max_seq_len=1024,
                                   use_bias=False, n_experts=4, moe_top_k=2),
-    # north-star-scale single-chip model (BASELINE.md): ~3.3B params with
+    # north-star-scale single-chip model (BASELINE.md): ~3.1B params with
     # MXU-aligned shapes — head_dim 128, ffn 8192 (the open-llama-3B layout's
     # head_dim 100 wastes MXU lanes; this keeps every contraction 128-tiled)
     "llama_3b": TransformerConfig(vocab_size=32000, hidden_size=3072,

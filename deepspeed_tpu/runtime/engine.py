@@ -171,9 +171,12 @@ class DeepSpeedTPUEngine:
             optimizer = MaskedOptimizer(inner=optimizer,
                                         mask=self._trainable_mask)
         self.optimizer = optimizer
+        _inner_opt = optimizer
+        while hasattr(_inner_opt, "inner"):   # MaskedOptimizer/ZenFlow wrap
+            _inner_opt = _inner_opt.inner
         if (self.precision == "bfloat16"
                 and not self.config.bf16.fp32_master
-                and not getattr(optimizer, "stochastic_rounding", False)):
+                and not getattr(_inner_opt, "stochastic_rounding", False)):
             # without an fp32 master, updates below bf16's 8-bit-mantissa
             # step (~0.4% relative) round to zero and training silently
             # stalls — only stochastic-rounding optimizers can absorb them

@@ -36,12 +36,20 @@ from deepspeed_tpu.comm.mesh import (
     EXPERT_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
+    ZSHARD_AXIS,
     get_mesh_manager,
 )
 
 
 def _batch_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(a for a in (DATA_AXIS, EXPERT_AXIS) if mesh.shape.get(a, 1) > 1)
+    # MUST match the engine's batch sharding (partitioning.py batch_axes:
+    # data × zshard × expert — hpZ's 'zshard' is a DP subgroup). Omitting
+    # an axis here silently forces a batch re-shard at the attention
+    # boundary, which the SPMD partitioner can only do by replicate-then-
+    # repartition in the backward ("involuntary full rematerialization",
+    # caught by __graft_entry__.dryrun_multichip's stderr assert).
+    return tuple(a for a in (DATA_AXIS, ZSHARD_AXIS, EXPERT_AXIS)
+                 if mesh.shape.get(a, 1) > 1)
 
 
 def _maybe(axes: Tuple[str, ...]):

@@ -269,7 +269,7 @@ def test_adafactor_factory_and_engine_no_master(tmp_path):
     dp = jax.device_count()
     config = {"train_batch_size": 4 * dp, "train_micro_batch_size_per_gpu": 4,
               "gradient_accumulation_steps": 1,
-              "optimizer": {"type": "adafactor", "params": {"lr": 1e-2}},
+              "optimizer": {"type": "adafactor", "params": {"lr": 0.1}},
               "zero_optimization": {"stage": 1},
               "bf16": {"enabled": True, "fp32_master": False},
               "steps_per_print": 10 ** 9}

@@ -73,11 +73,15 @@ class CompressionPlan:
     pruning_specs: Tuple[PruningSpec, ...] = ()
     layer_reduction: Optional[Dict] = None
     schedule_offset: int = 0
+    # QAT activation quantization bits (reference ACTIVATION_QUANTIZATION
+    # section / basic_layer.QuantAct); 0 = off. Applied model-wide to the
+    # normed hidden stream feeding each block's linears.
+    act_quant_bits: int = 0
 
     @property
     def enabled(self) -> bool:
         return bool(self.quant_groups or self.pruning_specs
-                    or self.layer_reduction)
+                    or self.layer_reduction or self.act_quant_bits)
 
 
 def plan_compression(ds_config: Dict) -> CompressionPlan:
@@ -90,11 +94,24 @@ def plan_compression(ds_config: Dict) -> CompressionPlan:
         bits = int(params.get("target_bits", params.get("start_bits", 8)))
         plan.quant_groups.append((bits, _patterns_to_regex(mods)))
 
+    for name, params, mods in _groups(section.get("activation_quantization")):
+        # reference schema: bits under "bits" (QuantAct is per-module there;
+        # the functional translation is model-wide on the hidden stream)
+        bits = int(params.get("bits", 8))
+        if bits < 2:
+            # bits=1 would make fake_quant_symmetric's num_levels 0 → NaN
+            # activations; binary ACTIVATIONS are not a supported mode
+            # (the reference's QuantAct is likewise >= 2-bit)
+            raise ValueError(
+                f"activation_quantization bits must be >= 2 (got {bits})")
+        plan.act_quant_bits = bits
+
     specs: List[PruningSpec] = []
     for method, key, ratio_key in (
             ("sparse", "sparse_pruning", "dense_ratio"),
             ("row", "row_pruning", "dense_ratio"),
-            ("head", "head_pruning", "dense_ratio")):
+            ("head", "head_pruning", "dense_ratio"),
+            ("channel", "channel_pruning", "dense_ratio")):
         sec = section.get(key)
         shared = (sec or {}).get("shared_parameters", {})
         offset = int(shared.get("schedule_offset", 0))
@@ -132,7 +149,18 @@ def init_compression(spec, ds_config: Dict, step_fn=None):
         return spec
     log_dist(f"compression: quant_groups={len(plan.quant_groups)} "
              f"pruning_specs={len(plan.pruning_specs)} "
-             f"layer_reduction={bool(plan.layer_reduction)}")
+             f"layer_reduction={bool(plan.layer_reduction)} "
+             f"act_quant_bits={plan.act_quant_bits}")
+
+    if plan.act_quant_bits:
+        # activation QAT lives INSIDE the model forward (block-level fake
+        # quant on the normed hidden stream) — thread it through the spec's
+        # self-rebuild; specs without a builder can't host it
+        if spec.builder is None:
+            raise ValueError(
+                "activation_quantization needs a rebuildable model spec "
+                "(zoo causal_lm_spec); this spec has no builder")
+        spec = spec.builder(act_quant_bits=plan.act_quant_bits)
 
     base_init = spec.init_fn
     if plan.layer_reduction and plan.layer_reduction["teacher_layer"]:
@@ -168,15 +196,44 @@ def init_compression(spec, ds_config: Dict, step_fn=None):
 
 
 def redundancy_clean(params: PyTree, ds_config: Dict,
-                     step: Optional[int] = None) -> PyTree:
+                     step: Optional[int] = None, cfg=None):
     """Materialize the compression into the weights (reference
-    ``redundancy_clean`` — run after training to bake masks/quant in)."""
+    ``redundancy_clean`` — run after training to bake masks/quant in).
+
+    When a row-pruning group targets the FFN, the pruned intermediate
+    columns are PHYSICALLY DROPPED (the reference's ``dim_reduction=True``
+    helpers) via :func:`pruning.shrink_ffn` — the returned tree is smaller,
+    not just sparser. Returns ``params`` (legacy) or ``(params, new_cfg)``
+    when ``cfg`` is passed."""
+    import re as _re
+
     plan = plan_compression(ds_config)
     out = params
     for bits, pattern in plan.quant_groups:
         out = quantize_param_tree(out, bits=bits, pattern=pattern)
+    shrunk_cfg = cfg
     if plan.pruning_specs:
         big = step if step is not None else 10 ** 9
         masks = compute_masks(out, plan.pruning_specs, step=big)
         out = apply_masks(out, masks)
-    return jax.tree.map(lambda x: x, out)
+        row_ffn = [s for s in plan.pruning_specs
+                   if s.method == "row" and _re.search(s.pattern, "blocks/w_up")]
+        if row_ffn and isinstance(out, dict) and "blocks" in out \
+                and "w_up" in out["blocks"]:
+            from deepspeed_tpu.compression.pruning import (
+                mask_ffn_biases,
+                shrink_ffn,
+            )
+
+            # the reference's fix helpers mask the BIAS with the row mask
+            # too (basic_layer.py fix_row_col_pruning_helper) — without
+            # this, gelu(b_up[j]) of a zeroed column still leaks through
+            # w_down and the shrunk model wouldn't match the masked one
+            out = mask_ffn_biases(out, masks)
+            if cfg is not None:
+                # dimension reduction ONLY on the cfg-returning call: the
+                # legacy single-value form keeps the same-shape contract
+                # (callers feed the result back into same-topology specs)
+                out, shrunk_cfg = shrink_ffn(out, masks=masks, cfg=cfg)
+    out = jax.tree.map(lambda x: x, out)
+    return (out, shrunk_cfg) if cfg is not None else out

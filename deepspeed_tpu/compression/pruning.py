@@ -49,6 +49,7 @@ def row_mask(w: jax.Array, ratio: float, axis: int = 0) -> jax.Array:
     [out, in] weight; our zoo stores [in, out] so callers pass axis=1)."""
     if ratio <= 0:
         return jnp.ones_like(w, dtype=jnp.float32)
+    axis = axis % w.ndim
     other = tuple(d for d in range(w.ndim) if d != axis)
     scores = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=other)
     k = max(int(scores.shape[0] * (1.0 - ratio)), 1)
@@ -83,6 +84,16 @@ def head_mask(w: jax.Array, ratio: float, num_heads: int,
     return jnp.broadcast_to(mask_dim.reshape(shape), w.shape)
 
 
+def channel_mask(w: jax.Array, ratio: float) -> jax.Array:
+    """Prune whole conv OUTPUT channels by L1 norm (reference
+    ChannelPruning on ``Conv2dLayer_Compress``; our spatial convs are HWIO,
+    channels = last dim). For 2-D weights this degenerates to row_mask on
+    the output dim."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=jnp.float32)
+    return row_mask(w, ratio, axis=w.ndim - 1)
+
+
 # --------------------------------------------------------------------------- #
 # schedule + tree-level API
 # --------------------------------------------------------------------------- #
@@ -111,11 +122,17 @@ class PruningSpec:
     """One pruning rule: param-name regex → method + ratio schedule."""
 
     pattern: str
-    method: str = "sparse"            # sparse | row | head
+    method: str = "sparse"            # sparse | row | head | channel
     scheduler: Optional[PruningScheduler] = None
     ratio: float = 0.5
     num_heads: int = 1                # for method='head'
-    axis: int = 1                     # for method='row' ([in, out] zoo layout)
+    # method='row': the dim whose slices are pruned — the OUTPUT dim. -1
+    # covers both the 2-D [in, out] and stacked 3-D [L, in, out] layouts
+    # (an explicit positive axis keeps working for transposed weights).
+    # NOTE: for FFN-pair pruning target w_up/w_gate ONLY — w_down's pruned
+    # input dim follows via redundancy_clean's shrink; a row spec matching
+    # w_down prunes its OUTPUT (the residual stream), a different thing.
+    axis: int = -1
 
     def ratio_at(self, step: int) -> float:
         if self.scheduler is not None:
@@ -148,6 +165,8 @@ def compute_masks(params: PyTree, specs: Tuple[PruningSpec, ...],
                     return row_mask(leaf, r, axis=spec.axis)
                 if spec.method == "head":
                     return head_mask(leaf, r, spec.num_heads)
+                if spec.method == "channel":
+                    return channel_mask(leaf, r)
                 raise ValueError(f"unknown pruning method {spec.method!r}")
         return jnp.float32(1.0)
 
@@ -157,6 +176,96 @@ def compute_masks(params: PyTree, specs: Tuple[PruningSpec, ...],
 def apply_masks(params: PyTree, masks: PyTree) -> PyTree:
     """Elementwise multiply — jit-safe; XLA fuses into the consumer matmul."""
     return jax.tree.map(lambda p, m: (p * m).astype(p.dtype), params, masks)
+
+
+def shrink_ffn(params: PyTree, masks: Optional[PyTree] = None,
+               keep_frac: Optional[float] = None,
+               cfg=None) -> Tuple[PyTree, Optional[Any]]:
+    """Materialize FFN row pruning as a DIMENSION REDUCTION — the reference's
+    ``fix_row_col_pruning_helper(dim_reduction=True)``: instead of zeroing
+    intermediate columns, physically drop them from the weight tensors.
+
+    Zoo stacked layout: ``w_up`` [L, H, F], ``w_down`` [L, F, H]. The kept
+    F-columns come from the ``masks`` tree when given (the SAME mask
+    ``row_mask`` built — one global keep-set, so the shrunk model's logits
+    are BIT-IDENTICAL to the masked model's: gelu/silu map 0→0 and a
+    zeroed up-column contributes nothing through w_down), else from a
+    fresh L1 score at ``keep_frac``. Host-side, post-training.
+
+    Returns (new_params, new_cfg with ffn_hidden_size = kept count);
+    new_cfg is None when ``cfg`` is not passed."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    blocks = params.get("blocks") if isinstance(params, dict) else None
+    if blocks is None or "w_up" not in blocks:
+        raise ValueError("shrink_ffn expects the transformer zoo layout "
+                         "(params['blocks']['w_up'])")
+    w_up = blocks["w_up"]          # [L, H, F] dense / [L, E, H, Fe] MoE
+    F = w_up.shape[-1]
+    if masks is not None:
+        m = np.asarray(jax.device_get(masks["blocks"]["w_up"]))
+        m = np.broadcast_to(m, w_up.shape)
+        keep = np.flatnonzero(m.reshape(-1, F).max(axis=0) > 0)
+    else:
+        if keep_frac is None:
+            raise ValueError("pass masks or keep_frac")
+        scores = np.asarray(jax.device_get(jnp.sum(
+            jnp.abs(w_up.astype(jnp.float32)),
+            axis=tuple(range(w_up.ndim - 1)))))
+        k = max(int(F * keep_frac), 1)
+        keep = np.sort(np.argpartition(scores, -k)[-k:])
+    idx = jnp.asarray(keep, jnp.int32)
+    new_blocks = dict(blocks)
+    # ndim-relative axes: the intermediate dim is LAST on up/gate and
+    # SECOND-TO-LAST on w_down in both the dense [L, H, F]/[L, F, H] and
+    # MoE [L, E, H, Fe]/[L, E, Fe, H] layouts
+    for name in ("w_up", "w_gate"):
+        if name in new_blocks:
+            w = new_blocks[name]
+            new_blocks[name] = jnp.take(w, idx, axis=w.ndim - 1)
+    for name in ("b_up", "b_gate"):
+        if name in new_blocks:
+            b = new_blocks[name]
+            new_blocks[name] = jnp.take(b, idx, axis=b.ndim - 1)
+    wd = new_blocks["w_down"]
+    new_blocks["w_down"] = jnp.take(wd, idx, axis=wd.ndim - 2)
+    out = dict(params)
+    out["blocks"] = new_blocks
+    new_cfg = None
+    if cfg is not None:
+        field = "moe_ffn_size" if getattr(cfg, "n_experts", 0) > 0 and \
+            getattr(cfg, "moe_ffn_size", None) else "ffn_hidden_size"
+        new_cfg = _dc.replace(cfg, **{field: int(keep.size)})
+    return out, new_cfg
+
+
+def mask_ffn_biases(params: PyTree, masks: PyTree) -> PyTree:
+    """Apply the FFN row mask's column keep-vector to ``b_up``/``b_gate``
+    (the reference masks bias alongside the row mask,
+    ``fix_row_col_pruning_helper``): without it, act(b_up[j]) of a zeroed
+    column leaks through w_down and masked != shrunk."""
+    import numpy as np
+
+    blocks = params.get("blocks") if isinstance(params, dict) else None
+    if blocks is None or "w_up" not in blocks:
+        return params
+    m = np.asarray(jax.device_get(masks["blocks"]["w_up"]))
+    if getattr(m, "ndim", 0) < 2:
+        return params
+    w_up = blocks["w_up"]
+    m = np.broadcast_to(m, w_up.shape)
+    keep_cols = jnp.asarray(
+        (m.reshape(-1, w_up.shape[-1]).max(axis=0) > 0), jnp.float32)
+    new_blocks = dict(blocks)
+    for name in ("b_up", "b_gate"):
+        if name in new_blocks:
+            b = new_blocks[name]
+            new_blocks[name] = (b * keep_cols).astype(b.dtype)
+    out = dict(params)
+    out["blocks"] = new_blocks
+    return out
 
 
 def sparsity_report(masks: PyTree) -> Dict[str, float]:

@@ -38,10 +38,39 @@ def _fq_bwd(_, g):
 fake_quant_symmetric.defvjp(_fq_fwd, _fq_bwd)
 
 
+@jax.custom_vjp
+def binarize(w: jax.Array) -> jax.Array:
+    """1-bit weights: α·sign(w) with α = mean|w| (XNOR-Net scaling — the
+    reference's ``BinaryQuantizer``, basic_layer.py); identity gradient."""
+    alpha = jnp.mean(jnp.abs(w))
+    return jnp.where(w >= 0, alpha, -alpha).astype(w.dtype)
+
+
+binarize.defvjp(lambda w: (binarize(w), None), lambda _, g: (g,))
+
+
+@jax.custom_vjp
+def ternarize(w: jax.Array) -> jax.Array:
+    """2-bit ternary weights {-α, 0, +α}: threshold Δ = 0.7·mean|w|, scale
+    α = mean|w| over the kept entries (TWN — the reference's
+    ``TernaryQuantizer``); identity gradient."""
+    absw = jnp.abs(w)
+    delta = 0.7 * jnp.mean(absw)
+    keep = absw > delta
+    n_keep = jnp.maximum(jnp.sum(keep), 1)
+    alpha = jnp.sum(jnp.where(keep, absw, 0.0)) / n_keep
+    return (jnp.sign(w) * keep * alpha).astype(w.dtype)
+
+
+ternarize.defvjp(lambda w: (ternarize(w), None), lambda _, g: (g,))
+
+
 def quantize_param_tree(params: PyTree, bits: int = 8,
                         pattern: Optional[str] = None) -> PyTree:
     """Fake-quantize matching leaves (name regex; default: every float leaf
-    with ndim >= 2 — weights, not norms/biases)."""
+    with ndim >= 2 — weights, not norms/biases). ``bits`` routes like the
+    reference's quantizer choice (basic_layer.py): 1 → binary, 2 → ternary,
+    else symmetric int<bits>."""
     num_levels = float(2 ** (bits - 1) - 1)
     rx = re.compile(pattern) if pattern else None
 
@@ -52,6 +81,10 @@ def quantize_param_tree(params: PyTree, bits: int = 8,
         if rx is None and (leaf.ndim < 2 or not jnp.issubdtype(
                 leaf.dtype, jnp.floating)):
             return leaf
+        if bits == 1:
+            return binarize(leaf)
+        if bits == 2:
+            return ternarize(leaf)
         return fake_quant_symmetric(leaf, num_levels)
 
     return jax.tree_util.tree_map_with_path(one, params)

@@ -222,11 +222,19 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
 
     def _rebuild(attention: Optional[str] = None,
                  loss_tiles: int = 0,
-                 remat: Optional[str] = None) -> "ModelSpec":
+                 remat: Optional[str] = None,
+                 act_quant_bits: Optional[int] = None) -> "ModelSpec":
         # keep the stronger loss tiling of (original, requested) — AutoSP
         # must not untile a loss the user tiled to avoid full logits; an
-        # unspecified attention keeps the original named mechanism
-        cfg2 = dataclasses.replace(cfg, remat=remat) if remat else cfg
+        # unspecified attention keeps the original named mechanism.
+        # act_quant_bits threads QAT activation quantization into the block
+        # forward (compression/compress.py init_compression).
+        cfg_over = {}
+        if remat:
+            cfg_over["remat"] = remat
+        if act_quant_bits is not None:
+            cfg_over["act_quant_bits"] = act_quant_bits
+        cfg2 = dataclasses.replace(cfg, **cfg_over) if cfg_over else cfg
         return causal_lm_spec(cfg2,
                               attention=attention or orig_attention,
                               loss_tiles=max(loss_tiles, orig_loss_tiles),

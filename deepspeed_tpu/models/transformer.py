@@ -124,6 +124,11 @@ class TransformerConfig:
     #                         selective (save attn_out+ffn_act) |
     #                         offload_dots (selective saves live on pinned host)
     causal: bool = True                 # False → bidirectional encoder (BERT)
+    # QAT activation quantization (reference compression/basic_layer.py
+    # QuantAct): fake-quantize the normed hidden stream feeding each
+    # block's linears (STE backward). 0 = off; set via the
+    # compression_training "activation_quantization" config section.
+    act_quant_bits: int = 0
     # MoE (reference deepspeed/moe/; 0 experts → dense FFN)
     n_experts: int = 0
     moe_top_k: int = 2
@@ -788,7 +793,17 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
             "the sequential non-MLA block only; use full/selective for "
             "MLA/parallel-block models")
 
-    h = _norm(x, lp["ln1"], cfg.norm, cfg.norm_eps)
+    def _aq(h):
+        # QAT activation fake-quant on the linears' inputs (QuantAct
+        # placement: after the norm, before every projection); STE backward
+        if not cfg.act_quant_bits:
+            return h
+        from deepspeed_tpu.compression.quantize import fake_quant_symmetric
+
+        return fake_quant_symmetric(
+            h, float(2 ** (cfg.act_quant_bits - 1) - 1))
+
+    h = _aq(_norm(x, lp["ln1"], cfg.norm, cfg.norm_eps))
     if cfg.mla:
         q, k, v = _mla_qkv(h, lp, cfg,
                            lambda t: apply_rope(t, cos, sin))
@@ -801,7 +816,7 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         attn = _ckpt_name(attn, "attn_out")
         attn_out = attn @ lp["wo"].astype(dt)
         x = x + attn_out
-        h2 = _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+        h2 = _aq(_norm(x, lp["ln2"], cfg.norm, cfg.norm_eps))
         down, aux = _ffn(h2, lp, cfg)
         return x + down, aux
     def _attn_from_norm(h):
@@ -849,20 +864,20 @@ def _block_forward(x: jax.Array, lp: Dict[str, jax.Array], cfg: TransformerConfi
         # fusion is untouched. Memory ≈ 10·B·S·H bf16 per layer.
         attn_out = jax.checkpoint(
             lambda xin: _attn_from_norm(
-                _norm(xin, lp["ln1"], cfg.norm, cfg.norm_eps)))(x)
+                _aq(_norm(xin, lp["ln1"], cfg.norm, cfg.norm_eps))))(x)
     else:
         attn_out = _attn_from_norm(h)
 
     if cfg.parallel_block:
         h2 = h if cfg.shared_parallel_norm else \
-            _norm(x, lp["ln2"], cfg.norm, cfg.norm_eps)
+            _aq(_norm(x, lp["ln2"], cfg.norm, cfg.norm_eps))
         down, aux = _ffn(h2, lp, cfg)
         return x + attn_out + down, aux
 
     x = x + attn_out
 
     def _ffn_delta(xr):
-        h2 = _norm(xr, lp["ln2"], cfg.norm, cfg.norm_eps)
+        h2 = _aq(_norm(xr, lp["ln2"], cfg.norm, cfg.norm_eps))
         return _ffn(h2, lp, cfg)
 
     if cfg.remat == "ffn_block":

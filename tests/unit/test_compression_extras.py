@@ -130,3 +130,209 @@ class TestDistillation:
         np.testing.assert_array_equal(np.asarray(student["blocks"]["w"][1]),
                                       np.asarray(params["blocks"]["w"][2]))
         assert student["emb"].shape == (10, 4)
+
+
+# --------------------------------------------------------------------------- #
+# round-5 depth: binary/ternary weights, activation QAT, channel pruning,
+# dim-reduction shrink (reference basic_layer.py Binary/TernaryQuantizer,
+# QuantAct, ChannelPruning, fix_row_col_pruning_helper(dim_reduction=True))
+# --------------------------------------------------------------------------- #
+class TestExtremeQuant:
+    def test_binarize_values_and_ste(self):
+        from deepspeed_tpu.compression.quantize import binarize
+
+        w = jnp.array([[0.5, -2.0], [1.0, -0.1]], jnp.float32)
+        q = binarize(w)
+        alpha = float(jnp.mean(jnp.abs(w)))
+        assert {round(float(x), 5) for x in np.unique(np.asarray(q))} == \
+            {round(-alpha, 5), round(alpha, 5)}
+        g = jax.grad(lambda x: jnp.sum(binarize(x) * 3.0))(w)
+        np.testing.assert_allclose(np.asarray(g), 3.0)   # STE
+
+    def test_ternarize_values_and_ste(self):
+        from deepspeed_tpu.compression.quantize import ternarize
+
+        w = jnp.array([[2.0, -2.0, 0.01, 0.02]], jnp.float32)
+        q = np.asarray(ternarize(w))
+        assert q[0, 2] == 0.0 and q[0, 3] == 0.0          # below 0.7*mean
+        assert q[0, 0] > 0 and q[0, 1] < 0 and q[0, 0] == -q[0, 1]
+        g = jax.grad(lambda x: jnp.sum(ternarize(x)))(w)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    def test_quantize_param_tree_routes_by_bits(self):
+        from deepspeed_tpu.compression.quantize import quantize_param_tree
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+        q1 = quantize_param_tree(params, bits=1)
+        assert len(np.unique(np.asarray(q1["w"]))) == 2
+        q2 = quantize_param_tree(params, bits=2)
+        assert len(np.unique(np.asarray(q2["w"]))) == 3
+
+
+class TestActivationQuant:
+    def test_act_quant_spec_trains(self):
+        import itertools
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.compression.compress import init_compression
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                                  max_seq_len=64)
+        ds_config = {"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"aq1": {"params": {"bits": 8},
+                                         "modules": ["*"]}}}}}
+        cspec = init_compression(spec, ds_config)
+        assert cspec.config.act_quant_bits == 8
+        dp = jax.device_count()
+        config = {"train_batch_size": 4 * dp,
+                  "train_micro_batch_size_per_gpu": 4,
+                  "gradient_accumulation_steps": 1,
+                  "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                  "zero_optimization": {"stage": 1},
+                  "steps_per_print": 10 ** 9}
+        engine, *_ = dst.initialize(model=cspec, config=config)
+        data = itertools.repeat(next(synthetic_lm_data(4 * dp, 64, 512,
+                                                       seed=0)))
+        l0 = float(engine.train_batch(data))
+        for _ in range(30):
+            loss = float(engine.train_batch(data))
+        assert np.isfinite(loss) and loss < l0 - 0.5, (l0, loss)
+
+    def test_act_quant_changes_forward(self):
+        import deepspeed_tpu as dst
+
+        tok = jnp.zeros((1, 8), jnp.int32)
+        spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                                  max_seq_len=64)
+        params = spec.init_fn(jax.random.PRNGKey(0))
+        base = spec.apply_fn(params, tok)
+        aq = spec.builder(act_quant_bits=4)
+        out = aq.apply_fn(params, tok)
+        assert not np.allclose(np.asarray(base), np.asarray(out))
+
+
+class TestChannelPruning:
+    def test_channel_mask_conv_kernel(self):
+        from deepspeed_tpu.compression.pruning import channel_mask
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (3, 3, 8, 16))  # HWIO
+        m = np.asarray(channel_mask(w, 0.5))
+        per_chan = m.reshape(-1, 16).mean(axis=0)
+        assert set(np.unique(per_chan)) <= {0.0, 1.0}
+        assert abs(per_chan.mean() - 0.5) < 0.1
+
+    def test_channel_section_parsed(self):
+        from deepspeed_tpu.compression.compress import plan_compression
+
+        plan = plan_compression({"compression_training": {"channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["conv"]}}}}})
+        assert any(s.method == "channel" for s in plan.pruning_specs)
+
+
+class TestShrink:
+    def _spec_params(self, activation):
+        import deepspeed_tpu as dst
+
+        spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                                  max_seq_len=64, activation=activation,
+                                  use_bias=(activation == "gelu"))
+        return spec, spec.init_fn(jax.random.PRNGKey(0))
+
+    @pytest.mark.parametrize("activation", ["gelu", "swiglu"])
+    def test_shrunk_equals_masked(self, activation):
+        """The dim_reduction guarantee: masked-dense and shrunk models agree
+        exactly (act(0)=0 and zeroed up-columns contribute nothing)."""
+        import dataclasses
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.compression.compress import redundancy_clean
+
+        spec, params = self._spec_params(activation)
+        if "b_up" in params["blocks"]:
+            # TRAINED (nonzero) biases: a zeroed up-column with a live bias
+            # still leaks act(b_up[j]) through w_down — the mask path must
+            # mask biases too (mask_ffn_biases) or shrunk != masked
+            params["blocks"]["b_up"] = 0.3 * jax.random.normal(
+                jax.random.PRNGKey(7), params["blocks"]["b_up"].shape)
+        tok = jnp.asarray(np.random.default_rng(0).integers(0, 512, (2, 16)),
+                          jnp.int32)
+        ds_config = {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["w_up", "w_gate"]}}}}}
+        masked = redundancy_clean(params, ds_config)          # legacy path
+        # legacy single-value form keeps the same-shape contract (no shrink)
+        assert masked["blocks"]["w_up"].shape == \
+            params["blocks"]["w_up"].shape
+        small, small_cfg = redundancy_clean(params, ds_config,
+                                            cfg=spec.config)
+        F = spec.config.ffn_size
+        assert small["blocks"]["w_up"].shape[-1] < F
+        assert small_cfg.ffn_hidden_size == small["blocks"]["w_up"].shape[-1]
+        ref = spec.apply_fn(masked, tok)
+        small_spec = dst.causal_lm_spec(small_cfg)
+        out = small_spec.apply_fn(small, tok)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_shrunk_model_trains(self):
+        import itertools
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.compression.compress import redundancy_clean
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        spec, params = self._spec_params("gelu")
+        ds_config = {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp1": {"params": {"dense_ratio": 0.5},
+                                         "modules": ["w_up"]}}}}}
+        small, small_cfg = redundancy_clean(params, ds_config,
+                                            cfg=spec.config)
+        small_spec = dst.causal_lm_spec(small_cfg)
+        import dataclasses as _dc
+
+        small_spec = _dc.replace(small_spec, init_fn=lambda rng: small)
+        dp = jax.device_count()
+        config = {"train_batch_size": 4 * dp,
+                  "train_micro_batch_size_per_gpu": 4,
+                  "gradient_accumulation_steps": 1,
+                  "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                  "zero_optimization": {"stage": 1},
+                  "steps_per_print": 10 ** 9}
+        engine, *_ = dst.initialize(model=small_spec, config=config)
+        data = itertools.repeat(next(synthetic_lm_data(4 * dp, 64, 512,
+                                                       seed=0)))
+        l0 = float(engine.train_batch(data))
+        for _ in range(30):
+            loss = float(engine.train_batch(data))
+        assert np.isfinite(loss) and loss < l0 - 0.5, (l0, loss)
+
+
+def test_activation_quant_rejects_sub_2bit():
+    from deepspeed_tpu.compression.compress import plan_compression
+
+    with pytest.raises(ValueError, match=">= 2"):
+        plan_compression({"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"aq": {"params": {"bits": 1},
+                                        "modules": ["*"]}}}}})
+
+
+def test_shrink_ffn_moe_layout():
+    """MoE 4-D expert stacks [L, E, H, Fe]: the intermediate dim is still
+    the one shrunk (ndim-relative axes)."""
+    from deepspeed_tpu.compression.pruning import shrink_ffn
+
+    L, E, H, F = 2, 4, 8, 16
+    params = {"blocks": {
+        "w_up": jax.random.normal(jax.random.PRNGKey(0), (L, E, H, F)),
+        "w_down": jax.random.normal(jax.random.PRNGKey(1), (L, E, F, H)),
+    }}
+    out, _ = shrink_ffn(params, keep_frac=0.5)
+    assert out["blocks"]["w_up"].shape == (L, E, H, F // 2)
+    assert out["blocks"]["w_down"].shape == (L, E, F // 2, H)

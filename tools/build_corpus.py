@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Assemble a real-text corpus from documentation shipped in this image.
+
+The convergence lane (round-4 verdict Missing #3) needs REAL natural-language
+text — the reference's nightly model suites train on real corpora
+(/root/reference/tests/model/). This image has no network egress, so the
+corpus is the English prose already on disk: package documentation, READMEs,
+and licenses from /usr/share/doc, /usr/share/common-licenses, and
+site-packages *.md/*.rst/README files. Paragraph-level dedup keeps the
+boilerplate (identical license texts repeated per package) from dominating.
+
+Deterministic: sources sorted, content hashed; output committed at
+data/real_text_corpus.txt so the lane is reproducible without rebuilding.
+"""
+import glob
+import hashlib
+import os
+import sys
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir, "data",
+                   "real_text_corpus.txt")
+TARGET_BYTES = 4_000_000
+
+
+def _sources():
+    # READMEs/guides first (varied technical prose); license texts last and
+    # per-package "copyright" files excluded — their thousands of lightly
+    # edited license variants would otherwise dominate the token budget
+    pats = [
+        "/opt/venv/lib/python3*/site-packages/**/*.md",
+        "/opt/venv/lib/python3*/site-packages/**/*.rst",
+        "/usr/share/doc/**/README*",
+        "/usr/share/doc/**/*",
+        "/usr/share/common-licenses/*",
+    ]
+    seen, seen_set = [], set()
+    for pat in pats:
+        for p in sorted(glob.glob(pat, recursive=True)):
+            if (os.path.isfile(p) and p not in seen_set
+                    and not p.endswith((".gz", ".png", ".svg"))
+                    and os.path.basename(p) != "copyright"):
+                seen.append(p)
+                seen_set.add(p)
+    return seen
+
+
+def _prose_paragraphs(text: str):
+    """Split into paragraphs, keep ones that look like English prose."""
+    for para in text.split("\n\n"):
+        para = para.strip()
+        if len(para) < 120:              # headers, stubs
+            continue
+        if sum(c.isascii() for c in para) < 0.99 * len(para):
+            continue
+        letters = sum(c.isalpha() or c.isspace() for c in para)
+        if letters < 0.8 * len(para):    # tables, code, hex blobs
+            continue
+        yield para
+
+
+def _docstring_paragraphs():
+    """English prose from library docstrings (numpy/scipy/sklearn/jax docs
+    are reference-manual-quality text, megabytes of it)."""
+    import ast
+
+    roots = sorted(glob.glob(
+        "/opt/venv/lib/python3*/site-packages/"
+        "{numpy,scipy,sklearn,jax,pandas,matplotlib}/**/*.py",
+        recursive=True))
+    if not roots:   # brace glob isn't POSIX — expand manually
+        for pkg in ("numpy", "scipy", "sklearn", "jax", "pandas",
+                    "matplotlib", "torch", "flax"):
+            roots += sorted(glob.glob(
+                f"/opt/venv/lib/python3*/site-packages/{pkg}/**/*.py",
+                recursive=True))
+    for path in roots:
+        try:
+            with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                tree = ast.parse(f.read(1 << 20))
+        except (OSError, SyntaxError, ValueError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                doc = ast.get_docstring(node)
+                if doc:
+                    yield from _prose_paragraphs(doc)
+
+
+def build(target=TARGET_BYTES):
+    seen_hashes = set()
+    chunks = []
+    total = 0
+
+    def _add(para) -> bool:
+        nonlocal total
+        h = hashlib.sha1(para.encode()).digest()
+        if h in seen_hashes:
+            return False
+        seen_hashes.add(h)
+        chunks.append(para)
+        total += len(para) + 2
+        return total >= target
+
+    done = False
+    for path in _sources():
+        try:
+            with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                text = f.read(1 << 20)
+        except OSError:
+            continue
+        for para in _prose_paragraphs(text):
+            if _add(para):
+                done = True
+                break
+        if done:
+            break
+    if not done:
+        for para in _docstring_paragraphs():
+            if _add(para):
+                break
+    return "\n\n".join(chunks)
+
+
+if __name__ == "__main__":
+    corpus = build()
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(corpus)
+    print(f"wrote {len(corpus)/1e6:.2f} MB, "
+          f"sha1 {hashlib.sha1(corpus.encode()).hexdigest()[:12]}",
+          file=sys.stderr)

@@ -848,9 +848,31 @@ SUITE_SCHEDULE = [
     ("comm_bw_onchip", comm_bw_onchip, 120, 30),
 ]
 
-# long lanes: committed artifacts (STABILITY_r04.json etc.) re-runnable
-# under BENCH_LONG=1 — NOT part of the driver-budgeted default suite
+def converge_real_text():
+    """Real-data convergence lane (tools/converge_lane.py): held-out CE on
+    real English text must DECREASE — the committed CONVERGE_r05.json is
+    this lane's artifact (1000 steps, ~150 s on-chip)."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "converge_lane.py"),
+         "/tmp/converge_lane.json"],
+        capture_output=True, text=True, timeout=1200)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": (out.stderr or "no output")[-300:]}
+
+
+# long lanes: committed artifacts (STABILITY_r04.json, CONVERGE_r05.json)
+# re-runnable under BENCH_LONG=1 — NOT part of the driver-budgeted default
+# suite
 LONG_SCHEDULE = [
+    ("converge_real_text", converge_real_text, 1200, 300),
     ("stability_2k_cpu_mesh", stability_2k, 3300, 600),
     ("pipeline_1f1b_cpu_mesh", pipeline_bench, 2700, 600),
 ]
@@ -864,15 +886,28 @@ def _run_entry_subprocess(name: str, timeout: float):
     """Run one suite entry in a child process so an XLA OOM/abort in a
     deliberately-HBM-tight config can't take the headline JSON down with it,
     and a hung one costs its own timeout, not the bench."""
+    import signal
     import subprocess
 
+    # own session + group-kill on timeout: entries that spawn grandchildren
+    # (converge_real_text -> tools/converge_lane.py) must not leave an
+    # orphan training run burning the chip under later entries
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--entry", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--entry", name],
-            capture_output=True, text=True, timeout=timeout)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
         # a slow entry must cost ITS row, not the whole headline JSON line
         return {"error": f"entry timed out after {int(timeout)}s"}
+    proc = type("R", (), {"stdout": stdout, "stderr": stderr,
+                          "returncode": proc.returncode})
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             return json.loads(line)

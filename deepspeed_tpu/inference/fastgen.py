@@ -236,6 +236,7 @@ class FastGenEngine:
         (generate_all) trim the extras; servers keeping admission latency
         bounded leave it False.
         """
+        self._assert_stream_drained()
         live = [self.seqs[u] for u in self._admit_order
                 if u in self.seqs and not self.seqs[u].done]
         if not live or any(s.prefill_remaining > 0 or s.last_tok is None
@@ -335,6 +336,8 @@ class FastGenEngine:
         yielded; interactive callers should reconcile counts from engine
         state after an early exit.
         """
+        self._assert_stream_drained()   # a 2nd concurrent stream would
+        # read the optimistic pos/stale last_tok and corrupt both chains
         pending = None          # (out_dev, live, n, pos0)
         toks_dev = pos_dev = tables_dev = tables_mb = None
         chain = None            # (tier Bt, n, live uids) the chain was built on
@@ -383,6 +386,12 @@ class FastGenEngine:
                 for s in live:
                     s.pos += n
                 prev, pending = pending, (out, live, n, pos0)
+                # while a window is in flight, s.pos is optimistically a
+                # window AHEAD of s.last_tok: any interleaved step()/put()
+                # would decode a stale token at an advanced position and
+                # silently corrupt greedy parity — flag it so those entry
+                # points fail loudly instead (cleared when drained)
+                self._stream_inflight = True
                 if prev is not None:
                     yield drain(prev)
                     if any(s.done for s in prev[1]):
@@ -391,6 +400,7 @@ class FastGenEngine:
                         # and break the chain
                         res = drain(pending)
                         pending = None
+                        self._stream_inflight = False
                         yield res
                         return
         finally:
@@ -400,6 +410,7 @@ class FastGenEngine:
             if pending is not None:
                 last = drain(pending)
                 pending = None
+            self._stream_inflight = False
         if last is not None:
             yield last
 
@@ -440,10 +451,25 @@ class FastGenEngine:
     def can_schedule(self) -> bool:
         return self.allocator.free_blocks > 0
 
+    def _assert_stream_drained(self) -> None:
+        """decode_stream misuse guard: while its double-buffered window is
+        in flight, s.pos is one window ahead of s.last_tok — interleaving
+        step()/decode_steps()/put() would decode a stale token at an
+        advanced position and silently corrupt output. Exhaust or close()
+        the generator first (closing drains the window)."""
+        if getattr(self, "_stream_inflight", False):
+            raise RuntimeError(
+                "decode_stream window in flight — exhaust or close the "
+                "stream before step()/decode_steps()/put()")
+
     def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]]) -> None:
         """Admit sequences — host bookkeeping ONLY (no device dispatch, no
         compile). Prefill happens chunked inside subsequent ``step()`` ticks
         (reference ``put`` :107 + SplitFuse chunking)."""
+        # NOT guarded by _assert_stream_drained: mid-stream admission is a
+        # documented pattern (decode_stream drains + returns when the live
+        # set changes) and put() is host bookkeeping only — it cannot
+        # observe the optimistic s.pos/last_tok skew
         for uid, prompt in zip(uids, prompts):
             prompt = list(prompt)
             if uid in self.seqs:
@@ -473,6 +499,7 @@ class FastGenEngine:
         """One SplitFuse tick: decode every running sequence + prefill chunks
         under the token budget. Returns {uid: sampled token} for sequences
         that produced one this tick."""
+        self._assert_stream_drained()
         live = [self.seqs[u] for u in self._admit_order
                 if u in self.seqs and not self.seqs[u].done]
         need = sum(1 for s in live

@@ -134,6 +134,10 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
     ``loss_tiles > 1`` computes the LM loss over sequence tiles without
     materializing full logits (ALST TiledFusedLogitsLoss analog,
     reference ``runtime/sequence_parallel/ulysses_sp.py:1065``).
+    PRECEDENCE: tiling takes priority over ``loss_impl`` — a tiled loss
+    uses exact fp32 tile numerics, NOT the fused bf16-logit path
+    (``loss_impl`` only selects between fused/exact when untiled; the two
+    knobs answer different questions: memory class vs numerics class).
     ``pipeline_schedule``: '1f1b' (explicit backward, O(stages) activation
     memory — reference ``runtime/pipe/schedule.py:189``) or 'gpipe'
     (autodiff-reversed wavefront, O(microbatches)); only used when the mesh

@@ -149,13 +149,14 @@ def grouped_dot(x: jax.Array, w: jax.Array, group_sizes: jax.Array
     if jax.default_backend() == "tpu":
         import os
 
+        from deepspeed_tpu.utils import env_int
+
         tiles, explicit = [], False
         for env, dim, default in (("DSTPU_GMM_TM", M, 512),
                                   ("DSTPU_GMM_TK", K, 1024),
                                   ("DSTPU_GMM_TN", N, 1024)):
-            val = os.environ.get(env)
-            explicit |= val is not None
-            tiles.append(_pick_tile(dim, int(val) if val else default))
+            explicit |= env in os.environ
+            tiles.append(_pick_tile(dim, env_int(env, default)))
         tm, tk, tn = tiles
         if explicit and not (tm and tk and tn):
             import warnings

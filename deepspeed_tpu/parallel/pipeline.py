@@ -221,7 +221,9 @@ def pipelined_train_1f1b(inputs: Dict[str, jax.Array], blocks: PyTree,
     # scan unroll over ticks: lets XLA fuse across tick boundaries and halve
     # the while-loop iteration overhead (a real cost on the CPU mesh where
     # each iteration pays per-op thread dispatch; near-free on TPU)
-    unroll = int(os.environ.get("DSTPU_PIPE_UNROLL", 1))
+    from deepspeed_tpu.utils import env_int
+
+    unroll = env_int("DSTPU_PIPE_UNROLL", 1)
     if unroll < 1 or T % unroll != 0:
         unroll = 1
 

@@ -1,0 +1,15 @@
+def env_int(name: str, default: int) -> int:
+    """int(os.environ[name]) with a warn-and-default on junk values — a
+    malformed tuning knob must degrade to the default, not crash the
+    training step (same defensive posture as the GMM tile fallback)."""
+    import os
+    import warnings
+
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        warnings.warn(f"{name}={val!r} is not an int — using {default}")
+        return default

@@ -63,16 +63,12 @@ def _docstring_paragraphs():
     are reference-manual-quality text, megabytes of it)."""
     import ast
 
-    roots = sorted(glob.glob(
-        "/opt/venv/lib/python3*/site-packages/"
-        "{numpy,scipy,sklearn,jax,pandas,matplotlib}/**/*.py",
-        recursive=True))
-    if not roots:   # brace glob isn't POSIX — expand manually
-        for pkg in ("numpy", "scipy", "sklearn", "jax", "pandas",
-                    "matplotlib", "torch", "flax"):
-            roots += sorted(glob.glob(
-                f"/opt/venv/lib/python3*/site-packages/{pkg}/**/*.py",
-                recursive=True))
+    roots = []
+    for pkg in ("numpy", "scipy", "sklearn", "jax", "pandas",
+                "matplotlib", "torch", "flax"):
+        roots += sorted(glob.glob(
+            f"/opt/venv/lib/python3*/site-packages/{pkg}/**/*.py",
+            recursive=True))
     for path in roots:
         try:
             with open(path, "r", encoding="utf-8", errors="ignore") as f:

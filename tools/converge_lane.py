@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Real-data convergence lane (round-4 verdict Missing #3).
+
+The reference ships nightly MODEL convergence suites on real corpora
+(/root/reference/tests/model/, SURVEY.md §4) — evidence that training
+DECREASES HELD-OUT loss on real text, not just that synthetic tokens can be
+memorized. This lane trains a GPT-2-125M-body byte-level LM (vocab 256 —
+no network egress, so no pretrained BPE; byte-level keeps the data real
+and the tokenizer dependency-free) on ``data/real_text_corpus.txt`` (4 MB
+of deduplicated English prose shipped in the image, tools/build_corpus.py)
+with a 5% held-out tail, evaluating held-out cross-entropy every eval
+window ON CHIP.
+
+Pass criteria (committed with the artifact):
+  * every loss finite;
+  * held-out CE strictly decreases from first to last eval;
+  * final held-out CE below 2.6 nats/byte (random = ln(256) ≈ 5.55;
+    a few MB and ~20 min of chip time land well under 2.6 — the committed
+    CONVERGE_r05.json band is the reproduction target).
+
+Usage: python tools/converge_lane.py [out.json]
+Env: CONVERGE_STEPS (default 1000), CONVERGE_EVAL_EVERY (100).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEQ = 512
+BATCH = 32
+HELDOUT_FRAC = 0.05
+PASS_CE = 2.6
+
+
+def batches(tokens: np.ndarray, rng: np.random.Generator, n: int):
+    """n random [BATCH, SEQ] windows from a token stream."""
+    for _ in range(n):
+        starts = rng.integers(0, len(tokens) - SEQ - 1, BATCH)
+        yield np.stack([tokens[s:s + SEQ] for s in starts]).astype(np.int32)
+
+
+def main(out_path: str) -> int:
+    import deepspeed_tpu as dst
+
+    steps = int(os.environ.get("CONVERGE_STEPS", 1000))
+    eval_every = int(os.environ.get("CONVERGE_EVAL_EVERY", 100))
+    eval_every = max(1, min(eval_every, steps))   # smoke runs: >= 1 window
+
+    raw = open(os.path.join(REPO, "data", "real_text_corpus.txt"), "rb").read()
+    toks = np.frombuffer(raw, np.uint8)
+    split = int(len(toks) * (1 - HELDOUT_FRAC))
+    train, held = toks[:split], toks[split:]
+
+    spec = dst.causal_lm_spec("gpt2_125m", vocab_size=256, max_seq_len=SEQ,
+                              remat="full", attention="flash")
+    config = {
+        "train_batch_size": BATCH,
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 3e-4}},
+        "scheduler": {"type": "WarmupCosineLR",
+                      "params": {"warmup_num_steps": 50,
+                                 "total_num_steps": steps}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+
+    rng = np.random.default_rng(0)
+    ev_rng = np.random.default_rng(1)
+    eval_set = list(batches(held, ev_rng, 4))     # fixed held-out batches
+
+    def heldout_ce() -> float:
+        return float(np.mean([float(engine.eval_batch(b))
+                              for b in eval_set]))
+
+    t0 = time.time()
+    train_curve, held_curve = [], []
+    for w in range(steps // eval_every):
+        loss = engine.train_batches(
+            iter(batches(train, rng, eval_every)), eval_every)
+        train_curve.append(round(float(loss), 4))
+        held_curve.append(round(heldout_ce(), 4))
+        print(f"[converge] step {(w + 1) * eval_every}: "
+              f"train {train_curve[-1]} held-out {held_curve[-1]}",
+              file=sys.stderr)
+
+    finite = bool(np.isfinite(train_curve + held_curve).all())
+    out = {
+        "corpus": "data/real_text_corpus.txt (4MB deduplicated English "
+                  "prose from image docs; tools/build_corpus.py)",
+        "model": "gpt2_125m body, byte-level vocab 256 "
+                 f"({spec.num_params / 1e6:.0f}M params)",
+        "steps": steps, "batch": BATCH, "seq": SEQ,
+        "tokens_seen": steps * BATCH * SEQ,
+        "train_curve": train_curve,
+        "heldout_ce_curve": held_curve,
+        "random_ce": round(float(np.log(256)), 4),
+        "final_heldout_ce": held_curve[-1],
+        "finite": finite,
+        "heldout_decreasing": held_curve[-1] < held_curve[0],
+        "passed": finite and held_curve[-1] < held_curve[0]
+        and held_curve[-1] < PASS_CE,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("final_heldout_ce", "heldout_decreasing", "passed",
+                       "tokens_seen", "wall_s")}))
+    return 0 if out["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  os.path.join(REPO, "CONVERGE_r05.json")))

@@ -830,7 +830,22 @@ SUITE_SCHEDULE = [
         "moe_350m", zero_stage=2, precision="bf16",
         batch=16, seq_len=1024, gas=4, steps=8,
         attention="ulysses_flash", remat="selective",
-        report_moe_drops=True), 300, 120),
+        report_moe_drops=True,
+        note="K=768 expert shapes are kernel-ceiling-bound (grouped GEMM "
+             "~= dense matmul rate at this contraction; PROFILE.md r5 "
+             "rungs) — moe_1b below shows the ratio flip at 2x hidden"),
+        300, 120),
+    ("moe_1b_large_experts", lambda: train_bench(
+        "moe_1b", zero_stage=2, precision="bf16",
+        optimizer="adafactor", optimizer_params={"lr": 1e-2},
+        batch=16, seq_len=1024, gas=2, steps=4,
+        attention="ulysses_flash", remat="full",
+        config_extra={"bf16": {"enabled": True, "fp32_master": False},
+                      "data_types": {"grad_accum_dtype": "bfloat16"}},
+        windows=2, report_moe_drops=True,
+        note="~2B-total/0.7B-active MoE on one chip: expert shapes where "
+             "grouped GEMM matches dense throughput; fits via adafactor "
+             "no-master + bf16 grad accumulation"), 300, 120),
     ("zero2_fusedadam_bert_large_fp16", lambda: train_bench(
         "bert_large", zero_stage=2, precision="fp16",
         optimizer="fusedadam", batch=16, seq_len=512, gas=4, steps=4,

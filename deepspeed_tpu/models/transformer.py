@@ -1502,6 +1502,16 @@ PRESETS: Dict[str, TransformerConfig] = {
     "moe_350m": TransformerConfig(vocab_size=32000, hidden_size=768,
                                   num_layers=12, num_heads=12, max_seq_len=1024,
                                   use_bias=False, n_experts=4, moe_top_k=2),
+    # larger-expert MoE (~2B total / ~0.7B active): hidden 1536 (head_dim
+    # 128) and expert-ffn 6144 put the grouped GEMM at shapes where it
+    # matches dense matmul throughput (46-55 TF/s grouped vs 52 dense at
+    # [32k,1536]x[8,1536,6144], same-harness A/B) — at moe_350m's K=768
+    # shapes grouped and dense measure in the SAME low band, i.e. the
+    # contraction itself is the ceiling; full rung table in PROFILE.md r5
+    "moe_1b": TransformerConfig(vocab_size=32000, hidden_size=1536,
+                                num_layers=12, num_heads=12, max_seq_len=1024,
+                                ffn_hidden_size=6144, use_bias=False,
+                                n_experts=8, moe_top_k=2),
     # north-star-scale single-chip model (BASELINE.md): ~3.1B params with
     # MXU-aligned shapes — head_dim 128, ffn 8192 (the open-llama-3B layout's
     # head_dim 100 wastes MXU lanes; this keeps every contraction 128-tiled)

@@ -117,12 +117,17 @@ def _expert_act(up: jax.Array, gate: Optional[jax.Array], activation: str
 
 def _pick_tile(dim: int, prefer: int) -> Optional[int]:
     """Tile for one gmm axis: the whole dim when it fits ``prefer`` (e.g.
-    K=768 untiled — measured fastest), else the largest pow2 ≤ ``prefer``
-    dividing ``dim``; None when nothing divides (caller falls back to
-    lax.ragged_dot)."""
+    K=768 untiled — measured fastest), else the largest power of two ≤
+    ``prefer`` dividing ``dim`` (pow2 start so non-pow2 prefers like 3072
+    still ladder onto pow2 dims like 4096); None when nothing divides
+    (caller falls back to lax.ragged_dot)."""
+    if prefer <= 0:
+        return None            # degrade to ragged_dot, not a crash
     if 0 < dim <= prefer:
         return dim
-    t = prefer
+    if dim % prefer == 0:
+        return prefer          # an explicit tile that divides is honored
+    t = 1 << (prefer.bit_length() - 1)   # largest pow2 <= prefer
     while t >= 128:
         if dim % t == 0:
             return t
@@ -151,6 +156,16 @@ def grouped_dot(x: jax.Array, w: jax.Array, group_sizes: jax.Array
 
         from deepspeed_tpu.utils import env_int
 
+        # Tile defaults: (512, K-whole-up-to-1024, 1024) — the r4-measured
+        # optimum that fits the 16M scoped-vmem budget in-program for
+        # forward, dgrad AND tgmm. The r5 sweep (PROFILE.md) found wider
+        # tiles ((1024, 768, 3072): 43 vs 30 TF/s standalone FORWARD) but
+        # every variant either exceeds the in-program scoped-vmem limit
+        # (fwd 17.9M, dgrad 36M at 16M/20M budgets) or — with the limit
+        # raised via libtpu — REGRESSES the whole step (56.3k → 47.2k
+        # tok/s: the global limit also governs XLA's fusion buffering).
+        # ~43 TF/s standalone is therefore the measured KERNEL ceiling for
+        # these shapes, not an achievable in-program rate.
         tiles, explicit = [], False
         for env, dim, default in (("DSTPU_GMM_TM", M, 512),
                                   ("DSTPU_GMM_TK", K, 1024),

@@ -801,11 +801,22 @@ class DeepSpeedTPUEngine:
             metrics["loss_scale"] = new_state["scaler"].scale
         return new_state, metrics
 
+    def _grad_accum_dtype(self):
+        """GAS accumulator dtype: fp32 default; data_types.grad_accum_dtype
+        opts into bf16 (reference data_types section, including its
+        "bf16"/"fp16"/"fp32" spellings). Shared by every step builder —
+        at multi-B params the fp32 grad buffer IS the HBM ceiling."""
+        name = self.config.data_types.grad_accum_dtype
+        alias = {"bf16": "bfloat16", "fp16": "float16", "fp32": "float32"}
+        return jnp.dtype(alias.get(name, name) if name else jnp.float32)
+
     @staticmethod
     def accumulate_microbatches(micro_fn, zeros, batch, gas,
                                 constrain=lambda x: x, extra0=None):
-        """Shared GAS loop: fp32-accumulate grads from ``micro_fn(mb) ->
-        (loss, grads)`` over the leading micro-batch dim (scan for gas>1).
+        """Shared GAS loop: accumulate grads IN THE DTYPE OF ``zeros``
+        (callers build zeros via ``_grad_accum_dtype()``; fp32 default)
+        from ``micro_fn(mb) -> (loss, grads)`` over the leading micro-batch
+        dim (scan for gas>1).
         Used by the fused step, the host-step runner, and available to
         custom step builders — keep ONE copy of these semantics.
 
@@ -822,7 +833,7 @@ class DeepSpeedTPUEngine:
                 acc = carry
                 loss, grads = micro_fn(mb)
             acc = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                lambda a, g: a + g.astype(a.dtype), acc, grads)
             acc = constrain(acc)
             return ((acc, extra) if with_extra else acc), loss
 
@@ -842,10 +853,12 @@ class DeepSpeedTPUEngine:
         """The raw (unjitted) fused-step body — shared by the single-step
         jit and the multi-step ``lax.scan`` wrapper."""
 
+        acc_dt = self._grad_accum_dtype()
+
         def train_step(state, batch):
             scale = state["scaler"].scale if self.fp16_enabled else None
             zeros = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, jnp.float32), self._shapes)
+                lambda s: jnp.zeros(s.shape, acc_dt), self._shapes)
             zeros = self._constrain_grads(zeros)
 
             grads_sum, mean_loss = self.accumulate_microbatches(
@@ -960,10 +973,12 @@ class DeepSpeedTPUEngine:
             is_leaf=lambda x: isinstance(x, P))
         row = axes if len(axes) > 1 else axes[0]
 
+        acc_dt_loco = self._grad_accum_dtype()
+
         def local(master_local, err_local, batch_local, scale):
             err0 = jax.tree.map(lambda e: e[0], err_local)   # drop world row
             zeros = jax.tree.map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), master_local)
+                lambda x: jnp.zeros(x.shape, acc_dt_loco), master_local)
             # loop-invariant: ONE (possibly quantized) param gather per
             # step, not per micro — its VJP is unused here
             params = gather_tree(master_local)
@@ -1039,9 +1054,11 @@ class DeepSpeedTPUEngine:
             lambda s: C.manual_spec(s, axes), self.master_spec,
             is_leaf=lambda x: isinstance(x, P))
 
+        acc_dt_c = self._grad_accum_dtype()
+
         def local(master_local, batch_local, scale):
             zeros = jax.tree.map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), master_local)
+                lambda x: jnp.zeros(x.shape, acc_dt_c), master_local)
 
             def scaled_loss(ml, b):
                 params = gather_tree(ml)

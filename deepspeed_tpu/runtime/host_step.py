@@ -148,7 +148,8 @@ class HostStepRunner:
 
         def grad_step(params, batch):
             zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                lambda p: jnp.zeros(p.shape,
+                                    self.engine._grad_accum_dtype()), params)
             return type(eng).accumulate_microbatches(
                 lambda mb: jax.value_and_grad(eng.model_spec.loss_fn)(
                     params, mb),

@@ -45,3 +45,25 @@ def _reset_global_state():
     comm_mod._initialized = False
     comm_mod.comms_logger.reset()
     comm_mod.comms_logger.enabled = False
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: measured >= 5s on the 1-core box "
+        "(tests/slow_tests.txt; fast pre-commit tier = -m 'not slow')")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Mark nodeids listed in tests/slow_tests.txt as slow — the list is
+    measured data (tools/update_slow_marks.py), not hand-maintained
+    decorators. Fast tier: ``pytest -m "not slow"`` (~7 min vs ~57)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+    if not os.path.exists(path):
+        return
+    slow = {ln.strip() for ln in open(path)
+            if ln.strip() and not ln.startswith("#")}
+    for item in items:
+        if item.nodeid in slow:
+            item.add_marker(pytest.mark.slow)

@@ -256,3 +256,37 @@ class TestActivationCheckpointingConfig:
             0, 256, size=(8, 32)).astype(np.int32)}
         with pytest.raises(ValueError, match="unknown remat"):
             engine.train_batch(iter([batch]))
+
+
+def test_grad_accum_dtype_bf16():
+    """data_types.grad_accum_dtype switches the GAS accumulator (at multi-B
+    params the fp32 grad buffer is the HBM ceiling — see PROFILE.md r5)."""
+    import itertools
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+    spec = dst.causal_lm_spec("tiny", dtype="bfloat16", num_layers=2,
+                              max_seq_len=64)
+    dp = jax.device_count()
+    config = {"train_batch_size": 4 * dp * 2,
+              "train_micro_batch_size_per_gpu": 4,
+              "gradient_accumulation_steps": 2,
+              "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 1},
+              "bf16": {"enabled": True},
+              "data_types": {"grad_accum_dtype": "bfloat16"},
+              "steps_per_print": 10 ** 9}
+    engine, *_ = dst.initialize(model=spec, config=config)
+    # the wiring itself (not just convergence — fp32 accumulation would
+    # also converge): the shared dtype helper must honor the section,
+    # including the reference's short spellings
+    assert engine._grad_accum_dtype() == jnp.bfloat16
+    engine.config.data_types.grad_accum_dtype = "bf16"
+    assert engine._grad_accum_dtype() == jnp.bfloat16
+    engine.config.data_types.grad_accum_dtype = "bfloat16"
+    data = itertools.repeat(next(synthetic_lm_data(4 * dp, 64, 512, seed=0)))
+    l0 = float(engine.train_batch(data))
+    for _ in range(40):
+        loss = float(engine.train_batch(data))
+    assert loss < l0 - 1.0, (l0, loss)

@@ -54,6 +54,12 @@ BENCH_RESERVE_S = 25.0          # kept back for the final JSON emission
 def _remaining_budget() -> float:
     return BENCH_BUDGET_S - (time.monotonic() - BENCH_T0) - BENCH_RESERVE_S
 
+# the reference's own best PUBLISHED sustained training rate (vs_baseline's
+# referent everywhere in the JSON): ">175 TFlops/GPU (>54% of HW peak)" on
+# A100s — DeepSpeed-Ulysses blog, reference blogs/deepspeed-ulysses/
+# README.md:83 (BASELINE.md #4)
+BASELINE_TFLOPS_CITED = 175.0
+
 # bf16 peak TFLOP/s per chip, by TPU generation (fallback: v5e)
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
                "v6e": 918.0, "v6 lite": 918.0}
@@ -977,7 +983,6 @@ def headline_entry():
     # formula the MFU uses. Conservative referent: that number is the
     # reference's large-dense-model best case — a 125M model with its big
     # vocab-head fraction would not hit 54% MFU on an A100 either.
-    BASELINE_TFLOPS_CITED = 175.0
     # MEASURED matmul ceiling through this runtime (vs_ceiling's referent —
     # driver-verifiable, not a prose claim). ONE rung at the default iters:
     # the r4 4-rung shape-matched ladder lives in PROFILE.md as a committed
@@ -1073,6 +1078,23 @@ def main():
         result["configs"] = {
             name: run_timed(name, cap, floor)
             for name, _, cap, floor in schedule}
+
+    # surface the best-utilization training row at top level: the 125M
+    # headline keeps cross-round comparability, but its small-shape MFU is
+    # architecture-bound (PROFILE.md ceiling ladder) — the framework's
+    # utilization story is the north-star-scale rows below it
+    best = {"name": "headline", "mfu": result.get("mfu") or 0,
+            "model_tflops_per_sec_chip":
+                result.get("model_tflops_per_sec_chip")}
+    for name, row in (result.get("configs") or {}).items():
+        if isinstance(row, dict) and (row.get("mfu") or 0) > best["mfu"]:
+            best = {"name": name, "mfu": row["mfu"],
+                    "model_tflops_per_sec_chip":
+                        row.get("model_tflops_per_sec_chip")}
+    if best.get("model_tflops_per_sec_chip"):
+        best["vs_baseline"] = round(
+            best["model_tflops_per_sec_chip"] / BASELINE_TFLOPS_CITED, 3)
+    result["best_mfu_row"] = best
 
     result["budget_s"] = BENCH_BUDGET_S
     result["total_runtime_s"] = round(time.monotonic() - BENCH_T0, 1)

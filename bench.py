@@ -60,17 +60,12 @@ def _remaining_budget() -> float:
 # README.md:83 (BASELINE.md #4)
 BASELINE_TFLOPS_CITED = 175.0
 
-# bf16 peak TFLOP/s per chip, by TPU generation (fallback: v5e)
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
-               "v6e": 918.0, "v6 lite": 918.0}
-
-
 def chip_peak_tflops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in PEAK_TFLOPS.items():
-        if key in kind:
-            return peak
-    return 197.0
+    """Peak bf16 TFLOP/s — ONE table shared with the telemetry train_mfu
+    gauge (deepspeed_tpu/utils/chip_specs.py), v5e fallback."""
+    from deepspeed_tpu.utils.chip_specs import chip_peak_tflops as _peak
+
+    return _peak(getattr(device, "device_kind", ""), default=197.0)
 
 
 def _active_params(cfg, n_params):
@@ -179,6 +174,12 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
         config["bf16"] = {"enabled": True}
     elif precision == "fp16":
         config["fp16"] = {"enabled": True, "initial_scale_power": 12}
+    # bench rows embed a telemetry snapshot; the measured-MFU gauge prices
+    # a cost-analysis compile at snapshot time, which a timeout-bounded
+    # entry (3B adafactor) can't afford by default — BENCH_TELEMETRY_MFU=1
+    # opts in; the row's own mfu field stays the MFU source of record
+    config["telemetry"] = {
+        "measure_mfu": os.environ.get("BENCH_TELEMETRY_MFU", "0") != "0"}
     config.update(config_extra or {})
     engine, *_ = dst.initialize(model=spec, config=config)
     cfg = PRESETS[model]
@@ -209,6 +210,15 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     # in the bench row, not just the engine log (under EP the "dropless"
     # ragged path is only dropless per destination shard)
     moe_drop_frac = getattr(engine, "_moe_drop_frac", 0.0)
+    # price the scrape-time gauges (tokens/s from the fenced window, measured
+    # MFU via XLA cost analysis) while the engine is still alive — the
+    # --entry wrapper then embeds the full snapshot in this row's JSON
+    try:
+        from deepspeed_tpu import telemetry
+
+        telemetry.snapshot()
+    except Exception:
+        pass
     del engine
     gc.collect()
     out = {
@@ -589,6 +599,8 @@ def _run_cpu_world8(snippet: str, timeout: int = 900):
     import json as _json
     import subprocess
 
+    from deepspeed_tpu.utils.xla_compat import cpu_collective_timeout_flags
+
     env = dict(os.environ,
                JAX_PLATFORMS="cpu", DSTPU_ACCELERATOR="cpu",
                XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
@@ -598,11 +610,10 @@ def _run_cpu_world8(snippet: str, timeout: int = 900):
                           # rendezvous deadlines flake on long fused
                           # programs (observed: F rendezvous.cc:127 aborts
                           # mid-2k-step runs) — raise them far past any
-                          # legitimate scheduling delay
-                          + " --xla_cpu_collective_call_warn_stuck_timeout_"
-                            "seconds=300"
-                          + " --xla_cpu_collective_call_terminate_timeout_"
-                            "seconds=1200"),
+                          # legitimate scheduling delay, where this jaxlib
+                          # knows the flags (probed: unknown XLA_FLAGS
+                          # hard-abort backend init)
+                          + cpu_collective_timeout_flags()),
                PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run([sys.executable, "-c", snippet],
                          capture_output=True, text=True, env=env,
@@ -1046,7 +1057,20 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
         name = sys.argv[2]
         try:
-            print(json.dumps(SUITE_ENTRIES[name]()))
+            row = SUITE_ENTRIES[name]()
+            if isinstance(row, dict) and "error" not in row:
+                # each bench row carries its telemetry context (metric name
+                # catalog: README "Observability") — MFU/latency numbers in
+                # BENCH_*.json are re-derivable from this snapshot
+                try:
+                    from deepspeed_tpu import telemetry
+
+                    snap = telemetry.snapshot()
+                    if any(snap.values()):
+                        row["telemetry"] = snap
+                except Exception:
+                    pass
+            print(json.dumps(row))
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:200]}))
         return 0

@@ -138,13 +138,17 @@ def initialize_mesh(
     devices = list(devices) if devices is not None else jax.devices()
     sizes = mesh_config.resolve(len(devices))
     shape = tuple(sizes[a] for a in DEFAULT_AXIS_ORDER)
-    auto = tuple(jax.sharding.AxisType.Auto for _ in DEFAULT_AXIS_ORDER)
+    # AxisType landed in newer jax; older builds default every axis to the
+    # same auto sharding behavior, so simply omit the kwarg there
+    axis_type_cls = getattr(jax.sharding, "AxisType", None)
+    kw = {} if axis_type_cls is None else {
+        "axis_types": tuple(axis_type_cls.Auto for _ in DEFAULT_AXIS_ORDER)}
     try:
         mesh = jax.make_mesh(shape, DEFAULT_AXIS_ORDER, devices=devices,
-                             axis_types=auto)
+                             **kw)
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
-        mesh = Mesh(dev_array, DEFAULT_AXIS_ORDER, axis_types=auto)
+        mesh = Mesh(dev_array, DEFAULT_AXIS_ORDER, **kw)
     _GLOBAL_MESH = MeshManager(mesh)
     logger.info(f"initialized device mesh: {_GLOBAL_MESH}")
     return _GLOBAL_MESH

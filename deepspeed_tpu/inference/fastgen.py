@@ -25,12 +25,14 @@ prompts stream in (the SplitFuse headline property).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.models import paged as PG
 from deepspeed_tpu.models import transformer as T
@@ -77,6 +79,8 @@ class _Seq:
         self.generated: List[int] = []
         self.last_tok: Optional[int] = None   # next decode input
         self.done = False
+        self.admit_t = time.perf_counter()    # TTFT anchor (telemetry)
+        self.first_tok_seen = False
 
     @property
     def prefill_remaining(self) -> int:
@@ -124,6 +128,7 @@ class FastGenEngine:
         # splits (inside the fused scans) stay jax.random.
         self._host_rng = np.random.default_rng(seed)
         self._ticks: Dict[int, Any] = {}   # bucketed by tick token count
+        self._setup_telemetry()
 
         # --- TP serving (round-4 verdict Missing #5: "eventually served
         # TP>1"): when a live mesh has a non-trivial 'tensor' axis, params
@@ -203,6 +208,87 @@ class FastGenEngine:
         return self._dev(self._host_rng.integers(
             0, 2 ** 32, 2, dtype=np.uint32))
 
+    # ------------------------------------------------------------------ #
+    # telemetry (README "Observability" — fastgen_* metric catalog)
+    # ------------------------------------------------------------------ #
+    def _setup_telemetry(self) -> None:
+        """Serving metrics on the process-wide registry. Hot-path cost per
+        tick is a handful of dict updates plus an O(live-sequences) gauge
+        sweep — noise against a device dispatch; nothing here fences."""
+        self._tm_ttft = telemetry.histogram(
+            "fastgen_ttft_seconds",
+            "admission (put) to first generated token, host-observed")
+        self._tm_tok_lat = telemetry.histogram(
+            "fastgen_decode_token_seconds",
+            "per-token decode latency (window wall time / tokens)")
+        self._tm_ticks = telemetry.counter(
+            "fastgen_ticks_total",
+            "engine ticks by kind (mixed SplitFuse / fused decode / "
+            "planned) and block-table width tier")
+        self._tm_gen_tok = telemetry.counter(
+            "fastgen_generated_tokens_total", "tokens sampled and kept")
+        self._tm_prefill_tok = telemetry.counter(
+            "fastgen_prefill_tokens_total",
+            "prompt tokens written into the KV cache")
+        self._tm_preempt = telemetry.counter(
+            "fastgen_preemptions_total",
+            "sequences deferred a tick by KV-pool backpressure")
+        self._tm_evict = telemetry.counter(
+            "fastgen_evicted_blocks_total",
+            "KV blocks released at sequence finish/flush")
+        self._tm_finished = telemetry.counter(
+            "fastgen_sequences_finished_total", "sequences that completed")
+        self._tm_queue = telemetry.gauge(
+            "fastgen_queue_depth",
+            "live sequences by state (waiting=prefill pending, "
+            "running=decoding)")
+        self._tm_queue_peak = telemetry.gauge(
+            "fastgen_queue_depth_peak", "high-water mark of live sequences")
+        self._tm_occup = telemetry.gauge(
+            "fastgen_batch_occupancy",
+            "fraction of the tick's rows carrying real work")
+        self._tm_kv = telemetry.gauge(
+            "fastgen_kv_pool_utilization",
+            "fraction of the KV block pool allocated")
+        self._tm_kv_peak = telemetry.gauge(
+            "fastgen_kv_pool_utilization_peak",
+            "high-water mark of KV pool utilization")
+        self._tm_kv_tier = telemetry.gauge(
+            "fastgen_kv_blocks_in_use",
+            "allocated KV blocks bucketed by the owning sequence's "
+            "block-table width tier (quarter/half/full)")
+
+    def _mb_tier_name(self, mb: int) -> str:
+        """Label for a table width, derived from the SAME bounds as
+        _mb_tier so the metric labels can never drift from the actual
+        compile-cache tiers."""
+        quarter, half = self._mb_tier_bounds()
+        return "quarter" if mb <= quarter else \
+            "half" if mb <= half else "full"
+
+    def _tm_sched_gauges(self) -> None:
+        """Refresh queue/pool gauges from host scheduler state."""
+        live = [s for s in self.seqs.values() if not s.done]
+        waiting = sum(1 for s in live if s.prefill_remaining > 0)
+        self._tm_queue.set(waiting, state="waiting")
+        self._tm_queue.set(len(live) - waiting, state="running")
+        self._tm_queue_peak.set_max(len(live))
+        cap = max(1, self.allocator.n_blocks - 1)   # block 0 reserved
+        util = (cap - self.allocator.free_blocks) / cap
+        self._tm_kv.set(util)
+        self._tm_kv_peak.set_max(util)
+        in_use = {"quarter": 0, "half": 0, "full": 0}
+        for s in live:
+            if s.blocks:
+                in_use[self._mb_tier_name(len(s.blocks))] += len(s.blocks)
+        for tier, n in in_use.items():
+            self._tm_kv_tier.set(n, tier=tier)
+
+    def _tm_first_token(self, seq: _Seq) -> None:
+        if not seq.first_tok_seen:
+            seq.first_tok_seen = True
+            self._tm_ttft.observe(time.perf_counter() - seq.admit_t)
+
     @staticmethod
     def _slot_tier(n_slots: int) -> int:
         """Pow2 slot-count tier (min 4) — ONE rule shared by the grouped
@@ -213,6 +299,13 @@ class FastGenEngine:
             ns *= 2
         return ns
 
+    def _mb_tier_bounds(self):
+        """(quarter, half) table-width tier bounds — the single source both
+        _mb_tier (compile-cache keys) and _mb_tier_name (metric labels)
+        read from."""
+        quarter = max(2, self.max_blocks_per_seq // 4)
+        return quarter, max(quarter, self.max_blocks_per_seq // 2)
+
     def _mb_tier(self, mb_need: int) -> int:
         """Table-width tiers (quarter/half/full) — ONE rule for every
         compile-cache key (step / decode-scan / planned-serve must agree or
@@ -222,8 +315,7 @@ class FastGenEngine:
         the HALF tier halves the per-tick KV read (decode is KV+weight
         HBM-bound: ~600 MB/tick at full width for gpt2-125M b16, r5
         profile)."""
-        quarter = max(2, self.max_blocks_per_seq // 4)
-        half = max(quarter, self.max_blocks_per_seq // 2)
+        quarter, half = self._mb_tier_bounds()
         if mb_need <= quarter:
             return quarter
         if mb_need <= half:
@@ -358,13 +450,25 @@ class FastGenEngine:
             positions[i] = s.pos                    # pad rows → trash block 0
 
         key = ("dec", Bt, n, mb)
-        if key not in self._ticks:
+        cold = key not in self._ticks
+        if cold:
             self._ticks[key] = self._build_decode_scan(n)
         sub = self._next_key()
-        out, self.pool, _, _ = self._ticks[key](
-            self.params, self.pool, self._dev(tokens),
-            self._dev(positions), self._dev(tables[:, :mb]), sub)
-        out = np.asarray(jax.device_get(out))       # [n, Bt]
+        t0 = time.perf_counter()
+        with telemetry.span("decode_window", ticks=n):
+            out, self.pool, _, _ = self._ticks[key](
+                self.params, self.pool, self._dev(tokens),
+                self._dev(positions), self._dev(tables[:, :mb]), sub)
+            out = np.asarray(jax.device_get(out))   # [n, Bt]
+        if not cold:
+            # a cold key folds the XLA compile into the window wall time
+            # (~seconds vs ~ms/token) — keep the latency histogram steady-
+            # state only, same reason the train side uses best-window
+            self._tm_tok_lat.observe(
+                (time.perf_counter() - t0) / (n * B), n=n * B)
+        self._tm_ticks.inc(n, kind="decode", mb_tier=self._mb_tier_name(mb))
+        self._tm_occup.set(B / Bt, phase="decode")
+        self._tm_sched_gauges()
         return self._drain_decode_out(out, live, n, pos_advanced=False)
 
     def _drain_decode_out(self, out, live, n: int, pos_advanced: bool,
@@ -421,12 +525,21 @@ class FastGenEngine:
         pending = None          # (out_dev, live, n, pos0)
         toks_dev = pos_dev = tables_dev = tables_mb = None
         chain = None            # (tier Bt, n, live uids) the chain was built on
+        prev_drain_t = [None]   # drain-to-drain timing = steady-state rate
 
         def drain(p):
             p_out, p_live, p_n, p_pos0 = p
+            out_h = np.asarray(jax.device_get(p_out))
+            now = time.perf_counter()
+            if prev_drain_t[0] is not None:
+                # with a window always in flight, drain-to-drain wall time
+                # over the window's tokens IS the per-token serving rate
+                self._tm_tok_lat.observe(
+                    (now - prev_drain_t[0]) / max(1, p_n * len(p_live)),
+                    n=p_n * len(p_live))
+            prev_drain_t[0] = now
             return self._drain_decode_out(
-                np.asarray(jax.device_get(p_out)), p_live, p_n,
-                pos_advanced=True, pos0=p_pos0)
+                out_h, p_live, p_n, pos_advanced=True, pos0=p_pos0)
 
         last = None
         try:
@@ -458,9 +571,14 @@ class FastGenEngine:
                 if key not in self._ticks:
                     self._ticks[key] = self._build_decode_scan(n)
                 pos0 = [s.pos for s in live]
-                out, self.pool, toks_dev, pos_dev = self._ticks[key](
-                    self.params, self.pool, toks_dev, pos_dev,
-                    tables_dev, self._next_key())
+                with telemetry.span("decode_window", ticks=n):
+                    out, self.pool, toks_dev, pos_dev = self._ticks[key](
+                        self.params, self.pool, toks_dev, pos_dev,
+                        tables_dev, self._next_key())
+                self._tm_ticks.inc(n, kind="decode",
+                                   mb_tier=self._mb_tier_name(mb))
+                self._tm_occup.set(len(live) / Bt, phase="decode")
+                self._tm_sched_gauges()
                 # device is now computing THIS window; positions advance
                 # optimistically so the next iteration's block math is right
                 for s in live:
@@ -560,6 +678,7 @@ class FastGenEngine:
                     f"prompt len {len(prompt)} >= max_len {self.max_len}")
             self.seqs[uid] = _Seq(uid, prompt, self.max_blocks_per_seq)
             self._admit_order.append(uid)
+        self._tm_sched_gauges()
 
     def _ensure_blocks(self, seq: _Seq, upto_pos: int) -> bool:
         """Grow the sequence's block table to cover ``upto_pos``. Returns
@@ -606,6 +725,7 @@ class FastGenEngine:
             if row >= Tn:
                 break
             if not self._ensure_blocks(seq, seq.pos):
+                self._tm_preempt.inc(phase="decode")
                 continue   # pool full — this sequence waits a tick
             tokens[row] = seq.last_tok
             positions[row] = seq.pos
@@ -629,6 +749,7 @@ class FastGenEngine:
                 * self.block_size - seq.pos
             chunk = min(chunk, fits)
             if chunk <= 0:
+                self._tm_preempt.inc(phase="prefill")
                 continue
             self._ensure_blocks(seq, seq.pos + chunk - 1)
             lo = seq.prefilled
@@ -654,10 +775,16 @@ class FastGenEngine:
         if key not in self._ticks:
             self._ticks[key] = self._build_tick()
         sub = self._next_key()
-        sampled, self.pool = self._ticks[key](
-            self.params, self.pool, self._dev(tokens),
-            self._dev(positions), self._dev(tables[:, :mb]), sub)
-        sampled = np.asarray(jax.device_get(sampled))
+        with telemetry.span("decode_tick"):
+            sampled, self.pool = self._ticks[key](
+                self.params, self.pool, self._dev(tokens),
+                self._dev(positions), self._dev(tables[:, :mb]), sub)
+            sampled = np.asarray(jax.device_get(sampled))
+        n_decode_rows = sum(1 for _, _, is_d in heads if is_d)
+        self._tm_ticks.inc(kind="mixed", mb_tier=self._mb_tier_name(mb))
+        self._tm_prefill_tok.inc(row - n_decode_rows)
+        self._tm_occup.set(row / Tn, phase="mixed")
+        self._tm_sched_gauges()
 
         out: Dict[int, int] = {}
         for r, seq, is_decode in heads:
@@ -677,10 +804,15 @@ class FastGenEngine:
         position, not the optimistic current one."""
         if seq.done:
             return
+        # TTFT anchors on the FIRST sampled token even when it's EOS —
+        # excluding immediate-EOS sequences would bias the distribution
+        # toward longer-lived ones
+        self._tm_first_token(seq)
         if self.eos_token_id is not None and tok == self.eos_token_id:
             self._finish(seq)
             return
         seq.generated.append(tok)
+        self._tm_gen_tok.inc()
         if (seq.pos if pos is None else pos) + 1 >= self.max_len:
             self._finish(seq)
 
@@ -689,6 +821,9 @@ class FastGenEngine:
         never decodes again, and holding its blocks until flush() starves
         waiting prompts (livelock if the caller only flushes at the end)."""
         seq.done = True
+        if seq.blocks:
+            self._tm_evict.inc(len(seq.blocks))
+        self._tm_finished.inc()
         self.allocator.free(seq.blocks)
         seq.blocks = []
         seq.table[:] = 0
@@ -701,6 +836,8 @@ class FastGenEngine:
         for uid in uids:
             d = self.seqs.pop(uid, None)
             if d is not None:
+                if d.blocks:
+                    self._tm_evict.inc(len(d.blocks))
                 self.allocator.free(d.blocks)
                 # an in-flight decode_stream window may still hold a
                 # reference to this _Seq and drain into it later: clear the
@@ -710,6 +847,7 @@ class FastGenEngine:
                 d.done = True
                 if uid in self._admit_order:
                     self._admit_order.remove(uid)
+        self._tm_sched_gauges()
 
     # ------------------------------------------------------------------ #
     # planned (offline) serving — the whole SplitFuse schedule in ONE scan
@@ -948,6 +1086,7 @@ class FastGenEngine:
         # device OOM, interrupt) must roll the host bookkeeping back —
         # otherwise positions stay advanced with no tokens recorded and the
         # engine is permanently corrupted
+        prefilled_pre = sum(s.prefilled for s in self.seqs.values())
         try:
             plan = self._plan_schedule(max_new_tokens, until_prefilled)
             if plan is None:
@@ -959,8 +1098,14 @@ class FastGenEngine:
                 # pool/length headroom covers it (0 → the caller's decode-
                 # scan windows take over with per-window backpressure)
                 nd = self._plan_decode_tail(plan[0], plan[1], max_new_tokens)
-            return self._serve_planned_device(plan, max_new_tokens,
-                                              decode_ticks=nd)
+            ok = self._serve_planned_device(plan, max_new_tokens,
+                                            decode_ticks=nd)
+            if ok:
+                self._tm_prefill_tok.inc(
+                    sum(s.prefilled for s in self.seqs.values())
+                    - prefilled_pre)
+                self._tm_sched_gauges()
+            return ok
         except BaseException:     # incl. KeyboardInterrupt mid-dispatch
             restore()
             raise
@@ -1060,11 +1205,19 @@ class FastGenEngine:
                 dec_pos[i] = s.pos          # post-plan position
                 dec_tabs[i] = s.table[:mb]  # tail blocks pre-allocated
         sub = self._next_key()
-        out, self.pool = self._ticks[key](
-            self.params, self.pool, self._dev(toks), self._dev(kind),
-            self._dev(slots), self._dev(positions), self._dev(tables),
-            self._dev(gtabs), self._dev(heads), sub, self._dev(last0),
-            self._dev(dec_pos), self._dev(dec_tabs))
+        # no tick-count label here: planned tick counts are workload-shaped
+        # (unbounded cardinality); decode windows may label ticks because
+        # theirs come from the fixed DECODE_TIERS ladder
+        with telemetry.span("planned_serve"):
+            out, self.pool = self._ticks[key](
+                self.params, self.pool, self._dev(toks), self._dev(kind),
+                self._dev(slots), self._dev(positions), self._dev(tables),
+                self._dev(gtabs), self._dev(heads), sub, self._dev(last0),
+                self._dev(dec_pos), self._dev(dec_tabs))
+        tier = self._mb_tier_name(mb)
+        self._tm_ticks.inc(n, kind="planned", mb_tier=tier)
+        if decode_ticks:
+            self._tm_ticks.inc(decode_ticks, kind="decode", mb_tier=tier)
         out2 = None
         if decode_ticks:
             out, out2 = jax.device_get(out)        # ONE host fetch for both
@@ -1082,6 +1235,10 @@ class FastGenEngine:
                 s.last_tok = tok
                 if u in eos_hit or s.done:
                     continue
+                # TTFT on the first sampled token even when it's EOS —
+                # same policy as _note_token, or the planned path would
+                # bias the distribution differently than the tick path
+                self._tm_first_token(s)
                 if self.eos_token_id is not None \
                         and tok == self.eos_token_id:
                     eos_hit.add(u)
@@ -1089,6 +1246,8 @@ class FastGenEngine:
                     continue
                 if len(s.generated) < max_new_tokens:
                     s.generated.append(tok)
+                    self._tm_first_token(s)
+                    self._tm_gen_tok.inc()
         if out2 is not None:                       # fused decode tail
             for t in range(out2.shape[0]):
                 for i, u in enumerate(order):

@@ -25,28 +25,53 @@ class Monitor:
     def write_events(self, events: List[Event]) -> None:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Flush and release backend resources (file handles, writers).
+        Safe to call more than once; a closed monitor may still receive
+        write_events (it reopens or no-ops per backend)."""
+
 
 class csvMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
         self.output_path = getattr(config, "output_path", "") or "./csv_monitor"
         self.job_name = getattr(config, "job_name", "job")
+        # tag -> open append-mode file handle; without the cache every event
+        # paid an open/close syscall pair (the cache existed but was unused)
         self._files = {}
         if self.enabled and jax.process_index() == 0:
             os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
 
+    def _file_for(self, tag: str):
+        f = self._files.get(tag)
+        if f is None or f.closed:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            if new:
+                csv.writer(f).writerow(["step", tag])
+            self._files[tag] = f
+        return f
+
     def write_events(self, events: List[Event]) -> None:
         if not self.enabled or jax.process_index() != 0:
             return
+        touched = set()
         for tag, value, step in events:
-            fname = os.path.join(self.output_path, self.job_name,
-                                 tag.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([step, float(value)])
+            f = self._file_for(tag)
+            csv.writer(f).writerow([step, float(value)])
+            touched.add(tag)
+        for tag in touched:   # one flush per batch, not per event — readers
+            self._files[tag].flush()   # (tests, tail -f) see complete rows
+
+    def close(self) -> None:
+        for f in self._files.values():
+            if not f.closed:
+                f.flush()
+                f.close()
+        self._files.clear()
+
 
 
 class TensorBoardMonitor(Monitor):
@@ -70,6 +95,11 @@ class TensorBoardMonitor(Monitor):
         for tag, value, step in events:
             self.writer.add_scalar(tag, float(value), step)
         self.writer.flush()
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
 
 
 class WandbMonitor(Monitor):
@@ -95,6 +125,11 @@ class WandbMonitor(Monitor):
 
         for tag, value, step in events:
             wandb.log({tag: float(value)}, step=step)
+
+    def close(self) -> None:
+        if self.run is not None:
+            self.run.finish()
+            self.run = None
 
 
 class CometMonitor(Monitor):
@@ -141,5 +176,27 @@ class MonitorMaster(Monitor):
 
     def write_events(self, events: List[Event]) -> None:
         for b in self.backends:
-            if b.enabled:
+            if not b.enabled:
+                continue
+            try:
                 b.write_events(events)
+            except Exception as e:
+                # one dead backend (W&B connection drop, full disk) must not
+                # abort a training step — count it and keep the others going
+                from deepspeed_tpu import telemetry
+
+                telemetry.counter(
+                    "monitor_write_errors_total",
+                    "monitor backend write_events failures",
+                ).inc(backend=type(b).__name__)
+                logger.warning(
+                    f"monitor backend {type(b).__name__} failed to write "
+                    f"({len(events)} events dropped there): {e}")
+
+    def close(self) -> None:
+        for b in self.backends:
+            try:
+                b.close()
+            except Exception as e:
+                logger.warning(
+                    f"monitor backend {type(b).__name__} close failed: {e}")

@@ -24,9 +24,9 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _build_library() -> str:
+def _build_library(force: bool = False) -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if (not os.path.exists(_SO_PATH)
+    if (force or not os.path.exists(_SO_PATH)
             or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC)):
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                _SRC, "-o", _SO_PATH]
@@ -38,7 +38,13 @@ def _load() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is None:
-            lib = ctypes.CDLL(_build_library())
+            try:
+                lib = ctypes.CDLL(_build_library())
+            except OSError:
+                # a cached .so built on another image (libstdc++/GLIBCXX
+                # mismatch) passes the mtime check but fails to load —
+                # rebuild for THIS toolchain and retry
+                lib = ctypes.CDLL(_build_library(force=True))
             lib.aio_handle_create.restype = ctypes.c_void_p
             lib.aio_handle_create.argtypes = [ctypes.c_int]
             lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]

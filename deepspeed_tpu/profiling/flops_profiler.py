@@ -70,9 +70,22 @@ class FlopsProfiler:
         """FLOPs of one compiled train step (fwd+bwd+update)."""
         eng = self.engine
         gas = eng.gradient_accumulation_steps()
+        # reuse the live compiled step when present; else build the PLAIN
+        # step. Seed the engine cache (setdefault: atomic under the GIL,
+        # safe from a telemetry scrape thread; keeps the documented
+        # start_profile -> train_batch flow to ONE compile) — but ONLY for
+        # engines whose dispatcher would build the same plain step: the
+        # onebit/compressed/host-step variants select different builders
+        # under this key, and pre-seeding would silently disable them.
         key = ("train_step", gas)
-        if key not in eng._compiled:
-            eng._compiled[key] = eng._build_train_step(gas)
+        plain = not (getattr(eng, "_onebit_wire", False)
+                     or getattr(eng, "_compressed", None)
+                     or getattr(eng, "_host_runner", None))
+        fn = eng._compiled.get(key)
+        if fn is None:
+            fn = eng._build_train_step(gas)
+            if plain:
+                fn = eng._compiled.setdefault(key, fn)
         # build a matching abstract batch
         import jax.numpy as jnp
 
@@ -80,8 +93,7 @@ class FlopsProfiler:
         seq = getattr(eng.model_spec, "seq_len", None) or 128
         batch = {"tokens": jnp.zeros((gas, mb, seq), jnp.int32)}
         with eng.mesh:
-            costs = _cost_analysis(
-                lambda s, b: eng._compiled[key](s, b), eng.state, batch)
+            costs = _cost_analysis(lambda s, b: fn(s, b), eng.state, batch)
         return float(costs.get("flops", 0.0))
 
     # -- reporting -------------------------------------------------------- #

@@ -152,6 +152,27 @@ class CommsLoggerConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """The unified telemetry subsystem (``deepspeed_tpu/telemetry``).
+
+    ``enabled`` gates metric recording process-wide (the registry is also
+    process-0 gated like the monitor). ``http_port`` starts the Prometheus
+    ``/metrics`` endpoint when >= 0 (0 = ephemeral port; -1 = off).
+    ``stall_deadline_s`` arms the training stall watchdog: a warning (with
+    the last-completed span) logs when no optimizer step finishes within
+    the deadline. ``monitor_bridge`` forwards registry scalars into the
+    configured MonitorMaster backends at the ``steps_per_print`` cadence
+    (a no-op unless a monitor backend is enabled)."""
+    enabled: bool = True
+    http_port: int = -1
+    stall_deadline_s: float = 0.0
+    monitor_bridge: bool = True
+    # measured-MFU gauge prices ONE cost-analysis compile of the train step
+    # at first scrape — disable for huge models behind a live endpoint
+    measure_mfu: bool = True
+
+
+@dataclasses.dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing`` config. On TPU this selects a
     ``jax.checkpoint`` (remat) policy applied to the per-layer scan."""
@@ -338,6 +359,7 @@ class DeepSpeedTPUConfig:
     bf16: BF16Config = dataclasses.field(default_factory=BF16Config)
     zero_optimization: ZeroConfig = dataclasses.field(default_factory=ZeroConfig)
     comms_logger: CommsLoggerConfig = dataclasses.field(default_factory=CommsLoggerConfig)
+    telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

@@ -28,6 +28,9 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
+import weakref
 from functools import partial
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -307,6 +310,7 @@ class DeepSpeedTPUEngine:
             steps_per_output=self.config.steps_per_print)
         self._last_metrics_dev: Dict[str, jax.Array] = {}
         self.monitor = None  # attached by initialize() when configured
+        self._setup_telemetry()
 
         # EP-dispatch drop visibility: under an 'expert' mesh axis the ragged
         # MoE path can overflow its fixed all-to-all buffer on router skew;
@@ -494,6 +498,222 @@ class DeepSpeedTPUEngine:
     def _n_layers(self) -> int:
         cfg = getattr(self.model_spec, "config", None)
         return getattr(cfg, "num_layers", 0) or 0
+
+    # ------------------------------------------------------------------ #
+    # telemetry (deepspeed_tpu/telemetry — README "Observability")
+    # ------------------------------------------------------------------ #
+    def _setup_telemetry(self) -> None:
+        """Attach the engine to the process-wide metrics registry.
+
+        Hot-path cost is a few dict/float ops per optimizer step (host
+        side, no device fences). Everything priced — device_get of the
+        last step's metrics, the one-off FLOPS cost analysis behind
+        measured MFU — runs in a registry COLLECTOR, i.e. only when
+        something scrapes ``telemetry.snapshot()`` / the ``/metrics``
+        endpoint or the monitor bridge publishes."""
+        tcfg = self.config.telemetry
+        self._tm = None
+        self._watchdog = None
+        self._tm_bridge = None
+        self._tm_tokens_per_step = 0
+        self._tm_fenced_best_s: Optional[float] = None
+        self._tm_flops_cache: Optional[float] = None
+        self._tm_flops_lock = threading.Lock()
+        self._tm_owner_thread = threading.get_ident()
+        from deepspeed_tpu import telemetry
+
+        # the registry gate is process-wide (last engine's config wins, as
+        # with the global mesh) — without this, "enabled": false would only
+        # skip the engine's own instruments while fastgen/timer/comms kept
+        # recording
+        telemetry.get_registry().enabled = bool(tcfg.enabled)
+        if not tcfg.enabled:
+            return
+
+        self._tm = telemetry.get_registry()
+        self._tm_steps = telemetry.counter(
+            "train_steps_total", "completed optimizer steps")
+        self._tm_tokens = telemetry.counter(
+            "train_tokens_total", "tokens consumed by completed steps "
+            "(global batch, all chips)")
+        self._tm_step_hist = telemetry.histogram(
+            "train_step_seconds", "host wall time around each step "
+            "dispatch (async backends may record enqueue-only samples; "
+            "throughput/MFU gauges use fenced windows instead)")
+
+        def _on_fenced_window(duration: float, steps: int) -> None:
+            # fires inside ThroughputTimer._close_window — training thread
+            # only, AFTER a device fence, so per-step time is real
+            per = duration / steps
+            if self._tm_fenced_best_s is None \
+                    or per < self._tm_fenced_best_s:
+                self._tm_fenced_best_s = per
+
+        self.tput_timer.window_hook = _on_fenced_window
+        self._tm_heartbeat = telemetry.gauge(
+            "train_heartbeat_timestamp_seconds",
+            "unix time the last optimizer step completed")
+        ref = weakref.ref(self)
+
+        def _collect():
+            eng = ref()
+            if eng is None:
+                return False   # engine gone — deregister (weakref idiom)
+            eng._collect_telemetry()
+
+        self._tm.add_collector(_collect)
+        if tcfg.http_port >= 0 and jax.process_index() == 0:
+            try:
+                server = telemetry.start_metrics_server(tcfg.http_port)
+                log_dist(f"telemetry /metrics endpoint: {server.url}")
+            except OSError as e:
+                # port in use (second run on the host) — observability must
+                # never abort training; metrics stay scrapeable in-process
+                logger.warning(
+                    f"telemetry /metrics endpoint on port {tcfg.http_port} "
+                    f"failed to start ({e}); continuing without it")
+        if tcfg.stall_deadline_s > 0:
+            self._watchdog = telemetry.StallWatchdog(
+                tcfg.stall_deadline_s, self._tm).start()
+
+    def _chip_peak_flops(self) -> Optional[float]:
+        from deepspeed_tpu.utils.chip_specs import chip_peak_tflops
+
+        peak = chip_peak_tflops(
+            getattr(jax.devices()[0], "device_kind", ""))
+        # CPU backend etc.: no meaningful MFU referent → None
+        return peak * 1e12 if peak else None
+
+    def _measured_flops_per_step(self) -> float:
+        """One-off XLA cost analysis of the train step (what the flops
+        profiler reports; PER-DEVICE flops of the SPMD executable); cached
+        under a lock so concurrent scrapes price at most one compile.
+        Disable via ``telemetry.measure_mfu: false`` when the scrape-time
+        compile is unwanted (e.g. a huge model behind a live endpoint)."""
+        with self._tm_flops_lock:
+            if self._tm_flops_cache is None:
+                if not self.config.telemetry.measure_mfu:
+                    self._tm_flops_cache = 0.0
+                else:
+                    try:
+                        from deepspeed_tpu.profiling.flops_profiler import (
+                            FlopsProfiler,
+                        )
+
+                        self._tm_flops_cache = \
+                            FlopsProfiler(self).profile_train_step()
+                    except Exception as e:
+                        # cache the failure (retrying an expensive broken
+                        # compile every scrape would be worse) but say so —
+                        # a silent 0.0 makes the missing MFU gauge
+                        # undiagnosable
+                        self._tm_flops_cache = 0.0
+                        logger.warning(
+                            "telemetry MFU pricing failed — train_mfu/"
+                            f"train_model_flops_per_sec stay unset: {e}")
+                        from deepspeed_tpu import telemetry
+
+                        telemetry.counter(
+                            "telemetry_collector_errors_total",
+                            "collector callbacks that raised during a "
+                            "scrape").inc(error="mfu_pricing")
+            return self._tm_flops_cache
+
+    def _collect_telemetry(self) -> None:
+        """Scrape-time collector: lazily-priced gauges (loss/grad-norm from
+        the device metrics of the last step, tokens/s from the step-latency
+        histogram, measured MFU from the FLOPS profiler).
+
+        May run on the /metrics HTTP thread concurrent with training, so it
+        avoids mutating engine state: the step histogram (registry-locked)
+        gives steps/sec without touching ThroughputTimer's unsynchronized
+        window state or fencing the device mid-step. The one exception is
+        the FIRST MFU pricing, which compiles a cost-analysis copy of the
+        step (lock-guarded, never stored on the engine; opt out with
+        ``telemetry.measure_mfu: false``)."""
+        from deepspeed_tpu import telemetry
+
+        if self._last_metrics_dev:
+            try:
+                host = {k: float(jax.device_get(v))
+                        for k, v in self._last_metrics_dev.items()}
+            except Exception:
+                host = {}
+            for k in ("loss", "grad_norm", "lr", "loss_scale", "overflow"):
+                if k in host:
+                    telemetry.gauge(f"train_{k}").set(host[k])
+        expensive = getattr(self._tm, "collecting_expensive", True)
+        if expensive and threading.get_ident() == self._tm_owner_thread:
+            # only the engine's own thread may close the fenced throughput
+            # window (it fences the device and mutates the timer's
+            # unsynchronized window state); HTTP-thread scrapes reuse the
+            # last fenced sample
+            self.tput_timer.avg_samples_per_sec()
+        # best FENCED per-step wall (bench best-window methodology): the
+        # un-fenced dispatch walls in the histogram can be enqueue-only
+        # under async dispatch, and an all-time mean would fold warmup/
+        # compile into the rate
+        steps_per_sec = (1.0 / self._tm_fenced_best_s
+                         if self._tm_fenced_best_s else 0.0)
+        if steps_per_sec > 0 and self._tm_tokens_per_step:
+            telemetry.gauge(
+                "train_tokens_per_sec", "global token throughput from the "
+                "best fenced throughput window").set(
+                steps_per_sec * self._tm_tokens_per_step)
+        if steps_per_sec > 0 and expensive:
+            flops = self._measured_flops_per_step()
+            if flops:
+                # cost analysis reports the per-device SPMD executable's
+                # flops, so rate/peak are already per-chip — no device_count
+                # factor (the same per-chip accounting bench.py's mfu uses)
+                telemetry.gauge(
+                    "train_model_flops_per_sec",
+                    "measured per-device FLOPS rate (XLA cost analysis x "
+                    "step rate)").set(flops * steps_per_sec)
+                peak = self._chip_peak_flops()
+                if peak:
+                    telemetry.gauge(
+                        "train_mfu", "model FLOPS utilization vs chip bf16 "
+                        "peak").set(flops * steps_per_sec / peak)
+
+    @staticmethod
+    def _count_tokens(stacked: PyTree) -> int:
+        """Token count of one stacked step window (global batch)."""
+        arr = stacked
+        if isinstance(stacked, dict):
+            # engine-injected control keys (_pld_keep, _random_ltd_idx,
+            # lr_scale) sort first in the leaf order and are NOT tokens —
+            # prefer the conventional token keys, then any data key
+            for key in ("tokens", "input_ids"):
+                if key in stacked:
+                    arr = stacked[key]
+                    break
+            else:
+                data_keys = sorted(k for k in stacked
+                                   if not str(k).startswith("_")
+                                   and k != "lr_scale")
+                arr = stacked[data_keys[0]] if data_keys else None
+        if arr is None:
+            return 0
+        # metadata only — np.asarray on a jax array would be a full D2H copy
+        size = getattr(arr, "size", None)
+        return int(size) if size is not None else int(np.asarray(arr).size)
+
+    def shutdown_telemetry(self) -> None:
+        """Stop the stall watchdog thread. Called on engine GC too —
+        otherwise every watchdog-armed run that simply FINISHES training
+        would log a false stall (the watchdog can't distinguish 'done'
+        from 'stuck'); long-lived processes that keep the engine alive
+        after the last step should call this explicitly."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    def __del__(self):
+        try:
+            self.shutdown_telemetry()
+        except Exception:
+            pass   # interpreter teardown: attributes may already be gone
 
     def _inject_data_efficiency(self, stacked: PyTree, gas: int) -> PyTree:
         """Add per-micro PLD keep masks / random-LTD kept-token indices to
@@ -1367,30 +1587,34 @@ class DeepSpeedTPUEngine:
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        if self._host_runner is not None:
-            # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
-            _, metrics = self._host_runner.train_batch(batch, gas)
-        else:
-            if self._offload_opt:
-                self._opt_swap("in")
-            if self._offload_nvme:
-                self._nvme_swapper().swap_in_optimizer()
-            if self._offload_param_nvme:
-                self._param_nvme_swapper().swap_in_params()
-            self._ensure_master_tier_for_step()
-            with self.mesh:
-                self.state, metrics = step_fn(self.state, batch)
-            if self._offload_opt:
-                self._opt_swap("out")
-            if self._offload_nvme:
-                self._nvme_swapper().swap_out_optimizer()
-            if self._offload_param:
-                self._park_master()
-            if self._offload_param_nvme:
-                self._param_nvme_swapper().swap_out_params()
+        t0 = time.perf_counter()
+        with self._train_span("train_step"):
+            if self._host_runner is not None:
+                # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
+                _, metrics = self._host_runner.train_batch(batch, gas)
+            else:
+                if self._offload_opt:
+                    self._opt_swap("in")
+                if self._offload_nvme:
+                    self._nvme_swapper().swap_in_optimizer()
+                if self._offload_param_nvme:
+                    self._param_nvme_swapper().swap_in_params()
+                self._ensure_master_tier_for_step()
+                with self.mesh:
+                    self.state, metrics = step_fn(self.state, batch)
+                if self._offload_opt:
+                    self._opt_swap("out")
+                if self._offload_nvme:
+                    self._nvme_swapper().swap_out_optimizer()
+                if self._offload_param:
+                    self._park_master()
+                if self._offload_param_nvme:
+                    self._param_nvme_swapper().swap_out_params()
         self.global_steps += 1
         self.micro_steps += gas
-        self._after_step(metrics)
+        self._after_step(metrics, wall_s=time.perf_counter() - t0,
+                         tokens=self._count_tokens(stacked)
+                         if self._tm is not None else 0)
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop()
             self.timers.log([TRAIN_BATCH_TIMER])
@@ -1437,14 +1661,19 @@ class DeepSpeedTPUEngine:
             self._compiled[key] = self._build_train_multi(gas, n_steps)
         batch = self._shard_batch(big, leading=2)
         self.tput_timer.start()
-        self._ensure_master_tier_for_step()
-        with self.mesh:
-            self.state, metrics = self._compiled[key](self.state, batch)
-        if self._offload_param:
-            self._park_master()
+        t0 = time.perf_counter()
+        with self._train_span("train_window"):
+            self._ensure_master_tier_for_step()
+            with self.mesh:
+                self.state, metrics = self._compiled[key](self.state, batch)
+            if self._offload_param:
+                self._park_master()
         self.global_steps += n_steps
         self.micro_steps += gas * n_steps
-        self._after_step(metrics, n_steps=n_steps)
+        self._after_step(metrics, n_steps=n_steps,
+                         wall_s=time.perf_counter() - t0,
+                         tokens=self._count_tokens(big)
+                         if self._tm is not None else 0)
         return metrics["loss"]
 
     def _record_moe_drops(self, frac) -> None:
@@ -1452,10 +1681,33 @@ class DeepSpeedTPUEngine:
         the worst dropped-choice fraction seen since the last print window."""
         self._moe_drop_frac = max(self._moe_drop_frac, float(frac))
 
+    def _train_span(self, name: str):
+        """telemetry.span when enabled; inert otherwise."""
+        if self._tm is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from deepspeed_tpu import telemetry
+
+        return telemetry.span(name)
+
     def _after_step(self, metrics: Dict[str, jax.Array],
-                    n_steps: int = 1) -> None:
+                    n_steps: int = 1, wall_s: Optional[float] = None,
+                    tokens: int = 0) -> None:
         self.tput_timer.stop(global_step=True, steps=n_steps)
         self._last_metrics_dev = metrics  # lazy: no host sync off the print path
+        if self._tm is not None:
+            self._tm_steps.inc(n_steps)
+            if tokens:
+                self._tm_tokens.inc(tokens)
+                self._tm_tokens_per_step = tokens // n_steps
+            if wall_s is not None:
+                # amortize a fused window over its steps so the histogram
+                # stays per-step comparable across dispatch modes
+                self._tm_step_hist.observe(wall_s / n_steps, n=n_steps)
+            self._tm_heartbeat.set(time.time())
+            if self._watchdog is not None:
+                self._watchdog.beat()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(self.global_steps)
         if self.global_steps % max(1, self.config.steps_per_print) == 0:
@@ -1473,9 +1725,20 @@ class DeepSpeedTPUEngine:
                 f"step={self.global_steps} loss={host.get('loss', float('nan')):.4f} "
                 f"lr={host.get('lr', 0):.3e} grad_norm={host.get('grad_norm', 0):.3f}"
                 + (f" loss_scale={host.get('loss_scale', 0):.0f}" if self.fp16_enabled else ""))
+            # (train_loss/grad_norm/... gauges are set by the registry
+            # collector from _last_metrics_dev on every read path — no
+            # duplicate update here)
             if self.monitor is not None and self.monitor.enabled:
                 events = [(f"Train/{k}", v, self.global_steps) for k, v in host.items()]
                 self.monitor.write_events(events)
+            if self._tm is not None and self.config.telemetry.monitor_bridge \
+                    and self.monitor is not None and self.monitor.enabled:
+                if self._tm_bridge is None:
+                    from deepspeed_tpu import telemetry
+
+                    self._tm_bridge = telemetry.MonitorBridge(
+                        self.monitor, self._tm)
+                self._tm_bridge.publish(self.global_steps)
 
     # ------------------------------------------------------------------ #
     # eager forward/backward/step (API parity path)

@@ -1,0 +1,98 @@
+"""Unified telemetry: metrics registry, trace spans, exposition.
+
+One process-wide registry unifies the repo's metric islands — fenced timers
+(``utils/timer``), monitor fan-out (``monitor/monitor``), FLOPS profiling
+(``profiling/flops_profiler``), comms stats (``utils/comms_logging``) — and
+the two hot subsystems are instrumented end-to-end (``runtime/engine``,
+``inference/fastgen``). Read paths: a Prometheus-text ``/metrics`` HTTP
+endpoint, a JSON ``snapshot()``, and a bridge into ``MonitorMaster`` so
+CSV/TensorBoard/W&B get every scalar for free.
+
+Module-level convenience API (all operate on the default registry)::
+
+    from deepspeed_tpu import telemetry
+
+    ticks = telemetry.counter("fastgen_ticks_total", "SplitFuse ticks")
+    ticks.inc(kind="decode")
+    with telemetry.span("decode_tick"):      # histogram + XLA trace annotation
+        run_tick()
+    telemetry.snapshot()                      # JSON-ready dict
+    srv = telemetry.start_metrics_server(0)   # /metrics on an ephemeral port
+
+Metric name catalog: README.md "Observability".
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from deepspeed_tpu.telemetry.bridge import MonitorBridge
+from deepspeed_tpu.telemetry.exposition import (
+    MetricsServer,
+    render_prometheus as _render,
+    snapshot as _snapshot,
+    start_metrics_server as _start_server,
+    stop_metrics_server as _stop_server,
+)
+from deepspeed_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from deepspeed_tpu.telemetry.spans import StallWatchdog, span as _span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
+    "MonitorBridge", "StallWatchdog", "counter", "gauge", "histogram",
+    "get_registry", "span", "snapshot", "render_prometheus",
+    "start_metrics_server", "stop_metrics_server", "add_collector", "reset",
+]
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def counter(name: str, description: str = "") -> Counter:
+    return _default_registry.counter(name, description)
+
+
+def gauge(name: str, description: str = "") -> Gauge:
+    return _default_registry.gauge(name, description)
+
+
+def histogram(name: str, description: str = "",
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return _default_registry.histogram(name, description, buckets=buckets)
+
+
+def span(name: str, **labels):
+    return _span(name, _default_registry, **labels)
+
+
+def add_collector(fn) -> None:
+    _default_registry.add_collector(fn)
+
+
+def snapshot() -> Dict[str, Any]:
+    return _snapshot(_default_registry)
+
+
+def render_prometheus() -> str:
+    return _render(_default_registry)
+
+
+def start_metrics_server(port: int = 0) -> MetricsServer:
+    return _start_server(_default_registry, port=port)
+
+
+def stop_metrics_server() -> None:
+    _stop_server()
+
+
+def reset() -> None:
+    """Tests only: stop the server and clear the default registry."""
+    _stop_server()
+    _default_registry.reset()

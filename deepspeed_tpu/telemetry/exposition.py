@@ -1,0 +1,160 @@
+"""Exposition: Prometheus text format, JSON snapshot, and the /metrics server.
+
+Two read paths over one registry:
+
+* ``render_prometheus(registry)`` — Prometheus text exposition format 0.0.4
+  (``# HELP`` / ``# TYPE`` + samples; histograms as cumulative ``_bucket``
+  series with ``le`` labels plus ``_sum``/``_count``);
+* ``snapshot(registry)`` — a JSON-ready dict with counters/gauges verbatim
+  and histograms summarized (count/sum/mean/min/max/p50/p95) — what
+  ``bench.py`` embeds next to each bench row and what tests assert against.
+
+``MetricsServer`` is a stdlib ThreadingHTTPServer on a daemon thread serving
+``/metrics`` (text) and ``/snapshot`` (JSON). Port 0 binds an ephemeral port
+(exposed as ``.port``) — the tier-1 smoke test scrapes that. Start it on
+process 0 only (callers gate; the registry record path already is).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    format_labels,
+)
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v != v:  # NaN
+        return "NaN"
+    return repr(float(v))
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    registry.collect()
+    lines = []
+    for metric in registry.metrics():
+        lines.append(f"# HELP {metric.name} {metric.description}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+        if isinstance(metric, Histogram):
+            for key, child in metric.labels_items():
+                cum = 0
+                for i, edge in enumerate(metric.buckets):
+                    cum += child.bucket_counts[i]
+                    lk = format_labels(key + (("le", _format_value(edge)),))
+                    lines.append(f"{metric.name}_bucket{lk} {cum}")
+                cum += child.bucket_counts[-1]
+                lk = format_labels(key + (("le", "+Inf"),))
+                lines.append(f"{metric.name}_bucket{lk} {cum}")
+                lines.append(
+                    f"{metric.name}_sum{format_labels(key)} "
+                    f"{_format_value(child.sum)}")
+                lines.append(
+                    f"{metric.name}_count{format_labels(key)} {child.count}")
+        elif isinstance(metric, (Counter, Gauge)):
+            for key, value in metric.labels_items():
+                lines.append(f"{metric.name}{format_labels(key)} "
+                             f"{_format_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot(registry: MetricsRegistry) -> Dict[str, Any]:
+    registry.collect()
+    out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+    for metric in registry.metrics():
+        if isinstance(metric, Histogram):
+            for key, _child in metric.labels_items():
+                out["histograms"][metric.name + format_labels(key)] = \
+                    metric.summary(**dict(key))
+        elif isinstance(metric, Counter):
+            for key, value in metric.labels_items():
+                out["counters"][metric.name + format_labels(key)] = value
+        elif isinstance(metric, Gauge):
+            for key, value in metric.labels_items():
+                out["gauges"][metric.name + format_labels(key)] = value
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = None  # set by MetricsServer
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        try:
+            if self.path.split("?")[0] in ("/metrics", "/"):
+                body = render_prometheus(self.registry).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/snapshot":
+                body = json.dumps(snapshot(self.registry)).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+        except Exception as e:  # pragma: no cover - defensive
+            self.send_error(500, str(e)[:100])
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr spam
+        pass
+
+
+class MetricsServer:
+    """``/metrics`` + ``/snapshot`` on a daemon thread; ``port=0`` binds an
+    ephemeral port (read ``.port`` after construction)."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"registry": registry})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-metrics-server",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)
+
+
+_server: Optional[MetricsServer] = None
+_server_lock = threading.Lock()
+
+
+def start_metrics_server(registry: MetricsRegistry,
+                         port: int = 0) -> MetricsServer:
+    """Idempotent module-level server (one per process); returns the live
+    server. A second call with a different port keeps the first server —
+    stop it explicitly to rebind."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = MetricsServer(registry, port=port)
+        return _server
+
+
+def stop_metrics_server() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None

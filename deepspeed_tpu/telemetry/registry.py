@@ -1,0 +1,336 @@
+"""Dependency-free metrics registry: Counter / Gauge / Histogram with labels.
+
+The unification point for the repo's four metric islands (``utils/timer``,
+``monitor/monitor``, ``profiling/flops_profiler``, ``utils/comms_logging``):
+everything records here, and the exposition layer (``telemetry/exposition``)
+serves one Prometheus text endpoint + one JSON snapshot over it.
+
+Design constraints:
+
+* stdlib-only (no jax import on the record path — metrics must be writable
+  from watchdog/HTTP threads without touching a device runtime);
+* process-0 gated like ``monitor/monitor.py`` (SPMD: every host records the
+  same values; one writer is the rank-0 analog). The gate is evaluated
+  lazily on first record so importing telemetry never initializes jax;
+* recording is O(dict lookup + float add) under an RLock — cheap enough for
+  per-tick serving paths, but anything per-device-op still belongs in
+  ``jax.profiler`` traces, not here.
+
+Collectors: callables registered via :meth:`MetricsRegistry.add_collector`
+run right before a snapshot/render — the hook for lazily-priced values
+(device_get of the last step's metrics, allocator occupancy). A collector
+that returns ``False`` is deregistered (the weakref-to-owner idiom); one
+that raises is dropped into ``telemetry_collector_errors_total`` instead of
+breaking the scrape.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+# Prometheus-style latency buckets (seconds), wide enough for both a ~100us
+# CPU tick and a multi-second fused train window through a remote tunnel.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+_process_zero: Optional[bool] = None
+
+
+def _is_process_zero() -> bool:
+    """Rank-0 gate, resolved lazily (jax.process_index initializes the
+    backend — must not happen at import time)."""
+    global _process_zero
+    if _process_zero is None:
+        try:
+            import jax
+
+            _process_zero = jax.process_index() == 0
+        except Exception:
+            _process_zero = True
+    return _process_zero
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def format_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str, registry: "MetricsRegistry"):
+        self.name = name
+        self.description = description
+        self._registry = registry
+        self._lock = registry._lock
+        self._children: Dict[LabelKey, Any] = {}
+
+    def _enabled(self) -> bool:
+        return self._registry.enabled and _is_process_zero()
+
+    def labels_items(self):
+        with self._lock:
+            return list(self._children.items())
+
+
+class Counter(_Metric):
+    """Monotone counter; ``inc`` only accepts non-negative amounts."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._enabled():
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = _label_key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._children.get(_label_key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not self._enabled():
+            return
+        with self._lock:
+            self._children[_label_key(labels)] = float(value)
+
+    def set_max(self, value: float, **labels) -> None:
+        """Monotone high-water mark (peak queue depth, peak occupancy)."""
+        if not self._enabled():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._children[key] = max(self._children.get(key, float("-inf")),
+                                      float(value))
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._enabled():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._children.get(_label_key(labels))
+
+
+class _HistogramChild:
+    __slots__ = ("bucket_counts", "count", "sum", "min", "max")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * (n_buckets + 1)  # +1 = the +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics). ``observe`` takes
+    an optional ``n`` weight so a fused window can credit its per-item mean
+    once per item without a Python loop."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str, registry: "MetricsRegistry",
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, description, registry)
+        self.buckets = tuple(sorted(buckets if buckets is not None
+                                    else DEFAULT_BUCKETS))
+
+    def labels_items(self):
+        """Consistent SNAPSHOTS of each child, copied under the registry
+        lock — readers (exposition, bridge) iterate bucket lists outside
+        the lock, and a live child mutating mid-scrape would emit a
+        malformed histogram (count > +Inf bucket)."""
+        with self._lock:
+            out = []
+            for key, c in self._children.items():
+                cc = _HistogramChild.__new__(_HistogramChild)
+                cc.bucket_counts = list(c.bucket_counts)
+                cc.count, cc.sum = c.count, c.sum
+                cc.min, cc.max = c.min, c.max
+                out.append((key, cc))
+            return out
+
+    def observe(self, value: float, n: int = 1, **labels) -> None:
+        if not self._enabled() or n < 1:
+            return
+        value = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _HistogramChild(len(self.buckets))
+            idx = len(self.buckets)
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    idx = i
+                    break
+            child.bucket_counts[idx] += n
+            child.count += n
+            child.sum += value * n
+            child.min = min(child.min, value)
+            child.max = max(child.max, value)
+
+    def child(self, **labels) -> Optional[_HistogramChild]:
+        with self._lock:
+            return self._children.get(_label_key(labels))
+
+    @staticmethod
+    def _quantile(buckets: Sequence[float], child: _HistogramChild,
+                  q: float) -> float:
+        """Bucket-interpolated quantile estimate (what the snapshot reports;
+        exact samples are not retained)."""
+        if child.count == 0:
+            return 0.0
+        target = q * child.count
+        seen = 0
+        lo = 0.0
+        for i, edge in enumerate(buckets):
+            n = child.bucket_counts[i]
+            if seen + n >= target and n > 0:
+                frac = (target - seen) / n
+                return min(lo + (edge - lo) * frac, child.max)
+            seen += n
+            lo = edge
+        return child.max
+
+    def summary(self, **labels) -> Dict[str, float]:
+        with self._lock:   # copy, not live — same torn-read hazard as
+            live = self._children.get(_label_key(labels))   # labels_items
+            if live is None or live.count == 0:
+                return {"count": 0, "sum": 0.0}
+            child = _HistogramChild.__new__(_HistogramChild)
+            child.bucket_counts = list(live.bucket_counts)
+            child.count, child.sum = live.count, live.sum
+            child.min, child.max = live.min, live.max
+        return {
+            "count": child.count,
+            "sum": round(child.sum, 9),
+            "mean": round(child.sum / child.count, 9),
+            "min": round(child.min, 9),
+            "max": round(child.max, 9),
+            "p50": round(self._quantile(self.buckets, child, 0.5), 9),
+            "p95": round(self._quantile(self.buckets, child, 0.95), 9),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], Any]] = []
+        # watchdog substrate: the last completed span (name, end walltime)
+        self.last_span: Optional[Tuple[str, float]] = None
+        # per-thread collection mode (see collect()): thread-local so a
+        # concurrent /metrics scrape can't flip a cheap bridge publish on
+        # the training thread into an expensive one mid-iteration
+        self._collect_tls = threading.local()
+
+    @property
+    def collecting_expensive(self) -> bool:
+        """Whether the CURRENT THREAD's in-flight collect() may price
+        expensive values (compiles, fences). True outside a collect()."""
+        return getattr(self._collect_tls, "expensive", True)
+
+    # -- metric construction (idempotent by name, kind-checked) ---------- #
+    def _get_or_make(self, cls, name: str, description: str, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, requested {cls.kind}")
+                return existing
+            metric = cls(name, description, self, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, description: str = "") -> Counter:
+        return self._get_or_make(Counter, name, description)
+
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        return self._get_or_make(Gauge, name, description)
+
+    def histogram(self, name: str, description: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_make(Histogram, name, description, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- collectors ------------------------------------------------------ #
+    def add_collector(self, fn: Callable[[], Any]) -> None:
+        """Register a pre-scrape callback. Return ``False`` from the callback
+        to deregister it (weakref-owner idiom); exceptions are counted in
+        ``telemetry_collector_errors_total`` and the scrape proceeds."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def collect(self, expensive: bool = True) -> None:
+        """Run collectors. ``expensive=False`` (the MonitorBridge's print-
+        cadence publish, which runs ON the training thread) tells
+        collectors to skip anything priced — one-off compiles, device
+        fences; they read the mode via ``self.collecting_expensive``."""
+        with self._lock:
+            collectors = list(self._collectors)
+        self._collect_tls.expensive = expensive
+        dead = []
+        try:
+            for fn in collectors:
+                try:
+                    if fn() is False:
+                        dead.append(fn)
+                except Exception as e:  # broken collector must not kill scrapes
+                    self.counter(
+                        "telemetry_collector_errors_total",
+                        "collector callbacks that raised during a scrape",
+                    ).inc(error=type(e).__name__)
+        finally:
+            self._collect_tls.expensive = True
+        if dead:
+            with self._lock:
+                self._collectors = [f for f in self._collectors
+                                    if f not in dead]
+
+    # -- span bookkeeping (see telemetry/spans.py) ----------------------- #
+    def note_span_end(self, name: str) -> None:
+        with self._lock:
+            self.last_span = (name, time.time())
+
+    def reset(self) -> None:
+        """Tests only: zero every metric and drop collectors/span state.
+
+        Children are cleared IN PLACE and the metric objects stay
+        registered — engines (training or FastGen) cache their handles at
+        construction, and dropping the dict would strand a long-lived
+        engine's recordings in orphaned objects invisible to snapshots."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._children.clear()
+            self._collectors.clear()
+            self.last_span = None

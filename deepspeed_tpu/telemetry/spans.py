@@ -1,0 +1,139 @@
+"""Span-based tracing + the stall watchdog.
+
+``span("decode_tick")`` does three things at once:
+
+* records the span's wall time into the ``span_seconds{span=...}`` histogram
+  of the active registry (host-visible latency, scrapeable);
+* emits a ``jax.profiler.TraceAnnotation`` so the span brackets the ops it
+  dispatched in an XLA device trace (the compute/collective-overlap view
+  that T3-style analyses need — a captured ``jax.profiler.trace`` shows
+  these names on the host timeline aligned with device streams);
+* notes itself as the registry's *last completed span*, which is what the
+  stall watchdog reports when a training step misses its deadline.
+
+NOTE on async dispatch: the host wall time of a span that only *dispatches*
+work is not device time. Spans measure what the host observed — for fenced
+device timings use ``utils/timer.py``'s fenced timers (which also feed the
+``train_phase_seconds`` histogram) or a profiler trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+SPAN_HISTOGRAM = "span_seconds"
+
+
+def _trace_annotation(name: str):
+    """jax.profiler.TraceAnnotation when jax is importable; inert otherwise
+    (the registry itself is dependency-free and must stay usable without a
+    device runtime, e.g. from the HTTP scrape thread)."""
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str, registry: MetricsRegistry, **labels):
+    hist = registry.histogram(
+        SPAN_HISTOGRAM, "wall time of telemetry.span sections")
+    t0 = time.perf_counter()
+    with _trace_annotation(name):
+        try:
+            yield
+        finally:
+            hist.observe(time.perf_counter() - t0, span=name, **labels)
+            registry.note_span_end(name)
+
+
+class StallWatchdog:
+    """Logs a warning when no heartbeat lands within ``deadline_s``.
+
+    The engine beats (``beat()``) once per completed optimizer step/window;
+    a daemon thread checks at deadline/4 cadence and warns ONCE per stall
+    episode, naming the last completed span — the first question anyone asks
+    a wedged run is "what was it doing last". Recovery re-arms the warning.
+    A ``telemetry_stalls_total`` counter makes stall history scrapeable.
+
+    The deadline ARMS at the first beat: the watchdog monitors steady-state
+    training, and the first step's XLA compile routinely exceeds any sane
+    step deadline — firing during legitimate compilation would put a false
+    stall in every large-model run's metrics. (The cost: a run that never
+    completes step 1 is not flagged — that failure mode presents as an
+    obvious hang, not a mid-run stall.)
+    """
+
+    def __init__(self, deadline_s: float, registry: MetricsRegistry,
+                 name: str = "train", logger=None):
+        if deadline_s <= 0:
+            raise ValueError("StallWatchdog needs a positive deadline")
+        self.deadline_s = float(deadline_s)
+        self.registry = registry
+        self.name = name
+        if logger is None:
+            from deepspeed_tpu.utils.logging import logger as _l
+
+            logger = _l
+        self.logger = logger
+        self._last_beat = time.time()
+        self._armed = False   # first beat arms the deadline (see class doc)
+        self._stalled = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stall_counter = registry.counter(
+            "telemetry_stalls_total",
+            "watchdog deadline misses (no step completed in time)")
+
+    def beat(self) -> None:
+        self._last_beat = time.time()
+        self._armed = True
+        if self._stalled:
+            self.logger.warning(
+                f"[watchdog:{self.name}] recovered — a step completed after "
+                "the stall warning")
+            self._stalled = False
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"telemetry-watchdog-{self.name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One deadline check (the thread's body; callable directly in
+        tests). Returns True when a stall was (newly) reported."""
+        now = time.time() if now is None else now
+        if not self._armed or self._stalled \
+                or now - self._last_beat <= self.deadline_s:
+            return False
+        self._stalled = True
+        self._stall_counter.inc()
+        last = self.registry.last_span
+        where = (f"last completed span: {last[0]!r} "
+                 f"{now - last[1]:.1f}s ago" if last
+                 else "no span completed yet")
+        self.logger.warning(
+            f"[watchdog:{self.name}] no step finished in "
+            f"{now - self._last_beat:.1f}s (deadline {self.deadline_s:.1f}s) "
+            f"— {where}")
+        return True
+
+    def _run(self) -> None:
+        interval = max(self.deadline_s / 4.0, 0.05)
+        while not self._stop.wait(interval):
+            self.check()

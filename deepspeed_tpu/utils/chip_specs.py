@@ -1,0 +1,24 @@
+"""Chip datasheet facts shared by bench.py and the telemetry MFU gauge.
+
+One table so the headline bench MFU and the scraped ``train_mfu`` gauge can
+never disagree about a chip's peak. Stdlib-only — importable from the bench
+orchestrator before jax loads.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 peak TFLOP/s per chip, by TPU generation (fallback: v5e)
+PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0,
+                    "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
+
+
+def chip_peak_tflops(device_kind: str,
+                     default: Optional[float] = None) -> Optional[float]:
+    """Peak bf16 TFLOP/s for a PJRT ``device_kind`` string; ``default``
+    when the kind is unrecognized (CPU hosts have no meaningful peak)."""
+    kind = (device_kind or "").lower()
+    for key, peak in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return peak
+    return default

@@ -15,6 +15,24 @@ from typing import Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import log_dist
 
+def _tm():
+    """Telemetry handles: CommsLogger totals fold into the unified registry
+    (``comm_collectives_total`` / ``comm_bytes_total`` by op, plus measured
+    latency for eager host-level collectives) so per-collective volume is a
+    scrapeable metric, not just a log_summary line. Looked up fresh each
+    call (a locked dict get) so a test-time registry reset can't strand
+    recordings in orphaned metric objects."""
+    from deepspeed_tpu import telemetry
+
+    return (
+        telemetry.counter("comm_collectives_total",
+                          "collective ops issued (traced + eager)"),
+        telemetry.counter("comm_bytes_total",
+                          "bytes moved by collective ops"),
+        telemetry.histogram("comm_latency_seconds",
+                            "wall time of eagerly-executed collectives"),
+    )
+
 
 def get_caller_func(frame_depth: int = 3) -> str:
     import sys
@@ -79,6 +97,9 @@ class CommsLogger:
             return
         self.traced_counts[record_name] += 1
         self.traced_bytes[record_name] += size_bytes
+        counts, byts, _ = _tm()
+        counts.inc(op=record_name, mode="traced")
+        byts.inc(size_bytes, op=record_name, mode="traced")
 
     def append(self, raw_name: str, record_name: str, latency_s: float, size_bytes: int,
                group_size: int) -> None:
@@ -90,6 +111,10 @@ class CommsLogger:
         per_size[1].append(latency_s * 1000.0)
         per_size[2].append(bw["tput_GBps"])
         per_size[3].append(bw["busbw_GBps"])
+        counts, byts, lat = _tm()
+        counts.inc(op=record_name, mode="eager")
+        byts.inc(size_bytes, op=record_name, mode="eager")
+        lat.observe(latency_s, op=record_name)
         if self.verbose:
             log_dist(
                 f"comm op: {record_name} | time(ms): {latency_s * 1e3:.2f} | "

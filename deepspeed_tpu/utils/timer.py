@@ -29,6 +29,20 @@ def _sync() -> None:
     get_accelerator().synchronize()
 
 
+def _phase_hist():
+    """Telemetry feed: every fenced timer stop lands in the unified
+    registry as ``train_phase_seconds{phase=<timer name>}`` — the fwd/bwd/
+    step breakdown becomes scrapeable instead of log-only. Looked up fresh
+    per stop (locked dict get; timers only run under wall_clock_breakdown)
+    so registry resets can't strand a cached handle."""
+    from deepspeed_tpu import telemetry
+
+    return telemetry.histogram(
+        "train_phase_seconds",
+        "fenced wall time of named engine phases (fwd/bwd/step/"
+        "train_batch timers)")
+
+
 class _Timer:
     def __init__(self, name: str):
         self.name = name
@@ -55,6 +69,10 @@ class _Timer:
         if record:
             self._record.append(delta)
         self.started = False
+        try:
+            _phase_hist().observe(delta, phase=self.name)
+        except Exception:
+            pass   # telemetry must never break a timer
 
     def reset(self) -> None:
         self.started = False
@@ -143,6 +161,10 @@ class ThroughputTimer:
         self.local_step_count = 0
         self.total_elapsed_time = 0.0   # fenced wall time since start_step
         self._counted_steps = 0         # steps covered by total_elapsed_time
+        # optional (duration_s, steps) callback fired on every fenced window
+        # close — the telemetry feed for throughput gauges (async dispatch
+        # makes un-fenced per-step walls meaningless, see class docstring)
+        self.window_hook = None
         self._window_start: Optional[float] = None
         self._window_steps = 0
         self.started = False
@@ -196,6 +218,11 @@ class ThroughputTimer:
         self._counted_steps += steps
         self._window_start = time.perf_counter()
         self._window_steps = 0
+        if self.window_hook is not None and steps:
+            try:
+                self.window_hook(duration, steps)
+            except Exception:
+                pass   # telemetry must never break the timer
         return duration, steps
 
     def avg_samples_per_sec(self) -> float:

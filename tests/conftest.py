@@ -8,17 +8,27 @@ Note: a sitecustomize may register a TPU PJRT plugin and import jax before this
 file runs, so we both set the env vars AND update jax.config directly.
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-    # 8 device threads can time-slice a single core on small runners: the
-    # default 20s/40s collective-rendezvous deadlines then abort long fused
-    # programs spuriously (F rendezvous.cc:127) — raise them well clear
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200").strip()
+    + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["DSTPU_ACCELERATOR"] = "cpu"
+
+# 8 device threads can time-slice a single core on small runners: the
+# default 20s/40s collective-rendezvous deadlines then abort long fused
+# programs spuriously (F rendezvous.cc:127) — raise them well clear. The
+# flags only exist in some jaxlib builds and unknown XLA_FLAGS hard-abort
+# the backend (which used to kill the whole session) — probe first.
+from deepspeed_tpu.utils.xla_compat import (  # noqa: E402
+    cpu_collective_timeout_flags,
+)
+
+os.environ["XLA_FLAGS"] = (
+    os.environ["XLA_FLAGS"] + cpu_collective_timeout_flags()).strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,371 @@
+"""Unified telemetry subsystem: registry semantics, exposition, spans,
+monitor integration, and end-to-end instrumentation of the training engine
+and the FastGen serving engine (the ISSUE-1 acceptance surface)."""
+import itertools
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry.exposition import render_prometheus, snapshot
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.spans import StallWatchdog, span
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# --------------------------------------------------------------------- #
+# registry semantics
+# --------------------------------------------------------------------- #
+class TestRegistry:
+    def test_counter_monotone_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        c.inc()
+        c.inc(2, op="put")
+        c.inc(op="put")
+        assert c.value() == 1
+        assert c.value(op="put") == 3
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_counter_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("x_total")
+        c.inc(5)
+        assert c.value() == 0
+
+    def test_gauge_set_inc_and_peak(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set(4, state="waiting")
+        g.set(2, state="waiting")
+        g.inc(1.5)
+        g.set_max(7)
+        g.set_max(3)
+        assert g.value(state="waiting") == 2
+        assert g.value() == 7  # set_max superseded the inc'd 1.5
+
+    def test_histogram_buckets_sum_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=[0.01, 0.1, 1.0])
+        h.observe(0.005)
+        h.observe(0.05, n=3)
+        h.observe(5.0)
+        s = h.summary()
+        assert s["count"] == 5
+        assert s["sum"] == pytest.approx(0.005 + 3 * 0.05 + 5.0)
+        assert s["min"] == pytest.approx(0.005)
+        assert s["max"] == pytest.approx(5.0)
+        child = h.child()
+        assert child.bucket_counts == [1, 3, 0, 1]  # last = +Inf overflow
+
+    def test_same_name_same_metric_kind_conflict_raises(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("dup_total")
+        assert reg.counter("dup_total") is c1
+        with pytest.raises(ValueError):
+            reg.gauge("dup_total")
+
+    def test_collector_runs_on_snapshot_and_deregisters(self):
+        reg = MetricsRegistry()
+        calls = []
+
+        def fleeting():
+            calls.append(1)
+            reg.gauge("collected").set(42.0)
+            return False   # deregister after one scrape
+
+        reg.add_collector(fleeting)
+        s1 = snapshot(reg)
+        s2 = snapshot(reg)
+        assert s1["gauges"]["collected"] == 42.0
+        assert s2["gauges"]["collected"] == 42.0   # value persists
+        assert len(calls) == 1                     # collector ran once
+
+    def test_broken_collector_counted_not_raised(self):
+        reg = MetricsRegistry()
+        reg.add_collector(lambda: 1 / 0)
+        s = snapshot(reg)
+        errs = [v for k, v in s["counters"].items()
+                if k.startswith("telemetry_collector_errors_total")]
+        assert errs == [1.0]
+
+
+# --------------------------------------------------------------------- #
+# exposition: Prometheus text + JSON snapshot round-trip
+# --------------------------------------------------------------------- #
+class TestExposition:
+    def test_prometheus_rendering(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs_total", "jobs").inc(2, kind="a")
+        reg.gauge("temp").set(1.25)
+        h = reg.histogram("dur_seconds", buckets=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        text = render_prometheus(reg)
+        assert "# TYPE jobs_total counter" in text
+        assert 'jobs_total{kind="a"} 2.0' in text
+        assert "# TYPE temp gauge" in text and "temp 1.25" in text
+        assert 'dur_seconds_bucket{le="0.1"} 1' in text
+        assert 'dur_seconds_bucket{le="1.0"} 2' in text
+        assert 'dur_seconds_bucket{le="+Inf"} 2' in text
+        assert "dur_seconds_count 2" in text
+        # every non-comment line is "name{labels} value" — parseable
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name_part, value = line.rsplit(" ", 1)
+            assert name_part and (value == "+Inf" or float(value) is not None)
+
+    def test_snapshot_json_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc(3)
+        reg.gauge("b").set(0.5, site="x")
+        reg.histogram("c_seconds").observe(0.2)
+        snap = snapshot(reg)
+        back = json.loads(json.dumps(snap))
+        assert back == snap
+        assert back["counters"]["a_total"] == 3
+        assert back["gauges"]['b{site="x"}'] == 0.5
+        assert back["histograms"]["c_seconds"]["count"] == 1
+
+    def test_http_endpoint_ephemeral_port_scrape(self):
+        """Tier-1-safe /metrics smoke: bind port 0, scrape, validate."""
+        telemetry.counter("scrape_demo_total").inc(7)
+        srv = telemetry.start_metrics_server(0)
+        assert srv.port > 0
+        text = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+        assert "scrape_demo_total 7.0" in text
+        assert "# TYPE scrape_demo_total counter" in text
+        snap_url = srv.url.replace("/metrics", "/snapshot")
+        snap = json.loads(
+            urllib.request.urlopen(snap_url, timeout=10).read().decode())
+        assert snap["counters"]["scrape_demo_total"] == 7.0
+
+
+# --------------------------------------------------------------------- #
+# spans + watchdog
+# --------------------------------------------------------------------- #
+class TestSpans:
+    def test_span_records_histogram_and_last_span(self):
+        reg = MetricsRegistry()
+        with span("tick", reg, phase="decode"):
+            pass
+        s = reg.histogram("span_seconds").summary(span="tick", phase="decode")
+        assert s["count"] == 1 and s["sum"] >= 0
+        assert reg.last_span[0] == "tick"
+
+    def test_watchdog_warns_once_with_last_span(self):
+        reg = MetricsRegistry()
+        warnings = []
+
+        class L:
+            def warning(self, msg):
+                warnings.append(msg)
+
+        with span("fwd", reg):
+            pass
+        wd = StallWatchdog(0.01, reg, logger=L())
+        wd._last_beat -= 1.0
+        assert wd.check() is False      # unarmed: first-compile grace
+        wd.beat()                       # first step completes — armed
+        wd._last_beat -= 1.0            # simulate a 1s-old heartbeat
+        assert wd.check() is True
+        assert wd.check() is False      # once per stall episode
+        assert "fwd" in warnings[0]
+        assert reg.counter("telemetry_stalls_total").value() == 1
+        wd.beat()                       # recovery logs + re-arms
+        assert len(warnings) == 2
+        wd._last_beat -= 1.0
+        assert wd.check() is True
+
+
+# --------------------------------------------------------------------- #
+# monitor satellites: csv handle cache, close(), hardened fan-out
+# --------------------------------------------------------------------- #
+class TestMonitorSatellites:
+    def _cfg(self, tmp_path):
+        class Cfg:
+            enabled = True
+            output_path = str(tmp_path)
+            job_name = "job"
+
+        return Cfg()
+
+    def test_csv_monitor_round_trip_and_handle_cache(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+
+        mon = csvMonitor(self._cfg(tmp_path))
+        mon.write_events([("Train/loss", 1.0, 1), ("Train/lr", 0.1, 1)])
+        mon.write_events([("Train/loss", 0.5, 2)])
+        # handles are cached, not reopened per event
+        assert set(mon._files) == {"Train/loss", "Train/lr"}
+        f_loss = mon._files["Train/loss"]
+        mon.write_events([("Train/loss", 0.25, 3)])
+        assert mon._files["Train/loss"] is f_loss
+        mon.close()
+        assert mon._files == {}
+        rows = open(tmp_path / "job" / "Train_loss.csv").read().splitlines()
+        assert rows[0] == "step,Train/loss"
+        assert rows[1:] == ["1,1.0", "2,0.5", "3,0.25"]
+        # writes after close() reopen transparently and append
+        mon.write_events([("Train/loss", 0.1, 4)])
+        mon.close()
+        rows = open(tmp_path / "job" / "Train_loss.csv").read().splitlines()
+        assert rows[-1] == "4,0.1"
+
+    def test_master_survives_failing_backend(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import Monitor, MonitorMaster, \
+            csvMonitor
+
+        class Dead(Monitor):
+            def __init__(self):
+                self.enabled = True
+
+            def write_events(self, events):
+                raise ConnectionError("wandb went away")
+
+        master = MonitorMaster.__new__(MonitorMaster)
+        csv_backend = csvMonitor(self._cfg(tmp_path))
+        master.backends = [Dead(), csv_backend]
+        master.enabled = True
+        master.write_events([("Train/loss", 2.0, 1)])   # must not raise
+        master.close()
+        rows = open(tmp_path / "job" / "Train_loss.csv").read().splitlines()
+        assert rows[1] == "1,2.0"
+        errs = telemetry.snapshot()["counters"]
+        assert errs.get('monitor_write_errors_total{backend="Dead"}') == 1.0
+
+    def test_monitor_bridge_forwards_scalars(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+
+        telemetry.counter("bridge_demo_total").inc(5)
+        telemetry.gauge("bridge_gauge").set(1.5, kind="x")
+        mon = csvMonitor(self._cfg(tmp_path))
+        bridge = telemetry.MonitorBridge(mon, telemetry.get_registry())
+        bridge.publish(step=3)
+        mon.close()
+        out = os.listdir(tmp_path / "job")
+        assert "Telemetry_bridge_demo_total.csv" in out
+        assert any("bridge_gauge" in f for f in out)
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: engine + FastGen instrumentation (acceptance criteria)
+# --------------------------------------------------------------------- #
+FG_CFG = dict(hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128,
+              vocab_size=512, dtype="float32")
+
+
+class TestEndToEnd:
+    def test_train_loop_populates_metrics(self, tmp_path):
+        from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+        spec = dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                                  max_seq_len=64)
+        config = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                  "gradient_accumulation_steps": 1,
+                  "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                  "zero_optimization": {"stage": 0},
+                  "steps_per_print": 2,
+                  "csv_monitor": {"enabled": True,
+                                  "output_path": str(tmp_path),
+                                  "job_name": "job"},
+                  "telemetry": {"stall_deadline_s": 300.0}}
+        engine, *_ = dst.initialize(model=spec, config=config)
+        try:
+            data = itertools.cycle(synthetic_lm_data(8, 64, 512, seed=0))
+            # 4 steps: the fenced throughput window (tokens/s source) only
+            # opens after ThroughputTimer's start_step=2 warmup
+            for _ in range(4):
+                engine.train_batch(data)
+            snap = telemetry.snapshot()
+            assert snap["counters"]["train_steps_total"] == 4
+            assert snap["counters"]["train_tokens_total"] == 4 * 8 * 64
+            step_h = snap["histograms"]["train_step_seconds"]
+            assert step_h["count"] == 4 and step_h["sum"] > 0
+            assert snap["gauges"]["train_tokens_per_sec"] > 0
+            assert snap["gauges"]["train_loss"] > 0
+            assert "train_grad_norm" in snap["gauges"]
+            assert snap["gauges"]["train_heartbeat_timestamp_seconds"] > 0
+            # watchdog armed and not stalled
+            assert engine._watchdog is not None
+            assert engine._watchdog.check() is False
+            # the whole thing serves as valid Prometheus text
+            text = telemetry.render_prometheus()
+            assert "train_steps_total 4.0" in text
+            assert "train_step_seconds_bucket" in text
+            # default-on monitor bridge: registry scalars landed in the CSV
+            # backend alongside the engine's own Train/ events
+            files = os.listdir(tmp_path / "job")
+            assert any(f.startswith("Telemetry_train_steps_total")
+                       for f in files)
+            assert "Train_loss.csv" in files
+        finally:
+            engine.shutdown_telemetry()
+            if engine.monitor is not None:
+                engine.monitor.close()
+
+    def test_fastgen_generate_populates_metrics(self):
+        from deepspeed_tpu.inference.fastgen import FastGenEngine
+
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 512, n).tolist() for n in (5, 19, 33)]
+        fg = FastGenEngine("tiny", n_blocks=32, block_size=16,
+                           max_blocks_per_seq=8, token_budget=32,
+                           temperature=0.0, seed=0, **FG_CFG)
+        out = fg.generate_all([1, 2, 3], prompts, max_new_tokens=12)
+        assert all(len(v) > 0 for v in out.values())
+        # second (warm) run: decode-latency observations skip cold-compile
+        # windows by design, so steady-state samples need a warm cache
+        fg.generate_all([4, 5, 6], prompts, max_new_tokens=12)
+        snap = telemetry.snapshot()
+        ttft = [v for k, v in snap["histograms"].items()
+                if k.startswith("fastgen_ttft_seconds")]
+        assert ttft and ttft[0]["count"] == 6 and ttft[0]["sum"] > 0
+        tok_lat = [v for k, v in snap["histograms"].items()
+                   if k.startswith("fastgen_decode_token_seconds")]
+        assert tok_lat and tok_lat[0]["count"] > 0
+        assert snap["gauges"]["fastgen_queue_depth_peak"] == 3
+        assert snap["gauges"]["fastgen_kv_pool_utilization_peak"] > 0
+        assert snap["counters"]["fastgen_generated_tokens_total"] >= 6 * 12
+        assert snap["counters"]["fastgen_prefill_tokens_total"] == \
+            2 * (5 + 19 + 33)
+        # prefill/decode tick split is scrapeable
+        kinds = {k for k in snap["counters"]
+                 if k.startswith("fastgen_ticks_total")}
+        assert any('kind="decode"' in k for k in kinds)
+        assert any('kind="mixed"' in k or 'kind="planned"' in k
+                   for k in kinds)
+        # finished sequences released their blocks — eviction counter moved
+        assert snap["counters"]["fastgen_evicted_blocks_total"] > 0
+        # …and the endpoint serves it all
+        srv = telemetry.start_metrics_server(0)
+        text = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+        assert "fastgen_ttft_seconds_count 6" in text
+        assert "fastgen_kv_pool_utilization_peak" in text
+
+    def test_comms_logger_folds_into_registry(self):
+        from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+        cl = CommsLogger(enabled=True)
+        cl.append_traced("all_reduce", "all_reduce", 1024)
+        cl.append("all_reduce", "all_reduce", latency_s=0.002,
+                  size_bytes=2048, group_size=8)
+        snap = telemetry.snapshot()
+        c = snap["counters"]
+        assert c['comm_collectives_total{mode="traced",op="all_reduce"}'] == 1
+        assert c['comm_bytes_total{mode="traced",op="all_reduce"}'] == 1024
+        assert c['comm_collectives_total{mode="eager",op="all_reduce"}'] == 1
+        lat = snap["histograms"]['comm_latency_seconds{op="all_reduce"}']
+        assert lat["count"] == 1

@@ -61,6 +61,15 @@ def initialize(
 
     engine.monitor = MonitorMaster(engine.config)
 
+    # fault tolerance (config "fault_tolerance"): arm the graceful-
+    # preemption SIGTERM handler and restore the newest committed
+    # checkpoint before handing the engine back
+    ft = engine.config.fault_tolerance
+    if ft.graceful_preemption and (ft.resume_dir or ft.auto_resume):
+        engine.enable_preemption_handler()
+    if ft.auto_resume:
+        engine.maybe_auto_resume()
+
     dataloader = None
     if training_data is not None:
         dataloader = engine.deepspeed_io(training_data)

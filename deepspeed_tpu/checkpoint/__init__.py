@@ -1,0 +1,11 @@
+"""Checkpoint subsystem: tagged-dir save/load, pluggable writer engines,
+and the fault-tolerance layer (atomic commits, integrity manifests,
+walk-back recovery — ``checkpoint/fault_tolerance.py``)."""
+from deepspeed_tpu.checkpoint.fault_tolerance import (  # noqa: F401
+    COMMIT_MARKER,
+    CheckpointCorruptError,
+    committed_tags,
+    find_restore_tag,
+    gc_tags,
+    verify_tag,
+)

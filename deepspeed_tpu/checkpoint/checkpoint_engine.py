@@ -32,7 +32,31 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.utils.logging import logger
+
 PyTree = Any
+
+
+def resolve_np_dtype(name: str) -> np.dtype:
+    """Dtype-name → numpy dtype, with the ml_dtypes families as fallback.
+
+    ``np.dtype("bfloat16")`` only resolves while ``ml_dtypes`` is imported
+    (its import registers the extension types with numpy) — a bare loader
+    process that hasn't touched jax yet would crash restoring a bf16
+    checkpoint. Resolve through ml_dtypes explicitly instead of relying on
+    registration order."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    try:
+        return np.dtype(getattr(ml_dtypes, name))
+    except (AttributeError, TypeError):
+        raise TypeError(f"unresolvable checkpoint dtype {name!r} "
+                        "(not a numpy or ml_dtypes dtype)")
 
 
 class CheckpointEngine:
@@ -113,6 +137,7 @@ class FastCheckpointEngine(CheckpointEngine):
             manifest[name] = {"shape": list(arr.shape), "dtype": dtype_name,
                               "file": fname}
             self._staged.append(raw)
+            chaos_point("save/leaf_write")   # per-leaf torn-write window
             self.handle.async_pwrite(raw, os.path.join(path, fname))
         with open(os.path.join(path, "manifest.json"), "w") as f:
             json.dump(manifest, f)
@@ -126,15 +151,15 @@ class FastCheckpointEngine(CheckpointEngine):
             manifest = json.load(f)
         flat = {}
         for name, info in manifest.items():
-            nbytes = int(np.prod(info["shape"]) or 1) * \
-                np.dtype(info["dtype"]).itemsize
+            dtype = resolve_np_dtype(info["dtype"])
+            nbytes = int(np.prod(info["shape"]) or 1) * dtype.itemsize
             buf = np.empty(nbytes, np.uint8)
             self.handle.async_pread(buf, os.path.join(path, info["file"]))
-            flat[name] = (buf, info)
+            flat[name] = (buf, dtype, info)
         self.handle.wait_all()
         out = {}
-        for name, (buf, info) in flat.items():
-            out[name] = buf.view(np.dtype(info["dtype"])).reshape(info["shape"])
+        for name, (buf, dtype, info) in flat.items():
+            out[name] = buf.view(dtype).reshape(info["shape"])
         return _unflatten_like(template, out)
 
 
@@ -184,7 +209,23 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         return self.inner.load(path, template)
 
     def close(self) -> None:
-        self.wait()
+        # best-effort: close() runs on engine-teardown paths (often while
+        # an ORIGINAL training error is propagating) — a failed queued save
+        # must not raise here and mask it, and the drain thread must still
+        # be joined or it leaks holding the last queued state alive
+        try:
+            self.wait()
+        except Exception as e:   # NOT BaseException: a Ctrl-C aimed at a
+            # hung close() must still interrupt it
+            from deepspeed_tpu import telemetry
+
+            telemetry.counter(
+                "checkpoint_close_errors_total",
+                "save errors swallowed by best-effort engine close"
+            ).inc(error=type(e).__name__)
+            logger.warning(
+                f"DecoupledCheckpointEngine.close: queued save had failed "
+                f"({type(e).__name__}: {e}) — teardown continues")
         self.queue.put(None)
         self._thread.join(timeout=10)
 

@@ -8,14 +8,32 @@ the reference's per-rank writers, and the on-disk layout is topology-independent
 by construction — every host writes only its addressable shards, and reload can
 use a *different* mesh/sharding, which is the universal-checkpoint capability
 (``deepspeed/checkpoint/ds_to_universal.py``) without an offline conversion step.
+
+Fault tolerance (``checkpoint/fault_tolerance.py``): every save lands in a
+``<tag>.tmp`` dir, is fsynced, gains a ``COMMITTED`` integrity manifest
+(per-file size + CRC32 + step metadata), and is published by one atomic
+rename; ``latest`` updates only after commit — including for async saves,
+whose commit runs on a finalizer thread after the orbax write drains. Load
+verifies the manifest and walks back to the newest committed tag when the
+head is torn or corrupt. Transient I/O errors retry with exponential
+backoff + jitter (``checkpoint_save_retries_total`` /
+``checkpoint_save_failures_total``); saves and loads record
+``span("checkpoint/save")`` / ``span("checkpoint/load")``.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import shutil
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+from deepspeed_tpu.checkpoint import fault_tolerance as ft
+from deepspeed_tpu.checkpoint.fault_tolerance import CheckpointCorruptError
+from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.utils.logging import logger
 
 PyTree = Any
 
@@ -30,118 +48,259 @@ def _tag_dir(root: str, tag: str) -> str:
     return os.path.join(root, tag)
 
 
+def _span(name: str):
+    from deepspeed_tpu import telemetry
+
+    return telemetry.span(name)
+
+
 _async_ckptr = None
-_async_pending = None
+_async_thread: Optional[threading.Thread] = None
+_async_error: List[BaseException] = []
+# serializes save_state/finalize_async across threads (a watchdog-thread
+# emergency save can run concurrently with the training thread's save).
+# RLock: save_state calls finalize_async itself. The SIGNAL-handler path
+# never takes this lock reentrantly mid-save — the engine defers
+# preemption while a save is in flight (engine._saving).
+_save_lock = threading.RLock()
 
 
-def _finalize_async() -> None:
-    """Block until an in-flight async save completes (reference
-    ``DecoupledCheckpointEngine`` drain semantics)."""
-    global _async_pending
-    if _async_ckptr is not None:
-        _async_ckptr.wait_until_finished()
-    _async_pending = None
+def finalize_async() -> None:
+    """Block until an in-flight async save is fully COMMITTED (write
+    drained + marker + rename + ``latest``), re-raising any error it hit
+    (reference ``DecoupledCheckpointEngine`` drain semantics)."""
+    global _async_thread
+    with _save_lock:
+        thread, _async_thread = _async_thread, None
+        if thread is not None:
+            thread.join()
+        elif _async_ckptr is not None:
+            _async_ckptr.wait_until_finished()
+        if _async_error:
+            err = _async_error.pop()
+            _async_error.clear()
+            raise err
+
+
+# Back-compat alias (pre-fault-tolerance name).
+_finalize_async = finalize_async
+
+
+def _infer_step(tag: str, client_state: Optional[Dict]) -> Optional[int]:
+    if client_state and isinstance(client_state.get("global_steps"), int):
+        return client_state["global_steps"]
+    digits = "".join(c for c in tag if c.isdigit())
+    return int(digits) if digits else None
 
 
 def save_state(save_dir: str, tag: str, state: PyTree,
                client_state: Optional[Dict] = None, save_latest: bool = True,
-               async_save: bool = False, writer: str = "orbax") -> None:
-    """``async_save=True`` returns immediately with the write in flight — the
-    reference's decoupled/fast checkpoint engines
+               async_save: bool = False, writer: str = "orbax",
+               keep_n: int = 0, fsync: bool = True, checksums: bool = True,
+               retries: int = 3, retry_backoff_s: float = 0.2,
+               retry_jitter_s: float = 0.2) -> None:
+    """Commit-protocol save. ``async_save=True`` returns with the orbax
+    write in flight — the reference's decoupled/fast engines
     (``runtime/checkpoint_engine/decoupled_checkpoint_engine.py:78``,
-    ``fast_checkpoint_engine.py:16``); orbax's async checkpointer provides the
-    double-buffered background writer. ``writer='fast'`` routes through the
-    C++ aio thread-pool engine (``checkpoint/checkpoint_engine.py``)."""
+    ``fast_checkpoint_engine.py:16``) — and the COMMIT (fsync + manifest +
+    rename + ``latest``) runs on a finalizer thread after the write
+    drains, so ``latest`` never names an in-flight checkpoint.
+    ``writer='fast'`` routes through the C++ aio thread-pool engine
+    (``checkpoint/checkpoint_engine.py``). ``keep_n > 0`` prunes all but
+    the newest N committed tags after each successful commit."""
+    with _save_lock:
+        return _save_state_locked(
+            save_dir, tag, state, client_state, save_latest, async_save,
+            writer, keep_n, fsync, checksums, retries, retry_backoff_s,
+            retry_jitter_s)
+
+
+def _save_state_locked(save_dir, tag, state, client_state, save_latest,
+                       async_save, writer, keep_n, fsync, checksums,
+                       retries, retry_backoff_s, retry_jitter_s) -> None:
     import orbax.checkpoint as ocp
 
-    global _async_ckptr, _async_pending
-    path = os.path.abspath(_tag_dir(save_dir, tag))
-    os.makedirs(path, exist_ok=True)
+    global _async_ckptr, _async_thread
+    finalize_async()   # at most one save in flight
+    os.makedirs(save_dir, exist_ok=True)
+    tmp = ft.tmp_dir_for(save_dir, tag)
+    if _is_primary():
+        # clear a crashed previous attempt; non-primary hosts must not
+        # race the shared tmp dir (collective orbax writes use ONE path)
+        shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    step = _infer_step(tag, client_state)
+    retry_kw = dict(attempts=retries, backoff_s=retry_backoff_s,
+                    jitter_s=retry_jitter_s, kind="save")
+
+    def _write_client_state():
+        if _is_primary():
+            with open(os.path.join(tmp, "client_state.json"), "w") as f:
+                json.dump(client_state or {}, f, default=str)
+
+    def _commit_and_publish():
+        if not _is_primary():
+            return
+        with _span("checkpoint/commit"):
+            ft.commit_tag(save_dir, tmp, tag, step=step, fsync=fsync,
+                          checksums=checksums)
+            if save_latest:
+                ft.with_retries(lambda: ft.write_latest(
+                    save_dir, tag, LATEST_FILE, fsync=fsync),
+                    "write_latest", **retry_kw)
+            ft.gc_tags(save_dir, keep_n,
+                       protect=(tag, os.path.basename(tmp)))
+
+    chaos_point("save/pre_write")
     if writer == "fast":
         from deepspeed_tpu.checkpoint.checkpoint_engine import (
             FastCheckpointEngine,
         )
 
-        eng = FastCheckpointEngine()
-        eng.save(state, os.path.join(path, "state_fast"))
-        eng.wait()
-        if _is_primary():
-            with open(os.path.join(path, "client_state.json"), "w") as f:
-                json.dump(client_state or {}, f, default=str)
-            if save_latest:
-                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                    f.write(tag)
+        with _span("checkpoint/save"):
+            def _write_fast():
+                chaos_point("save/write")   # inside the retry loop
+                eng = FastCheckpointEngine()
+                eng.save(state, os.path.join(tmp, "state_fast"))
+                eng.wait()
+
+            ft.with_retries(_write_fast, "write_fast", **retry_kw)
+            chaos_point("save/mid_write")
+            ft.with_retries(_write_client_state, "client_state", **retry_kw)
+            _commit_and_publish()
         return
+
     if async_save:
-        _finalize_async()  # at most one save in flight
         if _async_ckptr is None:
             _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        _async_ckptr.save(os.path.join(path, "state"), state, force=True)
-        _async_pending = path
-    else:
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
-    if _is_primary():
-        with open(os.path.join(path, "client_state.json"), "w") as f:
-            json.dump(client_state or {}, f, default=str)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+        with _span("checkpoint/save"):
+            _async_ckptr.save(os.path.join(tmp, "state"), state, force=True)
+            ft.with_retries(_write_client_state, "client_state", **retry_kw)
+
+        def _finalize():
+            try:
+                _async_ckptr.wait_until_finished()
+                chaos_point("save/mid_write")
+                _commit_and_publish()
+            except BaseException as e:   # surfaced on finalize_async()
+                _async_error.append(e)
+
+        _async_thread = threading.Thread(
+            target=_finalize, name="ckpt-async-commit", daemon=True)
+        _async_thread.start()
+        return
+
+    def _write_orbax():
+        chaos_point("save/write")   # inside the retry loop
+        ocp.PyTreeCheckpointer().save(os.path.join(tmp, "state"), state,
+                                      force=True)
+
+    with _span("checkpoint/save"):
+        ft.with_retries(_write_orbax, "write_orbax", **retry_kw)
+        chaos_point("save/mid_write")
+        ft.with_retries(_write_client_state, "client_state", **retry_kw)
+        _commit_and_publish()
 
 
 def read_latest_tag(load_dir: str) -> Optional[str]:
     latest = os.path.join(load_dir, LATEST_FILE)
     if os.path.exists(latest):
         with open(latest) as f:
-            return f.read().strip()
+            tag = f.read().strip()
+        # an empty/whitespace latest (torn legacy write, truncated copy) is
+        # MISSING, not a real tag — returning "" produced a nonsense path
+        return tag or None
     return None
 
 
+def _resolve_restore_tag(load_dir: str, checksums: bool) -> str:
+    """tag=None resolution: newest committed tag that verifies (walk-back
+    over torn/corrupt heads); legacy ``latest``-file checkpoints without a
+    marker load with a warning."""
+    tag = ft.find_restore_tag(load_dir, checksums=checksums)
+    if tag is not None:
+        latest = read_latest_tag(load_dir)
+        if latest is not None and latest != tag:
+            logger.warning(
+                f"'latest' names {latest!r} but the newest committed+intact "
+                f"tag is {tag!r} — restoring {tag!r} (a crash between "
+                "commit and the latest update, or a corrupt head tag)")
+        return tag
+    legacy = read_latest_tag(load_dir)
+    if legacy is not None and os.path.isdir(_tag_dir(load_dir, legacy)):
+        logger.warning(
+            f"checkpoint tag {legacy!r} predates the commit protocol (no "
+            "COMMITTED marker) — loading WITHOUT integrity verification")
+        return legacy
+    raise FileNotFoundError(
+        f"no committed checkpoint (and no legacy 'latest' tag) in {load_dir}")
+
+
 def load_state(load_dir: str, tag: Optional[str], template_state: PyTree,
-               shardings: PyTree) -> Tuple[PyTree, Dict]:
-    """Restore into the given sharding layout (any mesh topology — UCP behavior)."""
+               shardings: PyTree, verify_checksums: bool = True
+               ) -> Tuple[PyTree, Dict]:
+    """Restore into the given sharding layout (any mesh topology — UCP
+    behavior), verifying the commit manifest first. An explicitly named
+    tag that fails verification raises :class:`CheckpointCorruptError`
+    (the caller asked for *that* data); ``tag=None`` walks back to the
+    newest committed tag that verifies."""
     import orbax.checkpoint as ocp
 
-    _finalize_async()  # a load must observe any in-flight save
-    tag = tag or read_latest_tag(load_dir)
-    if tag is None:
-        raise FileNotFoundError(f"no 'latest' tag file in {load_dir}")
-    path = os.path.abspath(_tag_dir(load_dir, tag))
-    fast_path = os.path.join(path, "state_fast")
-    if os.path.isdir(fast_path):
-        from deepspeed_tpu.checkpoint.checkpoint_engine import (
-            FastCheckpointEngine,
-        )
+    finalize_async()  # a load must observe any in-flight save
+    with _span("checkpoint/load"):
+        if tag is None:
+            tag = _resolve_restore_tag(load_dir, verify_checksums)
+        else:
+            marker = ft.read_marker(load_dir, tag)
+            if marker is None:
+                if not os.path.isdir(_tag_dir(load_dir, tag)):
+                    raise FileNotFoundError(
+                        f"checkpoint tag {tag!r} not found in {load_dir}")
+                logger.warning(
+                    f"checkpoint tag {tag!r} has no COMMITTED marker "
+                    "(pre-protocol save) — loading WITHOUT verification")
+            else:
+                ok, why = ft.verify_tag(load_dir, tag,
+                                        checksums=verify_checksums)
+                if not ok:
+                    raise CheckpointCorruptError(
+                        f"checkpoint tag {tag!r} failed verification: {why} "
+                        "(pass tag=None to walk back to the newest intact "
+                        "committed tag)")
+        path = os.path.abspath(_tag_dir(load_dir, tag))
+        fast_path = os.path.join(path, "state_fast")
+        if os.path.isdir(fast_path):
+            from deepspeed_tpu.checkpoint.checkpoint_engine import (
+                FastCheckpointEngine,
+            )
 
-        restored = FastCheckpointEngine().load(fast_path, template_state)
-        restored = jax.tree.map(
-            lambda x, sh: jax.device_put(x, sh), restored, shardings)
-        client_state: Dict = {}
-        cs_path = os.path.join(path, "client_state.json")
-        if os.path.exists(cs_path):
-            with open(cs_path) as f:
-                client_state = json.load(f)
-        return restored, client_state
-    state_path = os.path.join(path, "state")
-    if not os.path.exists(state_path):
-        raise FileNotFoundError(f"checkpoint not found: {state_path}")
+            restored = FastCheckpointEngine().load(fast_path, template_state)
+            restored = jax.tree.map(
+                lambda x, sh: jax.device_put(x, sh), restored, shardings)
+            return restored, _read_client_state(path)
+        state_path = os.path.join(path, "state")
+        if not os.path.exists(state_path):
+            raise FileNotFoundError(f"checkpoint not found: {state_path}")
 
-    abstract = jax.tree.map(
-        lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
-        template_state, shardings)
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(
-        state_path, args=ocp.args.PyTreeRestore(
-            item=abstract,
-            restore_args=jax.tree.map(
-                lambda a: ocp.ArrayRestoreArgs(sharding=a.sharding, global_shape=a.shape),
-                abstract)))
-    client_state: Dict = {}
+        abstract = jax.tree.map(
+            lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+            template_state, shardings)
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            state_path, args=ocp.args.PyTreeRestore(
+                item=abstract,
+                restore_args=jax.tree.map(
+                    lambda a: ocp.ArrayRestoreArgs(sharding=a.sharding, global_shape=a.shape),
+                    abstract)))
+        return restored, _read_client_state(path)
+
+
+def _read_client_state(path: str) -> Dict:
     cs_path = os.path.join(path, "client_state.json")
     if os.path.exists(cs_path):
         with open(cs_path) as f:
-            client_state = json.load(f)
-    return restored, client_state
+            return json.load(f)
+    return {}
 
 
 def load_16bit_model(save_dir: str, filename: str = "pytorch_model.npz"):
@@ -153,8 +312,9 @@ def load_16bit_model(save_dir: str, filename: str = "pytorch_model.npz"):
     ``save_16bit_model`` output, engine.py:5355)."""
     import json as _json
 
-    import ml_dtypes
     import numpy as _np
+
+    from deepspeed_tpu.checkpoint.checkpoint_engine import resolve_np_dtype
 
     path = os.path.join(save_dir, filename)
     data = dict(_np.load(path))
@@ -163,7 +323,7 @@ def load_16bit_model(save_dir: str, filename: str = "pytorch_model.npz"):
         with open(manifest_path) as f:
             dtypes = _json.load(f)
         for k, dt in dtypes.items():
-            want = ml_dtypes.bfloat16 if dt == "bfloat16" else _np.dtype(dt)
+            want = resolve_np_dtype(dt)
             if data[k].dtype != want:
                 data[k] = data[k].view(want)
     return data

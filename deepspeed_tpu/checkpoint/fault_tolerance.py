@@ -1,0 +1,328 @@
+"""Crash-consistent checkpoint commits: atomic rename, integrity manifest,
+walk-back recovery, retention GC, and transient-I/O retry.
+
+The durability contract (reference: the checkpoint engines' ``wait()``/
+commit semantics, SURVEY §checkpoint — ``TorchCheckpointEngine.commit``,
+``decoupled_checkpoint_engine.py``):
+
+1. every writer lands its payload in ``<root>/<tag>.tmp`` — a name the
+   loader never considers (deterministic across hosts: collective orbax
+   writes need every process on one path);
+2. the payload is fsynced, then a ``COMMITTED`` marker (JSON manifest:
+   step metadata + per-file size/CRC32) is written *inside* the tmp dir
+   with its own write-fsync-rename;
+3. one ``os.rename(tmp, <tag>)`` publishes the tag — POSIX rename is
+   atomic, so a tag dir either has everything + marker or does not exist;
+4. only after the rename does ``latest`` update (itself via
+   write-fsync-rename), closing the async-save window where ``latest``
+   named a checkpoint still in flight.
+
+Recovery inverts the protocol: a tag restores only if its marker is
+present and every manifest entry matches on size (and CRC32 unless
+disabled); a torn/corrupt tag is skipped and the loader walks back to
+the newest tag that verifies.
+
+Every crash window is a named :func:`chaos_point` so the fault-injection
+suite (``tests/unit/test_chaos.py``) can kill a real subprocess inside
+it and prove recovery.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.utils.logging import logger
+
+COMMIT_MARKER = "COMMITTED"
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly requested tag failed integrity verification."""
+
+
+def _counter(name: str, description: str = ""):
+    from deepspeed_tpu import telemetry
+
+    return telemetry.counter(name, description)
+
+
+# --------------------------------------------------------------------- #
+# durability primitives
+# --------------------------------------------------------------------- #
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    # directory fsync makes the rename itself durable; some filesystems
+    # (and CI tmpfs) reject O_RDONLY dir fsync — best-effort there
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def fsync_tree(root: str) -> None:
+    """fsync every file, then every directory bottom-up."""
+    for dirpath, _, names in os.walk(root, topdown=False):
+        for name in names:
+            fsync_file(os.path.join(dirpath, name))
+        fsync_dir(dirpath)
+
+
+def crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    """write-fsync-rename a small text file (marker, ``latest``)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.rename(tmp, path)
+    if fsync:
+        fsync_dir(os.path.dirname(path) or ".")
+
+
+# --------------------------------------------------------------------- #
+# manifest + commit
+# --------------------------------------------------------------------- #
+def tmp_dir_for(root: str, tag: str) -> str:
+    # deterministic across hosts: a multi-host orbax save is COLLECTIVE —
+    # every process must name the same directory (a per-pid suffix would
+    # scatter the shards); the loader never considers .tmp names, and two
+    # concurrent writers to one checkpoint root are unsupported anyway
+    # (they would already race `latest`)
+    return os.path.join(root, f"{tag}.tmp")
+
+
+def is_tmp_name(name: str) -> bool:
+    return ".tmp-" in name or name.endswith(".tmp") or ".old-" in name
+
+
+def build_manifest(tag_dir: str, step: Optional[int] = None,
+                   extra: Optional[Dict[str, Any]] = None,
+                   checksums: bool = True) -> Dict[str, Any]:
+    files: Dict[str, Dict[str, Any]] = {}
+    for dirpath, _, names in os.walk(tag_dir):
+        for name in names:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, tag_dir)
+            if rel == COMMIT_MARKER or rel.startswith(COMMIT_MARKER + ".tmp"):
+                continue
+            info: Dict[str, Any] = {"size": os.path.getsize(full)}
+            if checksums:
+                info["crc32"] = crc32_file(full)
+            files[rel] = info
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "step": step,
+        "wall_time": time.time(),
+        "files": files,
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def commit_tag(root: str, tmp_dir: str, tag: str, step: Optional[int] = None,
+               fsync: bool = True, checksums: bool = True,
+               extra: Optional[Dict[str, Any]] = None) -> str:
+    """Durably publish ``tmp_dir`` as ``<root>/<tag>`` (steps 2-3 of the
+    protocol). Returns the final tag path."""
+    chaos_point("save/pre_commit")
+    if fsync:
+        fsync_tree(tmp_dir)
+    manifest = build_manifest(tmp_dir, step=step, extra=extra,
+                              checksums=checksums)
+    atomic_write_text(os.path.join(tmp_dir, COMMIT_MARKER),
+                      json.dumps(manifest), fsync=fsync)
+    chaos_point("save/pre_rename")
+    final = os.path.join(root, tag)
+    if os.path.exists(final):
+        # overwrite via rename-swap: the tag is never observable half-new.
+        # A crash between the renames loses this tag entirely — the loader
+        # then walks back to an older committed tag, which is the contract.
+        trash = os.path.join(root, f"{tag}.old-{os.getpid()}")
+        shutil.rmtree(trash, ignore_errors=True)
+        os.rename(final, trash)
+        os.rename(tmp_dir, final)
+        shutil.rmtree(trash, ignore_errors=True)
+    else:
+        os.rename(tmp_dir, final)
+    if fsync:
+        fsync_dir(root)
+    return final
+
+
+def write_latest(root: str, tag: str, latest_file: str = "latest",
+                 fsync: bool = True) -> None:
+    chaos_point("save/pre_latest")
+    atomic_write_text(os.path.join(root, latest_file), tag, fsync=fsync)
+
+
+# --------------------------------------------------------------------- #
+# verification + recovery
+# --------------------------------------------------------------------- #
+def read_marker(root: str, tag: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(root, tag, COMMIT_MARKER)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning(f"unreadable commit marker {path}: {e}")
+        return None
+
+
+def verify_tag(root: str, tag: str, checksums: bool = True
+               ) -> Tuple[bool, str]:
+    """Integrity check of a published tag against its commit manifest."""
+    marker = read_marker(root, tag)
+    if marker is None:
+        return False, "no COMMITTED marker (torn or pre-protocol save)"
+    tag_dir = os.path.join(root, tag)
+    for rel, info in marker.get("files", {}).items():
+        full = os.path.join(tag_dir, rel)
+        if not os.path.exists(full):
+            return False, f"missing file {rel!r}"
+        size = os.path.getsize(full)
+        if size != info.get("size"):
+            return False, (f"size mismatch for {rel!r}: "
+                           f"{size} != {info.get('size')}")
+        if checksums and "crc32" in info and crc32_file(full) != info["crc32"]:
+            return False, f"checksum mismatch for {rel!r}"
+    return True, "ok"
+
+
+def committed_tags(root: str) -> List[str]:
+    """Tags carrying a commit marker, newest first (marker step, then
+    marker wall time)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    for name in names:
+        if is_tmp_name(name) or not os.path.isdir(os.path.join(root, name)):
+            continue
+        marker = read_marker(root, name)
+        if marker is None:
+            continue
+        step = marker.get("step")
+        out.append((step if isinstance(step, (int, float)) else -1,
+                    marker.get("wall_time") or 0.0, name))
+    out.sort(reverse=True)
+    return [name for _, _, name in out]
+
+
+def find_restore_tag(root: str, checksums: bool = True,
+                     exclude: Tuple[str, ...] = ()) -> Optional[str]:
+    """Newest committed tag that passes verification — the walk-back the
+    loader relies on when the head tag is torn or corrupt."""
+    for tag in committed_tags(root):
+        if tag in exclude:
+            continue
+        ok, why = verify_tag(root, tag, checksums=checksums)
+        if ok:
+            return tag
+        _counter("checkpoint_verify_failures_total",
+                 "published tags that failed integrity verification"
+                 ).inc(reason="corrupt")
+        logger.warning(
+            f"checkpoint tag {tag!r} failed verification ({why}) — "
+            "walking back to an older committed tag")
+    return None
+
+
+# --------------------------------------------------------------------- #
+# retention GC
+# --------------------------------------------------------------------- #
+def gc_tags(root: str, keep_n: int,
+            protect: Tuple[str, ...] = ()) -> int:
+    """Keep the newest ``keep_n`` committed tags; remove the rest plus any
+    stale tmp/old dirs from crashed writers. ``keep_n <= 0`` keeps all
+    (tmp-dir cleanup still runs). Returns the number of dirs removed."""
+    removed = 0
+    try:
+        names = os.listdir(root)
+    except (FileNotFoundError, NotADirectoryError):
+        return 0
+    for name in names:
+        # stale tmp/old dirs from crashed writers. Safe to reap
+        # unconditionally: GC runs only on the primary right after ITS OWN
+        # commit published (so no tmp of this run can be live — save_state
+        # allows one save in flight), and concurrent independent writers
+        # to one root are unsupported (they'd race `latest`).
+        full = os.path.join(root, name)
+        if is_tmp_name(name) and os.path.isdir(full) and name not in protect:
+            shutil.rmtree(full, ignore_errors=True)
+            removed += 1
+    if keep_n > 0:
+        tags = committed_tags(root)
+        for tag in tags[keep_n:]:
+            if tag in protect:
+                continue
+            shutil.rmtree(os.path.join(root, tag), ignore_errors=True)
+            removed += 1
+    if removed:
+        _counter("checkpoint_gc_removed_total",
+                 "checkpoint dirs removed by retention GC "
+                 "(old tags + stale tmp dirs)").inc(removed)
+    return removed
+
+
+# --------------------------------------------------------------------- #
+# transient-I/O retry
+# --------------------------------------------------------------------- #
+def with_retries(fn, what: str, attempts: int = 3, backoff_s: float = 0.2,
+                 jitter_s: float = 0.2, kind: str = "save"):
+    """Run ``fn`` with exponential backoff + jitter on OSError (covers
+    IOError and injected :class:`~deepspeed_tpu.testing.chaos.ChaosError`).
+    Counts every retry and every exhausted failure."""
+    attempts = max(1, int(attempts))
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt + 1 >= attempts:
+                _counter(f"checkpoint_{kind}_failures_total",
+                         f"checkpoint {kind} operations that exhausted "
+                         "their retries").inc(op=what)
+                raise
+            _counter(f"checkpoint_{kind}_retries_total",
+                     f"transient-I/O retries on checkpoint {kind} paths"
+                     ).inc(op=what)
+            delay = backoff_s * (2 ** attempt) + random.random() * jitter_s
+            logger.warning(
+                f"checkpoint {kind} {what!r} failed ({e}); retry "
+                f"{attempt + 1}/{attempts - 1} in {delay:.2f}s")
+            time.sleep(delay)

@@ -69,7 +69,8 @@ class BlockAllocator:
 class _Seq:
     """Host-side descriptor (reference ``sequence_descriptor.py``)."""
 
-    def __init__(self, uid: int, prompt: List[int], max_blocks: int):
+    def __init__(self, uid: int, prompt: List[int], max_blocks: int,
+                 deadline_s: Optional[float] = None):
         self.uid = uid
         self.prompt = prompt
         self.prefilled = 0            # prompt tokens written to cache
@@ -81,6 +82,10 @@ class _Seq:
         self.done = False
         self.admit_t = time.perf_counter()    # TTFT anchor (telemetry)
         self.first_tok_seen = False
+        # absolute expiry (perf_counter clock); None = no deadline
+        self.deadline = (self.admit_t + deadline_s
+                         if deadline_s is not None else None)
+        self.expired = False
 
     @property
     def prefill_remaining(self) -> int:
@@ -97,7 +102,8 @@ class FastGenEngine:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  use_pallas_kernel: Optional[bool] = None,
-                 tp: Optional[bool] = None, **overrides):
+                 tp: Optional[bool] = None,
+                 request_deadline_s: Optional[float] = None, **overrides):
         if isinstance(cfg, str):
             cfg = T.get_model_config(cfg, **overrides)
         self.cfg = cfg
@@ -115,6 +121,11 @@ class FastGenEngine:
         self.max_len = min(block_size * max_blocks_per_seq, cfg.max_seq_len)
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self.eos_token_id = eos_token_id
+        # default per-request deadline (seconds from admission; None = no
+        # deadline): expired requests are dropped at the next scheduling
+        # tick so one stuck/abandoned client can't pin KV blocks and
+        # queue slots forever. put() can override per request.
+        self.request_deadline_s = request_deadline_s
 
         self.allocator = BlockAllocator(n_blocks)
         self.pool = PG.init_paged_kv(cfg, n_blocks, block_size)
@@ -233,6 +244,10 @@ class FastGenEngine:
         self._tm_preempt = telemetry.counter(
             "fastgen_preemptions_total",
             "sequences deferred a tick by KV-pool backpressure")
+        self._tm_deadline = telemetry.counter(
+            "fastgen_deadline_expired_total",
+            "requests dropped past their deadline, by state at expiry "
+            "(waiting=still prefilling, running=decoding)")
         self._tm_evict = telemetry.counter(
             "fastgen_evicted_blocks_total",
             "KV blocks released at sequence finish/flush")
@@ -409,6 +424,7 @@ class FastGenEngine:
         bounded leave it False.
         """
         self._assert_stream_drained()
+        self._expire_deadlines()
         live = [self.seqs[u] for u in self._admit_order
                 if u in self.seqs and not self.seqs[u].done]
         if not live or any(s.prefill_remaining > 0 or s.last_tok is None
@@ -544,6 +560,11 @@ class FastGenEngine:
         last = None
         try:
             while True:
+                # deadline expiry changes the live set, which breaks the
+                # chain below and drains — same contract as a flush()
+                # mid-stream (the in-flight window's rows for an expired
+                # sequence fold into a _note_token no-op)
+                self._expire_deadlines()
                 live = [self.seqs[u] for u in self._admit_order
                         if u in self.seqs and not self.seqs[u].done]
                 n = self._fit_decode_tier(live, window)
@@ -660,14 +681,20 @@ class FastGenEngine:
                 "decode_stream window in flight — exhaust or close the "
                 "stream before step()/decode_steps()/put()")
 
-    def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]]) -> None:
+    def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]],
+            deadline_s: Optional[float] = None) -> None:
         """Admit sequences — host bookkeeping ONLY (no device dispatch, no
         compile). Prefill happens chunked inside subsequent ``step()`` ticks
-        (reference ``put`` :107 + SplitFuse chunking)."""
+        (reference ``put`` :107 + SplitFuse chunking). ``deadline_s``
+        overrides the engine's ``request_deadline_s`` for this admission
+        batch: past the deadline the request is dropped at the next
+        scheduling tick (``fastgen_deadline_expired_total``)."""
         # NOT guarded by _assert_stream_drained: mid-stream admission is a
         # documented pattern (decode_stream drains + returns when the live
         # set changes) and put() is host bookkeeping only — it cannot
         # observe the optimistic s.pos/last_tok skew
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
         for uid, prompt in zip(uids, prompts):
             prompt = list(prompt)
             if uid in self.seqs:
@@ -676,9 +703,33 @@ class FastGenEngine:
             if len(prompt) >= self.max_len:
                 raise ValueError(
                     f"prompt len {len(prompt)} >= max_len {self.max_len}")
-            self.seqs[uid] = _Seq(uid, prompt, self.max_blocks_per_seq)
+            self.seqs[uid] = _Seq(uid, prompt, self.max_blocks_per_seq,
+                                  deadline_s=deadline_s)
             self._admit_order.append(uid)
         self._tm_sched_gauges()
+
+    def _expire_deadlines(self) -> int:
+        """Drop live sequences past their deadline (blocks freed, marked
+        done+expired) — the scheduler-side half of request cancellation.
+        Runs at every dynamic scheduling entry point; a dropped request
+        answers ``query()`` with done=True and whatever it generated."""
+        now = time.perf_counter()
+        n = 0
+        for seq in self.seqs.values():
+            if seq.done or seq.deadline is None or now <= seq.deadline:
+                continue
+            state = "waiting" if seq.prefill_remaining > 0 else "running"
+            seq.expired = True
+            self._finish(seq)
+            self._tm_deadline.inc(state=state)
+            n += 1
+        if n:
+            self._tm_sched_gauges()
+        return n
+
+    def expired(self, uid: int) -> bool:
+        """Whether ``uid`` was dropped by deadline expiry."""
+        return self.seqs[uid].expired
 
     def _ensure_blocks(self, seq: _Seq, upto_pos: int) -> bool:
         """Grow the sequence's block table to cover ``upto_pos``. Returns
@@ -699,6 +750,7 @@ class FastGenEngine:
         under the token budget. Returns {uid: sampled token} for sequences
         that produced one this tick."""
         self._assert_stream_drained()
+        self._expire_deadlines()
         live = [self.seqs[u] for u in self._admit_order
                 if u in self.seqs and not self.seqs[u].done]
         need = sum(1 for s in live

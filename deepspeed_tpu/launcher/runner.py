@@ -44,6 +44,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--bind_core_list", default=None,
                    help="explicit comma/range core list to bind (e.g. "
                         "'0-7,16-23'); implies --bind_cores_to_rank")
+    p.add_argument("--resume_dir", default=None,
+                   help="checkpoint root for fault tolerance: exported as "
+                        "DSTPU_RESUME_DIR, consumed by the engine's "
+                        "fault_tolerance config as the default resume/"
+                        "emergency-checkpoint dir")
+    p.add_argument("--auto_resume", action="store_true",
+                   help="resume from the newest committed checkpoint in "
+                        "--resume_dir at initialize (exported as "
+                        "DSTPU_AUTO_RESUME=1); a missing/empty dir is a "
+                        "cold start — the restart-after-preemption loop "
+                        "can always pass this flag")
     p.add_argument("--module", action="store_true",
                    help="run the target as a python module (python -m)")
     p.add_argument("script", help="training script (or module with --module)")
@@ -108,9 +119,19 @@ def bind_cores(args: argparse.Namespace) -> None:
     logger.info(f"bound to {len(want)} host cores: {want[0]}-{want[-1]}")
 
 
+def export_fault_tolerance_env(args: argparse.Namespace) -> None:
+    """Fault-tolerance flags → env (read by ``runtime/config.load_config``
+    as section defaults; explicit JSON settings win)."""
+    if args.resume_dir:
+        os.environ["DSTPU_RESUME_DIR"] = os.path.abspath(args.resume_dir)
+    if args.auto_resume:
+        os.environ["DSTPU_AUTO_RESUME"] = "1"
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
     bind_cores(args)
+    export_fault_tolerance_env(args)
     maybe_init_distributed(args)
     sys.argv = [args.script] + args.script_args
     if args.module:

@@ -173,6 +173,65 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class CheckpointSectionConfig:
+    """Durable-checkpoint knobs (``checkpoint/fault_tolerance.py``).
+
+    Every save commits atomically: tmp-dir write → fsync → ``COMMITTED``
+    manifest (per-file size + CRC32 + step) → rename → ``latest``.
+    ``writer`` supersedes the legacy top-level ``checkpoint_writer`` when
+    set. ``keep_n`` prunes all but the newest N committed tags after each
+    commit (0 = keep everything). ``verify_checksums=False`` skips the
+    CRC pass on load/walk-back (size + marker checks remain). Transient
+    I/O errors retry ``save_retries`` times with exponential backoff
+    (``retry_backoff_s`` doubling) + uniform jitter (``retry_jitter_s``)."""
+    writer: Optional[str] = None   # orbax | fast (None → checkpoint_writer)
+    keep_n: int = 0
+    verify_checksums: bool = True
+    fsync: bool = True
+    save_retries: int = 3
+    retry_backoff_s: float = 0.2
+    retry_jitter_s: float = 0.2
+
+    def validate(self) -> None:
+        if self.writer not in (None, "orbax", "fast"):
+            raise DeepSpeedConfigError(
+                f"checkpoint.writer must be orbax|fast, got {self.writer!r}"
+                " (a typo would silently fall back to the orbax path)")
+
+
+@dataclasses.dataclass
+class FaultToleranceConfig:
+    """Preemption-safe training (``runtime/engine.py`` handlers).
+
+    ``resume_dir`` is the checkpoint root used for ``auto_resume`` and
+    emergency saves (env ``DSTPU_RESUME_DIR`` supplies a default — set by
+    ``launcher --resume_dir``). ``auto_resume=True`` makes ``initialize``
+    restore the newest committed checkpoint there (step + RNG + scheduler
+    client state) before returning; a missing/empty dir is a cold start,
+    not an error (env ``DSTPU_AUTO_RESUME=1`` also enables this).
+    ``graceful_preemption`` installs a SIGTERM handler that drains any
+    in-flight async save, writes an emergency checkpoint, and exits 0 —
+    the preemptible-VM contract; it arms only when ``resume_dir`` or
+    ``auto_resume`` is also set (a handler with nowhere to save would
+    change process signal behavior for nothing). ``on_stall="checkpoint"`` escalates the
+    telemetry stall watchdog from a log line to an emergency checkpoint
+    of the last completed state."""
+    # tri-state so env defaults can't override an EXPLICIT false in the
+    # JSON (None = unset → falsy, env DSTPU_AUTO_RESUME may enable)
+    auto_resume: Optional[bool] = None
+    resume_dir: Optional[str] = None
+    graceful_preemption: bool = True
+    emergency_tag_prefix: str = "emergency"
+    on_stall: str = "log"   # log | checkpoint
+
+    def validate(self) -> None:
+        if self.on_stall not in ("log", "checkpoint"):
+            raise DeepSpeedConfigError(
+                f"fault_tolerance.on_stall must be log|checkpoint, "
+                f"got {self.on_stall!r}")
+
+
+@dataclasses.dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing`` config. On TPU this selects a
     ``jax.checkpoint`` (remat) policy applied to the per-layer scan."""
@@ -337,7 +396,7 @@ class ProgressiveLayerDropConfig:
 _IGNORED_SECTIONS = (
     "amp", "autotuning", "aio", "hybrid_engine", "compression_training",
     "sparse_attention", "zero_allow_untested_optimizer", "communication_data_type",
-    "elasticity", "checkpoint",
+    "elasticity",
 )
 
 
@@ -377,6 +436,10 @@ class DeepSpeedTPUConfig:
     zero_force_ds_cpu_optimizer: bool = False
     checkpoint_tag_validation: str = "Warn"  # Ignore | Warn | Fail
     checkpoint_writer: str = "orbax"  # orbax | fast (checkpoint_engine.py)
+    checkpoint: CheckpointSectionConfig = dataclasses.field(
+        default_factory=CheckpointSectionConfig)
+    fault_tolerance: FaultToleranceConfig = dataclasses.field(
+        default_factory=FaultToleranceConfig)
     data_efficiency: DataEfficiencyConfig = dataclasses.field(
         default_factory=DataEfficiencyConfig)
     # legacy top-level section (reference supports both placements)
@@ -402,6 +465,12 @@ class DeepSpeedTPUConfig:
     @property
     def zero_enabled(self) -> bool:
         return self.zero_optimization.stage > 0
+
+    @property
+    def effective_checkpoint_writer(self) -> str:
+        """``checkpoint.writer`` when set, else the legacy top-level
+        ``checkpoint_writer`` (both spellings stay valid)."""
+        return self.checkpoint.writer or self.checkpoint_writer
 
     @property
     def precision_dtype(self) -> str:
@@ -461,7 +530,19 @@ def load_config(config) -> DeepSpeedTPUConfig:
         if section in config:
             logger.warning(f"config section {section!r} is not applicable on TPU — ignored")
             config.pop(section)
-    return config_from_dict(DeepSpeedTPUConfig, config)
+    cfg = config_from_dict(DeepSpeedTPUConfig, config)
+    # launcher/env defaults (deepspeed_tpu.launcher --resume_dir /
+    # --auto_resume): explicit JSON settings always win
+    import os as _os
+
+    env_dir = _os.environ.get("DSTPU_RESUME_DIR")
+    if env_dir and cfg.fault_tolerance.resume_dir is None:
+        cfg.fault_tolerance.resume_dir = env_dir
+    if cfg.fault_tolerance.auto_resume is None and \
+            _os.environ.get("DSTPU_AUTO_RESUME", "").lower() in \
+            ("1", "true", "yes"):
+        cfg.fault_tolerance.auto_resume = True
+    return cfg
 
 
 # Back-compat alias matching the reference class name.

@@ -310,6 +310,17 @@ class DeepSpeedTPUEngine:
             steps_per_output=self.config.steps_per_print)
         self._last_metrics_dev: Dict[str, jax.Array] = {}
         self.monitor = None  # attached by initialize() when configured
+
+        # fault tolerance (config "fault_tolerance"; README "Fault
+        # tolerance"): preemption flag checked at step boundaries, a lock
+        # serializing emergency saves (watchdog thread vs signal handler),
+        # and the last save_checkpoint dir as the emergency fallback root
+        self._preempt_requested = False
+        self._in_step = False
+        self._saving = False
+        self._ft_lock = threading.Lock()
+        self._last_save_dir: Optional[str] = None
+        self._prev_sig_handlers: Dict[int, Any] = {}
         self._setup_telemetry()
 
         # EP-dispatch drop visibility: under an 'expert' mesh axis the ragged
@@ -573,8 +584,21 @@ class DeepSpeedTPUEngine:
                     f"telemetry /metrics endpoint on port {tcfg.http_port} "
                     f"failed to start ({e}); continuing without it")
         if tcfg.stall_deadline_s > 0:
+            on_stall = None
+            if self.config.fault_tolerance.on_stall == "checkpoint":
+                # escalate detection → response: checkpoint the LAST
+                # COMPLETED state from the watchdog thread (self.state is
+                # immutable jax arrays, replaced only at step boundaries —
+                # a stalled step by definition hasn't replaced it)
+                wref = weakref.ref(self)
+
+                def on_stall():
+                    eng = wref()
+                    if eng is not None:
+                        eng._emergency_save("stall")
+
             self._watchdog = telemetry.StallWatchdog(
-                tcfg.stall_deadline_s, self._tm).start()
+                tcfg.stall_deadline_s, self._tm, on_stall=on_stall).start()
 
     def _chip_peak_flops(self) -> Optional[float]:
         from deepspeed_tpu.utils.chip_specs import chip_peak_tflops
@@ -1588,36 +1612,43 @@ class DeepSpeedTPUEngine:
             self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         t0 = time.perf_counter()
-        with self._train_span("train_step"):
-            if self._host_runner is not None:
-                # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
-                _, metrics = self._host_runner.train_batch(batch, gas)
-            else:
-                if self._offload_opt:
-                    self._opt_swap("in")
-                if self._offload_nvme:
-                    self._nvme_swapper().swap_in_optimizer()
-                if self._offload_param_nvme:
-                    self._param_nvme_swapper().swap_in_params()
-                self._ensure_master_tier_for_step()
-                with self.mesh:
-                    self.state, metrics = step_fn(self.state, batch)
-                if self._offload_opt:
-                    self._opt_swap("out")
-                if self._offload_nvme:
-                    self._nvme_swapper().swap_out_optimizer()
-                if self._offload_param:
-                    self._park_master()
-                if self._offload_param_nvme:
-                    self._param_nvme_swapper().swap_out_params()
-        self.global_steps += 1
-        self.micro_steps += gas
-        self._after_step(metrics, wall_s=time.perf_counter() - t0,
-                         tokens=self._count_tokens(stacked)
-                         if self._tm is not None else 0)
-        if self.config.wall_clock_breakdown:
-            self.timers(TRAIN_BATCH_TIMER).stop()
-            self.timers.log([TRAIN_BATCH_TIMER])
+        self._in_step = True   # a preemption signal now defers to the
+        try:                   # boundary check below
+            with self._train_span("train_step"):
+                if self._host_runner is not None:
+                    # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
+                    _, metrics = self._host_runner.train_batch(batch, gas)
+                else:
+                    if self._offload_opt:
+                        self._opt_swap("in")
+                    if self._offload_nvme:
+                        self._nvme_swapper().swap_in_optimizer()
+                    if self._offload_param_nvme:
+                        self._param_nvme_swapper().swap_in_params()
+                    self._ensure_master_tier_for_step()
+                    with self.mesh:
+                        self.state, metrics = step_fn(self.state, batch)
+                    if self._offload_opt:
+                        self._opt_swap("out")
+                    if self._offload_nvme:
+                        self._nvme_swapper().swap_out_optimizer()
+                    if self._offload_param:
+                        self._park_master()
+                    if self._offload_param_nvme:
+                        self._param_nvme_swapper().swap_out_params()
+            self.global_steps += 1
+            self.micro_steps += gas
+            self._after_step(metrics, wall_s=time.perf_counter() - t0,
+                             tokens=self._count_tokens(stacked)
+                             if self._tm is not None else 0)
+            if self.config.wall_clock_breakdown:
+                self.timers(TRAIN_BATCH_TIMER).stop()
+                self.timers.log([TRAIN_BATCH_TIMER])
+        finally:
+            # even a raising step must re-enable immediate preemption
+            # handling (a deferred SIGTERM would otherwise wait forever)
+            self._in_step = False
+        self._check_preemption_boundary()
         return metrics["loss"]
 
     def train_batches(self, data_iter: Iterator[PyTree],
@@ -1662,18 +1693,23 @@ class DeepSpeedTPUEngine:
         batch = self._shard_batch(big, leading=2)
         self.tput_timer.start()
         t0 = time.perf_counter()
-        with self._train_span("train_window"):
-            self._ensure_master_tier_for_step()
-            with self.mesh:
-                self.state, metrics = self._compiled[key](self.state, batch)
-            if self._offload_param:
-                self._park_master()
-        self.global_steps += n_steps
-        self.micro_steps += gas * n_steps
-        self._after_step(metrics, n_steps=n_steps,
-                         wall_s=time.perf_counter() - t0,
-                         tokens=self._count_tokens(big)
-                         if self._tm is not None else 0)
+        self._in_step = True
+        try:
+            with self._train_span("train_window"):
+                self._ensure_master_tier_for_step()
+                with self.mesh:
+                    self.state, metrics = self._compiled[key](self.state, batch)
+                if self._offload_param:
+                    self._park_master()
+            self.global_steps += n_steps
+            self.micro_steps += gas * n_steps
+            self._after_step(metrics, n_steps=n_steps,
+                             wall_s=time.perf_counter() - t0,
+                             tokens=self._count_tokens(big)
+                             if self._tm is not None else 0)
+        finally:
+            self._in_step = False
+        self._check_preemption_boundary()
         return metrics["loss"]
 
     def _record_moe_drops(self, frac) -> None:
@@ -1816,24 +1852,29 @@ class DeepSpeedTPUEngine:
                 apply, out_shardings=(state_sh, None), donate_argnums=(0, 1))
         if self.config.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
-        if self._offload_opt:
-            self._opt_swap("in")
-        self._materialize_master()
-        with self.mesh:
-            self.state, metrics = self._compiled["apply"](self.state, self._grad_buffer)
-        if self._offload_opt:
-            self._opt_swap("out")
-        if self._offload_param:
-            self._park_master()
-        if self._offload_param_nvme:
-            self._param_nvme_swapper().swap_out_params()
-        self._grad_buffer = None
-        self.global_steps += 1
-        self._after_step(metrics)
-        if self.config.wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).stop()
-            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
-                             STEP_GLOBAL_TIMER])
+        self._in_step = True   # preemption defers to the boundary check
+        try:
+            if self._offload_opt:
+                self._opt_swap("in")
+            self._materialize_master()
+            with self.mesh:
+                self.state, metrics = self._compiled["apply"](self.state, self._grad_buffer)
+            if self._offload_opt:
+                self._opt_swap("out")
+            if self._offload_param:
+                self._park_master()
+            if self._offload_param_nvme:
+                self._param_nvme_swapper().swap_out_params()
+            self._grad_buffer = None
+            self.global_steps += 1
+            self._after_step(metrics)
+            if self.config.wall_clock_breakdown:
+                self.timers(STEP_GLOBAL_TIMER).stop()
+                self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                                 STEP_GLOBAL_TIMER])
+        finally:
+            self._in_step = False
+        self._check_preemption_boundary()
 
     def eval_batch(self, batch: PyTree) -> jax.Array:
         if self._host_runner is not None:
@@ -1935,6 +1976,134 @@ class DeepSpeedTPUEngine:
         return it
 
     # ------------------------------------------------------------------ #
+    # fault tolerance: preemption handling + emergency checkpoints
+    # (config "fault_tolerance"; README "Fault tolerance")
+    # ------------------------------------------------------------------ #
+    def enable_preemption_handler(self, signals=None) -> bool:
+        """Install the graceful-preemption signal handler (SIGTERM by
+        default — what GCE/GKE send a preempted VM). On delivery the
+        engine drains any in-flight async save, writes an emergency
+        checkpoint, and exits 0; a signal landing mid-step defers to the
+        step boundary (interrupting a dispatched XLA program to do I/O
+        from the handler frame is not safe). Returns False off the main
+        thread (signal.signal would raise there)."""
+        import signal
+
+        signals = signals or (signal.SIGTERM,)
+        try:
+            for s in signals:
+                self._prev_sig_handlers[s] = signal.signal(
+                    s, self._on_preempt_signal)
+        except ValueError:   # not the main thread
+            logger.warning("preemption handler not installed (not on the "
+                           "main thread)")
+            return False
+        log_dist(f"graceful-preemption handler armed for "
+                 f"{[signal.Signals(s).name for s in signals]}")
+        return True
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        self._preempt_requested = True
+        busy = self._in_step or self._saving
+        logger.warning(
+            f"received signal {signum}: preemption imminent — will drain "
+            "saves, write an emergency checkpoint, and exit cleanly"
+            + (" (deferred to the step/save boundary)" if busy else ""))
+        # a signal-handler frame interrupting a dispatched step or an
+        # in-flight save must not reenter checkpoint I/O (same-thread
+        # reentrancy into save_state) — defer to the boundary checks
+        if not busy:
+            self._preemption_exit()
+
+    def _preemption_exit(self) -> None:
+        """Drain → emergency save → clean exit (SystemExit(0) unwinds the
+        training loop; preemption is a normal lifecycle event, not a
+        failure)."""
+        self._preempt_requested = False   # the exit is running — don't recurse
+        from deepspeed_tpu.checkpoint.engine import finalize_async
+
+        try:
+            finalize_async()
+        except Exception as e:
+            logger.warning(f"async-save drain during preemption failed: {e}")
+        self._emergency_save("preemption")
+        self.shutdown_telemetry()
+        log_dist("preemption: emergency checkpoint committed — exiting 0")
+        raise SystemExit(0)
+
+    def preemption_requested(self) -> bool:
+        """Cooperative check for training loops that manage their own
+        shutdown (the handler already exits at the next step boundary)."""
+        return self._preempt_requested
+
+    def _emergency_save(self, reason: str) -> Optional[str]:
+        """Synchronous committed checkpoint into the fault-tolerance
+        resume dir (fallback: the last ``save_checkpoint`` dir). Non-
+        blocking lock: a second trigger while one save runs (watchdog
+        thread vs signal handler) is dropped, not deadlocked."""
+        if not self._ft_lock.acquire(blocking=False):
+            return None
+        try:
+            ftc = self.config.fault_tolerance
+            save_dir = ftc.resume_dir or self._last_save_dir
+            if not save_dir:
+                logger.error(
+                    f"emergency checkpoint ({reason}) skipped: no "
+                    "fault_tolerance.resume_dir and no prior save dir")
+                return None
+            tag = f"{ftc.emergency_tag_prefix}_step{self.global_steps}"
+            from deepspeed_tpu import telemetry
+
+            telemetry.counter(
+                "checkpoint_emergency_saves_total",
+                "emergency checkpoints by trigger (preemption/stall)"
+            ).inc(reason=reason)
+            try:
+                self.save_checkpoint(save_dir, tag=tag, async_save=False)
+            except Exception as e:
+                logger.error(f"emergency checkpoint ({reason}) FAILED: {e}")
+                return None
+            return tag
+        finally:
+            self._ft_lock.release()
+
+    def maybe_auto_resume(self) -> bool:
+        """``fault_tolerance.auto_resume``: restore the newest committed
+        checkpoint from ``resume_dir`` (called by ``initialize``). A
+        missing/empty dir is a cold start, not an error."""
+        ftc = self.config.fault_tolerance
+        if not ftc.auto_resume:
+            return False
+        if not ftc.resume_dir:
+            logger.warning("auto_resume=true but no fault_tolerance."
+                           "resume_dir — cold start")
+            return False
+        from deepspeed_tpu.checkpoint.engine import read_latest_tag
+        from deepspeed_tpu.checkpoint.fault_tolerance import find_restore_tag
+
+        ckcfg = self.config.checkpoint
+        has_ckpt = (find_restore_tag(
+            ftc.resume_dir, checksums=ckcfg.verify_checksums) is not None
+            or read_latest_tag(ftc.resume_dir) is not None)
+        if not has_ckpt:
+            log_dist(f"auto_resume: no checkpoint in {ftc.resume_dir} — "
+                     "cold start")
+            return False
+        self.load_checkpoint(ftc.resume_dir)
+        log_dist(f"auto_resume: restored step {self.global_steps} from "
+                 f"{ftc.resume_dir}")
+        return True
+
+    def _check_preemption_boundary(self) -> None:
+        """Step/save-boundary half of the deferred preemption handshake.
+        Main thread only: SystemExit from a worker thread (e.g. a
+        watchdog-thread save that finished while preemption was pending)
+        would kill that thread, not the process."""
+        if self._preempt_requested and \
+                threading.current_thread() is threading.main_thread():
+            self._preemption_exit()
+
+    # ------------------------------------------------------------------ #
     # checkpointing (reference engine.py:4557 / :4079)
     # ------------------------------------------------------------------ #
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
@@ -1956,12 +2125,26 @@ class DeepSpeedTPUEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
             "curriculum": (self._curriculum.state_dict()
                            if self._curriculum else None),
+            # host RNG (data-efficiency sampling: PLD masks, LTD indices) —
+            # auto_resume must not replay or skip sampled randomness
+            "np_rng": self._np_rng.bit_generator.state,
         })
-        save_state(save_dir, tag, self.state, client_state,
-                   save_latest=save_latest, async_save=async_save,
-                   writer=self.config.checkpoint_writer)
+        ck = self.config.checkpoint
+        self._saving = True   # a preemption signal mid-save defers here
+        try:
+            save_state(save_dir, tag, self.state, client_state,
+                       save_latest=save_latest, async_save=async_save,
+                       writer=self.config.effective_checkpoint_writer,
+                       keep_n=ck.keep_n, fsync=ck.fsync,
+                       checksums=ck.verify_checksums, retries=ck.save_retries,
+                       retry_backoff_s=ck.retry_backoff_s,
+                       retry_jitter_s=ck.retry_jitter_s)
+        finally:
+            self._saving = False
+        self._last_save_dir = save_dir
         log_dist(f"saved checkpoint {save_dir}/{tag}"
-                 + (" (async, in flight)" if async_save else ""))
+                 + (" (async, commit in flight)" if async_save else ""))
+        self._check_preemption_boundary()
 
     def save_16bit_model(self, save_dir: str,
                          save_filename: str = "pytorch_model.npz") -> None:
@@ -2009,7 +2192,8 @@ class DeepSpeedTPUEngine:
             # there would transiently double optimizer-state HBM
             self._opt_swapper.swap_in_optimizer()
         state, client_state = load_state(
-            load_dir, tag, self.state, self._state_shardings())
+            load_dir, tag, self.state, self._state_shardings(),
+            verify_checksums=self.config.checkpoint.verify_checksums)
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
         self.state = state
@@ -2038,6 +2222,12 @@ class DeepSpeedTPUEngine:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         if self._curriculum is not None and client_state.get("curriculum"):
             self._curriculum.load_state_dict(client_state["curriculum"])
+        if client_state.get("np_rng"):
+            try:
+                self._np_rng.bit_generator.state = client_state["np_rng"]
+            except (TypeError, ValueError) as e:
+                logger.warning(f"host RNG state in checkpoint not "
+                               f"restorable ({e}) — fresh stream")
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state
 

@@ -62,6 +62,13 @@ class StallWatchdog:
     a wedged run is "what was it doing last". Recovery re-arms the warning.
     A ``telemetry_stalls_total`` counter makes stall history scrapeable.
 
+    ``on_stall``: optional escalation callback fired (once per stall
+    episode, on the watchdog thread) after the warning — the training
+    engine hooks its emergency-checkpoint path here when
+    ``fault_tolerance.on_stall == "checkpoint"``, turning detection into
+    response. A raising callback is counted
+    (``telemetry_stall_action_errors_total``) and never kills the thread.
+
     The deadline ARMS at the first beat: the watchdog monitors steady-state
     training, and the first step's XLA compile routinely exceeds any sane
     step deadline — firing during legitimate compilation would put a false
@@ -71,7 +78,7 @@ class StallWatchdog:
     """
 
     def __init__(self, deadline_s: float, registry: MetricsRegistry,
-                 name: str = "train", logger=None):
+                 name: str = "train", logger=None, on_stall=None):
         if deadline_s <= 0:
             raise ValueError("StallWatchdog needs a positive deadline")
         self.deadline_s = float(deadline_s)
@@ -82,6 +89,7 @@ class StallWatchdog:
 
             logger = _l
         self.logger = logger
+        self.on_stall = on_stall
         self._last_beat = time.time()
         self._armed = False   # first beat arms the deadline (see class doc)
         self._stalled = False
@@ -131,6 +139,16 @@ class StallWatchdog:
             f"[watchdog:{self.name}] no step finished in "
             f"{now - self._last_beat:.1f}s (deadline {self.deadline_s:.1f}s) "
             f"— {where}")
+        if self.on_stall is not None:
+            try:
+                self.on_stall()
+            except Exception as e:
+                self.registry.counter(
+                    "telemetry_stall_action_errors_total",
+                    "on_stall escalation callbacks that raised"
+                ).inc(error=type(e).__name__)
+                self.logger.warning(
+                    f"[watchdog:{self.name}] on_stall action failed: {e}")
         return True
 
     def _run(self) -> None:

@@ -1,0 +1,15 @@
+"""Test-support utilities that ship with the package.
+
+``deepspeed_tpu.testing.chaos`` is the fault-injection harness the
+crash-consistency test suite drives; it lives in the package (not under
+``tests/``) so subprocess crash tests can arm it via one env var and so
+users can chaos-test their own checkpoint directories.
+"""
+from deepspeed_tpu.testing.chaos import (  # noqa: F401
+    ChaosCheckpointEngine,
+    ChaosError,
+    arm,
+    chaos_point,
+    disarm,
+    failing_writes,
+)

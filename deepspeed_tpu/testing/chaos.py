@@ -1,0 +1,221 @@
+"""Fault-injection harness for crash-consistency testing.
+
+Three layers, all dependency-free (no jax import — the hooks sit on
+checkpoint hot paths that must stay importable everywhere):
+
+* **Named fault points** — the checkpoint commit path calls
+  :func:`chaos_point` at every window where a crash used to lose data
+  (``save/pre_write``, ``save/mid_write``, ``save/pre_commit``,
+  ``save/pre_rename``, ``save/pre_latest``). Unarmed, a point is one
+  global-is-None check. Armed (via :func:`arm` in-process, or the
+  ``DSTPU_CHAOS`` env var for subprocess kill tests), a point can raise a
+  transient I/O error or hard-kill the process — exactly what a preempted
+  TPU VM does.
+* **ChaosCheckpointEngine** — a ``CheckpointEngine`` wrapper that injects
+  failing saves, torn (partially written) tag payloads, and
+  kill-at-Nth-save crashes underneath the commit protocol.
+* **failing_writes** — an fs shim that makes the first N file-*write*
+  opens under a path prefix raise, for exercising the retry/backoff loop
+  around marker and ``latest`` writes.
+
+``DSTPU_CHAOS`` grammar: ``point=action[:n][;point=action[:n]...]``
+  * ``fail:n``  — the first ``n`` hits of the point raise :class:`ChaosError`
+    (default 1); later hits pass — the transient-I/O shape retry must absorb.
+  * ``kill:n``  — the ``n``-th hit of the point calls ``os._exit(137)``
+    (default 1): an un-catchable crash, the preemption/OOM-killer shape.
+
+Example (kill the writer between data write and commit marker)::
+
+    DSTPU_CHAOS="save/pre_commit=kill" python train.py
+"""
+from __future__ import annotations
+
+import builtins
+import contextlib
+import os
+import threading
+from typing import Any, Dict, Optional
+
+CHAOS_ENV = "DSTPU_CHAOS"
+
+# exit code chosen to look like SIGKILL (128+9) — what a preemption or the
+# OOM killer leaves behind; tests assert on it
+KILL_EXIT_CODE = 137
+
+
+class ChaosError(IOError):
+    """Injected transient I/O failure (an IOError so production retry paths
+    treat it exactly like a real flaky disk/GCS hiccup)."""
+
+
+class FaultPlan:
+    """Hit-counted actions per fault point. Thread-safe: async/decoupled
+    writers hit points from worker threads."""
+
+    def __init__(self, rules: Dict[str, Any]):
+        # rules: point -> ("fail"|"kill", n)
+        self.rules = dict(rules)
+        self._hits: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        rules: Dict[str, Any] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            point, _, action = part.partition("=")
+            action, _, n = action.partition(":")
+            if action not in ("fail", "kill"):
+                raise ValueError(
+                    f"chaos action must be fail|kill, got {action!r} "
+                    f"(spec {spec!r})")
+            rules[point.strip()] = (action, int(n) if n else 1)
+        return cls(rules)
+
+    def hit(self, point: str) -> None:
+        with self._lock:
+            rule = self.rules.get(point)
+            if rule is None:
+                return
+            self._hits[point] = count = self._hits.get(point, 0) + 1
+            action, n = rule
+        if action == "kill":
+            if count == n:
+                # hard crash: no atexit, no finally blocks, no flushing —
+                # the honest model of preemption/OOM-kill
+                os._exit(KILL_EXIT_CODE)
+        elif count <= n:
+            raise ChaosError(f"chaos: injected failure at {point!r} "
+                             f"(hit {count}/{n})")
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+
+_armed: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def arm(plan) -> FaultPlan:
+    """Arm a plan in-process (a ``FaultPlan`` or a ``DSTPU_CHAOS`` spec
+    string). Returns the armed plan for hit-count assertions."""
+    global _armed
+    _armed = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    return _armed
+
+
+def disarm() -> None:
+    global _armed, _env_checked
+    _armed = None
+    _env_checked = True   # an explicit disarm also wins over the env
+
+
+def chaos_point(point: str) -> None:
+    """Production-code hook: no-op unless a plan is armed (in-process or
+    via ``DSTPU_CHAOS``)."""
+    global _armed, _env_checked
+    if _armed is None:
+        if _env_checked:
+            return
+        _env_checked = True
+        spec = os.environ.get(CHAOS_ENV)
+        if not spec:
+            return
+        _armed = FaultPlan.parse(spec)
+    _armed.hit(point)
+
+
+class ChaosCheckpointEngine:
+    """``CheckpointEngine`` wrapper injecting save-path faults under the
+    commit protocol (duck-typed: save/load/wait/close).
+
+    * ``fail_first_saves=n`` — the first ``n`` ``save()`` calls raise
+      :class:`ChaosError` before touching disk (flaky-volume shape; proves
+      the retry/backoff loop).
+    * ``tear_after_save=True`` — ``save()`` completes durably, then one
+      payload file is truncated to half (a torn write the checksum
+      manifest must catch).
+    * ``kill_at_save=n`` — the ``n``-th ``save()`` hard-kills the process
+      mid-write (after data is staged, before the caller can commit).
+    """
+
+    def __init__(self, inner, fail_first_saves: int = 0,
+                 tear_after_save: bool = False,
+                 kill_at_save: Optional[int] = None):
+        self.inner = inner
+        self.fail_first_saves = fail_first_saves
+        self.tear_after_save = tear_after_save
+        self.kill_at_save = kill_at_save
+        self.saves = 0
+
+    def _tear_one_file(self, path: str) -> Optional[str]:
+        """Truncate the largest payload file under ``path`` to half."""
+        victim, size = None, -1
+        for dirpath, _, names in os.walk(path):
+            for name in names:
+                p = os.path.join(dirpath, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    victim, size = p, s
+        if victim is not None and size > 0:
+            with open(victim, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+        return victim
+
+    def save(self, state, path: str) -> None:
+        self.saves += 1
+        if self.saves <= self.fail_first_saves:
+            raise ChaosError(
+                f"chaos: injected save failure ({self.saves}/"
+                f"{self.fail_first_saves})")
+        if self.kill_at_save is not None and self.saves == self.kill_at_save:
+            self.inner.save(state, path)   # stage real bytes, then die
+            os._exit(KILL_EXIT_CODE)
+        self.inner.save(state, path)
+        if self.tear_after_save:
+            self.inner.wait()
+            self._tear_one_file(path)
+
+    def load(self, path: str, template):
+        return self.inner.load(path, template)
+
+    def wait(self) -> None:
+        self.inner.wait()
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
+@contextlib.contextmanager
+def failing_writes(prefix: str, first_n: int):
+    """fs shim: the first ``first_n`` *write-mode* ``open()`` calls under
+    ``prefix`` raise :class:`ChaosError`; reads are untouched. Exercises
+    the transient-I/O retry around marker/``latest`` writes without
+    touching any engine."""
+    prefix = os.path.abspath(prefix)
+    state = {"left": first_n}
+    real_open = builtins.open
+    lock = threading.Lock()
+
+    def chaos_open(file, mode="r", *args, **kwargs):
+        if isinstance(file, (str, os.PathLike)) and any(
+                m in str(mode) for m in ("w", "a", "x", "+")):
+            p = os.path.abspath(os.fspath(file))
+            if p.startswith(prefix):
+                with lock:
+                    if state["left"] > 0:
+                        state["left"] -= 1
+                        raise ChaosError(
+                            f"chaos: injected write-open failure for {p}")
+        return real_open(file, mode, *args, **kwargs)
+
+    builtins.open = chaos_open
+    try:
+        yield state
+    finally:
+        builtins.open = real_open

@@ -61,6 +61,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: measured >= 5s on the 1-core box "
         "(tests/slow_tests.txt; fast pre-commit tier = -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests that kill/signal REAL "
+        "subprocesses (CPU backend, no TPU I/O — runs in tier-1; "
+        "deselect with -m 'not chaos' on boxes where subprocesses are "
+        "restricted)")
 
 
 def pytest_collection_modifyitems(config, items):

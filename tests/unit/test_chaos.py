@@ -1,0 +1,459 @@
+"""Crash-consistency suite: every injected failure point must recover.
+
+Drives the commit protocol (``checkpoint/fault_tolerance.py``) with the
+fault-injection harness (``deepspeed_tpu/testing/chaos.py``), including
+REAL subprocess kills (exit 137 = SIGKILL shape) inside the crash
+windows, and the preemption path end-to-end: SIGTERM mid-epoch → clean
+emergency save → ``auto_resume`` continues at the right step.
+
+All tests run on the CPU backend in seconds — no real TPU I/O — so they
+belong to tier-1 (``-m 'not slow'``).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.checkpoint import fault_tolerance as ftmod
+from deepspeed_tpu.checkpoint.engine import (
+    finalize_async,
+    load_state,
+    read_latest_tag,
+    save_state,
+)
+from deepspeed_tpu.checkpoint.fault_tolerance import CheckpointCorruptError
+from deepspeed_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _state(step: int):
+    return {"w": jnp.arange(16, dtype=jnp.float32) + step,
+            "step": jnp.int32(step)}
+
+
+def _shardings(template):
+    dev = jax.devices()[0]
+    return jax.tree.map(
+        lambda _: jax.sharding.SingleDeviceSharding(dev), template)
+
+
+def _load(root, tag=None):
+    t = _state(0)
+    return load_state(root, tag, t, _shardings(t))
+
+
+def _save(root, step, **kw):
+    save_state(root, f"global_step{step}", _state(step),
+               {"global_steps": step}, retry_backoff_s=0.01,
+               retry_jitter_s=0.0, **kw)
+
+
+# --------------------------------------------------------------------- #
+# commit protocol (in-process)
+# --------------------------------------------------------------------- #
+class TestCommitProtocol:
+    def test_layout_marker_checksums_latest(self, tmp_path):
+        root = str(tmp_path)
+        _save(root, 1)
+        marker = ftmod.read_marker(root, "global_step1")
+        assert marker is not None and marker["step"] == 1
+        assert marker["files"] and all(
+            "crc32" in info for info in marker["files"].values())
+        assert read_latest_tag(root) == "global_step1"
+        assert not any(ftmod.is_tmp_name(n) for n in os.listdir(root))
+        ok, why = ftmod.verify_tag(root, "global_step1")
+        assert ok, why
+
+    def test_async_save_commits_after_drain(self, tmp_path):
+        root = str(tmp_path)
+        _save(root, 1, async_save=True)
+        finalize_async()
+        ok, why = ftmod.verify_tag(root, "global_step1")
+        assert ok, why
+        assert read_latest_tag(root) == "global_step1"
+        state, client = _load(root)
+        assert client["global_steps"] == 1
+        np.testing.assert_allclose(np.asarray(state["w"]),
+                                   np.arange(16, dtype=np.float32) + 1)
+
+    @pytest.mark.parametrize("writer", ["orbax", "fast"])
+    def test_fail_first_writes_then_succeed_via_backoff(self, tmp_path,
+                                                        writer):
+        root = str(tmp_path)
+        chaos.arm("save/write=fail:2")
+        _save(root, 1, writer=writer, retries=3)
+        ok, why = ftmod.verify_tag(root, "global_step1")
+        assert ok, why
+        op = "write_fast" if writer == "fast" else "write_orbax"
+        assert telemetry.counter(
+            "checkpoint_save_retries_total").value(op=op) >= 2
+
+    def test_retries_exhausted_raises_and_counts(self, tmp_path):
+        chaos.arm("save/write=fail:99")
+        with pytest.raises(OSError):
+            _save(str(tmp_path), 1, retries=2)
+        assert telemetry.counter(
+            "checkpoint_save_failures_total").value(op="write_orbax") >= 1
+
+    def test_keep_n_retention_gc(self, tmp_path):
+        root = str(tmp_path)
+        for step in (1, 2, 3, 4):
+            _save(root, step, keep_n=2)
+        assert ftmod.committed_tags(root) == ["global_step4", "global_step3"]
+        state, client = _load(root)
+        assert client["global_steps"] == 4
+
+
+class TestSelfHealingLoad:
+    def _corrupt(self, root, tag, mode="flip"):
+        """Damage the largest payload file listed in the tag's manifest."""
+        marker = ftmod.read_marker(root, tag)
+        rel = max(marker["files"],
+                  key=lambda r: marker["files"][r]["size"])
+        full = os.path.join(root, tag, rel)
+        size = os.path.getsize(full)
+        with open(full, "r+b") as f:
+            if mode == "truncate":
+                f.truncate(max(size // 2, 1))
+            else:   # same-size bit flip: only the CRC can catch it
+                f.seek(0)
+                first = f.read(1)
+                f.seek(0)
+                f.write(bytes([first[0] ^ 0xFF]))
+        return rel
+
+    @pytest.mark.parametrize("mode", ["flip", "truncate"])
+    def test_corrupt_leaf_walks_back(self, tmp_path, mode):
+        root = str(tmp_path)
+        _save(root, 1)
+        _save(root, 2)
+        self._corrupt(root, "global_step2", mode)
+        state, client = _load(root)   # tag=None: walk back past the head
+        assert client["global_steps"] == 1
+        np.testing.assert_allclose(np.asarray(state["w"]),
+                                   np.arange(16, dtype=np.float32) + 1)
+        assert telemetry.counter(
+            "checkpoint_verify_failures_total").value(reason="corrupt") >= 1
+
+    def test_explicit_corrupt_tag_raises(self, tmp_path):
+        root = str(tmp_path)
+        _save(root, 1)
+        _save(root, 2)
+        self._corrupt(root, "global_step2")
+        with pytest.raises(CheckpointCorruptError):
+            _load(root, tag="global_step2")
+
+    def test_empty_latest_is_missing(self, tmp_path):
+        # satellite: an empty/whitespace latest file must read as None,
+        # not "" (which produced a nonsense tag path downstream)
+        root = str(tmp_path)
+        with open(os.path.join(root, "latest"), "w") as f:
+            f.write("  \n")
+        assert read_latest_tag(root) is None
+        with pytest.raises(FileNotFoundError):
+            _load(root)
+
+    def test_legacy_tag_without_marker_still_loads(self, tmp_path):
+        root = str(tmp_path)
+        _save(root, 3)
+        os.remove(os.path.join(root, "global_step3", ftmod.COMMIT_MARKER))
+        state, client = _load(root)   # latest-file fallback, warned
+        assert client["global_steps"] == 3
+
+
+class TestChaosHarness:
+    def test_plan_parse_and_counts(self):
+        plan = chaos.FaultPlan.parse("a/b=fail:2;c=kill:3")
+        assert plan.rules == {"a/b": ("fail", 2), "c": ("kill", 3)}
+        with pytest.raises(ValueError):
+            chaos.FaultPlan.parse("x=explode")
+        chaos.arm("p=fail:1")
+        with pytest.raises(chaos.ChaosError):
+            chaos.chaos_point("p")
+        chaos.chaos_point("p")   # second hit passes
+        chaos.chaos_point("unarmed-point")
+
+    def test_failing_writes_shim(self, tmp_path):
+        target = tmp_path / "f.txt"
+        with chaos.failing_writes(str(tmp_path), first_n=1):
+            with pytest.raises(chaos.ChaosError):
+                open(target, "w")
+            with open(target, "w") as f:   # budget spent — succeeds
+                f.write("ok")
+            with open(target) as f:        # reads never fail
+                assert f.read() == "ok"
+
+    def test_chaos_engine_tears_payload(self, tmp_path):
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            FastCheckpointEngine,
+        )
+
+        eng = chaos.ChaosCheckpointEngine(FastCheckpointEngine(),
+                                          tear_after_save=True)
+        path = str(tmp_path / "ckpt")
+        state = {"w": jnp.ones((64,), jnp.float32)}
+        eng.save(state, path)
+        eng.wait()
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        torn = os.path.join(path, manifest["w"]["file"])
+        assert os.path.getsize(torn) < 64 * 4
+
+
+# --------------------------------------------------------------------- #
+# subprocess kill tests — a REAL process dies inside the crash window
+# --------------------------------------------------------------------- #
+_SAVE_SCRIPT = """
+import sys
+import jax.numpy as jnp
+from deepspeed_tpu.checkpoint.engine import save_state
+
+root, step, writer = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+state = {"w": jnp.arange(16, dtype=jnp.float32) + step,
+         "step": jnp.int32(step)}
+save_state(root, f"global_step{step}", state, {"global_steps": step},
+           writer=writer)
+print("SAVED", step, flush=True)
+"""
+
+
+def _subproc_env(**extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(chaos.CHAOS_ENV, None)
+    env.update(extra)
+    return env
+
+
+def _subproc_save(script_path, root, step, writer="orbax", chaos_spec=None):
+    extra = {chaos.CHAOS_ENV: chaos_spec} if chaos_spec else {}
+    return subprocess.run(
+        [sys.executable, script_path, root, str(step), writer],
+        env=_subproc_env(**extra), capture_output=True, text=True,
+        timeout=240)
+
+
+@pytest.mark.chaos
+class TestSubprocessKill:
+    @pytest.fixture()
+    def save_script(self, tmp_path):
+        path = str(tmp_path / "save_script.py")
+        with open(path, "w") as f:
+            f.write(_SAVE_SCRIPT)
+        return path
+
+    def _assert_recovers_to(self, root, step):
+        state, client = _load(root)
+        assert client["global_steps"] == step
+        np.testing.assert_allclose(np.asarray(state["w"]),
+                                   np.arange(16, dtype=np.float32) + step)
+
+    def test_kill_pre_commit_recovers_previous(self, save_script, tmp_path):
+        root = str(tmp_path / "ckpt")
+        r = _subproc_save(save_script, root, 1)
+        assert "SAVED 1" in r.stdout, r.stderr
+        r = _subproc_save(save_script, root, 2,
+                          chaos_spec="save/pre_commit=kill")
+        assert r.returncode == chaos.KILL_EXIT_CODE, r.stderr
+        # the torn write is invisible: tag never published
+        assert not os.path.isdir(os.path.join(root, "global_step2"))
+        assert any(ftmod.is_tmp_name(n) for n in os.listdir(root))
+        self._assert_recovers_to(root, 1)
+        # retention GC reaps the dead writer's tmp dir
+        ftmod.gc_tags(root, keep_n=0)
+        assert not any(ftmod.is_tmp_name(n) for n in os.listdir(root))
+
+    def test_kill_pre_latest_recovers_new_commit(self, save_script,
+                                                 tmp_path):
+        root = str(tmp_path / "ckpt")
+        r = _subproc_save(save_script, root, 1)
+        assert "SAVED 1" in r.stdout, r.stderr
+        r = _subproc_save(save_script, root, 2,
+                          chaos_spec="save/pre_latest=kill")
+        assert r.returncode == chaos.KILL_EXIT_CODE, r.stderr
+        # committed but `latest` is stale — resolution prefers the newest
+        # committed tag, so the step-2 data is NOT lost
+        assert read_latest_tag(root) == "global_step1"
+        ok, why = ftmod.verify_tag(root, "global_step2")
+        assert ok, why
+        self._assert_recovers_to(root, 2)
+
+    def test_kill_mid_leaf_write_fast_writer(self, save_script, tmp_path):
+        root = str(tmp_path / "ckpt")
+        r = _subproc_save(save_script, root, 1, writer="fast")
+        assert "SAVED 1" in r.stdout, r.stderr
+        r = _subproc_save(save_script, root, 2, writer="fast",
+                          chaos_spec="save/leaf_write=kill:2")
+        assert r.returncode == chaos.KILL_EXIT_CODE, r.stderr
+        assert not os.path.isdir(os.path.join(root, "global_step2"))
+        self._assert_recovers_to(root, 1)
+
+
+# --------------------------------------------------------------------- #
+# preemption: SIGTERM mid-epoch → emergency save → auto-resume
+# --------------------------------------------------------------------- #
+_TRAIN_SCRIPT = """
+import sys, time
+import numpy as np
+import deepspeed_tpu as dst
+
+root, progress = sys.argv[1], sys.argv[2]
+spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                          num_layers=1, num_heads=2, max_seq_len=16)
+config = {
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+    "steps_per_print": 10 ** 9,
+    "fault_tolerance": {"resume_dir": root, "auto_resume": True},
+}
+engine, *_ = dst.initialize(model=spec, config=config)
+batch = {"tokens": np.random.RandomState(0).randint(
+    0, 64, size=(8, 16)).astype(np.int32)}
+it = iter(lambda: batch, None)
+for _ in range(10 ** 6):
+    engine.train_batch(it)
+    with open(progress, "w") as f:
+        f.write(str(engine.global_steps))
+    time.sleep(0.05)
+"""
+
+
+def _wait_for_step(progress, min_step, timeout, proc):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(f"trainer died early:\n{out}")
+        try:
+            with open(progress) as f:
+                step = int(f.read().strip() or 0)
+            if step >= min_step:
+                return step
+        except (FileNotFoundError, ValueError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError(f"trainer never reached step {min_step}")
+
+
+@pytest.mark.chaos
+class TestPreemption:
+    def test_sigterm_emergency_save_then_auto_resume(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        progress = str(tmp_path / "progress")
+        script = str(tmp_path / "train_script.py")
+        with open(script, "w") as f:
+            f.write(_TRAIN_SCRIPT)
+        proc = subprocess.Popen(
+            [sys.executable, script, root, progress], env=_subproc_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        _wait_for_step(progress, min_step=2, timeout=180, proc=proc)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+        assert proc.returncode == 0, out   # clean exit, not a crash
+        tag = ftmod.find_restore_tag(root)
+        assert tag is not None and tag.startswith("emergency_step"), out
+        saved_step = ftmod.read_marker(root, tag)["step"]
+        assert saved_step >= 2
+
+        # auto-resume continues at the saved step
+        from deepspeed_tpu.comm import mesh as mesh_mod
+
+        mesh_mod.reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                                  num_layers=1, num_heads=2, max_seq_len=16)
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+            "fault_tolerance": {"resume_dir": root, "auto_resume": True,
+                                "graceful_preemption": False},
+        }
+        engine, *_ = dst.initialize(model=spec, config=config)
+        assert engine.global_steps == saved_step
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 64, size=(8, 16)).astype(np.int32)}
+        engine.train_batch(iter(lambda: batch, None))
+        assert engine.global_steps == saved_step + 1
+
+
+# --------------------------------------------------------------------- #
+# engine-level fault tolerance (in-process)
+# --------------------------------------------------------------------- #
+def _make_engine(tmp_path, extra_ft=None):
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                              num_layers=1, num_heads=2, max_seq_len=16)
+    ftc = {"resume_dir": str(tmp_path), "graceful_preemption": False}
+    ftc.update(extra_ft or {})
+    config = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10 ** 9,
+        "fault_tolerance": ftc,
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return engine
+
+
+def _one_step(engine):
+    batch = {"tokens": np.random.RandomState(0).randint(
+        0, 64, size=(8, 16)).astype(np.int32)}
+    engine.train_batch(iter(lambda: batch, None))
+
+
+class TestEngineFaultTolerance:
+    def test_emergency_save_is_committed(self, tmp_path):
+        engine = _make_engine(tmp_path)
+        _one_step(engine)
+        tag = engine._emergency_save("stall")
+        assert tag == "emergency_step1"
+        ok, why = ftmod.verify_tag(str(tmp_path), tag)
+        assert ok, why
+        assert telemetry.counter(
+            "checkpoint_emergency_saves_total").value(reason="stall") >= 1
+
+    def test_auto_resume_cold_start_on_empty_dir(self, tmp_path):
+        engine = _make_engine(tmp_path / "nothing-here",
+                              extra_ft={"auto_resume": True})
+        assert engine.global_steps == 0
+
+    def test_auto_resume_restores_rng_and_steps(self, tmp_path):
+        engine = _make_engine(tmp_path)
+        _one_step(engine)
+        _one_step(engine)
+        rng_before = engine._np_rng.bit_generator.state
+        engine.save_checkpoint(str(tmp_path))
+        engine2 = _make_engine(tmp_path, extra_ft={"auto_resume": True})
+        assert engine2.global_steps == 2
+        assert engine2._np_rng.bit_generator.state == rng_before
+
+    def test_watchdog_on_stall_callback_fires_once(self):
+        fired = []
+        wd = telemetry.StallWatchdog(0.01, telemetry.get_registry(),
+                                     on_stall=lambda: fired.append(1))
+        wd.beat()
+        time.sleep(0.03)
+        assert wd.check() is True
+        assert wd.check() is False   # one escalation per stall episode
+        assert fired == [1]

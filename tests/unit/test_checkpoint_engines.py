@@ -113,3 +113,57 @@ class TestEngineFastWriter:
         e2.load_checkpoint(str(tmp_path))
         assert e2.global_steps == 2
         np.testing.assert_allclose(float(e2.eval_batch(batch)), l1, rtol=1e-5)
+
+
+class TestDtypeResolution:
+    def test_resolve_np_dtype_families(self):
+        import ml_dtypes
+
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            resolve_np_dtype,
+        )
+
+        assert resolve_np_dtype("float32") == np.float32
+        assert resolve_np_dtype("int32") == np.int32
+        # bf16 must resolve even where np.dtype("bfloat16") depends on
+        # ml_dtypes registration order (satellite: FastCheckpointEngine
+        # load crash)
+        assert resolve_np_dtype("bfloat16") == np.dtype(ml_dtypes.bfloat16)
+        assert resolve_np_dtype("float8_e4m3fn") == np.dtype(
+            ml_dtypes.float8_e4m3fn)
+        with pytest.raises(TypeError, match="unresolvable"):
+            resolve_np_dtype("not-a-dtype")
+
+    def test_fast_engine_bf16_roundtrip_via_helper(self, tmp_path):
+        """bf16 leaves survive a fast-writer save/load byte-exactly."""
+        eng = FastCheckpointEngine()
+        state = {"b": (jnp.arange(33, dtype=jnp.float32) / 7.0
+                       ).astype(jnp.bfloat16)}
+        path = str(tmp_path / "ckpt")
+        eng.save(state, path)
+        eng.wait()
+        restored = eng.load(path, state)
+        assert restored["b"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(state["b"], np.float32),
+            np.asarray(restored["b"], np.float32))
+
+
+class TestDecoupledClose:
+    def test_close_after_failed_save_is_best_effort(self, tmp_path):
+        """Satellite: close() after a failed queued save must not raise
+        (it runs on teardown paths where raising would mask the original
+        training error) and must still join the drain thread."""
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.testing.chaos import ChaosCheckpointEngine
+
+        eng = DecoupledCheckpointEngine(inner=ChaosCheckpointEngine(
+            OrbaxCheckpointEngine(), fail_first_saves=1))
+        eng.save({"w": jnp.ones(2)}, str(tmp_path / "x"))
+        before = telemetry.counter(
+            "checkpoint_close_errors_total").value(error="ChaosError")
+        eng.close()   # must NOT raise
+        assert not eng._thread.is_alive()
+        assert telemetry.counter(
+            "checkpoint_close_errors_total").value(
+                error="ChaosError") == before + 1

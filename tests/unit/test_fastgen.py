@@ -582,3 +582,69 @@ class TestFastGenTP:
         assert fg.mesh is None
         # tp=False: never engage even on a compatible model
         assert self._engine(tp=False).mesh is None
+
+
+def test_fastgen_request_deadline_drops_expired():
+    """Per-request deadlines: expired requests are dropped at the next
+    scheduling tick (blocks freed, counter bumped) so one stuck client
+    can't pin queue slots/KV blocks forever."""
+    from deepspeed_tpu import telemetry
+
+    rng = np.random.default_rng(11)
+    fg = FastGenEngine("tiny", n_blocks=16, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0, **CFG)
+    base = telemetry.counter("fastgen_deadline_expired_total")
+    waiting0 = base.value(state="waiting")
+    running0 = base.value(state="running")
+    # uid 1: already-expired deadline, never prefills (waiting at expiry);
+    # uid 2: expires after its first decode (running at expiry);
+    # uid 3: no deadline — must be untouched
+    fg.put([1], _prompts(rng, [24]), deadline_s=-1.0)
+    fg.put([2], _prompts(rng, [8]), deadline_s=0.2)
+    fg.put([3], _prompts(rng, [8]))
+    fg.step()
+    assert fg.seqs[1].done and fg.expired(1)
+    assert not fg.seqs[1].blocks, "expired request must free its KV blocks"
+    assert base.value(state="waiting") == waiting0 + 1
+    time.sleep(0.25)
+    for _ in range(3):
+        fg.step()
+    assert fg.expired(2) and fg.seqs[2].done
+    assert base.value(state="running") == running0 + 1
+    assert not fg.expired(3) and not fg.seqs[3].done
+    assert len(fg.seqs[3].generated) >= 2
+    done, toks = fg.query(1)
+    assert done and toks == []
+
+
+def test_fastgen_engine_default_deadline():
+    """Engine-level request_deadline_s applies when put() passes none."""
+    rng = np.random.default_rng(12)
+    fg = FastGenEngine("tiny", n_blocks=16, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0,
+                       request_deadline_s=-1.0, **CFG)
+    fg.put([1], _prompts(rng, [8]))
+    assert fg.step() == {}
+    assert fg.expired(1)
+    # per-request override beats the engine default
+    fg.put([2], _prompts(rng, [8]), deadline_s=60.0)
+    fg.step()
+    assert not fg.expired(2) and len(fg.seqs[2].generated) >= 1
+
+
+def test_fastgen_decode_stream_drops_expired():
+    """Deadline expiry must also cover the decode_stream scheduling path:
+    an expired request is dropped at stream entry (blocks freed) instead
+    of pinning KV blocks while the stream loops."""
+    rng = np.random.default_rng(13)
+    fg = FastGenEngine("tiny", n_blocks=16, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0, **CFG)
+    fg.put([1], _prompts(rng, [8]), deadline_s=0.15)
+    fg.step()            # prefill + first token
+    time.sleep(0.2)      # deadline passes
+    list(fg.decode_stream(window=4))
+    assert fg.expired(1) and fg.seqs[1].done
+    assert not fg.seqs[1].blocks

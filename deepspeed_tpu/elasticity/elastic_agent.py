@@ -28,7 +28,11 @@ class RestartableFailure(Exception):
 @dataclasses.dataclass
 class ElasticAgentConfig:
     max_restarts: int = 3                # torch-elastic max_restarts analog
+    # backoff before restart k is restart_backoff_s * 2**(k-1), capped at
+    # restart_backoff_max_s — a crash-looping job must not hammer the
+    # scheduler/checkpoint store at a fixed cadence
     restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 60.0
     reload_on_restart: bool = True
 
 
@@ -69,9 +73,25 @@ class ElasticAgent:
                 log_dist("elastic agent: no checkpoint yet, cold start")
         return engine, start_step
 
+    def backoff_s(self, restart: int) -> float:
+        """Pre-restart sleep for restart number ``restart`` (1-based):
+        exponential from ``restart_backoff_s``, capped at
+        ``restart_backoff_max_s``."""
+        return min(self.config.restart_backoff_s * 2 ** (restart - 1),
+                   self.config.restart_backoff_max_s)
+
     def run(self) -> Any:
         """Run until train_fn returns; restart on RestartableFailure up to
-        ``max_restarts`` times. Returns the last engine."""
+        ``max_restarts`` times (exponential backoff between attempts).
+        Returns the last engine."""
+        from deepspeed_tpu import telemetry
+
+        tm_restarts = telemetry.counter(
+            "elastic_restarts_total",
+            "supervised restarts performed by the elastic agent")
+        tm_exhausted = telemetry.counter(
+            "elastic_restart_exhausted_total",
+            "elastic-agent runs that gave up after max_restarts")
         while True:
             engine, start_step = self._build()
             try:
@@ -80,11 +100,15 @@ class ElasticAgent:
             except RestartableFailure as e:
                 self.restarts += 1
                 if self.restarts > self.config.max_restarts:
+                    tm_exhausted.inc()
                     logger.error(
                         f"elastic agent: giving up after {self.restarts - 1} "
                         f"restarts: {e}")
                     raise
+                tm_restarts.inc()
+                backoff = self.backoff_s(self.restarts)
                 logger.warning(
                     f"elastic agent: restart {self.restarts}/"
-                    f"{self.config.max_restarts} after: {e}")
-                time.sleep(self.config.restart_backoff_s)
+                    f"{self.config.max_restarts} after: {e} "
+                    f"(backoff {backoff:.1f}s)")
+                time.sleep(backoff)

@@ -232,6 +232,11 @@ class FastGenEngine:
         self._tm_tok_lat = telemetry.histogram(
             "fastgen_decode_token_seconds",
             "per-token decode latency (window wall time / tokens)")
+        # per-ENGINE accumulators behind est_token_seconds: the histogram
+        # above is process-global, so two engines in one process (draft +
+        # large model) would blend into one lifetime mean there
+        self._tok_lat_sum = 0.0
+        self._tok_lat_n = 0
         self._tm_ticks = telemetry.counter(
             "fastgen_ticks_total",
             "engine ticks by kind (mixed SplitFuse / fused decode / "
@@ -288,8 +293,7 @@ class FastGenEngine:
         self._tm_queue.set(waiting, state="waiting")
         self._tm_queue.set(len(live) - waiting, state="running")
         self._tm_queue_peak.set_max(len(live))
-        cap = max(1, self.allocator.n_blocks - 1)   # block 0 reserved
-        util = (cap - self.allocator.free_blocks) / cap
+        util = self.kv_utilization()
         self._tm_kv.set(util)
         self._tm_kv_peak.set_max(util)
         in_use = {"quarter": 0, "half": 0, "full": 0}
@@ -298,6 +302,14 @@ class FastGenEngine:
                 in_use[self._mb_tier_name(len(s.blocks))] += len(s.blocks)
         for tier, n in in_use.items():
             self._tm_kv_tier.set(n, tier=tier)
+
+    def _observe_tok_lat(self, per_token_s: float, n: int) -> None:
+        """One funnel for every decode-latency observation: the global
+        histogram AND the per-engine accumulators est_token_seconds
+        reads (keeping multi-engine processes unblended)."""
+        self._tm_tok_lat.observe(per_token_s, n=n)
+        self._tok_lat_sum += per_token_s * n
+        self._tok_lat_n += n
 
     def _tm_first_token(self, seq: _Seq) -> None:
         if not seq.first_tok_seen:
@@ -480,7 +492,7 @@ class FastGenEngine:
             # a cold key folds the XLA compile into the window wall time
             # (~seconds vs ~ms/token) — keep the latency histogram steady-
             # state only, same reason the train side uses best-window
-            self._tm_tok_lat.observe(
+            self._observe_tok_lat(
                 (time.perf_counter() - t0) / (n * B), n=n * B)
         self._tm_ticks.inc(n, kind="decode", mb_tier=self._mb_tier_name(mb))
         self._tm_occup.set(B / Bt, phase="decode")
@@ -550,7 +562,7 @@ class FastGenEngine:
             if prev_drain_t[0] is not None:
                 # with a window always in flight, drain-to-drain wall time
                 # over the window's tokens IS the per-token serving rate
-                self._tm_tok_lat.observe(
+                self._observe_tok_lat(
                     (now - prev_drain_t[0]) / max(1, p_n * len(p_live)),
                     n=p_n * len(p_live))
             prev_drain_t[0] = now
@@ -695,14 +707,23 @@ class FastGenEngine:
         # observe the optimistic s.pos/last_tok skew
         if deadline_s is None:
             deadline_s = self.request_deadline_s
+        # validate the WHOLE batch before mutating anything: a ValueError
+        # mid-batch must not leave earlier uids of the same call admitted
+        # (the caller sees an exception and retries the batch — partial
+        # admission then double-admits the survivors)
+        batch = []
+        seen = set()
         for uid, prompt in zip(uids, prompts):
             prompt = list(prompt)
-            if uid in self.seqs:
+            if uid in self.seqs or uid in seen:
                 raise ValueError(
                     f"uid {uid} is still active — flush() it before re-use")
             if len(prompt) >= self.max_len:
                 raise ValueError(
                     f"prompt len {len(prompt)} >= max_len {self.max_len}")
+            seen.add(uid)
+            batch.append((uid, prompt))
+        for uid, prompt in batch:
             self.seqs[uid] = _Seq(uid, prompt, self.max_blocks_per_seq,
                                   deadline_s=deadline_s)
             self._admit_order.append(uid)
@@ -728,8 +749,63 @@ class FastGenEngine:
         return n
 
     def expired(self, uid: int) -> bool:
-        """Whether ``uid`` was dropped by deadline expiry."""
-        return self.seqs[uid].expired
+        """Whether ``uid`` was dropped by deadline expiry. Unknown or
+        already-flushed uids return False — a status poll racing a flush
+        must get an answer, not a KeyError (a flushed request is by
+        definition no longer expiring)."""
+        seq = self.seqs.get(uid)
+        return seq.expired if seq is not None else False
+
+    def kv_utilization(self, extra_blocks: int = 0) -> float:
+        """Fraction of the USABLE KV pool allocated (block 0 is the
+        reserved trash block and never counts as capacity) — the single
+        source for both the telemetry gauge and the serving front-end's
+        watermark checks. ``extra_blocks`` projects an admission's needs
+        on top of current allocation."""
+        cap = max(1, self.allocator.n_blocks - 1)
+        return (cap - self.allocator.free_blocks + extra_blocks) / cap
+
+    def est_token_seconds(self) -> Optional[float]:
+        """Mean per-token decode latency observed by THIS engine (None
+        before the first warm tick/window lands) — what the serving
+        front-end turns into retry-after hints and deadline-slack
+        estimates. Deliberately per-engine, not the process-global
+        histogram: two engines in one process must not blend rates."""
+        if self._tok_lat_n == 0:
+            return None
+        return self._tok_lat_sum / self._tok_lat_n
+
+    def _snapshot_host(self, seqs) -> tuple:
+        """Snapshot every scheduler-mutated host field of ``seqs`` plus
+        the allocator free list — the ONE definition both rollback paths
+        (step() on tick failure, serve_planned() on plan/dispatch failure)
+        share, so a new ``_Seq`` field added here protects both. Already-
+        emitted metric OBSERVATIONS (TTFT, token counters) cannot be
+        unobserved — a tick that fails after sampling may leave a phantom
+        sample; state consistency is the contract here, not metric
+        exactness."""
+        # generated is append-only within a tick/plan (nothing replaces or
+        # shrinks it mid-dispatch), so its snapshot is just the LENGTH —
+        # copying the full history would make every step() O(tokens
+        # generated so far) for a failure path that almost never fires
+        return ({s.uid: (s.prefilled, s.pos, list(s.blocks), s.table.copy(),
+                         len(s.generated), s.last_tok, s.done,
+                         s.first_tok_seen)
+                 for s in seqs},
+                list(self.allocator._free))
+
+    def _restore_host(self, snap: tuple) -> None:
+        seq_snap, free = snap
+        for u, st in seq_snap.items():
+            s = self.seqs.get(u)
+            if s is None:
+                continue
+            s.prefilled, s.pos = st[0], st[1]
+            s.blocks, s.table = st[2], st[3]
+            del s.generated[st[4]:]
+            s.last_tok, s.done = st[5], st[6]
+            s.first_tok_seen = st[7]
+        self.allocator._free = free
 
     def _ensure_blocks(self, seq: _Seq, upto_pos: int) -> bool:
         """Grow the sequence's block table to cover ``upto_pos``. Returns
@@ -748,11 +824,31 @@ class FastGenEngine:
     def step(self) -> Dict[int, int]:
         """One SplitFuse tick: decode every running sequence + prefill chunks
         under the token budget. Returns {uid: sampled token} for sequences
-        that produced one this tick."""
+        that produced one this tick.
+
+        Exception-safe: the scheduler advances host bookkeeping (prefilled,
+        pos, block tables, allocator) BEFORE the device call lands, so any
+        failure mid-tick (device fault, injected chaos, interrupt) rolls
+        all of it back before re-raising — a caught tick failure leaves the
+        engine consistent and retryable (what the serving front-end's
+        circuit breaker relies on). A fault inside the dispatched program
+        itself may still invalidate the donated KV pool; that is a
+        dead-device condition the breaker answers with backoff, not state
+        this rollback can save."""
         self._assert_stream_drained()
         self._expire_deadlines()
         live = [self.seqs[u] for u in self._admit_order
                 if u in self.seqs and not self.seqs[u].done]
+        snap = self._snapshot_host(live)
+        rr_snap = self._decode_rr
+        try:
+            return self._step_impl(live)
+        except BaseException:
+            self._restore_host(snap)
+            self._decode_rr = rr_snap
+            raise
+
+    def _step_impl(self, live: List[_Seq]) -> Dict[int, int]:
         need = sum(1 for s in live
                    if s.prefill_remaining == 0 and s.last_tok is not None)
         need += sum(s.prefill_remaining for s in live)
@@ -824,15 +920,28 @@ class FastGenEngine:
         mb = self._mb_tier(mb_need)
 
         key = (Tn, mb)
-        if key not in self._ticks:
+        cold = key not in self._ticks
+        if cold:
             self._ticks[key] = self._build_tick()
         sub = self._next_key()
+        t0 = time.perf_counter()
         with telemetry.span("decode_tick"):
             sampled, self.pool = self._ticks[key](
                 self.params, self.pool, self._dev(tokens),
                 self._dev(positions), self._dev(tables[:, :mb]), sub)
             sampled = np.asarray(jax.device_get(sampled))
         n_decode_rows = sum(1 for _, _, is_d in heads if is_d)
+        if not cold and n_decode_rows:
+            # per-token rate from the dynamic tick too (servers driving
+            # step() alone must still feed est_token_seconds for retry-
+            # after/deadline-slack estimates). Tick wall time over decode
+            # rows slightly OVERcounts when prefill shares the tick —
+            # conservative in the right direction for those hints. Cold
+            # keys fold the XLA compile into wall time and are skipped,
+            # same policy as decode_steps.
+            self._observe_tok_lat(
+                (time.perf_counter() - t0) / n_decode_rows,
+                n=n_decode_rows)
         self._tm_ticks.inc(kind="mixed", mb_tier=self._mb_tier_name(mb))
         self._tm_prefill_tok.inc(row - n_decode_rows)
         self._tm_occup.set(row / Tn, phase="mixed")
@@ -1120,18 +1229,10 @@ class FastGenEngine:
         blocks for the plan's duration — that's the memory-for-dispatches
         trade the planner makes).
         """
-        snap = {u: (s.prefilled, s.pos, list(s.blocks), s.table.copy(),
-                    list(s.generated), s.last_tok, s.done)
-                for u, s in self.seqs.items()}
-        free_snap = list(self.allocator._free)
+        snap = self._snapshot_host(self.seqs.values())
 
         def restore():
-            for u, st in snap.items():
-                s = self.seqs[u]
-                s.prefilled, s.pos = st[0], st[1]
-                s.blocks, s.table = st[2], st[3]
-                s.generated, s.last_tok, s.done = st[4], st[5], st[6]
-            self.allocator._free = free_snap
+            self._restore_host(snap)
 
         # any failure between planning (which advances seq positions /
         # allocator state) and the device call landing (compile error,

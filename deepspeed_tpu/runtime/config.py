@@ -173,6 +173,97 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class ServingSectionConfig:
+    """Serving resilience front-end (``deepspeed_tpu/serving``).
+
+    Admission is bounded by ``max_queue`` live requests and a KV-pool
+    ``kv_high_watermark`` (projected utilization after admitting the
+    prompt); past either bound the configured ``shed_policy`` decides who
+    pays: ``reject_newest`` turns the incoming request away,
+    ``reject_oldest`` sheds the longest-lived request to make room, and
+    ``deadline_aware`` sheds whichever request (incoming included) is
+    least likely to meet its deadline at current decode throughput.
+    Between ``kv_degrade_watermark`` and the high watermark new
+    admissions are accepted but their ``max_new_tokens`` is clamped to
+    ``degraded_max_new_tokens`` (graceful degradation before shedding).
+
+    The circuit breaker opens after ``circuit_failure_threshold``
+    consecutive engine-tick failures: requests are rejected immediately
+    for ``circuit_backoff_s`` (doubling per re-open up to
+    ``circuit_backoff_max_s``), then ONE half-open probe tick decides
+    between closing and re-opening. ``heartbeat_timeout_s`` bounds the
+    ``/healthz`` liveness window (stale tick heartbeat = sick replica)."""
+    max_queue: int = 64
+    kv_high_watermark: float = 0.95
+    kv_degrade_watermark: float = 0.80
+    degraded_max_new_tokens: int = 32
+    default_max_new_tokens: int = 128
+    shed_policy: str = "reject_newest"  # reject_newest | reject_oldest | deadline_aware
+    circuit_failure_threshold: int = 5
+    circuit_backoff_s: float = 0.5
+    circuit_backoff_max_s: float = 30.0
+    heartbeat_timeout_s: float = 15.0
+    # retry-after hint fallback when no decode-throughput sample exists
+    # yet (cold engine): assumed seconds per generated token
+    assumed_token_seconds: float = 0.05
+    # terminal RequestResult records kept for result() polling, oldest
+    # evicted first — sustained overload with fresh uids must not grow
+    # frontend memory without bound (callers should drop_result() after
+    # delivery; this cap is the backstop)
+    max_result_history: int = 4096
+
+    def validate(self) -> None:
+        if self.shed_policy not in ("reject_newest", "reject_oldest",
+                                    "deadline_aware"):
+            raise DeepSpeedConfigError(
+                "serving.shed_policy must be reject_newest|reject_oldest|"
+                f"deadline_aware, got {self.shed_policy!r}")
+        if not (0.0 < self.kv_high_watermark <= 1.0):
+            raise DeepSpeedConfigError(
+                f"serving.kv_high_watermark must be in (0, 1], got "
+                f"{self.kv_high_watermark}")
+        if self.kv_degrade_watermark > self.kv_high_watermark:
+            raise DeepSpeedConfigError(
+                "serving.kv_degrade_watermark must not exceed "
+                f"kv_high_watermark ({self.kv_degrade_watermark} > "
+                f"{self.kv_high_watermark})")
+        if self.max_queue < 1:
+            raise DeepSpeedConfigError(
+                f"serving.max_queue must be >= 1, got {self.max_queue}")
+        if self.circuit_failure_threshold < 1:
+            raise DeepSpeedConfigError(
+                "serving.circuit_failure_threshold must be >= 1, got "
+                f"{self.circuit_failure_threshold}")
+        if self.max_result_history < 1:
+            raise DeepSpeedConfigError(
+                "serving.max_result_history must be >= 1, got "
+                f"{self.max_result_history}")
+        if self.kv_degrade_watermark < 0:
+            raise DeepSpeedConfigError(
+                "serving.kv_degrade_watermark must be >= 0, got "
+                f"{self.kv_degrade_watermark}")
+        if self.degraded_max_new_tokens < 1 \
+                or self.default_max_new_tokens < 1:
+            raise DeepSpeedConfigError(
+                "serving.degraded_max_new_tokens / default_max_new_tokens "
+                f"must be >= 1, got {self.degraded_max_new_tokens} / "
+                f"{self.default_max_new_tokens}")
+        if self.circuit_backoff_s <= 0 \
+                or self.circuit_backoff_max_s < self.circuit_backoff_s:
+            raise DeepSpeedConfigError(
+                "serving circuit backoff must satisfy 0 < circuit_backoff_s "
+                f"<= circuit_backoff_max_s, got {self.circuit_backoff_s} / "
+                f"{self.circuit_backoff_max_s} (a zero backoff probes a "
+                "sick device at full tick rate — the hammering the breaker "
+                "exists to prevent)")
+        if self.heartbeat_timeout_s <= 0 or self.assumed_token_seconds <= 0:
+            raise DeepSpeedConfigError(
+                "serving.heartbeat_timeout_s and assumed_token_seconds "
+                f"must be > 0, got {self.heartbeat_timeout_s} / "
+                f"{self.assumed_token_seconds}")
+
+
+@dataclasses.dataclass
 class CheckpointSectionConfig:
     """Durable-checkpoint knobs (``checkpoint/fault_tolerance.py``).
 
@@ -419,6 +510,8 @@ class DeepSpeedTPUConfig:
     zero_optimization: ZeroConfig = dataclasses.field(default_factory=ZeroConfig)
     comms_logger: CommsLoggerConfig = dataclasses.field(default_factory=CommsLoggerConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    serving: ServingSectionConfig = dataclasses.field(
+        default_factory=ServingSectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

@@ -1,0 +1,54 @@
+"""Serving resilience layer over the FastGen engine.
+
+Production serving is not just a fast scheduler — it is a scheduler that
+survives traffic it cannot serve and hardware that stops cooperating.
+This package wraps ``inference/fastgen.FastGenEngine`` with the four
+pieces every production continuous-batching stack pairs with admission
+(vLLM's scheduler, Orca — see PAPERS.md):
+
+* bounded admission + retry-after hints (``admission.py``),
+* load-shedding policies + graceful degradation (``admission.py``),
+* a circuit breaker around the engine tick with poison-request
+  isolation (``circuit.py``, ``frontend.py``),
+* ``/healthz`` / ``/readyz`` surfaces on the telemetry HTTP endpoint
+  (``health.py``).
+
+Quick start::
+
+    from deepspeed_tpu.inference.fastgen import FastGenEngine
+    from deepspeed_tpu.serving import ServingFrontend
+
+    fe = ServingFrontend(FastGenEngine("tiny"), config={
+        "max_queue": 32, "shed_policy": "deadline_aware"})
+    res = fe.submit(uid=1, prompt=tokens, deadline_s=2.0)
+    while fe.active_count():
+        fe.run_tick()
+    print(fe.result(1))        # RequestResult(state="completed", ...)
+
+Config: the ``"serving"`` section of the runtime JSON config
+(``runtime/config.py:ServingSectionConfig``). Metrics: ``serving_*`` in
+the README "Observability" catalog.
+"""
+from deepspeed_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+    Admitted,
+    Overloaded,
+    Rejected,
+)
+from deepspeed_tpu.serving.circuit import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from deepspeed_tpu.serving.frontend import (  # noqa: F401
+    ACTIVE,
+    COMPLETED,
+    EXPIRED,
+    FAILED,
+    REJECTED,
+    SHED,
+    RequestResult,
+    ServingFrontend,
+)
+from deepspeed_tpu.serving.health import HealthSurface  # noqa: F401

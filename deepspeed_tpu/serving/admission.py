@@ -1,0 +1,160 @@
+"""Bounded admission + load-shedding policies for the serving front-end.
+
+FastGen's own scheduler applies *capacity* backpressure (a prompt that
+doesn't fit the KV pool waits), but it admits unboundedly: a traffic
+spike grows ``seqs``/``_admit_order`` without limit and every queued
+request still pays full bookkeeping. Production continuous-batching
+stacks (vLLM's scheduler, Orca) bound the waiting queue explicitly and
+reject past the bound — a fast structured rejection with a retry-after
+hint beats a request that sits in a doomed queue until its client gives
+up. This module is that bound:
+
+* ``max_queue`` live requests, plus a KV-pool **high watermark**: a
+  prompt whose projected pool utilization crosses it is not admitted
+  (the pool near exhaustion means decode of RUNNING sequences is about
+  to start preempting — new prefill work only deepens the hole).
+* When a bound is hit, the **shed policy** decides who pays:
+  ``reject_newest`` (default — turn the incoming request away),
+  ``reject_oldest`` (shed the longest-lived request; freshest traffic
+  wins), or ``deadline_aware`` (shed whichever request — incoming
+  included — is least likely to meet its deadline at current decode
+  throughput; requests without deadlines are never chosen over the
+  incoming one).
+* Between the **degrade watermark** and the high watermark, admissions
+  succeed but ``max_new_tokens`` is clamped — shorter answers for
+  everyone beats no answers for some (graceful degradation ladder:
+  degrade → shed → reject).
+
+Rejections carry :class:`Overloaded` with ``retry_after_s`` derived from
+the engine's measured per-token decode latency times the outstanding
+token backlog — the honest "come back when the backlog has drained"
+estimate a load balancer can act on.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+REJECT_NEWEST = "reject_newest"
+REJECT_OLDEST = "reject_oldest"
+DEADLINE_AWARE = "deadline_aware"
+
+#: admission-time rejection reasons (the label set of
+#: ``serving_rejected_total``)
+REASON_QUEUE_FULL = "queue_full"
+REASON_KV_PRESSURE = "kv_pressure"
+REASON_CIRCUIT_OPEN = "circuit_open"
+REASON_INVALID = "invalid"
+
+
+@dataclasses.dataclass
+class Admitted:
+    """Request accepted; ``max_new_tokens`` is the possibly-clamped
+    grant (``degraded`` marks a clamp)."""
+    uid: int
+    max_new_tokens: int
+    degraded: bool = False
+
+
+@dataclasses.dataclass
+class Overloaded:
+    """Structured fast rejection. ``retry_after_s`` estimates when the
+    rejecting condition clears (backlog drain time, or the circuit's
+    next probe window)."""
+    uid: int
+    reason: str                  # queue_full | kv_pressure | circuit_open
+    retry_after_s: float
+    policy: str
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class Rejected:
+    """Request invalid on its face (duplicate uid, over-long prompt) —
+    retrying without modification can never succeed, so no retry-after."""
+    uid: int
+    reason: str = REASON_INVALID
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class _Candidate:
+    """Shedding-policy view of a live (or incoming) request."""
+    uid: int
+    age_order: int               # admission order; lower = older
+    deadline_s: Optional[float]  # absolute, engine clock; None = none
+    remaining_tokens: int        # prefill left + decode grant left
+    incoming: bool = False
+
+
+class AdmissionController:
+    """Pure policy object: decides admit/degrade/shed from scheduler
+    facts the front-end supplies. Holds no request state itself, so the
+    front-end stays the single owner of lifecycle bookkeeping."""
+
+    def __init__(self, max_queue: int, kv_high_watermark: float,
+                 kv_degrade_watermark: float, degraded_max_new_tokens: int,
+                 shed_policy: str = REJECT_NEWEST):
+        if shed_policy not in (REJECT_NEWEST, REJECT_OLDEST, DEADLINE_AWARE):
+            raise ValueError(f"unknown shed policy {shed_policy!r}")
+        self.max_queue = max_queue
+        self.kv_high_watermark = kv_high_watermark
+        self.kv_degrade_watermark = kv_degrade_watermark
+        self.degraded_max_new_tokens = degraded_max_new_tokens
+        self.shed_policy = shed_policy
+
+    # ------------------------------------------------------------------ #
+    def overload_reason(self, queue_len: int,
+                        projected_kv_util: float) -> Optional[str]:
+        """Why this admission would overload the engine (None = fits)."""
+        if queue_len >= self.max_queue:
+            return REASON_QUEUE_FULL
+        if projected_kv_util > self.kv_high_watermark:
+            return REASON_KV_PRESSURE
+        return None
+
+    def degraded_grant(self, kv_util: float,
+                       max_new_tokens: int) -> Tuple[int, bool]:
+        """Clamp the decode grant under KV pressure (degrade rung of the
+        ladder). Returns (grant, was_clamped)."""
+        if kv_util >= self.kv_degrade_watermark \
+                and max_new_tokens > self.degraded_max_new_tokens:
+            return self.degraded_max_new_tokens, True
+        return max_new_tokens, False
+
+    # ------------------------------------------------------------------ #
+    def pick_victim(self, live: List[_Candidate], incoming: _Candidate,
+                    now: float, token_seconds: float) -> Optional[int]:
+        """Which live request to shed so ``incoming`` can be admitted.
+        ``None`` = shed nobody (reject the incoming request instead).
+
+        ``deadline_aware`` ranks every candidate (incoming included) by
+        deadline slack — time left minus estimated time to finish its
+        remaining tokens at ``token_seconds`` per token — and sheds the
+        most doomed one. A request with no deadline always "meets" it,
+        so an all-deadline-free queue degenerates to reject_newest.
+        """
+        if self.shed_policy == REJECT_NEWEST or not live:
+            return None
+        if self.shed_policy == REJECT_OLDEST:
+            return min(live, key=lambda c: c.age_order).uid
+        # deadline_aware: minimal slack loses; ties (e.g. several already
+        # hopeless) break toward the oldest so the choice is deterministic
+        def slack(c: _Candidate) -> float:
+            if c.deadline_s is None:
+                return float("inf")
+            return (c.deadline_s - now) - c.remaining_tokens * token_seconds
+
+        worst = min(live + [incoming], key=lambda c: (slack(c), c.age_order))
+        if worst.incoming or slack(worst) == float("inf"):
+            return None
+        return worst.uid
+
+
+def retry_after_from_backlog(outstanding_tokens: int, token_seconds: float,
+                             lo: float = 0.05, hi: float = 60.0) -> float:
+    """Retry-after hint: the serving loop retires roughly one token per
+    ``token_seconds`` across the batch, so the backlog drains in about
+    ``outstanding * token_seconds`` — clamped to a sane window so a cold
+    engine (no samples) or a monster backlog still yields a usable hint."""
+    return min(hi, max(lo, outstanding_tokens * token_seconds))

@@ -1,0 +1,547 @@
+"""ServingFrontend: the resilience wrapper around ``FastGenEngine``.
+
+The engine is a scheduler — it admits what it is given and backpressures
+on KV capacity, but it has no opinion about *whether* a request should
+be admitted, what to do when traffic exceeds capacity, or how to keep
+the loop alive when a tick raises. This front-end owns those policies:
+
+* **bounded admission** — ``submit()`` applies the queue cap and KV
+  high-watermark (``serving/admission.py``) and answers with a
+  structured :class:`Admitted` / :class:`Overloaded` / :class:`Rejected`
+  instead of letting the queue grow without limit;
+* **load shedding + degradation** — the configured shed policy picks a
+  victim when a bound is hit (at most one per admission), and under KV
+  pressure new grants are clamped before anyone is shed;
+* **circuit breaking + poison isolation** — ``run_tick()`` wraps the
+  engine tick: consecutive failures open the circuit
+  (``serving/circuit.py``), and on each failing tick the newest request
+  admitted since the last healthy tick is evicted and failed (reason
+  ``poisoned``) — the loop was healthy before it arrived, so it is the
+  prime suspect; a device-wide fault leaves no suspects and accumulates
+  into the breaker instead;
+* **terminal resolution** — every submitted uid ends in exactly one
+  terminal state (``completed | shed | expired | failed | rejected``)
+  queryable via :meth:`result`; shed/expired/failed requests release
+  their KV blocks at resolution, so a burst can never leak pool blocks.
+
+Single-threaded like the engine itself: one loop calls ``submit``/
+``run_tick``; the health probes (``serving/health.py``) are the only
+cross-thread readers and touch host scalars only.
+
+Chaos hook: ``run_tick`` passes through the ``serving/tick`` fault point
+(``deepspeed_tpu/testing/chaos.py``) so tests and operators can inject
+tick failures (``DSTPU_CHAOS="serving/tick=fail:3"``) and watch the
+circuit react.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving.admission import (
+    REASON_CIRCUIT_OPEN,
+    REASON_INVALID,
+    AdmissionController,
+    Admitted,
+    Overloaded,
+    Rejected,
+    _Candidate,
+    retry_after_from_backlog,
+)
+from deepspeed_tpu.serving.circuit import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from deepspeed_tpu.serving.health import HealthSurface
+from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.utils.logging import logger
+
+#: terminal request states (every submit eventually lands in exactly one)
+COMPLETED = "completed"
+SHED = "shed"
+EXPIRED = "expired"
+FAILED = "failed"
+REJECTED = "rejected"
+ACTIVE = "active"
+
+
+@dataclasses.dataclass
+class RequestResult:
+    uid: int
+    state: str                       # active | completed | shed | ...
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    reason: str = ""
+    detail: str = ""
+
+
+class _Request:
+    __slots__ = ("uid", "max_new_tokens", "degraded", "submit_t", "order",
+                 "abs_deadline", "served")
+
+    def __init__(self, uid: int, max_new_tokens: int, degraded: bool,
+                 submit_t: float, order: int,
+                 abs_deadline: Optional[float]):
+        self.uid = uid
+        self.max_new_tokens = max_new_tokens
+        self.degraded = degraded
+        self.submit_t = submit_t
+        self.order = order
+        self.abs_deadline = abs_deadline   # frontend clock; None = none
+        self.served = False                # first prefill progress seen
+
+
+class ServingFrontend:
+    """Admission + shedding + circuit breaking + health over one
+    ``FastGenEngine``. ``config`` is a ``ServingSectionConfig``, a plain
+    dict of its keys, or None (defaults); ``clock`` is injectable for
+    deterministic tests."""
+
+    def __init__(self, engine, config=None,
+                 clock=time.monotonic, register_health: bool = True,
+                 health_name: str = "serving"):
+        from deepspeed_tpu.runtime.config import ServingSectionConfig
+        from deepspeed_tpu.runtime.config_utils import config_from_dict
+
+        if config is None:
+            config = ServingSectionConfig()
+        elif isinstance(config, dict):
+            config = config_from_dict(ServingSectionConfig, config,
+                                      path="serving.")
+        else:
+            config.validate()   # dict path validates inside from_dict
+        self.engine = engine
+        self.cfg = config
+        self.clock = clock
+        self.breaker = CircuitBreaker(
+            failure_threshold=config.circuit_failure_threshold,
+            backoff_s=config.circuit_backoff_s,
+            backoff_max_s=config.circuit_backoff_max_s, clock=clock)
+        self.ctrl = AdmissionController(
+            max_queue=config.max_queue,
+            kv_high_watermark=config.kv_high_watermark,
+            kv_degrade_watermark=config.kv_degrade_watermark,
+            degraded_max_new_tokens=config.degraded_max_new_tokens,
+            shed_policy=config.shed_policy)
+        self._reqs: Dict[int, _Request] = {}      # active only
+        # terminal records, insertion-ordered and bounded (oldest evicted
+        # past cfg.max_result_history): sustained overload with fresh uids
+        # must not grow frontend memory without limit
+        self._results: Dict[int, RequestResult] = {}
+        # rejected uids in record order, lazily invalidated — gives the
+        # evict-rejections-first policy an O(1) victim during exactly the
+        # rejection storms that exercise it (entries whose record was
+        # dropped or superseded are skipped at pop time)
+        self._rejected_fifo: collections.deque = collections.deque()
+        self._order_counter = 0
+        self._suspects: List[int] = []   # admitted since last healthy tick
+        self.last_tick_t: Optional[float] = None
+        self._setup_telemetry()
+        self.health: Optional[HealthSurface] = None
+        if register_health:
+            # a second frontend in one process (multi-model replica) must
+            # not silently replace the first one's probes — and closing
+            # either must not unregister the survivor's — so suffix to a
+            # fresh name on collision
+            taken = set(telemetry.health_probe_names("live")) \
+                | set(telemetry.health_probe_names("ready"))
+            name, i = health_name, 1
+            while name in taken:
+                i += 1
+                name = f"{health_name}-{i}"
+            self.health = HealthSurface(self, name=name)
+
+    @classmethod
+    def from_ds_config(cls, engine, config, **kw) -> "ServingFrontend":
+        """Build from a full runtime config (dict / JSON path /
+        ``DeepSpeedTPUConfig``), using its ``"serving"`` section."""
+        from deepspeed_tpu.runtime.config import load_config
+
+        return cls(engine, config=load_config(config).serving, **kw)
+
+    # ------------------------------------------------------------------ #
+    def _setup_telemetry(self) -> None:
+        self._tm_admit = telemetry.counter(
+            "serving_admitted_total", "requests admitted past the front-end")
+        self._tm_reject = telemetry.counter(
+            "serving_rejected_total",
+            "requests rejected at admission, by reason "
+            "(queue_full / kv_pressure / circuit_open / invalid)")
+        self._tm_shed = telemetry.counter(
+            "serving_shed_total",
+            "live requests shed to admit newer traffic, by policy")
+        self._tm_degrade = telemetry.counter(
+            "serving_degraded_total",
+            "admissions whose max_new_tokens was clamped under KV pressure")
+        self._tm_resolved = telemetry.counter(
+            "serving_resolved_total",
+            "requests reaching a terminal state, by outcome")
+        self._tm_wait = telemetry.histogram(
+            "serving_queue_wait_seconds",
+            "submit() to first prefill progress (service start)")
+        self._tm_tick_fail = telemetry.counter(
+            "serving_tick_failures_total",
+            "engine ticks that raised, by exception type")
+        self._tm_poison = telemetry.counter(
+            "serving_poison_evictions_total",
+            "suspect requests evicted after a failing tick")
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+    def active_count(self) -> int:
+        return len(self._reqs)
+
+    def active_uids(self) -> List[int]:
+        """Active uids in admission order (oldest first)."""
+        return sorted(self._reqs, key=lambda u: self._reqs[u].order)
+
+    def _tokens_of(self, uid: int) -> List[int]:
+        """Tokens generated so far, empty when the engine no longer
+        tracks the uid (flushed externally — the frontend must answer,
+        not KeyError)."""
+        if uid in self.engine.seqs:
+            return list(self.engine.query(uid)[1])
+        return []
+
+    def result(self, uid: int) -> RequestResult:
+        """Terminal record for ``uid``, or its live ``active`` view.
+        Unknown uids raise KeyError (they were never submitted)."""
+        if uid in self._reqs:
+            return RequestResult(uid, ACTIVE, self._tokens_of(uid))
+        return self._results[uid]
+
+    def drop_result(self, uid: int) -> None:
+        """Forget a terminal record after delivering it (records are also
+        evicted oldest-first past ``max_result_history`` as a backstop)."""
+        self._results.pop(uid, None)
+
+    def _record_result(self, result: RequestResult) -> None:
+        prev = self._results.pop(result.uid, None)   # re-insert at tail
+        self._results[result.uid] = result
+        if result.state == REJECTED and \
+                not (prev is not None and prev.state == REJECTED):
+            # a uid re-rejected in place reuses its existing fifo entry —
+            # one client hammering one uid through a long open window
+            # must not grow the sidecar deque per retry
+            self._rejected_fifo.append(result.uid)
+        while len(self._results) > self.cfg.max_result_history:
+            # evict oldest REJECTED records first: the rejected caller
+            # already got its answer synchronously from submit(), while
+            # completed/shed/expired records are what result() polling
+            # exists for — a rejection storm must not wash those away
+            victim = None
+            while self._rejected_fifo:
+                u = self._rejected_fifo.popleft()
+                r = self._results.get(u)
+                if r is not None and r.state == REJECTED:
+                    victim = u
+                    break
+            self._results.pop(victim if victim is not None
+                              else next(iter(self._results)))
+
+    def _token_seconds(self) -> float:
+        est = self.engine.est_token_seconds()
+        return est if est is not None else self.cfg.assumed_token_seconds
+
+    def _outstanding_tokens(self) -> int:
+        """Backlog estimate: prompt tokens still to prefill + decode
+        grant still unserved, across active requests."""
+        total = 0
+        for uid, req in self._reqs.items():
+            seq = self.engine.seqs.get(uid)
+            if seq is None or seq.done:
+                continue
+            total += seq.prefill_remaining
+            total += max(0, req.max_new_tokens - len(seq.generated))
+        return total
+
+    def _kv_util(self, extra_blocks: int = 0) -> float:
+        return self.engine.kv_utilization(extra_blocks)
+
+    # ------------------------------------------------------------------ #
+    # admission
+    # ------------------------------------------------------------------ #
+    def submit(self, uid: int, prompt: Sequence[int],
+               deadline_s: Optional[float] = None,
+               max_new_tokens: Optional[int] = None
+               ) -> Union[Admitted, Overloaded, Rejected]:
+        """Admit one request through the resilience ladder. Never raises
+        for request-shaped problems — invalid requests come back as
+        :class:`Rejected`, capacity problems as :class:`Overloaded`
+        (both also recorded as terminal results for ``result(uid)``)."""
+        prompt = list(prompt)
+        if max_new_tokens is None:
+            max_new_tokens = self.cfg.default_max_new_tokens
+        now = self.clock()
+        # the deadline the ENGINE will enforce: an explicit per-request
+        # one, else the engine's request_deadline_s default — the shed
+        # policy must rank by the same deadline the scheduler expires by,
+        # or deadline_aware protects requests that are about to expire
+        eff_deadline_s = deadline_s if deadline_s is not None \
+            else self.engine.request_deadline_s
+        # fold finished-but-unharvested requests out of the queue first:
+        # without this, work that completed during the LAST tick still
+        # counts toward max_queue and spuriously rejects this admission
+        self._harvest()
+
+        # 1) validity — never shed a victim for a request that can't run
+        if uid in self._reqs or uid in self.engine.seqs:
+            return self._reject_invalid(uid, f"uid {uid} is still active")
+        if len(prompt) >= self.engine.max_len:
+            return self._reject_invalid(
+                uid, f"prompt len {len(prompt)} >= engine max_len "
+                f"{self.engine.max_len}")
+        if not prompt:
+            return self._reject_invalid(uid, "empty prompt")
+
+        # 2) circuit open — fail fast INSIDE the backoff window. Once the
+        # window expires the request is ADMITTED as the probe vehicle:
+        # with an empty queue nothing ever calls run_tick (the documented
+        # drive loops stop at zero active requests), so rejecting here
+        # after expiry would brick the replica forever — the half-open
+        # probe needs work to tick over
+        if self.breaker.state != CLOSED:
+            retry = self.breaker.retry_after_s()
+            if retry is None or retry > 0:
+                return self._reject_overloaded(
+                    uid, REASON_CIRCUIT_OPEN,
+                    retry if retry is not None
+                    else self.cfg.circuit_backoff_s,
+                    detail=f"circuit {self.breaker.state}")
+
+        # 3) capacity — queue cap and KV high watermark, shed per policy
+        tok_s = self._token_seconds()
+        blocks_needed = len(prompt) // self.engine.block_size + 1
+        reason = self.ctrl.overload_reason(
+            len(self._reqs), self._kv_util(blocks_needed))
+        if reason is not None:
+            incoming = _Candidate(
+                uid=uid, age_order=self._order_counter,
+                deadline_s=(now + eff_deadline_s)
+                if eff_deadline_s is not None else None,
+                remaining_tokens=len(prompt) + max_new_tokens, incoming=True)
+            victim = self.ctrl.pick_victim(
+                self._candidates(), incoming, now, tok_s)
+            if victim is not None and reason == "kv_pressure":
+                # shed only when freeing the victim's blocks can actually
+                # clear the bound — killing a live request AND rejecting
+                # the incoming one serves nobody (queue_full always
+                # clears: any victim frees a slot)
+                vblocks = len(self.engine.seqs[victim].blocks) \
+                    if victim in self.engine.seqs else 0
+                if self._kv_util(blocks_needed - vblocks) \
+                        > self.ctrl.kv_high_watermark:
+                    victim = None
+            if victim is not None:
+                self._shed(victim, reason)
+                # one victim per admission: recheck, reject if still over
+                reason = self.ctrl.overload_reason(
+                    len(self._reqs), self._kv_util(blocks_needed))
+            if reason is not None:
+                retry = retry_after_from_backlog(
+                    self._outstanding_tokens(), tok_s)
+                return self._reject_overloaded(uid, reason, retry)
+
+        # 4) graceful degradation — clamp the grant before anyone sheds.
+        # PROJECTED utilization (incoming prompt included), matching the
+        # rejection check: the request that itself pushes the pool into
+        # the degrade band must not escape the clamp
+        grant, degraded = self.ctrl.degraded_grant(
+            self._kv_util(blocks_needed), max_new_tokens)
+        if degraded:
+            self._tm_degrade.inc()
+
+        # 5) admit (engine put is batch-atomic: raises admit nothing)
+        try:
+            self.engine.put([uid], [prompt], deadline_s=deadline_s)
+        except ValueError as e:   # race-shaped residue; treat as invalid
+            return self._reject_invalid(uid, str(e))
+        self._order_counter += 1
+        self._reqs[uid] = _Request(
+            uid, grant, degraded, now, self._order_counter,
+            (now + eff_deadline_s) if eff_deadline_s is not None else None)
+        self._suspects.append(uid)
+        self._results.pop(uid, None)   # resubmission of a terminal uid
+        self._tm_admit.inc()
+        return Admitted(uid, grant, degraded)
+
+    def _candidates(self) -> List[_Candidate]:
+        out = []
+        for uid, req in self._reqs.items():
+            seq = self.engine.seqs.get(uid)
+            if seq is None or seq.done:
+                continue   # already terminal; harvest will resolve it
+            out.append(_Candidate(
+                uid=uid, age_order=req.order, deadline_s=req.abs_deadline,
+                remaining_tokens=seq.prefill_remaining
+                + max(0, req.max_new_tokens - len(seq.generated))))
+        return out
+
+    def _record_rejection(self, uid: int, reason: str, detail: str) -> None:
+        """Terminal record for a rejected submission — UNLESS the uid is
+        currently active (a duplicate submission must not clobber the
+        live request's lifecycle tracking)."""
+        self._tm_reject.inc(reason=reason)
+        if uid not in self._reqs:
+            self._record_result(RequestResult(uid, REJECTED, [], reason,
+                                              detail))
+            self._tm_resolved.inc(outcome=REJECTED)
+
+    def _reject_invalid(self, uid: int, detail: str) -> Rejected:
+        self._record_rejection(uid, REASON_INVALID, detail)
+        return Rejected(uid, REASON_INVALID, detail)
+
+    def _reject_overloaded(self, uid: int, reason: str, retry_after: float,
+                           detail: str = "") -> Overloaded:
+        self._record_rejection(uid, reason, detail)
+        return Overloaded(uid, reason, round(retry_after, 3),
+                          self.ctrl.shed_policy, detail)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def _resolve(self, uid: int, state: str, tokens: List[int],
+                 reason: str = "", detail: str = "",
+                 flush: bool = True) -> None:
+        """Move ``uid`` to a terminal state; frees engine bookkeeping
+        (and its KV blocks) when it was admitted."""
+        if flush:
+            self.engine.flush([uid])
+        self._reqs.pop(uid, None)
+        if uid in self._suspects:
+            self._suspects.remove(uid)
+        self._record_result(RequestResult(uid, state, tokens, reason,
+                                          detail))
+        self._tm_resolved.inc(outcome=state)
+
+    def _shed(self, uid: int, reason: str) -> None:
+        tokens = self._tokens_of(uid)
+        self._tm_shed.inc(policy=self.ctrl.shed_policy)
+        logger.warning(f"serving: shedding request {uid} "
+                       f"(policy={self.ctrl.shed_policy}, reason={reason})")
+        self._resolve(uid, SHED, tokens, reason=reason)
+
+    def _evict_suspect(self, exc: BaseException) -> None:
+        """Poison isolation: the newest request admitted since the last
+        healthy tick is evicted and failed — the loop worked before it
+        arrived. No suspects (a fault with no admission to blame) leaves
+        the failure to the circuit breaker alone."""
+        while self._suspects:
+            uid = self._suspects.pop()
+            if uid in self._reqs:
+                self._tm_poison.inc()
+                logger.warning(
+                    f"serving: evicting suspect request {uid} after tick "
+                    f"failure: {type(exc).__name__}: {exc}")
+                self._resolve(uid, FAILED, self._tokens_of(uid),
+                              reason="poisoned",
+                              detail=f"{type(exc).__name__}: {exc}")
+                return
+
+    def run_tick(self) -> bool:
+        """One protected engine tick. Returns True when a tick ran and
+        succeeded; False when the circuit rejected it or it failed (the
+        failure is absorbed — the loop NEVER sees the exception)."""
+        self.last_tick_t = self.clock()    # heartbeat: the loop is alive
+        if not self.breaker.allow():
+            return False
+        # a half-open probe's failure is presumed DEVICE fault (the
+        # circuit opened on repeated failures before any of the currently
+        # queued requests ticked) — don't scapegoat the request that
+        # happened to carry the probe
+        probing = self.breaker.state == HALF_OPEN
+        try:
+            chaos_point("serving/tick")
+            self.engine.step()
+        except Exception as e:
+            # always leave a trace: with no suspect to evict this branch
+            # would otherwise be metrics-only, and a replica going dark
+            # with zero log output is undebuggable. Bounded spam: ticks
+            # inside an open window never reach here
+            logger.warning(
+                f"serving: engine tick failed ({type(e).__name__}: {e}); "
+                f"failure streak {self.breaker.failure_streak + 1}, "
+                f"circuit {self.breaker.state}")
+            self._tm_tick_fail.inc(error=type(e).__name__)
+            self.breaker.record_failure()
+            if not probing:
+                self._evict_suspect(e)
+            self._harvest()
+            return False
+        except BaseException:
+            # KeyboardInterrupt/SystemExit mid-tick: still settle the
+            # breaker before propagating — a half-open probe that records
+            # nothing would wedge HALF_OPEN forever (allow() only has a
+            # time-based escape from OPEN)
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        self._suspects.clear()
+        self._harvest()
+        return True
+
+    def _harvest(self) -> None:
+        """Fold engine state into request lifecycle: queue-wait
+        observation at first service, terminal resolution (+ flush, which
+        releases KV blocks) for expired / completed / grant-reached
+        requests."""
+        for uid in list(self._reqs):
+            req = self._reqs[uid]
+            seq = self.engine.seqs.get(uid)
+            if seq is None:   # flushed behind our back — fail loudly-ish
+                self._resolve(uid, FAILED, [], reason="evicted",
+                              detail="sequence flushed outside the "
+                              "frontend", flush=False)
+                continue
+            if not req.served and (seq.prefilled > 0 or seq.done):
+                req.served = True
+                self._tm_wait.observe(self.clock() - req.submit_t)
+            if seq.expired:
+                self._resolve(uid, EXPIRED, list(seq.generated),
+                              reason="deadline")
+            elif seq.done or len(seq.generated) >= req.max_new_tokens:
+                self._resolve(uid, COMPLETED,
+                              list(seq.generated)[:req.max_new_tokens])
+
+    def run_until_drained(self, max_ticks: int = 10_000,
+                          open_wait_cap_s: float = 0.05) -> int:
+        """Tick until no request is active (or ``max_ticks``); returns
+        ticks consumed. While the circuit is open, each rejected tick
+        sleeps toward the probe window (capped at ``open_wait_cap_s``)
+        instead of busy-spinning a core through the backoff — so the
+        drain actually waits out an open circuit rather than burning its
+        whole tick budget in milliseconds. Callers writing their own
+        loop should do the same with ``breaker.retry_after_s()``."""
+        ticks = 0
+        while self._reqs and ticks < max_ticks:
+            if not self.run_tick() and self.breaker.state == OPEN:
+                retry = self.breaker.retry_after_s()
+                # real wall sleep only under the real clock: with an
+                # injected test clock the open window expires on FAKE
+                # time, which no amount of real sleeping advances — the
+                # test owns time and must advance it itself
+                if retry and self.clock is time.monotonic:
+                    time.sleep(min(retry, open_wait_cap_s))
+            ticks += 1
+        return ticks
+
+    def close(self) -> None:
+        """Unregister health probes and resolve any still-active request
+        as failed/draining (blocks released)."""
+        for uid in list(self._reqs):
+            self._resolve(uid, FAILED, self._tokens_of(uid),
+                          reason="shutdown")
+        if self.health is not None:
+            self.health.close()
+            self.health = None
+
+    def __enter__(self) -> "ServingFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,69 @@
+"""Serving health probes: liveness + readiness for load-balancer drains.
+
+The split follows the k8s convention, mapped onto continuous-batching
+reality:
+
+* **liveness** (``/healthz``) — is the serving LOOP alive? Staleness of
+  the tick heartbeat (stamped at every ``run_tick`` entry, including
+  circuit-rejected ones) only signals death while requests are PENDING:
+  a tick hung inside a device call stops stamping with work queued —
+  the restart-me signal. An idle frontend (nothing active — the
+  documented ``while fe.active_count(): fe.run_tick()`` loop parked) and
+  a frontend that has never ticked both report alive; idleness is not
+  death, or a traffic pause would restart healthy replicas.
+* **readiness** (``/readyz``) — should this replica receive NEW traffic?
+  True iff the circuit is closed AND the queue is below its admission
+  cap. An open circuit or a full queue flips the replica unready so the
+  balancer drains it while it recovers; requests already queued keep
+  being served.
+
+``HealthSurface`` registers both probes on the telemetry exposition
+server (``telemetry.register_health_probe``) under a shared name, so
+``/healthz``/``/readyz`` answer 200/503 with per-probe JSON detail.
+Probes read only host-side scalars — safe from the HTTP thread.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving.circuit import CLOSED
+
+
+class HealthSurface:
+    """Registers a frontend's liveness/readiness probes; ``close()``
+    (or the frontend's) unregisters them."""
+
+    def __init__(self, frontend, name: str = "serving"):
+        self.frontend = frontend
+        self.name = name
+        telemetry.register_health_probe("live", name, self.liveness)
+        telemetry.register_health_probe("ready", name, self.readiness)
+
+    def liveness(self) -> Tuple[bool, Dict[str, Any]]:
+        fe = self.frontend
+        if fe.last_tick_t is None:
+            return True, {"ticks": 0, "note": "loop not started"}
+        age = fe.clock() - fe.last_tick_t
+        timeout = fe.cfg.heartbeat_timeout_s
+        if fe.active_count() == 0:
+            return True, {"last_tick_age_s": round(age, 3),
+                          "note": "idle (no active requests)"}
+        return age <= timeout, {"last_tick_age_s": round(age, 3),
+                                "timeout_s": timeout,
+                                "active": fe.active_count()}
+
+    def readiness(self) -> Tuple[bool, Dict[str, Any]]:
+        fe = self.frontend
+        circuit_ok = fe.breaker.state == CLOSED
+        queue = fe.active_count()
+        queue_ok = queue < fe.cfg.max_queue
+        return circuit_ok and queue_ok, {
+            "circuit": fe.breaker.state,
+            "queue": queue,
+            "max_queue": fe.cfg.max_queue,
+        }
+
+    def close(self) -> None:
+        telemetry.unregister_health_probe("live", self.name)
+        telemetry.unregister_health_probe("ready", self.name)

@@ -28,10 +28,15 @@ from typing import Any, Dict, Optional, Sequence
 from deepspeed_tpu.telemetry.bridge import MonitorBridge
 from deepspeed_tpu.telemetry.exposition import (
     MetricsServer,
+    clear_health_probes,
+    health_probe_names,
+    health_report,
+    register_health_probe,
     render_prometheus as _render,
     snapshot as _snapshot,
     start_metrics_server as _start_server,
     stop_metrics_server as _stop_server,
+    unregister_health_probe,
 )
 from deepspeed_tpu.telemetry.registry import (
     Counter,
@@ -46,6 +51,8 @@ __all__ = [
     "MonitorBridge", "StallWatchdog", "counter", "gauge", "histogram",
     "get_registry", "span", "snapshot", "render_prometheus",
     "start_metrics_server", "stop_metrics_server", "add_collector", "reset",
+    "register_health_probe", "unregister_health_probe", "health_report",
+    "health_probe_names", "clear_health_probes",
 ]
 
 _default_registry = MetricsRegistry()
@@ -93,6 +100,8 @@ def stop_metrics_server() -> None:
 
 
 def reset() -> None:
-    """Tests only: stop the server and clear the default registry."""
+    """Tests only: stop the server, clear the default registry, and drop
+    any registered health probes."""
     _stop_server()
+    clear_health_probes()
     _default_registry.reset()

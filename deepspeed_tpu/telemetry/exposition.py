@@ -13,13 +13,24 @@ Two read paths over one registry:
 ``/metrics`` (text) and ``/snapshot`` (JSON). Port 0 binds an ephemeral port
 (exposed as ``.port``) — the tier-1 smoke test scrapes that. Start it on
 process 0 only (callers gate; the registry record path already is).
+
+**Health surfaces** ride the same server: ``/healthz`` (liveness) and
+``/readyz`` (readiness) run the probes registered via
+:func:`register_health_probe` and answer 200 (all probes ok) or 503 with
+a JSON body of per-probe details — the contract external load balancers
+use to drain a sick replica. With no probes registered both endpoints
+answer 200 (a bare metrics process is alive, and nothing claims it
+unready); the serving front-end (``deepspeed_tpu/serving``) registers
+tick-heartbeat liveness and circuit/queue readiness probes. Probe
+callbacks run on the HTTP thread — they must be cheap, lock-light, and
+never touch a device runtime.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from deepspeed_tpu.telemetry.registry import (
     Counter,
@@ -83,16 +94,84 @@ def snapshot(registry: MetricsRegistry) -> Dict[str, Any]:
     return out
 
 
+# --------------------------------------------------------------------- #
+# health probes (/healthz, /readyz)
+# --------------------------------------------------------------------- #
+#: probe: () -> (ok, detail_dict). Registered per kind under a unique
+#: name so several subsystems can contribute to one endpoint.
+HealthProbe = Callable[[], Tuple[bool, Dict[str, Any]]]
+
+_health_probes: Dict[str, Dict[str, HealthProbe]] = {"live": {}, "ready": {}}
+_health_lock = threading.Lock()
+
+
+def register_health_probe(kind: str, name: str, fn: HealthProbe) -> None:
+    """Register ``fn`` under ``/healthz`` (kind ``"live"``) or ``/readyz``
+    (kind ``"ready"``). Re-registering a name replaces the probe (the
+    restart-the-frontend idiom)."""
+    if kind not in _health_probes:
+        raise ValueError(f"health probe kind must be live|ready, got {kind!r}")
+    with _health_lock:
+        _health_probes[kind][name] = fn
+
+
+def unregister_health_probe(kind: str, name: str) -> None:
+    with _health_lock:
+        _health_probes.get(kind, {}).pop(name, None)
+
+
+def health_probe_names(kind: str) -> list:
+    """Registered probe names for one endpoint (callers picking a fresh
+    name — e.g. a second serving frontend in one process — check here
+    instead of silently replacing someone else's probe)."""
+    with _health_lock:
+        return list(_health_probes.get(kind, {}))
+
+
+def clear_health_probes() -> None:
+    """Tests only: drop every registered probe (telemetry.reset calls
+    this so one test's frontend can't leak unreadiness into the next)."""
+    with _health_lock:
+        for probes in _health_probes.values():
+            probes.clear()
+
+
+def health_report(kind: str) -> Tuple[bool, Dict[str, Any]]:
+    """Aggregate verdict for one endpoint: ok iff EVERY probe is ok.
+    A probe that raises reports as failed (a broken check must read as
+    sick, not healthy) rather than breaking the endpoint."""
+    with _health_lock:
+        probes = dict(_health_probes.get(kind, {}))
+    ok = True
+    checks: Dict[str, Any] = {}
+    for name, fn in sorted(probes.items()):
+        try:
+            p_ok, detail = fn()
+        except Exception as e:  # pragma: no cover - defensive
+            p_ok, detail = False, {"error": f"{type(e).__name__}: {e}"}
+        ok = ok and bool(p_ok)
+        checks[name] = {"ok": bool(p_ok), **detail}
+    return ok, {"status": "ok" if ok else "unavailable", "checks": checks}
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None  # set by MetricsServer
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        status = 200
         try:
-            if self.path.split("?")[0] in ("/metrics", "/"):
+            path = self.path.split("?")[0]
+            if path in ("/metrics", "/"):
                 body = render_prometheus(self.registry).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif self.path.split("?")[0] == "/snapshot":
+            elif path == "/snapshot":
                 body = json.dumps(snapshot(self.registry)).encode()
+                ctype = "application/json"
+            elif path in ("/healthz", "/readyz"):
+                ok, report = health_report(
+                    "live" if path == "/healthz" else "ready")
+                status = 200 if ok else 503
+                body = json.dumps(report).encode()
                 ctype = "application/json"
             else:
                 self.send_error(404)
@@ -100,7 +179,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # pragma: no cover - defensive
             self.send_error(500, str(e)[:100])
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

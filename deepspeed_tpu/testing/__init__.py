@@ -8,6 +8,7 @@ users can chaos-test their own checkpoint directories.
 from deepspeed_tpu.testing.chaos import (  # noqa: F401
     ChaosCheckpointEngine,
     ChaosError,
+    OverloadGenerator,
     arm,
     chaos_point,
     disarm,

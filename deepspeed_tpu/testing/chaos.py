@@ -6,7 +6,9 @@ checkpoint hot paths that must stay importable everywhere):
 * **Named fault points** — the checkpoint commit path calls
   :func:`chaos_point` at every window where a crash used to lose data
   (``save/pre_write``, ``save/mid_write``, ``save/pre_commit``,
-  ``save/pre_rename``, ``save/pre_latest``). Unarmed, a point is one
+  ``save/pre_rename``, ``save/pre_latest``), and the serving loop calls
+  it before every engine tick (``serving/tick`` — the circuit-breaker /
+  load-shed suite arms it to fake a sick device). Unarmed, a point is one
   global-is-None check. Armed (via :func:`arm` in-process, or the
   ``DSTPU_CHAOS`` env var for subprocess kill tests), a point can raise a
   transient I/O error or hard-kill the process — exactly what a preempted
@@ -17,6 +19,9 @@ checkpoint hot paths that must stay importable everywhere):
 * **failing_writes** — an fs shim that makes the first N file-*write*
   opens under a path prefix raise, for exercising the retry/backoff loop
   around marker and ``latest`` writes.
+* **OverloadGenerator** — a deterministic burst-traffic source (unique
+  uids + random prompts) for slamming the serving front-end with N× its
+  queue capacity and asserting clean shedding / zero KV leaks.
 
 ``DSTPU_CHAOS`` grammar: ``point=action[:n][;point=action[:n]...]``
   * ``fail:n``  — the first ``n`` hits of the point raise :class:`ChaosError`
@@ -33,8 +38,9 @@ from __future__ import annotations
 import builtins
 import contextlib
 import os
+import random
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 CHAOS_ENV = "DSTPU_CHAOS"
 
@@ -189,6 +195,36 @@ class ChaosCheckpointEngine:
         close = getattr(self.inner, "close", None)
         if close is not None:
             close()
+
+
+class OverloadGenerator:
+    """Deterministic burst-traffic source for overload/shedding tests.
+
+    Yields ``(uid, prompt)`` pairs with process-unique monotone uids and
+    seeded-random token prompts, so an overload test can slam a serving
+    front-end with ``burst(10 * max_queue)`` and assert every uid reaches
+    a terminal state with zero KV-block leaks. Dependency-free (stdlib
+    ``random``) like the rest of this module.
+    """
+
+    def __init__(self, vocab_size: int = 512,
+                 prompt_len: Tuple[int, int] = (4, 24), seed: int = 0,
+                 start_uid: int = 100_000):
+        self.vocab_size = vocab_size
+        self.prompt_len = prompt_len
+        self._rng = random.Random(seed)
+        self._next_uid = start_uid
+
+    def request(self) -> Tuple[int, List[int]]:
+        uid = self._next_uid
+        self._next_uid += 1
+        lo, hi = self.prompt_len
+        n = self._rng.randint(lo, hi)
+        return uid, [self._rng.randrange(self.vocab_size) for _ in range(n)]
+
+    def burst(self, n: int) -> List[Tuple[int, List[int]]]:
+        """``n`` requests arriving "at once" (one scheduling instant)."""
+        return [self.request() for _ in range(n)]
 
 
 @contextlib.contextmanager

@@ -66,6 +66,41 @@ def pytest_configure(config):
         "subprocesses (CPU backend, no TPU I/O — runs in tier-1; "
         "deselect with -m 'not chaos' on boxes where subprocesses are "
         "restricted)")
+    config.addinivalue_line(
+        "markers", "overload: serving burst/shedding tests (CPU backend, "
+        "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
+        "(default 120s; overload(timeout_s=N) overrides) so a Python-level "
+        "hang (spinning drain loop, deadlocked bookkeeping) fails THAT "
+        "test fast instead of eating the suite budget. A hang inside a "
+        "C-level XLA call can't be interrupted this way — the outer "
+        "tier-1 `timeout` still bounds those")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Per-test SIGALRM timeout for ``overload``-marked tests (no
+    pytest-timeout on this image). Only armed on the main thread of a
+    platform with SIGALRM; elsewhere the marker is timeout-less."""
+    import signal
+    import threading
+
+    marker = item.get_closest_marker("overload")
+    if marker is None or not hasattr(signal, "SIGALRM") \
+            or threading.current_thread() is not threading.main_thread():
+        return (yield)
+    timeout_s = marker.kwargs.get("timeout_s", 120)
+
+    def _on_alarm(signum, frame):
+        pytest.fail(f"overload test exceeded its {timeout_s}s timeout "
+                    "(hung engine tick?)", pytrace=True)
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, timeout_s)
+    try:
+        return (yield)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -75,6 +75,35 @@ class TestElasticAgent:
             agent.run()
         assert agent.restarts == 3
 
+    def test_exponential_backoff_with_cap_and_counters(self, monkeypatch):
+        from deepspeed_tpu import telemetry
+
+        restarts0 = telemetry.counter("elastic_restarts_total").value()
+        exhausted0 = telemetry.counter(
+            "elastic_restart_exhausted_total").value()
+        sleeps = []
+        monkeypatch.setattr("time.sleep", sleeps.append)
+
+        def factory(n):
+            return object()
+
+        def train_fn(engine, start_step):
+            raise RestartableFailure("always broken")
+
+        agent = ElasticAgent(
+            factory, train_fn, checkpoint_dir=None,
+            config=ElasticAgentConfig(max_restarts=3, restart_backoff_s=0.01,
+                                      restart_backoff_max_s=0.03,
+                                      reload_on_restart=False))
+        with pytest.raises(RestartableFailure):
+            agent.run()
+        # 0.01 -> 0.02 -> 0.04 capped to 0.03; 4th failure gives up, no sleep
+        assert sleeps == [0.01, 0.02, 0.03]
+        assert telemetry.counter(
+            "elastic_restarts_total").value() == restarts0 + 3
+        assert telemetry.counter(
+            "elastic_restart_exhausted_total").value() == exhausted0 + 1
+
 
 class TestOnDevice:
     def test_meta_returns_shapes(self):

@@ -634,6 +634,68 @@ def test_fastgen_engine_default_deadline():
     assert not fg.expired(2) and len(fg.seqs[2].generated) >= 1
 
 
+def test_fastgen_put_batch_atomic():
+    """A ValueError mid-batch (duplicate uid, over-long prompt) must admit
+    NOTHING — partial admission double-admits the survivors when the
+    caller retries the batch."""
+    rng = np.random.default_rng(14)
+    fg = FastGenEngine("tiny", n_blocks=16, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0, **CFG)
+    fg.put([1], _prompts(rng, [8]))
+    # duplicate of an ACTIVE uid in the middle of the batch
+    with pytest.raises(ValueError, match="still active"):
+        fg.put([2, 1, 3], _prompts(rng, [8, 8, 8]))
+    assert set(fg.seqs) == {1} and fg._admit_order == [1]
+    # duplicate WITHIN the batch
+    with pytest.raises(ValueError, match="still active"):
+        fg.put([4, 4], _prompts(rng, [8, 8]))
+    assert set(fg.seqs) == {1}
+    # over-long prompt after valid entries
+    with pytest.raises(ValueError, match="max_len"):
+        fg.put([5, 6], _prompts(rng, [8, 500]))
+    assert set(fg.seqs) == {1} and fg._admit_order == [1]
+    # the engine still serves normally after the rejected batches
+    out = fg.generate_all([7], _prompts(rng, [8]), max_new_tokens=4)
+    assert len(out[7]) == 4
+
+
+def test_fastgen_expired_unknown_uid_returns_false():
+    """expired() answers status polls for flushed/unknown uids instead of
+    raising KeyError (a flushed request is no longer expiring)."""
+    rng = np.random.default_rng(15)
+    fg = FastGenEngine("tiny", n_blocks=16, block_size=16,
+                       max_blocks_per_seq=8, token_budget=32,
+                       temperature=0.0, seed=0, **CFG)
+    assert fg.expired(999) is False            # never admitted
+    fg.put([1], _prompts(rng, [8]), deadline_s=-1.0)
+    fg.step()
+    assert fg.expired(1) is True
+    fg.flush([1])
+    assert fg.expired(1) is False              # flushed -> documented False
+
+
+def test_fastgen_est_token_seconds_is_per_engine():
+    """est_token_seconds must reflect only THIS engine's ticks: the
+    process-global histogram would blend a fast draft model and a slow
+    large model into one useless mean."""
+    rng = np.random.default_rng(16)
+
+    def mk():
+        return FastGenEngine("tiny", n_blocks=32, block_size=16,
+                             max_blocks_per_seq=8, token_budget=32,
+                             temperature=0.0, seed=0, **CFG)
+
+    a, b = mk(), mk()
+    assert a.est_token_seconds() is None
+    # two generations: the first warms the compile caches, the second
+    # produces warm observations (cold ticks are skipped by design)
+    a.generate_all([1, 2], _prompts(rng, [7, 21]), max_new_tokens=8)
+    a.generate_all([3, 4], _prompts(rng, [7, 21]), max_new_tokens=8)
+    assert a.est_token_seconds() is not None and a.est_token_seconds() > 0
+    assert b.est_token_seconds() is None, "engine b never ticked"
+
+
 def test_fastgen_decode_stream_drops_expired():
     """Deadline expiry must also cover the decode_stream scheduling path:
     an expired request is dropped at stream entry (blocks freed) instead

@@ -1052,6 +1052,26 @@ def headline_entry():
     }
 
 
+def _dslint_gate():
+    """Refuse to record benchmarks from a tree carrying new (non-baselined)
+    dslint findings: a host-sync or lock hazard that slipped in makes the
+    numbers unrepresentative at best and racy at worst, and a recorded
+    BENCH_*.json outlives the bug. Returns the new findings (None = clean
+    or gate unavailable). ``BENCH_DSLINT=0`` opts out for local what-if
+    runs — the committed history stays gated."""
+    if os.environ.get("BENCH_DSLINT", "1") == "0":
+        return None
+    try:
+        from deepspeed_tpu import analysis
+
+        new, _ = analysis.lint_repo()
+    except Exception as e:   # a broken linter must not kill benchmarking
+        print(f"bench: dslint gate unavailable ({type(e).__name__}: {e}); "
+              "proceeding ungated", file=sys.stderr)
+        return None
+    return new or None
+
+
 def main():
     _logs_to_stderr()
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
@@ -1076,6 +1096,18 @@ def main():
         return 0
 
     # ---- budget-orchestrated run: every entry is a bounded subprocess ----
+    findings = _dslint_gate()
+    if findings:
+        for f in findings[:20]:
+            print(f"bench: {f.render()}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench refused: dslint found new hazards",
+            "value": 0, "unit": "findings",
+            "error": f"dslint: {len(findings)} new non-baselined "
+                     "finding(s) — fix or baseline them before recording "
+                     "benchmarks (BENCH_DSLINT=0 overrides locally)"}))
+        return 1
+
     elapsed = {}
 
     def run_timed(name, cap, floor):

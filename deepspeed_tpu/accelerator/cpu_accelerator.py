@@ -46,7 +46,7 @@ class CPU_Accelerator(DeepSpeedTPUAccelerator):
             vm = psutil.virtual_memory()
             return {"bytes_in_use": vm.used, "peak_bytes_in_use": vm.used,
                     "bytes_limit": vm.total}
-        except Exception:
+        except (ImportError, OSError):   # psutil optional; zeros = unknown
             return {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
 
     def op_builder_dir(self) -> str:

@@ -29,7 +29,7 @@ def _detect_name() -> str:
         import jax
 
         platforms = {d.platform for d in jax.devices()}
-    except Exception:
+    except (ImportError, RuntimeError):   # no jax / no backend -> cpu
         return "cpu"
     if "tpu" in platforms:
         return "tpu"
@@ -41,8 +41,8 @@ def _detect_name() -> str:
         kinds = {d.device_kind.lower() for d in jax.devices()}
         if any("tpu" in k for k in kinds):
             return "tpu"
-    except Exception:
-        pass
+    except (ImportError, RuntimeError, AttributeError):
+        pass   # plugin device without device_kind -> fall through to cpu
     return "cpu"
 
 

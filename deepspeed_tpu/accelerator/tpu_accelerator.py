@@ -43,7 +43,13 @@ class TPU_Accelerator(DeepSpeedTPUAccelerator):
         dev = devs[device_index or 0]
         try:
             stats = dev.memory_stats() or {}
-        except Exception:
+        except Exception as e:
+            # PJRT plugins without the stats API raise backend-specific
+            # types; zeros mean "unknown", but leave a trace of why
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.debug(f"device memory_stats unavailable "
+                         f"({type(e).__name__}: {e})")
             stats = {}
         return {
             "bytes_in_use": stats.get("bytes_in_use", 0),
@@ -59,7 +65,7 @@ class TPU_Accelerator(DeepSpeedTPUAccelerator):
 
         try:
             return any(d.platform == "tpu" for d in jax.devices())
-        except Exception:
+        except RuntimeError:   # no backend at all -> not available
             return False
 
     def is_triton_supported(self) -> bool:

@@ -1,0 +1,78 @@
+"""dslint — TPU-hazard static analysis for this codebase.
+
+An AST-level lint pass over ``deepspeed_tpu/`` that catches the bug
+classes runtime checks can't: host syncs reachable from traced code,
+retracing hazards, lock-discipline violations on the state shared with
+the checkpoint-finalizer / watchdog / health-probe threads, wall-clock
+misuse in interval math, config-key typos, and metric-name drift.
+
+Self-enforcing: ``tests/unit/test_analysis.py`` runs the full pass over
+the package in tier-1 and fails on any non-baselined finding, and
+``bench.py`` refuses to record results from a tree with new findings.
+
+CLI::
+
+    python -m deepspeed_tpu.analysis deepspeed_tpu/        # text report
+    python -m deepspeed_tpu.analysis --format json ...     # machine output
+    python -m deepspeed_tpu.analysis --list-rules
+
+Suppression: ``# dslint: disable=<rule>[,<rule>...]`` on (or directly
+above) the offending line; ``# dslint: disable-file=<rule>`` anywhere in
+a file. Grandfathered findings live in ``analysis/baseline.json`` with a
+justification each — the baseline only shrinks. Rule catalog: README.md
+"Static analysis".
+
+This package is import-light on purpose (stdlib + ast only — no jax):
+the linter must run anywhere, including hosts with no device runtime.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    load_baseline,
+    load_project,
+    run_rules,
+    split_baselined,
+    write_baseline,
+)
+from deepspeed_tpu.analysis.rules import ALL_RULES, RULE_IDS, select_rules
+
+__all__ = [
+    "Finding", "Project", "SourceFile", "ALL_RULES", "RULE_IDS",
+    "load_baseline", "load_project", "run_rules", "split_baselined",
+    "select_rules", "write_baseline", "default_baseline_path", "lint",
+    "lint_repo",
+]
+
+#: the checked-in baseline shipping with the package
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def lint(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
+         baseline_path: Optional[str] = None, use_baseline: bool = True,
+         root: Optional[str] = None
+         ) -> Tuple[List[Finding], List[Finding]]:
+    """Run dslint over ``paths``; returns ``(new, baselined)`` findings.
+    ``baseline_path=None`` with ``use_baseline=True`` uses the checked-in
+    package baseline."""
+    project, parse_errors = load_project(paths, root=root)
+    active = select_rules(rules) if rules else list(ALL_RULES)
+    findings = run_rules(project, active, parse_errors=parse_errors)
+    if not use_baseline:
+        return findings, []
+    bl = load_baseline(baseline_path or default_baseline_path())
+    return split_baselined(findings, bl)
+
+
+def lint_repo() -> Tuple[List[Finding], List[Finding]]:
+    """Lint the installed ``deepspeed_tpu`` package against the checked-in
+    baseline — the self-enforcement entry point used by tier-1 and
+    ``bench.py``."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint([pkg_root], root=os.path.dirname(pkg_root))

@@ -1,0 +1,103 @@
+"""``python -m deepspeed_tpu.analysis`` — the dslint CLI.
+
+Exit codes: 0 = clean (or everything baselined/suppressed), 1 = new
+findings, 2 = usage or internal error. ``--format json`` emits a stable
+machine schema (see ``tests/unit/test_analysis.py``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu.analysis import ALL_RULES, lint, write_baseline
+
+JSON_SCHEMA_VERSION = 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dslint",
+        description="TPU-hazard static analysis (trace safety, retracing, "
+                    "lock discipline, wall-clock, silent-except, config "
+                    "keys, metric names)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the deepspeed_tpu "
+                        "package this CLI shipped with)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default: the checked-in "
+                        "analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report grandfathered findings too")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write current findings as a new baseline (with "
+                        "TODO justifications) and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--root", default=None,
+                   help="path-key root (default: parent of a single "
+                        "lint dir)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.RULE_ID:15s} {rule.RULE_DOC}")
+        return 0
+    paths = args.paths
+    if not paths:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [pkg]
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        new, baselined = lint(
+            paths, rules=rules,
+            baseline_path=args.baseline,
+            use_baseline=not args.no_baseline,
+            root=args.root)
+    except (KeyError, ValueError, OSError) as e:
+        print(f"dslint: error: {e}", file=sys.stderr)
+        return 2
+    if args.no_baseline:
+        new, baselined = new + baselined, []
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, new + baselined)
+        print(f"dslint: wrote {len(set(f.key for f in new + baselined))} "
+              f"baseline entries to {args.write_baseline}")
+        return 0
+
+    if args.format == "json":
+        payload = {
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [f.to_json() for f in new],
+            "baselined_count": len(baselined),
+            "counts": _counts(new),
+            "ok": not new,
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        summary = (f"dslint: {len(new)} finding(s)"
+                   + (f", {len(baselined)} baselined" if baselined else ""))
+        print(summary if new else
+              f"dslint: clean"
+              + (f" ({len(baselined)} baselined)" if baselined else ""))
+    return 1 if new else 0
+
+
+def _counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

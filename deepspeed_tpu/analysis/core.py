@@ -1,0 +1,282 @@
+"""dslint core: findings, suppressions, baseline, and the rule runner.
+
+The linter is AST-level and import-free: it parses the files under
+analysis, it never imports them (so a lint run can't be broken by a
+missing accelerator runtime, and linting a file with an import-time bug
+still works). Everything here is stdlib-only.
+
+Vocabulary:
+
+* a **rule** is a callable ``check(project) -> Iterable[Finding]`` with
+  ``RULE_ID`` / ``RULE_DOC`` attributes (see ``analysis/rules/``);
+* a **finding** is one diagnosed hazard, keyed for baselining by
+  ``rule::path::anchor`` — deliberately line-number-free so unrelated
+  edits above a grandfathered finding don't invalidate the baseline;
+* a **suppression** is an in-source ``# dslint: disable=<rule>`` comment
+  (same line, the line above, or any line of the flagged statement);
+  ``# dslint: disable-file=<rule>`` anywhere in a file silences the rule
+  for that whole file;
+* the **baseline** is a checked-in JSON file of grandfathered finding
+  keys, each with a human justification — the contract is that it only
+  ever shrinks (``tests/unit/test_analysis.py`` enforces the ceiling).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: every id a ``disable=`` comment may name; a typo'd id becomes an
+#: ``unknown-suppression`` finding instead of silently suppressing nothing
+KNOWN_RULES = (
+    "trace-safety",
+    "retracing",
+    "guarded-by",
+    "wall-clock",
+    "silent-except",
+    "config-key",
+    "metric-name",
+    "all",
+    "parse-error",
+    "unknown-suppression",
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dslint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_\-]+"
+    r"(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnosed hazard. ``anchor`` is the stable symbol the finding
+    hangs off (function/attribute/metric/config-key name) — it, not the
+    line number, keys the baseline."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    anchor: str = ""
+    end_line: int = 0  # statement span end — widens suppression matching
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.anchor or self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "anchor": self.anchor,
+                "key": self.key}
+
+
+class SourceFile:
+    """A parsed file plus its comment-derived suppression tables."""
+
+    def __init__(self, path: str, rel_path: str, text: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> set of rule ids disabled on that line; "all" disables all
+        self.line_disables: Dict[int, Set[str]] = {}
+        self.file_disables: Set[str] = set()
+        # (line, bogus id) for disable= comments naming no known rule — a
+        # typo'd suppression must fail loudly, not silently suppress nothing
+        self.unknown_suppressions: List[Tuple[int, str]] = []
+        self._scan_comments()
+
+    def _comment_lines(self):
+        """(lineno, comment text) for every REAL comment token — a
+        directive quoted inside a docstring or string literal must not
+        act as a suppression, so raw line scanning is not enough."""
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except tokenize.TokenError:   # truncated file: best-effort prefix
+            return
+
+    def _scan_comments(self) -> None:
+        for lineno, comment in self._comment_lines():
+            if "dslint" not in comment:
+                continue
+            for kind, rules in _SUPPRESS_RE.findall(comment):
+                ids = {r.strip() for r in rules.split(",") if r.strip()}
+                for bogus in ids - set(KNOWN_RULES):
+                    self.unknown_suppressions.append((lineno, bogus))
+                ids &= set(KNOWN_RULES)
+                if kind == "disable-file":
+                    self.file_disables |= ids
+                else:
+                    self.line_disables.setdefault(lineno, set()).update(ids)
+
+    def suppressed(self, rule: str, lineno: int,
+                   end_lineno: Optional[int] = None) -> bool:
+        """Whether ``rule`` is suppressed for a statement spanning
+        ``lineno..end_lineno`` — a disable comment counts on any line of
+        the span or on the line directly above it."""
+        if rule in self.file_disables or "all" in self.file_disables:
+            return True
+        last = end_lineno if end_lineno is not None else lineno
+        for ln in range(lineno - 1, last + 1):
+            ids = self.line_disables.get(ln)
+            if ids and (rule in ids or "all" in ids):
+                return True
+        return False
+
+
+class Project:
+    """The unit every rule sees: all files under analysis at once (the
+    config-key and metric-name rules are inherently cross-file)."""
+
+    def __init__(self, files: Sequence[SourceFile], root: str):
+        self.files = list(files)
+        self.root = root
+
+    def file(self, rel_path: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel_path == rel_path:
+                return f
+        return None
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of ``.py`` paths,
+    skipping caches and hidden directories. A path that is neither a
+    ``.py`` file nor a directory raises — a typo'd lint target must
+    fail loudly, not report "clean" over nothing."""
+    out: Set[str] = set()
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.add(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and not d.startswith(".")]
+                for name in names:
+                    if name.endswith(".py"):
+                        out.add(os.path.abspath(os.path.join(dirpath, name)))
+        else:
+            raise FileNotFoundError(
+                f"lint target {p!r} is not a .py file or directory")
+    return sorted(out)
+
+
+def load_project(paths: Sequence[str],
+                 root: Optional[str] = None) -> Tuple[Project, List[Finding]]:
+    """Parse every file; unparseable files become ``parse-error`` findings
+    instead of aborting the run (a syntax error in one file must not hide
+    every other file's hazards)."""
+    if root is None:
+        abs_paths = [os.path.abspath(p) for p in paths] or [os.getcwd()]
+        common = os.path.commonpath(abs_paths)
+        if os.path.isfile(common):
+            common = os.path.dirname(common)
+        # key paths relative to the lint target's PACKAGE root's parent:
+        # ascend out of any __init__.py-bearing package first, so
+        # "dslint deepspeed_tpu/serving/" and "dslint deepspeed_tpu/"
+        # produce identical baseline keys ("deepspeed_tpu/serving/…")
+        while os.path.exists(os.path.join(common, "__init__.py")) \
+                and os.path.basename(common):
+            common = os.path.dirname(common)
+        if len(abs_paths) == 1 and os.path.isdir(abs_paths[0]) \
+                and abs_paths[0] == common and os.path.basename(common):
+            common = os.path.dirname(common)
+        root = common
+    root = os.path.abspath(root)
+    files: List[SourceFile] = []
+    errors: List[Finding] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with tokenize.open(path) as f:   # honors PEP 263 encodings
+                text = f.read()
+            files.append(SourceFile(path, rel, text))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(Finding(
+                "parse-error", rel, getattr(e, "lineno", 0) or 0,
+                f"cannot analyze: {type(e).__name__}: {e}", anchor="parse"))
+    return Project(files, root), errors
+
+
+# ------------------------------------------------------------------ #
+# baseline
+# ------------------------------------------------------------------ #
+def load_baseline(path: str) -> Dict[str, str]:
+    """Baseline file → {finding key: justification}. A missing file is an
+    empty baseline; a malformed one is an error (silently ignoring it
+    would un-baseline everything or, worse, nothing)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"malformed baseline {path}: expected "
+                         '{"version": 1, "entries": [...]}')
+    out: Dict[str, str] = {}
+    for entry in data["entries"]:
+        out[entry["key"]] = entry.get("justification", "")
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   justification: str = "TODO: justify or fix") -> None:
+    entries = []
+    seen: Set[str] = set()
+    for f in sorted(findings, key=lambda f: f.key):
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({"key": f.key, "justification": justification})
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_baselined(findings: Sequence[Finding], baseline: Dict[str, str]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered) partition of ``findings`` against the
+    baseline. Every finding whose key is baselined is grandfathered —
+    the baseline carries the justification."""
+    new, old = [], []
+    for f in findings:
+        (old if f.key in baseline else new).append(f)
+    return new, old
+
+
+# ------------------------------------------------------------------ #
+# runner
+# ------------------------------------------------------------------ #
+def run_rules(project: Project, rules: Sequence,
+              parse_errors: Sequence[Finding] = ()) -> List[Finding]:
+    """Run every rule over the project and apply in-source suppressions.
+    Findings come back sorted by (path, line, rule) for stable output."""
+    findings: List[Finding] = list(parse_errors)
+    by_rel = {f.rel_path: f for f in project.files}
+    for src in project.files:
+        for lineno, bogus in src.unknown_suppressions:
+            findings.append(Finding(
+                "unknown-suppression", src.rel_path, lineno,
+                f"'# dslint: disable={bogus}' names no known rule — the "
+                f"comment suppresses NOTHING (known: "
+                f"{', '.join(r for r in KNOWN_RULES if r != 'all')})",
+                anchor=f"unknown/{bogus}"))
+    for rule in rules:
+        for finding in rule.check(project):
+            src = by_rel.get(finding.path)
+            if src is not None and src.suppressed(
+                    finding.rule, finding.line,
+                    finding.end_line or finding.line):
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

@@ -1,0 +1,40 @@
+"""dslint rule registry. Each rule module exports ``RULE_ID``,
+``RULE_DOC``, and ``check(project) -> Iterable[Finding]``."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from deepspeed_tpu.analysis.rules import (
+    config_keys,
+    lock_discipline,
+    metric_names,
+    retracing,
+    silent_except,
+    trace_safety,
+    wall_clock,
+)
+
+ALL_RULES = (
+    trace_safety,
+    retracing,
+    lock_discipline,
+    wall_clock,
+    silent_except,
+    config_keys,
+    metric_names,
+)
+
+RULE_IDS: List[str] = [r.RULE_ID for r in ALL_RULES]
+
+
+def rules_by_id() -> Dict[str, object]:
+    return {r.RULE_ID: r for r in ALL_RULES}
+
+
+def select_rules(ids: Sequence[str]):
+    table = rules_by_id()
+    missing = [i for i in ids if i not in table]
+    if missing:
+        raise KeyError(
+            f"unknown rule id(s) {missing}; known: {sorted(table)}")
+    return [table[i] for i in ids]

@@ -1,0 +1,164 @@
+"""Shared AST helpers for dslint rules (stdlib-only, import-free)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def add_parents(tree: ast.AST) -> None:
+    """Annotate every node with ``_dslint_parent`` (ast has no uplinks).
+    Idempotent and memoized on the tree — several rules call this on the
+    same SourceFile trees, and only the first call pays the walk."""
+    if getattr(tree, "_dslint_parented", False):
+        return
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._dslint_parent = parent  # type: ignore[attr-defined]
+    tree._dslint_parented = True  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_dslint_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_dslint_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return p
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p
+    return None
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local alias -> canonical dotted module/name. ``import numpy as np``
+    yields {"np": "numpy"}; ``from jax import jit`` yields
+    {"jit": "jax.jit"}; ``from time import time`` -> {"time": "time.time"}
+    (the *name*, so bare calls resolve to their origin)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolve_call(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of the callee, import aliases applied to the
+    head segment (``np.asarray`` -> ``numpy.asarray``)."""
+    name = call_name(call)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+_JIT_WRAPPER_SUFFIXES = ("jax.jit", "jax.pjit", "pjit.pjit", "jit", "pjit",
+                         "shard_map", "jax.experimental.pjit.pjit",
+                         "jax.experimental.shard_map.shard_map",
+                         "jax.shard_map")
+
+
+def is_jit_wrapper(name: Optional[str]) -> bool:
+    """Whether a resolved callee/decorator name is a tracing wrapper
+    (jit / pjit / shard_map, any import spelling)."""
+    if not name:
+        return False
+    return name in _JIT_WRAPPER_SUFFIXES or \
+        any(name.endswith("." + s) for s in ("jit", "pjit", "shard_map"))
+
+
+def decorator_is_jit(dec: ast.AST, aliases: Dict[str, str]) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` (+ pjit /
+    shard_map spellings)."""
+    if isinstance(dec, ast.Call):
+        name = resolve_call(dec, aliases)
+        if is_jit_wrapper(name):
+            return True   # @jax.jit(static_argnums=...) factory form
+        if name and name.split(".")[-1] == "partial" and dec.args:
+            first = dec.args[0]
+            return is_jit_wrapper(
+                aliases.get(first.id, first.id) if isinstance(first, ast.Name)
+                else dotted_name(first))
+        return False
+    name = dotted_name(dec)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return is_jit_wrapper(f"{head}.{rest}" if rest else head)
+
+
+def functions_by_scope(tree: ast.AST) -> Dict[ast.AST, List[ast.AST]]:
+    """scope node (Module/FunctionDef/ClassDef) -> functions defined
+    directly in it."""
+    out: Dict[ast.AST, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parent = getattr(node, "_dslint_parent", None)
+            out.setdefault(parent, []).append(node)
+    return out
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def in_with_lock(node: ast.AST, lock_expr: str) -> bool:
+    """Whether ``node`` sits lexically inside ``with <lock_expr>:`` (the
+    unparsed context expression must match textually)."""
+    for p in parents(node):
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                if ast.unparse(item.context_expr).replace(" ", "") \
+                        == lock_expr.replace(" ", ""):
+                    return True
+    return False
+
+
+def def_line_comment(src_lines: List[str], func: ast.AST) -> str:
+    """The trailing comment text on a ``def`` line (annotation carrier for
+    ``# locked: <expr>``). Multi-line signatures: scans def line through
+    the line the body starts on."""
+    start = func.lineno
+    body_start = func.body[0].lineno if getattr(func, "body", None) else start
+    last = max(start, body_start - 1)   # signature lines only, not the body
+    chunks = []
+    for ln in range(start, min(last, len(src_lines)) + 1):
+        if ln - 1 < len(src_lines) and "#" in src_lines[ln - 1]:
+            chunks.append(src_lines[ln - 1].split("#", 1)[1])
+    return " ".join(chunks)

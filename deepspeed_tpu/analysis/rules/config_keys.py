@@ -1,0 +1,124 @@
+"""config-key: string config keys must exist in the config schema.
+
+The bug class: a typo'd key in a DeepSpeed-style JSON config (or in the
+code reading one) is silently ignored — the section falls back to its
+defaults and nobody notices until the run behaves wrong. PR 2 fixed one
+of these by hand (the un-ignored ``"checkpoint"`` section); this rule
+catches the whole class at lint time.
+
+Schema extraction is AST-based (no imports): the key universe is
+
+* every ``@dataclass`` field name found anywhere in the analyzed tree —
+  ``runtime/config.py``'s section classes and the satellite
+  ``from_ds_config`` dataclasses alike;
+* the ``_IGNORED_SECTIONS`` literal in ``runtime/config.py`` (accepted-
+  and-warned reference sections);
+* ``EXTRA_KEYS`` below: reference-JSON spellings handled by hand-rolled
+  parsers rather than dataclasses (each entry documents where).
+
+Checked sites: ``<config>.get("key" ...)``, ``<config>["key"]`` reads
+and writes, where ``<config>`` is a name matching ``config`` /
+``cfg`` / ``ds_config`` / ``base_config`` / ``config_dict`` etc. —
+dict-shaped locals with other names are out of scope by design (zero
+false positives beats exhaustiveness here).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Set
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import str_const
+
+RULE_ID = "config-key"
+RULE_DOC = ("string keys on config-shaped dicts must exist in the "
+            "config schema (dataclass fields)")
+
+#: reference-JSON keys consumed by hand-rolled parsers (not dataclass
+#: fields anywhere). Each entry names its consumer.
+EXTRA_KEYS = {
+    "quant",                 # inference/quantization.from_ds_config
+    "weight_quantization",   # inference/quantization (reference spelling)
+    "post_init_quant",       # inference/quantization (reference spelling)
+    "compression_training",  # compression/compress.plan_compression
+    "elasticity",            # elasticity/elasticity.compute_elastic_config
+    "micro_batch",           # autotuning candidate dicts share the name
+}
+
+_CONFIG_NAME_RE = re.compile(
+    r"^(ds_|base_|json_|full_)?(config|cfg)(_dict|_params)?$")
+
+
+def _is_dataclass_decorated(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        name = None
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Attribute):
+            name = dec.attr
+        elif isinstance(dec, ast.Name):
+            name = dec.id
+        if name == "dataclass":
+            return True
+    return False
+
+
+def _schema_keys(project: Project) -> Set[str]:
+    keys: Set[str] = set(EXTRA_KEYS)
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    _is_dataclass_decorated(node):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        keys.add(stmt.target.id)
+                    elif isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                keys.add(t.id)
+            elif isinstance(node, ast.Assign) and \
+                    src.rel_path.endswith("runtime/config.py") and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                # _IGNORED_SECTIONS and friends: tuple-of-str consts in the
+                # schema module are accepted section spellings
+                for elt in node.value.elts:
+                    s = str_const(elt)
+                    if s is not None:
+                        keys.add(s)
+    return keys
+
+
+def _config_base_name(node: ast.AST):
+    if isinstance(node, ast.Name):
+        return node.id if _CONFIG_NAME_RE.match(node.id) else None
+    if isinstance(node, ast.Attribute):   # self.base_config, self.cfg ...
+        return node.attr if _CONFIG_NAME_RE.match(node.attr) else None
+    return None
+
+
+def check(project: Project):
+    schema = _schema_keys(project)
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            key = None
+            base = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args:
+                base = _config_base_name(node.func.value)
+                key = str_const(node.args[0])
+            elif isinstance(node, ast.Subscript):
+                base = _config_base_name(node.value)
+                key = str_const(node.slice)
+            if base is None or key is None or key in schema:
+                continue
+            yield Finding(
+                RULE_ID, src.rel_path, node.lineno,
+                f"config key {key!r} (on {base!r}) is not in the config "
+                "schema — typo'd keys are silently ignored at runtime; "
+                "add the field to its section dataclass or to "
+                "analysis/rules/config_keys.EXTRA_KEYS",
+                anchor=f"key/{key}",
+                end_line=node.end_lineno or node.lineno)

@@ -1,0 +1,243 @@
+"""guarded-by: annotation-checked lock discipline for shared state.
+
+The threads this codebase runs — async-checkpoint finalizer, stall
+watchdog, serving health probes, the HTTP scrape thread — share state
+with the training/serving loop. The registry takes a lock; the
+discipline this rule enforces is that every WRITE site of a declared
+shared attribute actually holds it.
+
+Declaration syntax (a trailing comment on the assignment that
+introduces the state):
+
+    self._metrics = {}          # guarded-by: self._lock
+    _async_thread = None        # guarded-by: _save_lock     (module global)
+    self.last_tick_t = None     # guarded-by: single-writer
+
+Enforcement, per write site (``x = ...`` / ``x += ...`` targets):
+
+* writes inside the declaring ``__init__`` / at the declaration itself
+  are exempt (construction happens-before publication);
+* a lock-expression guard passes when the write is lexically inside
+  ``with <lock>:`` (textual match on the unparsed context expression),
+  or when the enclosing function's ``def`` line carries
+  ``# locked: <lock>`` — the caller-holds-the-lock contract for helper
+  functions like ``_save_state_locked``;
+* ``single-writer`` declares thread-confined state read (not written)
+  cross-thread: writes are legal only inside methods of the declaring
+  class — any write from another class or module level is flagged.
+
+"Write" covers rebinding (``x = / x += ...``), subscript stores on the
+guarded container (``self._metrics[k] = v``, ``del self._metrics[k]``),
+and in-place mutator calls (``self._collectors.append(...)``, ``.pop``,
+``.clear``, ``.update`` …) — a lock that only guards rebinding while
+the dict fills unlocked protects nothing.
+
+Reads are deliberately unchecked: lock-free reads of atomic scalars are
+a documented idiom here (health probes), and flagging every read would
+drown the real findings.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    def_line_comment,
+    enclosing_class,
+    enclosing_function,
+    in_with_lock,
+    parents,
+)
+
+RULE_ID = "guarded-by"
+RULE_DOC = ("writes to '# guarded-by:' annotated shared state outside "
+            "the declared lock")
+
+_DECL_RE = re.compile(r"#\s*guarded-by:\s*([^#]+?)\s*(?:#|$)")
+# matched against def-line comment TEXT (the '#' is already stripped)
+_HELD_RE = re.compile(r"(?:^|\s)locked:\s*([^#]+?)\s*(?:#|$)")
+
+SINGLE_WRITER = "single-writer"
+
+#: method names that mutate their receiver in place (list/dict/set/deque)
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
+             "appendleft", "clear", "add", "discard", "update",
+             "setdefault", "popitem", "sort", "reverse"}
+
+
+def _decl_on_line(src, lineno: int) -> Optional[str]:
+    if 1 <= lineno <= len(src.lines):
+        m = _DECL_RE.search(src.lines[lineno - 1])
+        if m:
+            return m.group(1).strip()
+    return None
+
+
+def _held_locks(src, fn: ast.AST) -> List[str]:
+    """Locks the enclosing function chain declares held via '# locked:'."""
+    out = []
+    cur = fn
+    while cur is not None:
+        m = _HELD_RE.search(def_line_comment(src.lines, cur))
+        if m:
+            out.append(m.group(1).strip())
+        cur = enclosing_function(cur)
+    return out
+
+
+def _write_targets(node) -> List[Tuple[ast.AST, str]]:
+    """Mutation sites of ``node`` as (owning expression, kind) pairs.
+    kind: "rebind" for plain name/attribute targets, "mutate" for
+    subscript stores (``x[k] = v`` / ``del x[k]``) and mutator-method
+    calls (``x.append(...)``) — rebinding a NAME only touches the module
+    global when a ``global`` statement is in force, while mutation
+    reaches the shared object through any reference."""
+    if isinstance(node, ast.Assign):
+        raw = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        raw = [node.target]
+    elif isinstance(node, ast.Delete):
+        raw = list(node.targets)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return [(node.func.value, "mutate")]
+    else:
+        return []
+    out: List[Tuple[ast.AST, str]] = []
+    for t in raw:   # unpack `a, b = ...` tuple targets
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Subscript):
+                out.append((e.value, "mutate"))   # x[k] = v mutates x
+            else:
+                out.append((e, "rebind"))
+    return out
+
+
+def _collect_declarations(src) -> Tuple[Dict[Tuple[str, str], Tuple[str, int]],
+                                        Dict[str, Tuple[str, int]]]:
+    """((class, attr) -> (lock, decl line), global name -> (lock, line))."""
+    attr_decls: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    global_decls: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(src.tree):
+        for target, kind in _write_targets(node):
+            if kind != "rebind":
+                continue   # declarations live on plain assignments
+            lock = _decl_on_line(src, node.lineno)
+            if lock is None:
+                continue
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                cls = enclosing_class(node)
+                if cls is not None:
+                    attr_decls[(cls.name, target.attr)] = (lock, node.lineno)
+            elif isinstance(target, ast.Name) and \
+                    enclosing_function(node) is None:
+                global_decls[target.id] = (lock, node.lineno)
+    return attr_decls, global_decls
+
+
+def _in_init(node: ast.AST) -> bool:
+    fn = enclosing_function(node)
+    return getattr(fn, "name", "") == "__init__"
+
+
+def check(project: Project):
+    for src in project.files:
+        add_parents(src.tree)
+        attr_decls, global_decls = _collect_declarations(src)
+        if not attr_decls and not global_decls:
+            continue
+        for node in ast.walk(src.tree):
+            for target, kind in _write_targets(node):
+                yield from _check_write(src, node, target, kind,
+                                        attr_decls, global_decls)
+
+
+def _declares_global(fn, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global) and name in node.names:
+            return True
+    return False
+
+
+def _check_write(src, stmt, target, kind, attr_decls, global_decls):
+    # self.<attr> writes
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        cls = enclosing_class(stmt)
+        if cls is None:
+            return
+        decl = attr_decls.get((cls.name, target.attr))
+        if decl is None:
+            return
+        lock, decl_line = decl
+        if stmt.lineno == decl_line or _in_init(stmt):
+            return
+        if lock == SINGLE_WRITER:
+            return   # writes inside the declaring class are the contract
+        if _holds(src, stmt, lock):
+            return
+        yield Finding(
+            RULE_ID, src.rel_path, stmt.lineno,
+            f"write to self.{target.attr} (guarded-by: {lock}) outside "
+            f"'with {lock}:' — annotate the enclosing def with "
+            f"'# locked: {lock}' if the caller holds it",
+            anchor=f"{cls.name}.{target.attr}",
+            end_line=stmt.end_lineno or stmt.lineno)
+        return
+    # module-global writes (both at module level and via `global` in defs)
+    if isinstance(target, ast.Name) and target.id in global_decls:
+        lock, decl_line = global_decls[target.id]
+        if stmt.lineno == decl_line:
+            return
+        fn = enclosing_function(stmt)
+        if fn is None:
+            return   # module-level (import-time) rebinding: single-threaded
+        if kind == "rebind" and not _declares_global(fn, target.id):
+            # a plain local binding merely SHADOWS the global name — not a
+            # write to the shared state; subscript stores / mutator calls
+            # ("mutate") reach the global object without a `global` stmt
+            return
+        if lock == SINGLE_WRITER or _holds(src, stmt, lock):
+            return
+        yield Finding(
+            RULE_ID, src.rel_path, stmt.lineno,
+            f"write to global {target.id} (guarded-by: {lock}) outside "
+            f"'with {lock}:' — annotate the enclosing def with "
+            f"'# locked: {lock}' if the caller holds it",
+            anchor=f"<module>.{target.id}",
+            end_line=stmt.end_lineno or stmt.lineno)
+    # writes from OTHER classes to a single-writer attribute
+    if isinstance(target, ast.Attribute):
+        for (cls_name, attr), (lock, _) in attr_decls.items():
+            if lock == SINGLE_WRITER and target.attr == attr:
+                cls = enclosing_class(stmt)
+                base_is_self = isinstance(target.value, ast.Name) and \
+                    target.value.id == "self"
+                if base_is_self and cls is not None and cls.name == cls_name:
+                    continue
+                if not base_is_self:
+                    yield Finding(
+                        RULE_ID, src.rel_path, stmt.lineno,
+                        f"write to .{attr} (declared single-writer in "
+                        f"{cls_name}) from outside the owning class — "
+                        "cross-thread/cross-object writes break the "
+                        "single-writer contract",
+                        anchor=f"{cls_name}.{attr}/foreign",
+                        end_line=stmt.end_lineno or stmt.lineno)
+
+
+def _holds(src, stmt, lock: str) -> bool:
+    if in_with_lock(stmt, lock):
+        return True
+    fn = enclosing_function(stmt)
+    if fn is not None:
+        norm = lock.replace(" ", "")
+        return any(h.replace(" ", "") == norm
+                   for h in _held_locks(src, fn))
+    return False

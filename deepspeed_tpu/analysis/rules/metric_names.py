@@ -1,0 +1,187 @@
+"""metric-name: one name, one kind, one label schema, one catalog row.
+
+The telemetry registry creates metrics idempotently by name — which
+means a typo'd name silently creates a SECOND metric, a kind mismatch
+raises at runtime (in whatever code path constructs second), and
+inconsistent label keys split one logical series into disjoint children
+that no dashboard can sum. This rule moves all three to lint time:
+
+* every literal-named ``counter("x", ...)`` / ``gauge`` / ``histogram``
+  construction site is collected (``telemetry.counter``,
+  ``registry.counter``, the ``_counter`` indirection — any callee whose
+  last segment matches);
+* a name constructed with more than one kind is flagged at every site;
+* label keys are gathered from ``.inc(...)`` / ``.set(...)`` /
+  ``.observe(...)`` / ``.set_max(...)`` sites — both direct chains
+  (``counter("x").inc(reason="y")``) and handles assigned in the same
+  file (``self._tm_x = telemetry.counter("x")`` … ``self._tm_x.inc``).
+  Among sites that pass ANY labels, the key sets must agree (label-less
+  sites are fine: they are the unlabeled child). ``**kwargs`` sites are
+  skipped — the keys are not statically known;
+* every metric name must appear in the README metric catalog
+  (``README.md``) — an undocumented metric is invisible to operators.
+
+Dynamic names (f-strings) are skipped; keep them rare.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import str_const
+
+RULE_ID = "metric-name"
+RULE_DOC = ("telemetry metric names: one kind + one label set across all "
+            "call sites, and a README catalog row")
+
+_CTOR_NAMES = {"counter": "counter", "gauge": "gauge",
+               "histogram": "histogram", "_counter": "counter",
+               "_gauge": "gauge", "_histogram": "histogram"}
+_RECORD_METHODS = {"inc", "set", "set_max", "observe"}
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else None
+    return _CTOR_NAMES.get(name or "")
+
+
+def _find_readme(project: Project) -> str:
+    # ONLY the README at the project root is the catalog — walking up
+    # further would match unrelated READMEs when linting stray files
+    candidate = os.path.join(project.root, "README.md")
+    if os.path.exists(candidate):
+        with open(candidate) as f:
+            return f.read()
+    return ""
+
+
+class _Site:
+    __slots__ = ("path", "line", "end_line")
+
+    def __init__(self, path, line, end_line):
+        self.path, self.line, self.end_line = path, line, end_line
+
+
+def check(project: Project):
+    # name -> kind -> [sites];  name -> [(label key frozenset, site)]
+    kinds: Dict[str, Dict[str, List[_Site]]] = {}
+    labels: Dict[str, List[Tuple[Optional[frozenset], _Site]]] = {}
+
+    for src in project.files:
+        handle_to_name: Dict[str, str] = {}
+        ambiguous: Set[str] = set()
+        # pass 1: constructions + handle assignments
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _ctor_kind(node)
+            if kind is None or not node.args:
+                continue
+            name = str_const(node.args[0])
+            if name is None:
+                continue   # dynamic name — not statically checkable
+            site = _Site(src.rel_path, node.lineno,
+                         node.end_lineno or node.lineno)
+            kinds.setdefault(name, {}).setdefault(kind, []).append(site)
+        # pass 2: handle assignments (name/attr -> metric name)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                kind = _ctor_kind(node.value)
+                name = str_const(node.value.args[0]) \
+                    if kind and node.value.args else None
+                if name is None:
+                    continue
+                for t in node.targets:
+                    handle = None
+                    if isinstance(t, ast.Name):
+                        handle = t.id
+                    elif isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        handle = f"self.{t.attr}"
+                    if handle is None:
+                        continue
+                    if handle in handle_to_name and \
+                            handle_to_name[handle] != name:
+                        ambiguous.add(handle)
+                    handle_to_name[handle] = name
+        # pass 3: record-call label keys
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr not in _RECORD_METHODS:
+                continue
+            recv = node.func.value
+            name = None
+            if isinstance(recv, ast.Call):
+                kind = _ctor_kind(recv)
+                name = str_const(recv.args[0]) if kind and recv.args else None
+            elif isinstance(recv, ast.Name):
+                if recv.id not in ambiguous:
+                    name = handle_to_name.get(recv.id)
+            elif isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                h = f"self.{recv.attr}"
+                if h not in ambiguous:
+                    name = handle_to_name.get(h)
+            if name is None:
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue   # **labels — keys unknown statically
+            keyset = frozenset(kw.arg for kw in node.keywords)
+            site = _Site(src.rel_path, node.lineno,
+                         node.end_lineno or node.lineno)
+            labels.setdefault(name, []).append(
+                (keyset if keyset else None, site))
+
+    readme = _find_readme(project)
+
+    for name, by_kind in sorted(kinds.items()):
+        if len(by_kind) > 1:
+            desc = ", ".join(f"{k} at {s[0].path}:{s[0].line}"
+                             for k, s in sorted(by_kind.items()))
+            for kind, sites in sorted(by_kind.items()):
+                for site in sites:
+                    yield Finding(
+                        RULE_ID, site.path, site.line,
+                        f"metric {name!r} constructed as more than one "
+                        f"kind ({desc}) — the registry raises on the "
+                        "second kind at runtime",
+                        anchor=f"kind/{name}",
+                        end_line=site.end_line)
+        # word-boundary match: 'fastgen_queue' must NOT pass because
+        # 'fastgen_queue_depth' is documented
+        if readme and not re.search(
+                rf"(?<![A-Za-z0-9_]){re.escape(name)}(?![A-Za-z0-9_])",
+                readme):
+            first = min((s for ss in by_kind.values() for s in ss),
+                        key=lambda s: (s.path, s.line))
+            yield Finding(
+                RULE_ID, first.path, first.line,
+                f"metric {name!r} is not documented in the README metric "
+                "catalog — add a row to the Observability table",
+                anchor=f"catalog/{name}",
+                end_line=first.end_line)
+
+    for name, sites in sorted(labels.items()):
+        labeled = [(ks, s) for ks, s in sites if ks is not None]
+        distinct = {ks for ks, _ in labeled}
+        if len(distinct) > 1:
+            detail = "; ".join(
+                f"{{{','.join(sorted(ks))}}} at {s.path}:{s.line}"
+                for ks, s in labeled)
+            for ks, site in labeled:
+                yield Finding(
+                    RULE_ID, site.path, site.line,
+                    f"metric {name!r} recorded with inconsistent label "
+                    f"keys ({detail}) — one logical series is split into "
+                    "children no query can aggregate",
+                    anchor=f"labels/{name}",
+                    end_line=site.end_line)

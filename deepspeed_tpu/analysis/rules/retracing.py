@@ -1,0 +1,138 @@
+"""retracing: patterns that silently recompile on every call.
+
+Two checkable hazards:
+
+* **jit-in-loop** — a ``jax.jit`` / ``pjit`` / ``shard_map`` call inside
+  a ``for`` / ``while`` body creates a FRESH wrapped callable each
+  iteration; jit caches by function object identity, so every iteration
+  traces and compiles again. Hoist the wrapper out of the loop (or cache
+  it, the ``self._compiled[...]`` idiom).
+* **unhashable-static** — a parameter named by ``static_argnums`` /
+  ``static_argnames`` whose default value is a list/dict/set literal:
+  static args are cache keys and must be hashable; an unhashable one
+  raises at call time, and a *mutable* hashable stand-in (tuple rebuilt
+  per call with different contents) retraces per distinct value.
+
+Both checks are lexical: a jit call in a loop that is actually cached
+behind a conditional should carry a ``# dslint: disable=retracing``
+with its justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    decorator_is_jit,
+    import_aliases,
+    is_jit_wrapper,
+    parents,
+    resolve_call,
+)
+
+RULE_ID = "retracing"
+RULE_DOC = ("jit/shard_map wrappers rebuilt per loop iteration; "
+            "unhashable static-arg defaults")
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _in_loop(node: ast.AST) -> bool:
+    cur = node
+    for p in parents(node):
+        # For.iter evaluates ONCE; While.test re-evaluates every
+        # iteration, so a wrapper built there retraces per loop too
+        if isinstance(p, (ast.For, ast.While)) \
+                and cur is not getattr(p, "iter", None):
+            return True
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            # a def inside a loop resets the context: the inner function
+            # body does not re-run per iteration
+            return False
+        cur = p
+    return False
+
+
+def _static_names(call_or_dec: ast.Call, fn: ast.AST):
+    """Parameter names designated static by static_argnums/argnames."""
+    args = fn.args
+    positional = [a.arg for a in args.posonlyargs + args.args]
+    names = set()
+    for kw in call_or_dec.keywords:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+        elif kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and 0 <= v.value < len(positional):
+                    names.add(positional[v.value])
+    return names
+
+
+def _default_of(fn: ast.AST, param: str):
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    n_defaults = len(args.defaults)
+    for i, a in enumerate(pos):
+        if a.arg == param:
+            j = i - (len(pos) - n_defaults)
+            return args.defaults[j] if j >= 0 else None
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if a.arg == param:
+            return d
+    return None
+
+
+def check(project: Project):
+    for src in project.files:
+        aliases = import_aliases(src.tree)
+        add_parents(src.tree)
+        # function defs by name, for resolving jit(f, static_argnums=...)
+        defs = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and \
+                    is_jit_wrapper(resolve_call(node, aliases)):
+                if _in_loop(node):
+                    yield Finding(
+                        RULE_ID, src.rel_path, node.lineno,
+                        "jit/shard_map wrapper built inside a loop body — "
+                        "each iteration traces and compiles afresh; hoist "
+                        "or cache the wrapped callable",
+                        anchor="jit-in-loop",
+                        end_line=node.end_lineno or node.lineno)
+                target = None
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = defs.get(node.args[0].id)
+                if target is not None:
+                    yield from _check_static(src, node, target)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            decorator_is_jit(dec, aliases):
+                        yield from _check_static(src, dec, node)
+
+
+def _check_static(src, call: ast.Call, fn: ast.AST):
+    for name in _static_names(call, fn):
+        default = _default_of(fn, name)
+        if default is not None and isinstance(default, _MUTABLE_LITERALS):
+            yield Finding(
+                RULE_ID, src.rel_path, call.lineno,
+                f"static arg {name!r} of {fn.name!r} defaults to an "
+                "unhashable (mutable) value — static args are trace-cache "
+                "keys; use a tuple/frozen value",
+                anchor=f"static/{fn.name}/{name}",
+                end_line=call.end_lineno or call.lineno)

@@ -1,0 +1,108 @@
+"""silent-except: broad handlers that swallow errors without a trace.
+
+``except Exception: pass`` turns every failure mode — including the one
+you didn't anticipate — into silence. In a serving or training loop
+that means a replica degrades with zero log output and zero metrics,
+the failure class DeepSpeed's runtime checks exist to prevent.
+
+A broad handler (bare ``except``, ``except Exception``, ``except
+BaseException``, or a tuple containing either) passes the rule when its
+body leaves ANY trace:
+
+* re-raises (``raise``), or
+* logs (``logger.*`` / ``logging.*`` / ``log_dist`` / ``warnings.warn``
+  / ``print``), or
+* records a metric (an ``.inc(`` / ``.observe(`` / ``.set(`` call —
+  the telemetry-counter idiom), or
+* binds the exception (``as e``) and actually uses it (surfacing the
+  error in a return value or report counts as handling it).
+
+Handlers that deliberately probe ("is this optional dependency /
+backend available?") should narrow the exception type where the
+failure class is known (``ImportError``, ``OSError``), or carry a
+``# dslint: disable=silent-except`` with the justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    dotted_name,
+    enclosing_function,
+)
+
+RULE_ID = "silent-except"
+RULE_DOC = ("broad except handlers that neither log, count, re-raise, "
+            "nor use the exception")
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_HEADS = {"logger", "logging", "log", "warnings"}
+_LOG_BARE = {"log_dist", "print", "warn"}
+# logging-method tails accepted on ANY receiver (self.logger.warning,
+# cls._log.error, …) — the receiver spelling varies, the verb doesn't
+_LOG_METHODS = {"warning", "warn", "error", "info", "debug", "exception",
+                "critical", "log"}
+# metric records: inc/observe/set_max are unambiguous; bare .set() is NOT
+# (threading.Event.set() in a handler is a shutdown idiom, not a trace),
+# so .set only counts on a metric-ish receiver (self._tm_x, gauge, …)
+_METRIC_METHODS = {"inc", "observe", "set_max"}
+_METRIC_RECV_TOKENS = ("tm", "metric", "gauge", "counter", "histogram")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) for e in t.elts]
+    else:
+        names = [dotted_name(t)]
+    return any(n and n.split(".")[-1] in _BROAD for n in names)
+
+
+def _leaves_trace(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name   # "e" from `except Exception as e`
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name) and bound and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            head = name.split(".")[0]
+            tail = name.split(".")[-1]
+            if head in _LOG_HEADS or name in _LOG_BARE or tail in _LOG_BARE:
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in (_METRIC_METHODS | _LOG_METHODS):
+                return True
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "set":
+                recv = (dotted_name(node.func.value) or "").lower()
+                if any(t in recv for t in _METRIC_RECV_TOKENS):
+                    return True
+    return False
+
+
+def check(project: Project):
+    for src in project.files:
+        add_parents(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _leaves_trace(node):
+                continue
+            fn = enclosing_function(node)
+            where = getattr(fn, "name", "<module>") if fn else "<module>"
+            caught = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            yield Finding(
+                RULE_ID, src.rel_path, node.lineno,
+                f"{caught} in {where!r} swallows the error silently — "
+                "narrow the exception type, or log / count it",
+                anchor=f"except/{where}",
+                end_line=node.end_lineno or node.lineno)

@@ -1,0 +1,221 @@
+"""trace-safety: host syncs and side effects reachable from traced code.
+
+On TPU the silent performance killers are host round-trips inside code
+that XLA traces: ``.item()`` / ``float()`` on a tracer forces a device
+fence, ``np.asarray`` pulls the array to host, ``time.time()`` reads a
+host clock that is meaningless under tracing (it runs ONCE, at trace
+time), and ``print`` fires at trace time instead of per step.
+
+Detection is intra-module and conservative:
+
+1. a function is **traced** when it is decorated with jit/pjit/shard_map
+   (any import spelling, including ``@partial(jax.jit, ...)``), or when
+   its name is passed to a ``jax.jit(f, ...)`` / ``shard_map(f, ...)``
+   call in the same module, or when it is a lambda argument to one;
+2. traced-ness propagates through same-module calls: a helper invoked by
+   name from a traced function body is traced too (one module deep —
+   cross-module reachability would need a whole-program import graph);
+3. inside traced functions (nested defs included), host-sync and
+   side-effect calls are flagged. ``jax.debug.*`` is exempt (that is the
+   supported way to print/inspect under tracing), as are callback
+   escape hatches (``pure_callback`` / ``io_callback`` wrappers are
+   host-side by contract).
+
+``float()/int()/bool()/complex()`` are flagged only when applied
+directly to a parameter of the traced function — the static stand-in
+for "on a tracer" that avoids flagging host-side scalar math.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    decorator_is_jit,
+    enclosing_class,
+    enclosing_function,
+    import_aliases,
+    is_jit_wrapper,
+    resolve_call,
+)
+
+RULE_ID = "trace-safety"
+RULE_DOC = ("host-sync / side-effect calls reachable from jit/pjit/"
+            "shard_map-traced functions")
+
+#: resolved callee names that force a host sync or host side effect
+_BANNED_CALLS = {
+    "time.time": "host clock read (runs at trace time, not per step)",
+    "time.monotonic": "host clock read (runs at trace time, not per step)",
+    "time.perf_counter":
+        "host clock read (runs at trace time, not per step)",
+    "time.sleep": "host sleep inside traced code",
+    "numpy.asarray": "device->host transfer (forces a sync)",
+    "numpy.array": "device->host transfer (forces a sync)",
+    "jax.device_get": "device->host transfer (forces a sync)",
+    "print": "trace-time print (use jax.debug.print)",
+    "input": "host I/O inside traced code",
+}
+
+#: method names (attribute calls) that force a sync on any receiver
+_BANNED_METHODS = {
+    "item": "forces a device sync (.item() on a traced value)",
+    "block_until_ready": "explicit device fence inside traced code",
+    "tolist": "device->host transfer (forces a sync)",
+}
+
+_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _is_exempt(resolved: Optional[str]) -> bool:
+    if not resolved:
+        return False
+    return resolved.startswith("jax.debug.") or resolved.split(".")[-1] in (
+        "pure_callback", "io_callback", "callback")
+
+
+class _ModuleIndex:
+    """Per-module function table + traced-entry detection.
+
+    Name resolution is lexical: ``jax.jit(step)`` marks the ``step``
+    visible from the call site's scope chain (enclosing functions, then
+    module level) — NOT every function in the file that happens to share
+    the name (a nested traced ``step`` must not taint a host-side
+    ``step`` method). Class bodies are scope barriers: methods are only
+    reachable as ``self.<name>`` from within their own class.
+    """
+
+    def __init__(self, src):
+        self.src = src
+        self.aliases = import_aliases(src.tree)
+        add_parents(src.tree)
+        self.traced: Set[ast.AST] = set()
+        self._find_entries()
+        self._propagate()
+
+    def _resolve(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        """Lexically resolve a bare function name from ``at``'s scope."""
+        scope = enclosing_function(at)
+        while scope is not None:
+            for stmt in ast.walk(scope):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and stmt.name == name \
+                        and enclosing_function(stmt) is scope:
+                    return stmt
+            scope = enclosing_function(scope)
+        for stmt in self.src.tree.body:   # module level
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == name:
+                return stmt
+        return None
+
+    def _resolve_method(self, name: str, at: ast.AST) -> Optional[ast.AST]:
+        """``self.<name>`` from inside a class body."""
+        cls = enclosing_class(at)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == name:
+                return stmt
+        return None
+
+    def _mark(self, fn: Optional[ast.AST]) -> None:
+        if fn is not None:
+            self.traced.add(fn)
+
+    def _find_entries(self) -> None:
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(decorator_is_jit(d, self.aliases)
+                       for d in node.decorator_list):
+                    self.traced.add(node)
+            elif isinstance(node, ast.Call) and \
+                    is_jit_wrapper(resolve_call(node, self.aliases)):
+                for arg in node.args[:1]:   # the traced callable is arg 0
+                    if isinstance(arg, ast.Name):
+                        self._mark(self._resolve(arg.id, node))
+                    elif isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id in ("self", "cls"):
+                        self._mark(self._resolve_method(arg.attr, node))
+                    elif isinstance(arg, ast.Lambda):
+                        self.traced.add(arg)
+                    elif isinstance(arg, ast.Call):
+                        # jit(partial(f, ...)) / jit(shard_map(f, ...))
+                        inner = resolve_call(arg, self.aliases)
+                        if arg.args and isinstance(arg.args[0], ast.Name) \
+                                and (is_jit_wrapper(inner) or
+                                     (inner or "").endswith("partial")):
+                            self._mark(self._resolve(arg.args[0].id, node))
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = None
+                    if isinstance(node.func, ast.Name):
+                        target = self._resolve(node.func.id, node)
+                    elif isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id in ("self", "cls"):
+                        target = self._resolve_method(node.func.attr, node)
+                    if target is not None and target not in self.traced:
+                        self.traced.add(target)
+                        changed = True
+
+
+def _params_of(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def check(project: Project):
+    for src in project.files:
+        index = _ModuleIndex(src)
+        if not index.traced:
+            continue
+        seen = set()   # a nested traced def is walked under its parent too
+        for fn in index.traced:
+            fn_name = getattr(fn, "name", "<lambda>")
+            params = _params_of(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = resolve_call(node, index.aliases)
+                if _is_exempt(resolved):
+                    continue
+                why = _BANNED_CALLS.get(resolved or "")
+                bare = resolved if why is not None else \
+                    (resolved or "").split(".")[-1]
+                if why is None and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _BANNED_METHODS:
+                    bare = node.func.attr
+                    why = _BANNED_METHODS[node.func.attr]
+                if why is None and isinstance(node.func, ast.Name) \
+                        and node.func.id in _SCALAR_CASTS and node.args \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in params:
+                    bare = node.func.id
+                    why = (f"python {node.func.id}() on a traced argument "
+                           "forces a host sync")
+                if why is None or (node.lineno, bare) in seen:
+                    continue
+                seen.add((node.lineno, bare))
+                yield Finding(
+                    RULE_ID, src.rel_path, node.lineno,
+                    f"{bare}() inside traced function {fn_name!r}: {why}",
+                    anchor=f"{fn_name}/{bare}",
+                    end_line=node.end_lineno or node.lineno)

@@ -1,0 +1,62 @@
+"""wall-clock: ``time.time()`` used where ``time.monotonic()`` belongs.
+
+``time.time()`` is the wall clock: NTP slew, leap smearing, and VM
+suspend/resume move it arbitrarily, in both directions. Any *interval*
+computed from it — stall deadlines, heartbeat ages, backoff windows,
+retry timers — silently breaks when the clock steps: a 30s NTP
+correction fakes a watchdog stall or collapses a backoff window to
+zero. ``time.monotonic()`` is immune by construction.
+
+The rule flags EVERY ``time.time()`` call site. The legitimate uses —
+human-facing timestamps (checkpoint manifests, exported
+``*_timestamp_seconds`` gauges) — are a deliberate, documented choice:
+mark them with ``# dslint: disable=wall-clock`` and the reason, so
+every wall-clock read in the tree is either interval-safe or visibly
+intentional.
+"""
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    enclosing_class,
+    enclosing_function,
+    import_aliases,
+    resolve_call,
+)
+
+RULE_ID = "wall-clock"
+RULE_DOC = ("time.time() call sites — intervals/backoff/heartbeats must "
+            "use time.monotonic()")
+
+
+def check(project: Project):
+    for src in project.files:
+        aliases = import_aliases(src.tree)
+        add_parents(src.tree)
+        sites = [n for n in ast.walk(src.tree)
+                 if isinstance(n, ast.Call)
+                 and resolve_call(n, aliases) == "time.time"]
+        # occurrence indices are assigned in SOURCE order (ast.walk is
+        # BFS), per class-qualified function — anchors must not alias
+        # distinct call sites or migrate when nesting depth changes, or
+        # baselining one justified timestamp could silently grandfather
+        # a different (hazardous) site
+        sites.sort(key=lambda n: (n.lineno, n.col_offset))
+        seen_in_fn = {}
+        for node in sites:
+            fn = enclosing_function(node)
+            where = getattr(fn, "name", "<module>") if fn else "<module>"
+            cls = enclosing_class(node)
+            if cls is not None:   # qualify: same-named methods in two
+                where = f"{cls.name}.{where}"   # classes must not alias
+            idx = seen_in_fn[where] = seen_in_fn.get(where, 0) + 1
+            yield Finding(
+                RULE_ID, src.rel_path, node.lineno,
+                "time.time() is wall-clock (NTP/suspend can step it); "
+                "use time.monotonic() for intervals, or suppress with "
+                "a justification for human-facing timestamps",
+                anchor=f"time.time/{where}/{idx}",
+                end_line=node.end_lineno or node.lineno)

@@ -192,8 +192,11 @@ def hbm_capacity_bytes() -> int:
         limit = stats.get("bytes_limit", 0)
         if limit:
             return int(limit * HBM_USABLE_FRACTION)
-    except Exception:
-        pass
+    except Exception as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"live HBM probe failed ({type(e).__name__}: {e}); "
+                     "using the default chip capacity")
     return int(DEFAULT_HBM_BYTES * HBM_USABLE_FRACTION)
 
 
@@ -235,5 +238,9 @@ def compiled_memory_bytes(compiled: Any) -> Optional[int]:
             return None
         return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
                    + ma.output_size_in_bytes - ma.alias_size_in_bytes)
-    except Exception:
+    except Exception as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"XLA memory_analysis unsupported here "
+                     f"({type(e).__name__}: {e})")
         return None

@@ -54,8 +54,12 @@ def _span(name: str):
     return telemetry.span(name)
 
 
-_async_ckptr = None
-_async_thread: Optional[threading.Thread] = None
+_async_ckptr = None                                 # guarded-by: _save_lock
+_async_thread: Optional[threading.Thread] = None    # guarded-by: _save_lock
+# _async_error is deliberately NOT lock-guarded: the finalizer thread
+# appends to it while finalize_async may HOLD _save_lock joining that same
+# thread — taking the lock in the finalizer would deadlock the drain. The
+# join itself is the happens-before edge that publishes the append.
 _async_error: List[BaseException] = []
 # serializes save_state/finalize_async across threads (a watchdog-thread
 # emergency save can run concurrently with the training thread's save).
@@ -117,7 +121,8 @@ def save_state(save_dir: str, tag: str, state: PyTree,
 
 def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                        async_save, writer, keep_n, fsync, checksums,
-                       retries, retry_backoff_s, retry_jitter_s) -> None:
+                       retries, retry_backoff_s,
+                       retry_jitter_s) -> None:   # locked: _save_lock
     import orbax.checkpoint as ocp
 
     global _async_ckptr, _async_thread

@@ -144,7 +144,9 @@ def build_manifest(tag_dir: str, step: Optional[int] = None,
     manifest = {
         "version": MANIFEST_VERSION,
         "step": step,
-        "wall_time": time.time(),
+        # human-facing manifest timestamp (also the commit-recency tie-break
+        # in committed_tags) — wall clock is the point here
+        "wall_time": time.time(),   # dslint: disable=wall-clock
         "files": files,
     }
     if extra:

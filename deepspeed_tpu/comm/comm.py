@@ -184,8 +184,9 @@ def _is_traced(x) -> bool:
 def _nbytes(x) -> int:
     try:
         return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
-    except Exception:
-        return 0
+    except (TypeError, AttributeError, ValueError):
+        return 0   # not array-shaped (scalar leaf, odd dtype): no bytes
+
 
 
 def timed_op(fn):

@@ -146,7 +146,12 @@ def initialize_mesh(
     try:
         mesh = jax.make_mesh(shape, DEFAULT_AXIS_ORDER, devices=devices,
                              **kw)
-    except Exception:
+    except Exception as e:
+        # make_mesh is missing on older jax and rejects kwargs across
+        # versions — the raw Mesh fallback is topology-order-naive but
+        # always constructible, so note WHY we degraded
+        logger.debug(f"jax.make_mesh unavailable/failed "
+                     f"({type(e).__name__}: {e}); using raw Mesh fallback")
         dev_array = np.asarray(devices).reshape(shape)
         mesh = Mesh(dev_array, DEFAULT_AXIS_ORDER, **kw)
     _GLOBAL_MESH = MeshManager(mesh)
@@ -169,6 +174,21 @@ def get_mesh_manager() -> MeshManager:
 
 def get_mesh() -> Mesh:
     return get_mesh_manager().mesh
+
+
+def maybe_mesh() -> Optional[Mesh]:
+    """The process mesh if one can be (lazily) initialized, else None —
+    THE probe idiom for layers that degrade gracefully to replicated
+    execution (MoE dispatch, inference TP, AutoSP planning). The broad
+    catch is deliberate and traced at debug level: mesh construction can
+    fail for backend-specific reasons (no devices yet, incompatible jax
+    build), and every caller treats "no mesh" as "run unsharded"."""
+    try:
+        return get_mesh_manager().mesh
+    except Exception as e:
+        logger.debug(f"mesh unavailable ({type(e).__name__}: {e}); "
+                     "callers degrade to replicated execution")
+        return None
 
 
 def mesh_is_initialized() -> bool:

@@ -22,8 +22,8 @@ def _try_version(mod: str) -> str:
     try:
         m = importlib.import_module(mod)
         return getattr(m, "__version__", "unknown")
-    except Exception:
-        return RED_NO
+    except Exception as e:  # import-time failures vary; surface the type
+        return f"{RED_NO} ({type(e).__name__})"
 
 
 def op_report() -> list:

@@ -94,13 +94,10 @@ class InferenceEngine:
 
     @staticmethod
     def _live_mesh():
-        from deepspeed_tpu.comm.mesh import get_mesh_manager
+        from deepspeed_tpu.comm.mesh import maybe_mesh
 
-        try:
-            mesh = get_mesh_manager().mesh
-        except Exception:
-            return None
-        return mesh if mesh.size > 1 else None
+        mesh = maybe_mesh()
+        return mesh if mesh is not None and mesh.size > 1 else None
 
     def _cache_constraint(self, cache):
         """Shard KV cache [L, B, M, K, D]: batch over data, kv-heads over

@@ -151,15 +151,11 @@ class FastGenEngine:
         self.mesh = None
         self._rep_sh = None
         if tp is not False:
-            try:
-                from deepspeed_tpu.comm.mesh import (TENSOR_AXIS,
-                                                     get_mesh_manager)
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS, maybe_mesh
 
-                _m = get_mesh_manager().mesh
-                if _m.shape.get(TENSOR_AXIS, 1) > 1:
-                    self.mesh = _m
-            except Exception:
-                pass
+            _m = maybe_mesh()
+            if _m is not None and _m.shape.get(TENSOR_AXIS, 1) > 1:
+                self.mesh = _m
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 

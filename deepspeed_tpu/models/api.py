@@ -161,12 +161,10 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
             cfg = dataclasses.replace(cfg, **overrides)
 
     def _pipe_stages() -> int:
-        from deepspeed_tpu.comm.mesh import PIPE_AXIS, get_mesh_manager
+        from deepspeed_tpu.comm.mesh import PIPE_AXIS, maybe_mesh
 
-        try:
-            return get_mesh_manager().mesh.shape.get(PIPE_AXIS, 1)
-        except Exception:
-            return 1
+        mesh = maybe_mesh()
+        return mesh.shape.get(PIPE_AXIS, 1) if mesh is not None else 1
 
     def loss_fn(params, batch):
         tokens = _tokens_of(batch)

@@ -42,9 +42,10 @@ from deepspeed_tpu.comm.mesh import (
     SEQ_AXIS,
     TENSOR_AXIS,
     ZSHARD_AXIS,
-    get_mesh_manager,
+    maybe_mesh,
     on_reset_mesh,
 )
+from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.moe.gating import (
     GateOutput,
     IndexGateOutput,
@@ -81,11 +82,8 @@ def set_drop_monitor(fn) -> None:
 
 def _expert_constraint(x: jax.Array, n_lead: int = 1) -> jax.Array:
     """Constrain the leading expert dim onto the 'expert' mesh axis (if present)."""
-    try:
-        mesh = get_mesh_manager().mesh
-    except Exception:
-        return x
-    if mesh.shape.get(EXPERT_AXIS, 1) <= 1:
+    mesh = maybe_mesh()
+    if mesh is None or mesh.shape.get(EXPERT_AXIS, 1) <= 1:
         return x
     spec = [None] * x.ndim
     spec[0] = EXPERT_AXIS
@@ -414,7 +412,11 @@ def _already_manual_axes() -> set:
         am = jax.sharding.get_abstract_mesh()
         return {n for n, t in zip(am.axis_names, am.axis_types)
                 if "Manual" in str(t)}
-    except Exception:
+    except Exception as e:
+        # abstract-mesh introspection only exists on newer jax; absence
+        # means no enclosing shard_map manualized anything
+        logger.debug(f"abstract-mesh probe unavailable "
+                     f"({type(e).__name__}: {e}); assuming no manual axes")
         return set()
 
 
@@ -483,11 +485,8 @@ def resolve_dispatch(dispatch: str, rng: Optional[jax.Array],
     if noisy:
         return "dense"
     if B is not None:
-        try:
-            mesh = get_mesh_manager().mesh
-        except Exception:
-            mesh = None
-        kind, _ = ragged_mesh_plan(mesh, B, S, E if E is not None else 1)
+        kind, _ = ragged_mesh_plan(maybe_mesh(), B, S,
+                                   E if E is not None else 1)
         if kind == "indivisible":
             return "dense"
     return "ragged"
@@ -566,10 +565,7 @@ def _ragged_routed(x: jax.Array, gate_w: jax.Array,
     """
     B, S, H = x.shape
     E = gate_w.shape[1]
-    try:
-        mesh = get_mesh_manager().mesh
-    except Exception:
-        mesh = None
+    mesh = maybe_mesh()
 
     kind, plan = ragged_mesh_plan(mesh, B, S, E)
     if kind != "shard":

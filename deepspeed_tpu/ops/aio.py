@@ -76,7 +76,11 @@ def uring_supported() -> bool:
     """True when the kernel accepts io_uring_setup (DeepNVMe fast path)."""
     try:
         return bool(_load().aio_uring_supported())
-    except Exception:
+    except Exception as e:   # no compiler / load failure -> threads engine
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"io_uring probe failed ({type(e).__name__}: {e}); "
+                     "falling back to the thread-pool engine")
         return False
 
 
@@ -115,7 +119,9 @@ class AsyncIOHandle:
                 self._lib.aio_wait_all(self._h)
                 self._lib.aio_handle_destroy(self._h)
                 self._h = None
-        except Exception:
+        # interpreter teardown: ctypes globals / the lib itself may already
+        # be gone, and raising from __del__ only prints noise
+        except Exception:   # dslint: disable=silent-except
             pass
 
     # ------------------------------------------------------------ #

@@ -51,7 +51,9 @@ class PallasOpBuilder(OpBuilder):
             if verbose and not ok:
                 logger.warning(f"{self.NAME}: no TPU and no CPU interpret fallback")
             return ok
-        except Exception:
+        except Exception as e:   # no backend at all -> not compatible
+            logger.debug(f"{self.NAME}: compatibility probe failed "
+                         f"({type(e).__name__}: {e})")
             return False
 
 

@@ -34,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
-from deepspeed_tpu.comm.mesh import SEQ_AXIS, get_mesh_manager
+from deepspeed_tpu.comm.mesh import SEQ_AXIS, maybe_mesh
 from deepspeed_tpu.utils.logging import log_dist
 
 # sequences at or beyond this many tokens get tiled loss by default
@@ -159,10 +159,8 @@ def plan_sp(num_heads: Optional[int] = None, seq_len: Optional[int] = None,
         info = SPSiteInfo(num_heads=num_heads or 0, kv_heads=num_heads or 0,
                           head_dim=64, seq_len=seq_len)
     if sp_size is None:
-        try:
-            sp_size = get_mesh_manager().axis_size(SEQ_AXIS)
-        except Exception:
-            sp_size = 1
+        mesh = maybe_mesh()
+        sp_size = mesh.shape.get(SEQ_AXIS, 1) if mesh is not None else 1
     if sp_size <= 1:
         return SPPlan(False, 1, "none", 0, "mesh has no 'seq' axis > 1")
     if info.num_heads <= 0:

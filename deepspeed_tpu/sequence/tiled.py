@@ -156,7 +156,11 @@ def _memory_constraint(x: jax.Array, kind: str) -> jax.Array:
         return x
     try:
         return jax.device_put(x, jax.sharding.TransferToMemoryKind(kind))
-    except Exception:  # memories API unavailable on this backend/version
+    except Exception as e:  # memories API unavailable on this backend/version
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"memories API unavailable ({type(e).__name__}: {e}); "
+                     f"keeping intermediate on-device instead of {kind!r}")
         return x
 
 

@@ -139,7 +139,9 @@ class ServingFrontend:
         self._rejected_fifo: collections.deque = collections.deque()
         self._order_counter = 0
         self._suspects: List[int] = []   # admitted since last healthy tick
-        self.last_tick_t: Optional[float] = None
+        # stamped by run_tick on the serving loop; the health-probe thread
+        # only READS it (atomic float — tearing-tolerant by design)
+        self.last_tick_t: Optional[float] = None   # guarded-by: single-writer
         self._setup_telemetry()
         self.health: Optional[HealthSurface] = None
         if register_health:

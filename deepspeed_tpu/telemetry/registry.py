@@ -48,6 +48,9 @@ def _is_process_zero() -> bool:
             import jax
 
             _process_zero = jax.process_index() == 0
+        # any failure (no jax, no backend, mid-init) means single-process:
+        # record. The registry is dependency-free by contract, so no logger
+        # here — and this resolves ONCE.  # dslint: disable=silent-except
         except Exception:
             _process_zero = True
     return _process_zero
@@ -236,10 +239,11 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.RLock()
-        self._metrics: Dict[str, _Metric] = {}
-        self._collectors: List[Callable[[], Any]] = []
-        # watchdog substrate: the last completed span (name, end walltime)
-        self.last_span: Optional[Tuple[str, float]] = None
+        self._metrics: Dict[str, _Metric] = {}          # guarded-by: self._lock
+        self._collectors: List[Callable[[], Any]] = []  # guarded-by: self._lock
+        # watchdog substrate: the last completed span as (name, monotonic
+        # end time) — interval math only, never exported as a timestamp
+        self.last_span: Optional[Tuple[str, float]] = None  # guarded-by: self._lock
         # per-thread collection mode (see collect()): thread-local so a
         # concurrent /metrics scrape can't flip a cheap bridge publish on
         # the training thread into an expensive one mid-iteration
@@ -320,7 +324,7 @@ class MetricsRegistry:
     # -- span bookkeeping (see telemetry/spans.py) ----------------------- #
     def note_span_end(self, name: str) -> None:
         with self._lock:
-            self.last_span = (name, time.time())
+            self.last_span = (name, time.monotonic())
 
     def reset(self) -> None:
         """Tests only: zero every metric and drop collectors/span state.

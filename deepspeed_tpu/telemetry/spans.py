@@ -36,6 +36,9 @@ def _trace_annotation(name: str):
         from jax.profiler import TraceAnnotation
 
         return TraceAnnotation(name)
+    # a span must NEVER raise into the section it brackets, whatever the
+    # profiler backend is doing — inert fallback, no logging on what can
+    # be a per-tick path  # dslint: disable=silent-except
     except Exception:
         return contextlib.nullcontext()
 
@@ -69,6 +72,14 @@ class StallWatchdog:
     response. A raising callback is counted
     (``telemetry_stall_action_errors_total``) and never kills the thread.
 
+    Clocks + threading: deadlines are measured on ``time.monotonic()`` —
+    the wall clock steps under NTP slew and VM suspend/resume, and a 30s
+    correction must not fake (or mask) a stall. ``beat()`` runs on the
+    training thread while ``check()`` runs on the watchdog thread, so the
+    beat/armed/stalled triple is updated under a small lock; the
+    ``on_stall`` callback and all logging run OUTSIDE it (an emergency
+    checkpoint must not block the training thread's next ``beat()``).
+
     The deadline ARMS at the first beat: the watchdog monitors steady-state
     training, and the first step's XLA compile routinely exceeds any sane
     step deadline — firing during legitimate compilation would put a false
@@ -90,9 +101,10 @@ class StallWatchdog:
             logger = _l
         self.logger = logger
         self.on_stall = on_stall
-        self._last_beat = time.time()
-        self._armed = False   # first beat arms the deadline (see class doc)
-        self._stalled = False
+        self._lock = threading.Lock()
+        self._last_beat = time.monotonic()  # guarded-by: self._lock
+        self._armed = False                 # guarded-by: self._lock
+        self._stalled = False               # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stall_counter = registry.counter(
@@ -100,13 +112,14 @@ class StallWatchdog:
             "watchdog deadline misses (no step completed in time)")
 
     def beat(self) -> None:
-        self._last_beat = time.time()
-        self._armed = True
-        if self._stalled:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._armed = True
+            recovered, self._stalled = self._stalled, False
+        if recovered:
             self.logger.warning(
                 f"[watchdog:{self.name}] recovered — a step completed after "
                 "the stall warning")
-            self._stalled = False
 
     def start(self) -> "StallWatchdog":
         if self._thread is None:
@@ -124,12 +137,15 @@ class StallWatchdog:
 
     def check(self, now: Optional[float] = None) -> bool:
         """One deadline check (the thread's body; callable directly in
-        tests). Returns True when a stall was (newly) reported."""
-        now = time.time() if now is None else now
-        if not self._armed or self._stalled \
-                or now - self._last_beat <= self.deadline_s:
-            return False
-        self._stalled = True
+        tests — ``now`` is a ``time.monotonic()`` reading). Returns True
+        when a stall was (newly) reported."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if not self._armed or self._stalled \
+                    or now - self._last_beat <= self.deadline_s:
+                return False
+            self._stalled = True
+            last_beat = self._last_beat
         self._stall_counter.inc()
         last = self.registry.last_span
         where = (f"last completed span: {last[0]!r} "
@@ -137,7 +153,7 @@ class StallWatchdog:
                  else "no span completed yet")
         self.logger.warning(
             f"[watchdog:{self.name}] no step finished in "
-            f"{now - self._last_beat:.1f}s (deadline {self.deadline_s:.1f}s) "
+            f"{now - last_beat:.1f}s (deadline {self.deadline_s:.1f}s) "
             f"— {where}")
         if self.on_stall is not None:
             try:

@@ -174,12 +174,10 @@ def main() -> None:
     p.add_argument("--sizes-mb", type=float, nargs="*", default=None)
     args = p.parse_args()
 
-    from deepspeed_tpu.comm.mesh import MeshConfig, get_mesh_manager, initialize_mesh
+    from deepspeed_tpu.comm.mesh import get_mesh_manager
 
-    try:
-        mesh = get_mesh_manager().mesh
-    except Exception:
-        mesh = initialize_mesh(MeshConfig()).mesh
+    # lazily initializes a default mesh when none is configured
+    mesh = get_mesh_manager().mesh
     rows = bench_collectives(mesh, args.axis, args.sizes_mb, args.trials)
     print(f"{'op':<16}{'size':>12}{'time':>12}{'algbw GB/s':>14}{'busbw GB/s':>14}")
     for r in rows:

@@ -39,7 +39,7 @@ def get_caller_func(frame_depth: int = 3) -> str:
 
     try:
         return sys._getframe(frame_depth).f_code.co_name
-    except Exception:
+    except ValueError:   # call stack shallower than frame_depth
         return "unknown"
 
 

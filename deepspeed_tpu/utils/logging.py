@@ -47,7 +47,10 @@ def _process_index() -> int:
         import jax
 
         return jax.process_index()
-    except Exception:  # jax.distributed not initialized / no backend yet
+    # jax.distributed not initialized / no backend yet -> single process.
+    # This runs inside every log_dist call: logging about a logging
+    # fallback would recurse/spam  # dslint: disable=silent-except
+    except Exception:
         return 0
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
 FORWARD_GLOBAL_TIMER = "fwd"
@@ -71,8 +71,9 @@ class _Timer:
         self.started = False
         try:
             _phase_hist().observe(delta, phase=self.name)
-        except Exception:
-            pass   # telemetry must never break a timer
+        except Exception as e:   # telemetry must never break a timer
+            logger.debug(f"phase-histogram observe failed "
+                         f"({type(e).__name__}: {e})")
 
     def reset(self) -> None:
         self.started = False
@@ -221,8 +222,9 @@ class ThroughputTimer:
         if self.window_hook is not None and steps:
             try:
                 self.window_hook(duration, steps)
-            except Exception:
-                pass   # telemetry must never break the timer
+            except Exception as e:   # telemetry must never break the timer
+                logger.debug(f"throughput window_hook failed "
+                             f"({type(e).__name__}: {e})")
         return duration, steps
 
     def avg_samples_per_sec(self) -> float:

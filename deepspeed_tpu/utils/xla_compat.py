@@ -25,7 +25,7 @@ def _jaxlib_version() -> str:
         import importlib.metadata as md
 
         return md.version("jaxlib")
-    except Exception:
+    except ImportError:   # PackageNotFoundError subclasses ImportError
         return "unknown"
 
 

@@ -24,8 +24,11 @@ setup(
             "dstpu=deepspeed_tpu.launcher.runner:main",
             "dstpu_report=deepspeed_tpu.env_report:main",
             "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
+            "dslint=deepspeed_tpu.analysis.__main__:main",
         ],
     },
+    # tools/dslint is a checkout-only shim; the `dslint` console entry
+    # point covers installs (listing both would collide on bin/dslint)
     scripts=["bin/dstpu", "bin/dstpu_report", "bin/dstpu_bench",
              "bin/dstpu_elastic", "bin/dstpu_io"],
 )

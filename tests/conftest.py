@@ -67,6 +67,10 @@ def pytest_configure(config):
         "deselect with -m 'not chaos' on boxes where subprocesses are "
         "restricted)")
     config.addinivalue_line(
+        "markers", "analysis: dslint static-analysis tests (AST-only, no "
+        "device work; the self-enforcement pass runs the full linter over "
+        "deepspeed_tpu/ and fails tier-1 on any non-baselined finding)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

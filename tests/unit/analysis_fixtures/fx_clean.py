@@ -1,0 +1,7 @@
+"""A file dslint finds nothing in (CLI exit-0 fixture)."""
+import time
+
+
+def healthy_interval():
+    start = time.monotonic()
+    return time.monotonic() - start

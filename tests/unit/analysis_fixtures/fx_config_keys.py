@@ -1,0 +1,22 @@
+"""config-key fixture (parsed by dslint tests, never imported)."""
+
+
+def read_sections(config):
+    zero = config.get("zero_optimization", {})          # ok: schema key
+    typo = config.get("zero_optimizations", {})         # finding: typo
+    stage = zero
+    return stage, typo
+
+
+def write_sections(ds_config):
+    ds_config["train_batch_size"] = 8                   # ok
+    ds_config["trian_batch_size"] = 8                   # finding: typo
+
+
+def suppressed(cfg):
+    return cfg.get("my_experimental_section")  # dslint: disable=config-key
+
+
+def not_config_shaped(payload):
+    # base name doesn't match the config pattern: out of scope by design
+    return payload.get("whatever_key")

@@ -1,0 +1,49 @@
+"""guarded-by fixture (parsed by dslint tests, never imported)."""
+import threading
+
+_shared = None        # guarded-by: _glock
+_glock = threading.Lock()
+
+
+def global_bad():
+    global _shared
+    _shared = 1                        # finding: no lock held
+
+
+def global_ok():
+    global _shared
+    with _glock:
+        _shared = 2                    # ok: under the lock
+
+
+def global_helper_ok():                # locked: _glock
+    global _shared
+    _shared = 3                        # ok: caller-holds contract
+
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0                 # guarded-by: self._lock
+        self.tick = 0.0                # guarded-by: single-writer
+
+    def bad_write(self):
+        self.state = 1                 # finding: lock not held
+
+    def ok_write(self):
+        with self._lock:
+            self.state = 2             # ok
+
+    def ok_helper(self):               # locked: self._lock
+        self.state = 3                 # ok: annotated holder
+
+    def suppressed_write(self):
+        self.state = 4                 # dslint: disable=guarded-by
+
+    def own_tick(self):
+        self.tick = 1.0                # ok: single-writer inside owner
+
+
+class Foreign:
+    def poke(self, owner):
+        owner.tick = 2.0               # finding: foreign single-writer write

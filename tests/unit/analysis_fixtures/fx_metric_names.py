@@ -1,0 +1,19 @@
+"""metric-name fixture (parsed by dslint tests, never imported)."""
+from deepspeed_tpu import telemetry
+
+
+class Worker:
+    def __init__(self):
+        # kind conflict: same name as counter AND gauge (2 findings)
+        self._tm_a = telemetry.counter("fx_conflicted_total", "demo")
+        self._tm_b = telemetry.gauge("fx_conflicted_total", "demo")
+        # label drift: reason= vs error= at different sites (2 findings)
+        self._tm_c = telemetry.counter("fx_drifting_total", "demo")
+        # undocumented: not in the README catalog (1 finding per name)
+        self._tm_d = telemetry.counter("fx_undocumented_total", "demo")
+
+    def record(self):
+        self._tm_c.inc(reason="x")
+        self._tm_c.inc(error="y")
+        self._tm_c.inc()            # unlabeled child: never a conflict
+        self._tm_d.inc()

@@ -1,0 +1,35 @@
+"""retracing fixture (parsed by dslint tests, never imported)."""
+import jax
+
+
+def rebuild_per_iteration(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)   # finding: jit-in-loop
+        out.append(f(x))
+    return out
+
+
+def hoisted_ok(xs):
+    f = jax.jit(lambda v: v * 2)       # ok: built once
+    return [f(x) for x in xs]
+
+
+def bad_static(x, shape=[1, 2]):       # mutable default as static arg
+    return x
+
+
+bad = jax.jit(bad_static, static_argnames=("shape",))
+
+
+def good_static(x, shape=(1, 2)):      # hashable tuple: fine
+    return x
+
+
+good = jax.jit(good_static, static_argnames=("shape",))
+
+
+def suppressed(xs):
+    for x in xs:
+        f = jax.jit(lambda v: v)       # dslint: disable=retracing
+        yield f(x)

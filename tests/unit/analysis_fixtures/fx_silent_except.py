@@ -1,0 +1,57 @@
+"""silent-except fixture (parsed by dslint tests, never imported)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def swallowed():
+    try:
+        risky()
+    except Exception:                  # finding: nothing leaves a trace
+        return None
+
+
+def bare_swallowed():
+    try:
+        risky()
+    except:                            # finding: bare except, silent
+        pass
+
+
+def logged_ok():
+    try:
+        risky()
+    except Exception as e:
+        logger.warning(f"risky failed: {e}")
+
+
+def reraised_ok():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def surfaced_ok():
+    try:
+        risky()
+    except Exception as e:
+        return f"failed: {type(e).__name__}"   # the error is surfaced
+
+
+def narrow_ok():
+    try:
+        risky()
+    except ValueError:                 # narrow type: out of scope
+        return None
+
+
+def suppressed_ok():
+    try:
+        risky()
+    except Exception:                  # dslint: disable=silent-except
+        return None
+
+
+def risky():
+    raise ValueError("boom")

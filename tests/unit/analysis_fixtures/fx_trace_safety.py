@@ -1,0 +1,45 @@
+"""trace-safety fixture: host syncs inside traced code (NEVER imported —
+parsed by dslint tests only)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_bad(x):
+    print("tracing")              # finding: trace-time print
+    t = time.time()               # finding: host clock in trace
+    return x + t
+
+
+def helper(x):
+    return np.asarray(x)          # finding: reached from traced entry
+
+
+def wrapped_bad(x):
+    y = helper(x)                 # propagation: helper becomes traced
+    return float(x)               # finding: float() on traced argument
+
+
+wrapped = jax.jit(wrapped_bad)
+
+
+def suppressed_ok(x):
+    print("debug")                # dslint: disable=trace-safety
+    return x
+
+
+sup = jax.jit(suppressed_ok)
+
+
+def host_side(x):
+    # NOT traced: same banned calls are fine on the host
+    print("host")
+    return np.asarray(x), time.time()   # dslint: disable=wall-clock
+
+
+@jax.jit
+def debug_exempt(x):
+    jax.debug.print("x = {}", x)  # exempt: the supported trace-time print
+    return x

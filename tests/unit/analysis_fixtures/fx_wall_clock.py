@@ -1,0 +1,23 @@
+"""wall-clock fixture (parsed by dslint tests, never imported)."""
+import time
+
+
+def interval_bad():
+    start = time.time()                # finding
+    work()
+    return time.time() - start         # finding
+
+
+def interval_ok():
+    start = time.monotonic()           # ok
+    work()
+    return time.monotonic() - start
+
+
+def manifest_ok():
+    # human-facing timestamp  # dslint: disable=wall-clock
+    return {"wall_time": time.time()}
+
+
+def work():
+    pass

@@ -1,0 +1,368 @@
+"""dslint self-enforcement + unit coverage of every rule.
+
+The headline test runs the FULL pass over ``deepspeed_tpu/`` and fails
+on any non-baselined finding — this is what makes the linter
+self-enforcing in tier-1: a PR that introduces a host-sync in traced
+code, an unguarded write to annotated shared state, a ``time.time()``
+interval, a silent ``except Exception``, a config-key typo, or a
+metric-name drift fails CI with the finding text in the assertion.
+
+Per-rule coverage drives the fixture files in ``analysis_fixtures/``
+(never imported — parsed only): positive findings, suppressed lines,
+and baseline mechanics. CLI tests cover exit codes and the JSON schema.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu import analysis
+from deepspeed_tpu.analysis import core as dsl_core
+
+pytestmark = pytest.mark.analysis
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+PKG = os.path.join(REPO_ROOT, "deepspeed_tpu")
+
+# The baseline may only SHRINK: fix a finding -> delete its entry -> lower
+# this ceiling. Raising it means grandfathering NEW debt — don't.
+BASELINE_CEILING = 0
+
+
+def _lint_fixture(name, rule, extra_paths=()):
+    path = os.path.join(FIXTURES, name)
+    new, _ = analysis.lint([path, *extra_paths], rules=[rule],
+                           use_baseline=False, root=REPO_ROOT)
+    return [f for f in new if f.path.endswith(name)]
+
+
+# ------------------------------------------------------------------ #
+# self-enforcement
+# ------------------------------------------------------------------ #
+class TestRepoIsClean:
+    def test_package_has_no_new_findings(self):
+        new, baselined = analysis.lint_repo()
+        assert not new, (
+            "dslint found new (non-baselined) hazards — fix them or, for "
+            "a deliberate pattern, add a '# dslint: disable=<rule>' with "
+            "a justification:\n" + "\n".join(f.render() for f in new))
+
+    def test_baseline_only_shrinks(self):
+        bl = analysis.load_baseline(analysis.default_baseline_path())
+        assert len(bl) <= BASELINE_CEILING, (
+            f"baseline grew to {len(bl)} entries (ceiling "
+            f"{BASELINE_CEILING}). The baseline exists to retire debt, "
+            "not accumulate it — fix the finding instead of baselining it.")
+
+    def test_baseline_file_is_wellformed(self):
+        with open(analysis.default_baseline_path()) as f:
+            data = json.load(f)
+        assert data["version"] == 1
+        for entry in data["entries"]:
+            assert entry["key"] and entry.get("justification"), (
+                "every baseline entry needs a non-empty justification")
+
+
+# ------------------------------------------------------------------ #
+# per-rule fixtures
+# ------------------------------------------------------------------ #
+class TestTraceSafety:
+    def test_findings(self):
+        fs = _lint_fixture("fx_trace_safety.py", "trace-safety")
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == [
+            "decorated_bad/print", "decorated_bad/time.time",
+            "helper/numpy.asarray", "wrapped_bad/float",
+        ]
+
+    def test_suppressed_and_exempt_not_flagged(self):
+        fs = _lint_fixture("fx_trace_safety.py", "trace-safety")
+        assert not any("suppressed_ok" in f.anchor for f in fs)
+        assert not any("debug_exempt" in f.anchor for f in fs)
+        assert not any("host_side" in f.anchor for f in fs)
+
+
+class TestRetracing:
+    def test_findings(self):
+        fs = _lint_fixture("fx_retracing.py", "retracing")
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == ["jit-in-loop", "static/bad_static/shape"]
+
+
+class TestGuardedBy:
+    def test_findings(self):
+        fs = _lint_fixture("fx_guarded_by.py", "guarded-by")
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == ["<module>._shared", "Owner.state",
+                           "Owner.tick/foreign"]
+
+    def test_locked_annotation_and_with_block_pass(self):
+        fs = _lint_fixture("fx_guarded_by.py", "guarded-by")
+        lines = {f.line for f in fs}
+        src = open(os.path.join(FIXTURES, "fx_guarded_by.py")).read()
+        for snippet in ("_shared = 2", "_shared = 3", "self.state = 2",
+                        "self.state = 3", "self.tick = 1.0"):
+            ok_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                           if snippet in ln)
+            assert ok_line not in lines, f"{snippet!r} falsely flagged"
+
+
+class TestWallClock:
+    def test_findings_have_distinct_anchors(self):
+        # two call sites in one function must NOT share a baseline key —
+        # baselining a justified timestamp must not grandfather a later
+        # interval-misuse next to it
+        fs = _lint_fixture("fx_wall_clock.py", "wall-clock")
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == ["time.time/interval_bad/1",
+                           "time.time/interval_bad/2"]
+
+
+class TestSilentExcept:
+    def test_findings(self):
+        fs = _lint_fixture("fx_silent_except.py", "silent-except")
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == ["except/bare_swallowed", "except/swallowed"]
+
+
+class TestConfigKeys:
+    def test_findings(self):
+        # the schema lives in runtime/config.py — the rule is cross-file
+        fs = _lint_fixture(
+            "fx_config_keys.py", "config-key",
+            extra_paths=(os.path.join(PKG, "runtime", "config.py"),))
+        anchors = sorted(f.anchor for f in fs)
+        assert anchors == ["key/trian_batch_size", "key/zero_optimizations"]
+
+
+class TestMetricNames:
+    def test_kind_conflict_and_label_drift_and_catalog(self):
+        fs = _lint_fixture("fx_metric_names.py", "metric-name")
+        by_anchor = {}
+        for f in fs:
+            by_anchor.setdefault(f.anchor, []).append(f)
+        assert len(by_anchor.get("kind/fx_conflicted_total", [])) == 2
+        assert len(by_anchor.get("labels/fx_drifting_total", [])) == 2
+        for name in ("fx_conflicted_total", "fx_drifting_total",
+                     "fx_undocumented_total"):
+            assert f"catalog/{name}" in by_anchor
+
+
+# ------------------------------------------------------------------ #
+# suppression / baseline machinery
+# ------------------------------------------------------------------ #
+class TestMachinery:
+    def test_file_level_suppression(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("# dslint: disable-file=wall-clock\n"
+                     "import time\n\n"
+                     "def f():\n    return time.time()\n")
+        new, _ = analysis.lint([str(p)], use_baseline=False)
+        assert not [f for f in new if f.rule == "wall-clock"]
+
+    def test_unparseable_file_reports_not_raises(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f(:\n")
+        new, _ = analysis.lint([str(p)], use_baseline=False)
+        assert [f for f in new if f.rule == "parse-error"]
+
+    def test_baseline_roundtrip_silences_findings(self, tmp_path):
+        fix = os.path.join(FIXTURES, "fx_wall_clock.py")
+        new, _ = analysis.lint([fix], use_baseline=False, root=REPO_ROOT)
+        assert new
+        bl_path = str(tmp_path / "bl.json")
+        analysis.write_baseline(bl_path, new)
+        new2, baselined = analysis.lint([fix], baseline_path=bl_path,
+                                        root=REPO_ROOT)
+        assert not new2 and baselined
+
+    def test_nonexistent_path_errors_not_clean(self, tmp_path):
+        # a typo'd lint target must fail loudly, not pass over nothing
+        with pytest.raises(FileNotFoundError):
+            analysis.lint([str(tmp_path / "no_such_dir")],
+                          use_baseline=False)
+
+    def test_wall_clock_indices_follow_source_order(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("import time\n\n"
+                     "def f():\n"
+                     "    a = [time.time() for _ in range(1)]\n"
+                     "    b = time.time()\n"
+                     "    return a, b\n")
+        new, _ = analysis.lint([str(p)], rules=["wall-clock"],
+                               use_baseline=False)
+        by_line = {f.line: f.anchor for f in new}
+        assert by_line[4].endswith("/1") and by_line[5].endswith("/2")
+
+    def test_finding_keys_are_line_free(self):
+        f = dsl_core.Finding("wall-clock", "a/b.py", 42, "msg", anchor="x")
+        assert "42" not in f.key
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(KeyError):
+            analysis.select_rules(["no-such-rule"])
+
+    def test_known_rules_covers_the_registry(self):
+        # KNOWN_RULES gates disable= comments; a new rule module that
+        # forgets to register there would make its suppressions no-ops
+        assert set(analysis.RULE_IDS) <= set(dsl_core.KNOWN_RULES)
+
+    def test_docstring_directive_is_not_a_suppression(self, tmp_path):
+        # a module whose DOCSTRING quotes a disable-file example must not
+        # get the rule disabled — only real comment tokens count
+        p = tmp_path / "mod.py"
+        p.write_text('"""docs say: # dslint: disable-file=wall-clock"""\n'
+                     "import time\n\n"
+                     "def f():\n    return time.time()\n")
+        new, _ = analysis.lint([str(p)], use_baseline=False)
+        assert [f for f in new if f.rule == "wall-clock"]
+
+    def test_typoed_suppression_is_a_finding(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("import time\n\n"
+                     "def f():\n"
+                     "    return time.time()   # dslint: disable=wall-clok\n")
+        new, _ = analysis.lint([str(p)], use_baseline=False)
+        rules = {f.rule for f in new}
+        assert "unknown-suppression" in rules   # the typo is diagnosed
+        assert "wall-clock" in rules            # and nothing got suppressed
+
+    def test_guarded_by_sees_container_mutation(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "import threading\n\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._m = {}     # guarded-by: self._lock\n"
+            "        self._l = []     # guarded-by: self._lock\n\n"
+            "    def bad(self):\n"
+            "        self._m['k'] = 1\n"
+            "        self._l.append(2)\n"
+            "        del self._m['k']\n\n"
+            "    def good(self):\n"
+            "        with self._lock:\n"
+            "            self._m['k'] = 1\n"
+            "            self._l.append(2)\n")
+        new, _ = analysis.lint([str(p)], rules=["guarded-by"],
+                               use_baseline=False)
+        assert len(new) == 3 and all(f.line in (10, 11, 12) for f in new)
+
+    def test_local_shadow_of_guarded_global_not_flagged(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "import threading\n"
+            "_g = None     # guarded-by: _lk\n"
+            "_lk = threading.Lock()\n\n"
+            "def pure_local():\n"
+            "    _g = 1        # local shadow, not the global\n"
+            "    return _g\n\n"
+            "def real_write():\n"
+            "    global _g\n"
+            "    _g = 2        # THE global, no lock -> finding\n")
+        new, _ = analysis.lint([str(p)], rules=["guarded-by"],
+                               use_baseline=False)
+        assert len(new) == 1 and new[0].line == 11
+
+    def test_event_set_is_not_a_metric_trace(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "class W:\n"
+            "    def run(self):\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            self._stop.set()   # shutdown, NOT a trace\n"
+            "    def ok(self):\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            self._tm_state.set(2)   # metric gauge: a trace\n")
+        new, _ = analysis.lint([str(p)], rules=["silent-except"],
+                               use_baseline=False)
+        assert len(new) == 1 and new[0].line == 5
+
+    def test_jit_in_while_test_is_flagged(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("import jax\n\n"
+                     "def spin(x):\n"
+                     "    while jax.jit(lambda v: v)(x) > 0:\n"
+                     "        x -= 1\n")
+        new, _ = analysis.lint([str(p)], rules=["retracing"],
+                               use_baseline=False)
+        assert len(new) == 1   # While.test re-evaluates per iteration
+
+    def test_attribute_logger_counts_as_trace(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(
+            "class W:\n"
+            "    def run(self):\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            self.logger.warning('work failed')\n")
+        new, _ = analysis.lint([str(p)], rules=["silent-except"],
+                               use_baseline=False)
+        assert not new
+
+    def test_subdir_lint_keys_match_package_lint(self):
+        # README documents `tools/dslint deepspeed_tpu/serving/`; its
+        # baseline keys must match the whole-package run's
+        proj, _ = dsl_core.load_project(
+            [os.path.join(PKG, "serving")])
+        assert all(f.rel_path.startswith("deepspeed_tpu/serving/")
+                   for f in proj.files)
+
+    def test_catalog_match_is_word_bounded(self, tmp_path):
+        # a metric whose name is a PREFIX of a documented one must still
+        # be flagged as undocumented
+        p = tmp_path / "mod.py"
+        p.write_text("from deepspeed_tpu import telemetry\n"
+                     "telemetry.counter('fastgen_queue', 'x').inc()\n")
+        (tmp_path / "README.md").write_text(
+            "| `fastgen_queue_depth` | documented |\n")
+        new, _ = analysis.lint([str(p)], rules=["metric-name"],
+                               use_baseline=False, root=str(tmp_path))
+        assert any(f.anchor == "catalog/fastgen_queue" for f in new)
+
+
+# ------------------------------------------------------------------ #
+# CLI
+# ------------------------------------------------------------------ #
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+
+
+class TestCLI:
+    def test_exit_codes_and_json_schema(self):
+        # dirty fixture -> exit 1 + schema'd findings
+        r = _run_cli(os.path.join(FIXTURES, "fx_wall_clock.py"),
+                     "--no-baseline", "--format", "json",
+                     "--root", REPO_ROOT)
+        assert r.returncode == 1, r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["version"] == 1
+        assert payload["ok"] is False
+        assert payload["counts"]["wall-clock"] == 2
+        assert isinstance(payload["baselined_count"], int)
+        for f in payload["findings"]:
+            assert set(f) == {"rule", "path", "line", "message", "anchor",
+                              "key"}
+        # clean fixture -> exit 0
+        r0 = _run_cli(os.path.join(FIXTURES, "fx_clean.py"),
+                      "--no-baseline")
+        assert r0.returncode == 0, r0.stdout + r0.stderr
+
+    def test_list_rules(self):
+        r = _run_cli("--list-rules")
+        assert r.returncode == 0
+        for rid in ("trace-safety", "retracing", "guarded-by", "wall-clock",
+                    "silent-except", "config-key", "metric-name"):
+            assert rid in r.stdout

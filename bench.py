@@ -60,6 +60,20 @@ def _remaining_budget() -> float:
 # README.md:83 (BASELINE.md #4)
 BASELINE_TFLOPS_CITED = 175.0
 
+def _telemetry_section() -> dict:
+    """The one "telemetry" config section every bench engine uses. Engine
+    init reconfigures the PROCESS-WIDE tracer from its config section
+    (last-engine-wins), so any entry whose config omitted these keys
+    would silently disarm the --entry wrapper's tracer mid-entry and
+    drop the row's trace_phases; measured-MFU stays opt-in because it
+    prices a cost-analysis compile a timeout-bounded entry can't afford."""
+    return {
+        "measure_mfu": os.environ.get("BENCH_TELEMETRY_MFU", "0") != "0",
+        "tracing": os.environ.get("BENCH_TRACING", "1") != "0",
+        "trace_buffer_events": 8192,
+    }
+
+
 def chip_peak_tflops(device) -> float:
     """Peak bf16 TFLOP/s — ONE table shared with the telemetry train_mfu
     gauge (deepspeed_tpu/utils/chip_specs.py), v5e fallback."""
@@ -174,12 +188,9 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
         config["bf16"] = {"enabled": True}
     elif precision == "fp16":
         config["fp16"] = {"enabled": True, "initial_scale_power": 12}
-    # bench rows embed a telemetry snapshot; the measured-MFU gauge prices
-    # a cost-analysis compile at snapshot time, which a timeout-bounded
-    # entry (3B adafactor) can't afford by default — BENCH_TELEMETRY_MFU=1
-    # opts in; the row's own mfu field stays the MFU source of record
-    config["telemetry"] = {
-        "measure_mfu": os.environ.get("BENCH_TELEMETRY_MFU", "0") != "0"}
+    # bench rows embed a telemetry snapshot + trace phases; the row's own
+    # mfu field stays the MFU source of record
+    config["telemetry"] = _telemetry_section()
     config.update(config_extra or {})
     engine, *_ = dst.initialize(model=spec, config=config)
     cfg = PRESETS[model]
@@ -491,7 +502,8 @@ def run(mesh_cfg, batch, steps=4, n_micro=None):
               batch // dp, "gradient_accumulation_steps": 1,
               "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
               "zero_optimization": {"stage": 0}, "mesh": mesh_cfg,
-              "steps_per_print": 10 ** 9}
+              "steps_per_print": 10 ** 9,
+              "telemetry": _telemetry_section()}
     engine, *_ = dst.initialize(model=spec, config=config)
     data = itertools.repeat(next(synthetic_lm_data(batch, 128, 512, seed=0)))
     loss = engine.train_batch(data)          # compile
@@ -649,7 +661,8 @@ def curve(zero_cfg):
               "gradient_accumulation_steps": 1,
               "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
               "zero_optimization": zero_cfg,
-              "steps_per_print": 10 ** 9}
+              "steps_per_print": 10 ** 9,
+              "telemetry": _telemetry_section()}
     engine, *_ = dst.initialize(model=spec, config=config)
     # 16-batch corpus cycled: loss must DECREASE (memorization) without
     # NaN/drift over the full horizon — the long-run state-corruption
@@ -725,7 +738,8 @@ def offload_param_memory_evidence():
                   "gradient_accumulation_steps": 1,
                   "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
                   "zero_optimization": zero, "bf16": {"enabled": True},
-                  "steps_per_print": 10 ** 9}
+                  "steps_per_print": 10 ** 9,
+                  "telemetry": _telemetry_section()}
         spec = dst.causal_lm_spec("gpt2_125m", remat="full",
                                   attention="flash")
         engine, *_ = dst.initialize(model=spec, config=config)
@@ -1077,6 +1091,17 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
         name = sys.argv[2]
         try:
+            # arm the structured tracer for the whole entry (BENCH_TRACING=0
+            # opts out): the row then carries per-phase latency
+            # DISTRIBUTIONS, not just the snapshot's means
+            try:
+                from deepspeed_tpu.telemetry import tracing as _tracing
+
+                _tracing.configure(
+                    enabled=os.environ.get("BENCH_TRACING", "1") != "0",
+                    capacity=8192)
+            except Exception:
+                pass
             row = SUITE_ENTRIES[name]()
             if isinstance(row, dict) and "error" not in row:
                 # each bench row carries its telemetry context (metric name
@@ -1088,6 +1113,12 @@ def main():
                     snap = telemetry.snapshot()
                     if any(snap.values()):
                         row["telemetry"] = snap
+                    # per-phase p50/p95/p99 span durations from the trace
+                    # buffer: the latency-distribution companion to the
+                    # snapshot's aggregate means
+                    phases = telemetry.get_tracer().phase_stats()
+                    if phases:
+                        row["trace_phases"] = phases
                 except Exception:
                     pass
             print(json.dumps(row))

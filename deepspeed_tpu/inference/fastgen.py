@@ -845,66 +845,76 @@ class FastGenEngine:
             raise
 
     def _step_impl(self, live: List[_Seq]) -> Dict[int, int]:
-        need = sum(1 for s in live
-                   if s.prefill_remaining == 0 and s.last_tok is not None)
-        need += sum(s.prefill_remaining for s in live)
-        Tn = self._bucket(need)
-        tokens = np.zeros((Tn,), np.int32)
-        positions = np.zeros((Tn,), np.int32)
-        tables = np.zeros((Tn, self.max_blocks_per_seq), np.int32)
-        # (row, seq, is_decode): rows whose logits get sampled this tick
-        heads: List[tuple] = []
-        row = 0
+        # the host-side SplitFuse packing gets its own span so a tick's
+        # timeline splits into schedule (host) vs dispatch (device) —
+        # the first question about a slow tick is which side it was
+        with telemetry.span("schedule_tick"):
+            need = sum(1 for s in live
+                       if s.prefill_remaining == 0
+                       and s.last_tok is not None)
+            need += sum(s.prefill_remaining for s in live)
+            Tn = self._bucket(need)
+            tokens = np.zeros((Tn,), np.int32)
+            positions = np.zeros((Tn,), np.int32)
+            tables = np.zeros((Tn, self.max_blocks_per_seq), np.int32)
+            # (row, seq, is_decode): rows whose logits get sampled this tick
+            heads: List[tuple] = []
+            row = 0
 
-        # 1) decode tokens — one per fully-prefilled live sequence, starting
-        # from a rotating offset so tails never starve when live sequences
-        # exceed the budget (the reference scheduler's fairness rotation)
-        order = self._admit_order
-        rr = self._decode_rr % max(len(order), 1)
-        for uid in order[rr:] + order[:rr]:
-            seq = self.seqs.get(uid)
-            if seq is None or seq.done or seq.prefill_remaining > 0 \
-                    or seq.last_tok is None:
-                continue
-            if row >= Tn:
-                break
-            if not self._ensure_blocks(seq, seq.pos):
-                self._tm_preempt.inc(phase="decode")
-                continue   # pool full — this sequence waits a tick
-            tokens[row] = seq.last_tok
-            positions[row] = seq.pos
-            tables[row] = seq.table
-            heads.append((row, seq, True))
-            row += 1
-        self._decode_rr += 1
+            # 1) decode tokens — one per fully-prefilled live sequence,
+            # starting from a rotating offset so tails never starve when
+            # live sequences exceed the budget (the reference scheduler's
+            # fairness rotation)
+            order = self._admit_order
+            rr = self._decode_rr % max(len(order), 1)
+            for uid in order[rr:] + order[:rr]:
+                seq = self.seqs.get(uid)
+                if seq is None or seq.done or seq.prefill_remaining > 0 \
+                        or seq.last_tok is None:
+                    continue
+                if row >= Tn:
+                    break
+                if not self._ensure_blocks(seq, seq.pos):
+                    self._tm_preempt.inc(phase="decode")
+                    continue   # pool full — this sequence waits a tick
+                tokens[row] = seq.last_tok
+                positions[row] = seq.pos
+                tables[row] = seq.table
+                heads.append((row, seq, True))
+                row += 1
+            self._decode_rr += 1
 
-        # 2) prefill chunks — FIFO admission, split to fit the remaining
-        # budget (Dynamic SplitFuse: long prompts stream across ticks)
-        for uid in self._admit_order:
-            seq = self.seqs.get(uid)
-            if seq is None or seq.done or seq.prefill_remaining == 0:
-                continue
-            if row >= Tn:
-                break
-            chunk = min(seq.prefill_remaining, Tn - row)
-            # capacity backpressure: shrink the chunk to the blocks the pool
-            # can actually supply; zero → the prompt waits for a flush
-            fits = (len(seq.blocks) + self.allocator.free_blocks) \
-                * self.block_size - seq.pos
-            chunk = min(chunk, fits)
-            if chunk <= 0:
-                self._tm_preempt.inc(phase="prefill")
-                continue
-            self._ensure_blocks(seq, seq.pos + chunk - 1)
-            lo = seq.prefilled
-            tokens[row:row + chunk] = seq.prompt[lo:lo + chunk]
-            positions[row:row + chunk] = np.arange(seq.pos, seq.pos + chunk)
-            tables[row:row + chunk] = seq.table
-            row += chunk
-            seq.prefilled += chunk
-            seq.pos += chunk
-            if seq.prefill_remaining == 0:
-                heads.append((row - 1, seq, False))  # first generated token
+            # 2) prefill chunks — FIFO admission, split to fit the
+            # remaining budget (Dynamic SplitFuse: long prompts stream
+            # across ticks)
+            for uid in self._admit_order:
+                seq = self.seqs.get(uid)
+                if seq is None or seq.done or seq.prefill_remaining == 0:
+                    continue
+                if row >= Tn:
+                    break
+                chunk = min(seq.prefill_remaining, Tn - row)
+                # capacity backpressure: shrink the chunk to the blocks
+                # the pool can actually supply; zero → the prompt waits
+                # for a flush
+                fits = (len(seq.blocks) + self.allocator.free_blocks) \
+                    * self.block_size - seq.pos
+                chunk = min(chunk, fits)
+                if chunk <= 0:
+                    self._tm_preempt.inc(phase="prefill")
+                    continue
+                self._ensure_blocks(seq, seq.pos + chunk - 1)
+                lo = seq.prefilled
+                tokens[row:row + chunk] = seq.prompt[lo:lo + chunk]
+                positions[row:row + chunk] = np.arange(seq.pos,
+                                                       seq.pos + chunk)
+                tables[row:row + chunk] = seq.table
+                row += chunk
+                seq.prefilled += chunk
+                seq.pos += chunk
+                if seq.prefill_remaining == 0:
+                    heads.append((row - 1, seq, False))  # first generated
+                    # token of a just-finished prefill
 
         if row == 0:
             return {}

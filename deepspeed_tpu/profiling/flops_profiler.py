@@ -5,24 +5,61 @@ Parity: reference ``profiling/flops_profiler/profiler.py:30`` (``FlopsProfiler``
 count MACs as the model runs (:880); on TPU the compiled HLO *is* the ground
 truth, so the profiler asks XLA's cost analysis for flops/bytes — exact, free,
 and inclusive of fusion effects the reference can't see.
+
+Every cost-analysis compile also lands in a bounded per-process **compile
+log** (:func:`compile_log`: fn name, compile wall time, flops, bytes) and
+— when ``telemetry.tracing`` is on — as a ``compile/<fn>`` trace event,
+so a retracing storm shows up as a wall of compile spans in the flight
+recorder's timeline instead of only via the dslint retracing rule.
 """
 from __future__ import annotations
 
+import collections
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 PyTree = Any
 
+#: newest-N per-process compile records ({fn, compile_seconds, flops,
+#: bytes_accessed}) — bounded so a pathological retracing loop can't grow
+#: host memory while it burns the compiler
+_COMPILE_LOG: collections.deque = collections.deque(maxlen=256)
+
+
+def compile_log() -> List[Dict[str, Any]]:
+    """Per-jit-entry compile records observed by this module (newest-256)."""
+    return list(_COMPILE_LOG)
+
+
+def _note_compile(name: str, compile_s: float,
+                  costs: Dict[str, float]) -> None:
+    entry = {
+        "fn": name,
+        "compile_seconds": round(compile_s, 6),
+        "flops": float(costs.get("flops", 0.0)),
+        "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
+    }
+    _COMPILE_LOG.append(entry)
+    from deepspeed_tpu.telemetry import tracing
+
+    tracing.get_tracer().record_span(
+        f"compile/{name}", compile_s, cat="compile",
+        flops=entry["flops"], bytes_accessed=entry["bytes_accessed"])
+
 
 def _cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    t0 = time.perf_counter()
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    compile_s = time.perf_counter() - t0
     costs = compiled.cost_analysis()
     if isinstance(costs, list):  # older jax returns [dict]
         costs = costs[0] if costs else {}
-    return dict(costs or {})
+    costs = dict(costs or {})
+    _note_compile(getattr(fn, "__name__", "<fn>"), compile_s, costs)
+    return costs
 
 
 def profile_fn(fn: Callable, *args, **kwargs) -> Dict[str, float]:
@@ -92,8 +129,11 @@ class FlopsProfiler:
         mb = eng.train_micro_batch_size() * eng.dp_world_size
         seq = getattr(eng.model_spec, "seq_len", None) or 128
         batch = {"tokens": jnp.zeros((gas, mb, seq), jnp.int32)}
+        def train_step(s, b):   # named: the compile log records __name__
+            return fn(s, b)
+
         with eng.mesh:
-            costs = _cost_analysis(lambda s, b: fn(s, b), eng.state, batch)
+            costs = _cost_analysis(train_step, eng.state, batch)
         return float(costs.get("flops", 0.0))
 
     # -- reporting -------------------------------------------------------- #
@@ -121,8 +161,11 @@ def get_model_profile(model_spec, batch_shape: Tuple[int, int],
 
     params = model_spec.init_fn(jax.random.PRNGKey(0))
     tokens = jnp.zeros(batch_shape, jnp.int32)
-    costs = profile_fn(
-        lambda p, t: model_spec.loss_fn(p, {"tokens": t}), params, tokens)
+
+    def model_forward(p, t):    # named: the compile log records __name__
+        return model_spec.loss_fn(p, {"tokens": t})
+
+    costs = profile_fn(model_forward, params, tokens)
     flops = costs["flops"]
     n_params = model_spec.num_params
     if as_string:

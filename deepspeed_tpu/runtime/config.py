@@ -162,7 +162,16 @@ class TelemetryConfig:
     the last-completed span) logs when no optimizer step finishes within
     the deadline. ``monitor_bridge`` forwards registry scalars into the
     configured MonitorMaster backends at the ``steps_per_print`` cadence
-    (a no-op unless a monitor backend is enabled)."""
+    (a no-op unless a monitor backend is enabled).
+
+    ``tracing`` turns on the structured tracer + flight recorder
+    (``telemetry/tracing.py``): every ``telemetry.span`` site and every
+    serving request gets a timeline entry in a ring buffer of
+    ``trace_buffer_events`` completed spans, sampled per trace at
+    ``trace_sample_rate``, with crash-context dumps (stall, circuit
+    open, preemption, engine-step exception) written under
+    ``flight_dump_dir``. Off by default — a disabled tracer costs one
+    attribute check per span."""
     enabled: bool = True
     http_port: int = -1
     stall_deadline_s: float = 0.0
@@ -170,6 +179,21 @@ class TelemetryConfig:
     # measured-MFU gauge prices ONE cost-analysis compile of the train step
     # at first scrape — disable for huge models behind a live endpoint
     measure_mfu: bool = True
+    tracing: bool = False
+    trace_buffer_events: int = 4096
+    trace_sample_rate: float = 1.0
+    flight_dump_dir: str = "flight_dumps"
+
+    def validate(self) -> None:
+        if not (0.0 <= self.trace_sample_rate <= 1.0):
+            raise DeepSpeedConfigError(
+                "telemetry.trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}")
+        if self.trace_buffer_events < 1:
+            raise DeepSpeedConfigError(
+                "telemetry.trace_buffer_events must be >= 1, got "
+                f"{self.trace_buffer_events} (a zero-size flight recorder "
+                "dumps empty context)")
 
 
 @dataclasses.dataclass
@@ -304,22 +328,26 @@ class FaultToleranceConfig:
     in-flight async save, writes an emergency checkpoint, and exits 0 —
     the preemptible-VM contract; it arms only when ``resume_dir`` or
     ``auto_resume`` is also set (a handler with nowhere to save would
-    change process signal behavior for nothing). ``on_stall="checkpoint"`` escalates the
-    telemetry stall watchdog from a log line to an emergency checkpoint
-    of the last completed state."""
+    change process signal behavior for nothing). ``on_stall`` escalates
+    the telemetry stall watchdog beyond its log line: ``"dump_trace"``
+    writes a flight-recorder dump naming the last-completed span
+    (requires ``telemetry.tracing``; a no-op without it), and
+    ``"checkpoint"`` additionally writes an emergency checkpoint of the
+    last completed state (the dump rides along — a stall report without
+    its surrounding timeline answers nothing)."""
     # tri-state so env defaults can't override an EXPLICIT false in the
     # JSON (None = unset → falsy, env DSTPU_AUTO_RESUME may enable)
     auto_resume: Optional[bool] = None
     resume_dir: Optional[str] = None
     graceful_preemption: bool = True
     emergency_tag_prefix: str = "emergency"
-    on_stall: str = "log"   # log | checkpoint
+    on_stall: str = "log"   # log | dump_trace | checkpoint
 
     def validate(self) -> None:
-        if self.on_stall not in ("log", "checkpoint"):
+        if self.on_stall not in ("log", "dump_trace", "checkpoint"):
             raise DeepSpeedConfigError(
-                f"fault_tolerance.on_stall must be log|checkpoint, "
-                f"got {self.on_stall!r}")
+                f"fault_tolerance.on_stall must be log|dump_trace|"
+                f"checkpoint, got {self.on_stall!r}")
 
 
 @dataclasses.dataclass

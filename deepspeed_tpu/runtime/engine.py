@@ -57,6 +57,7 @@ from deepspeed_tpu.runtime.loss_scaler import (
     global_grad_norm,
 )
 from deepspeed_tpu.runtime.lr_schedules import LRSchedule, get_lr_schedule
+from deepspeed_tpu.testing.chaos import chaos_point
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (
     BACKWARD_GLOBAL_TIMER,
@@ -538,6 +539,16 @@ class DeepSpeedTPUEngine:
         # skip the engine's own instruments while fastgen/timer/comms kept
         # recording
         telemetry.get_registry().enabled = bool(tcfg.enabled)
+        # tracer gate is process-wide too (same last-engine-wins rule);
+        # configuring with enabled=False keeps every span() site at its
+        # one-attribute-check disabled cost
+        from deepspeed_tpu.telemetry import tracing as _tracing
+
+        _tracing.configure(
+            enabled=bool(tcfg.enabled and tcfg.tracing),
+            capacity=tcfg.trace_buffer_events,
+            sample_rate=tcfg.trace_sample_rate,
+            dump_dir=tcfg.flight_dump_dir)
         if not tcfg.enabled:
             return
 
@@ -585,16 +596,26 @@ class DeepSpeedTPUEngine:
                     f"failed to start ({e}); continuing without it")
         if tcfg.stall_deadline_s > 0:
             on_stall = None
-            if self.config.fault_tolerance.on_stall == "checkpoint":
-                # escalate detection → response: checkpoint the LAST
-                # COMPLETED state from the watchdog thread (self.state is
-                # immutable jax arrays, replaced only at step boundaries —
-                # a stalled step by definition hasn't replaced it)
+            action = self.config.fault_tolerance.on_stall
+            if action in ("dump_trace", "checkpoint"):
+                # escalate detection → response, both flavors leading
+                # with a flight-recorder dump named after the last
+                # completed span (the timeline that led INTO the stall);
+                # "checkpoint" then saves the LAST COMPLETED state from
+                # the watchdog thread (self.state is immutable jax
+                # arrays, replaced only at step boundaries — a stalled
+                # step by definition hasn't replaced it)
                 wref = weakref.ref(self)
 
                 def on_stall():
                     eng = wref()
-                    if eng is not None:
+                    if eng is None:
+                        return
+                    last = eng._tm.last_span if eng._tm is not None \
+                        else None
+                    _tracing.get_tracer().dump_flight(
+                        "stall", note=last[0] if last else None)
+                    if action == "checkpoint":
                         eng._emergency_save("stall")
 
             self._watchdog = telemetry.StallWatchdog(
@@ -1619,6 +1640,7 @@ class DeepSpeedTPUEngine:
         self._in_step = True   # a preemption signal now defers to the
         try:                   # boundary check below
             with self._train_span("train_step"):
+                chaos_point("train/step")
                 if self._host_runner is not None:
                     # SuperOffload/ZenFlow host-executed update (runtime/host_step.py)
                     _, metrics = self._host_runner.train_batch(batch, gas)
@@ -1648,6 +1670,12 @@ class DeepSpeedTPUEngine:
             if self.config.wall_clock_breakdown:
                 self.timers(TRAIN_BATCH_TIMER).stop()
                 self.timers.log([TRAIN_BATCH_TIMER])
+        except Exception:
+            # crash context for an unhandled step failure: the flight
+            # recorder's last N spans ARE the timeline that led here
+            # (no-op unless telemetry.tracing is on); then re-raise
+            self._dump_step_crash_context()
+            raise
         finally:
             # even a raising step must re-enable immediate preemption
             # handling (a deferred SIGTERM would otherwise wait forever)
@@ -1700,6 +1728,7 @@ class DeepSpeedTPUEngine:
         self._in_step = True
         try:
             with self._train_span("train_window"):
+                chaos_point("train/step")
                 self._ensure_master_tier_for_step()
                 with self.mesh:
                     self.state, metrics = self._compiled[key](self.state, batch)
@@ -1711,6 +1740,9 @@ class DeepSpeedTPUEngine:
                              wall_s=time.perf_counter() - t0,
                              tokens=self._count_tokens(big)
                              if self._tm is not None else 0)
+        except Exception:
+            self._dump_step_crash_context()   # then re-raise unchanged
+            raise
         finally:
             self._in_step = False
         self._check_preemption_boundary()
@@ -1720,6 +1752,18 @@ class DeepSpeedTPUEngine:
         """Async jax.debug.callback sink (moe.layer.set_drop_monitor) — keeps
         the worst dropped-choice fraction seen since the last print window."""
         self._moe_drop_frac = max(self._moe_drop_frac, float(frac))
+
+    def _dump_step_crash_context(self) -> None:
+        """Flight-recorder dump for an unhandled train-step exception
+        (no-op unless ``telemetry.tracing`` is on). Must never raise —
+        it runs on the exception path it exists to explain."""
+        try:
+            from deepspeed_tpu.telemetry import tracing
+
+            tracing.get_tracer().dump_flight(
+                "engine_step_exception", note=f"step={self.global_steps}")
+        except Exception as e:   # the original exception must win
+            logger.warning(f"flight dump on step failure failed too: {e}")
 
     def _train_span(self, name: str):
         """telemetry.span when enabled; inert otherwise."""
@@ -2034,6 +2078,12 @@ class DeepSpeedTPUEngine:
         except Exception as e:
             logger.warning(f"async-save drain during preemption failed: {e}")
         self._emergency_save("preemption")
+        # the last seconds of timeline ride along with the emergency
+        # checkpoint — what WAS the run doing when the VM was reclaimed
+        # (no-op unless telemetry.tracing is on)
+        from deepspeed_tpu.telemetry import tracing
+
+        tracing.get_tracer().dump_flight("preemption")
         self.shutdown_telemetry()
         log_dist("preemption: emergency checkpoint committed — exiting 0")
         raise SystemExit(0)

@@ -73,6 +73,15 @@ class CircuitBreaker:
         self.state = state
         self._tm_state.set(_STATE_VALUE[state])
         self._tm_trans.inc(to=state)
+        if state == OPEN:
+            # the flight recorder holds the ticks/requests that led to
+            # the failure streak — dump them while they're still in the
+            # buffer (no-op unless telemetry.tracing is on)
+            from deepspeed_tpu.telemetry import tracing
+
+            tracing.get_tracer().dump_flight(
+                "circuit_open",
+                note=f"failure_streak={self.failure_streak}")
 
     def allow(self) -> bool:
         """Whether a tick may run now. An expired open window transitions

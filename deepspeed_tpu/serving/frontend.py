@@ -22,7 +22,12 @@ the loop alive when a tick raises. This front-end owns those policies:
 * **terminal resolution** — every submitted uid ends in exactly one
   terminal state (``completed | shed | expired | failed | rejected``)
   queryable via :meth:`result`; shed/expired/failed requests release
-  their KV blocks at resolution, so a burst can never leak pool blocks.
+  their KV blocks at resolution, so a burst can never leak pool blocks;
+* **request-scoped tracing** — when ``telemetry.tracing`` is on, every
+  uid gets a flight-recorder trace: admission verdict (incl. shed /
+  overload reasons), queue wait at first service, the tick spans that
+  served it, and its terminal state — one slow request's full timeline
+  is reconstructable from ``/trace`` or a flight dump.
 
 Single-threaded like the engine itself: one loop calls ``submit``/
 ``run_tick``; the health probes (``serving/health.py``) are the only
@@ -58,6 +63,7 @@ from deepspeed_tpu.serving.circuit import (
     CircuitBreaker,
 )
 from deepspeed_tpu.serving.health import HealthSurface
+from deepspeed_tpu.telemetry import tracing as _tracing
 from deepspeed_tpu.testing.chaos import chaos_point
 from deepspeed_tpu.utils.logging import logger
 
@@ -142,6 +148,10 @@ class ServingFrontend:
         # stamped by run_tick on the serving loop; the health-probe thread
         # only READS it (atomic float — tearing-tolerant by design)
         self.last_tick_t: Optional[float] = None   # guarded-by: single-writer
+        # the default tracer is a stable singleton (configure mutates it
+        # in place) — cache the handle; every call is a no-op while
+        # tracing is disabled
+        self._tracer = _tracing.get_tracer()
         self._setup_telemetry()
         self.health: Optional[HealthSurface] = None
         if register_health:
@@ -279,6 +289,11 @@ class ServingFrontend:
         prompt = list(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.cfg.default_max_new_tokens
+        # request trace opens at the front door so even a rejection has a
+        # timeline (no-op if the uid is already live: a duplicate submit
+        # must not clobber the live request's trace — its rejection lands
+        # as an event on that trace instead)
+        self._tracer.request_begin(uid, prompt_len=len(prompt))
         now = self.clock()
         # the deadline the ENGINE will enforce: an explicit per-request
         # one, else the engine's request_deadline_s default — the shed
@@ -370,6 +385,8 @@ class ServingFrontend:
         self._suspects.append(uid)
         self._results.pop(uid, None)   # resubmission of a terminal uid
         self._tm_admit.inc()
+        self._tracer.request_event(uid, "admission", verdict="admitted",
+                                   grant=grant, degraded=degraded)
         return Admitted(uid, grant, degraded)
 
     def _candidates(self) -> List[_Candidate]:
@@ -393,13 +410,20 @@ class ServingFrontend:
             self._record_result(RequestResult(uid, REJECTED, [], reason,
                                               detail))
             self._tm_resolved.inc(outcome=REJECTED)
+            self._tracer.request_end(uid, REJECTED, reason=reason,
+                                     detail=detail)
 
     def _reject_invalid(self, uid: int, detail: str) -> Rejected:
+        self._tracer.request_event(uid, "admission", verdict="rejected",
+                                   reason=REASON_INVALID, detail=detail)
         self._record_rejection(uid, REASON_INVALID, detail)
         return Rejected(uid, REASON_INVALID, detail)
 
     def _reject_overloaded(self, uid: int, reason: str, retry_after: float,
                            detail: str = "") -> Overloaded:
+        self._tracer.request_event(
+            uid, "admission", verdict="overloaded", reason=reason,
+            retry_after_s=round(retry_after, 3), detail=detail)
         self._record_rejection(uid, reason, detail)
         return Overloaded(uid, reason, round(retry_after, 3),
                           self.ctrl.shed_policy, detail)
@@ -420,6 +444,8 @@ class ServingFrontend:
         self._record_result(RequestResult(uid, state, tokens, reason,
                                           detail))
         self._tm_resolved.inc(outcome=state)
+        self._tracer.request_end(uid, state, reason=reason, detail=detail,
+                                 tokens=len(tokens))
 
     def _shed(self, uid: int, reason: str) -> None:
         tokens = self._tokens_of(uid)
@@ -458,8 +484,9 @@ class ServingFrontend:
         # happened to carry the probe
         probing = self.breaker.state == HALF_OPEN
         try:
-            chaos_point("serving/tick")
-            self.engine.step()
+            with telemetry.span("serving_tick"):
+                chaos_point("serving/tick")
+                self.engine.step()
         except Exception as e:
             # always leave a trace: with no suspect to evict this branch
             # would otherwise be metrics-only, and a replica going dark
@@ -470,6 +497,8 @@ class ServingFrontend:
                 f"failure streak {self.breaker.failure_streak + 1}, "
                 f"circuit {self.breaker.state}")
             self._tm_tick_fail.inc(error=type(e).__name__)
+            self._tracer.event("tick_failure", error=type(e).__name__,
+                               streak=self.breaker.failure_streak + 1)
             self.breaker.record_failure()
             if not probing:
                 self._evict_suspect(e)
@@ -502,7 +531,10 @@ class ServingFrontend:
                 continue
             if not req.served and (seq.prefilled > 0 or seq.done):
                 req.served = True
-                self._tm_wait.observe(self.clock() - req.submit_t)
+                wait_s = self.clock() - req.submit_t
+                self._tm_wait.observe(wait_s)
+                self._tracer.request_event(uid, "first_service",
+                                           queue_wait_s=round(wait_s, 6))
             if seq.expired:
                 self._resolve(uid, EXPIRED, list(seq.generated),
                               reason="deadline")

@@ -45,11 +45,18 @@ from deepspeed_tpu.telemetry.registry import (
     MetricsRegistry,
 )
 from deepspeed_tpu.telemetry.spans import StallWatchdog, span as _span
+from deepspeed_tpu.telemetry import tracing
+from deepspeed_tpu.telemetry.tracing import (
+    Tracer,
+    configure as configure_tracing,
+    get_tracer,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
-    "MonitorBridge", "StallWatchdog", "counter", "gauge", "histogram",
-    "get_registry", "span", "snapshot", "render_prometheus",
+    "MonitorBridge", "StallWatchdog", "Tracer", "counter", "gauge",
+    "histogram", "get_registry", "get_tracer", "configure_tracing",
+    "tracing", "span", "snapshot", "render_prometheus",
     "start_metrics_server", "stop_metrics_server", "add_collector", "reset",
     "register_health_probe", "unregister_health_probe", "health_report",
     "health_probe_names", "clear_health_probes",
@@ -100,8 +107,9 @@ def stop_metrics_server() -> None:
 
 
 def reset() -> None:
-    """Tests only: stop the server, clear the default registry, and drop
-    any registered health probes."""
+    """Tests only: stop the server, clear the default registry, drop any
+    registered health probes, and disable/clear the default tracer."""
     _stop_server()
     clear_health_probes()
+    tracing.reset()
     _default_registry.reset()

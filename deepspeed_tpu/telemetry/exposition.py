@@ -14,6 +14,14 @@ Two read paths over one registry:
 (exposed as ``.port``) — the tier-1 smoke test scrapes that. Start it on
 process 0 only (callers gate; the registry record path already is).
 
+**Trace surfaces** ride the same server: ``/trace`` serves the flight
+recorder's current ring buffer as Chrome trace-event JSON (curl it into
+a file, open in Perfetto — a live timeline of the last N spans without
+waiting for a crash dump) and ``/flight`` reports flight-recorder
+status (enabled, buffer fill, open request traces, dumps written).
+Both answer from ``telemetry/tracing.py``'s default tracer; with
+tracing disabled ``/trace`` is an empty (but valid) trace document.
+
 **Health surfaces** ride the same server: ``/healthz`` (liveness) and
 ``/readyz`` (readiness) run the probes registered via
 :func:`register_health_probe` and answer 200 (all probes ok) or 503 with
@@ -166,6 +174,14 @@ class _Handler(BaseHTTPRequestHandler):
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/snapshot":
                 body = json.dumps(snapshot(self.registry)).encode()
+                ctype = "application/json"
+            elif path in ("/trace", "/flight"):
+                from deepspeed_tpu.telemetry import tracing
+
+                tracer = tracing.get_tracer()
+                body = json.dumps(tracer.export_chrome()
+                                  if path == "/trace"
+                                  else tracer.flight_status()).encode()
                 ctype = "application/json"
             elif path in ("/healthz", "/readyz"):
                 ok, report = health_report(

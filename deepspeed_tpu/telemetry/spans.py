@@ -1,6 +1,6 @@
 """Span-based tracing + the stall watchdog.
 
-``span("decode_tick")`` does three things at once:
+``span("decode_tick")`` does four things at once:
 
 * records the span's wall time into the ``span_seconds{span=...}`` histogram
   of the active registry (host-visible latency, scrapeable);
@@ -8,6 +8,9 @@
   dispatched in an XLA device trace (the compute/collective-overlap view
   that T3-style analyses need — a captured ``jax.profiler.trace`` shows
   these names on the host timeline aligned with device streams);
+* feeds the structured tracer (``telemetry/tracing.py``) when tracing is
+  enabled, so every already-instrumented site lands in the flight
+  recorder's timeline for free (disabled: one attribute check);
 * notes itself as the registry's *last completed span*, which is what the
   stall watchdog reports when a training step misses its deadline.
 
@@ -23,6 +26,7 @@ import threading
 import time
 from typing import Optional
 
+from deepspeed_tpu.telemetry import tracing as _tracing
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
 SPAN_HISTOGRAM = "span_seconds"
@@ -48,7 +52,7 @@ def span(name: str, registry: MetricsRegistry, **labels):
     hist = registry.histogram(
         SPAN_HISTOGRAM, "wall time of telemetry.span sections")
     t0 = time.perf_counter()
-    with _trace_annotation(name):
+    with _trace_annotation(name), _tracing.get_tracer().span(name, **labels):
         try:
             yield
         finally:

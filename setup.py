@@ -25,6 +25,7 @@ setup(
             "dstpu_report=deepspeed_tpu.env_report:main",
             "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
             "dslint=deepspeed_tpu.analysis.__main__:main",
+            "trace-dump=deepspeed_tpu.telemetry.tracing:main",
         ],
     },
     # tools/dslint is a checkout-only shim; the `dslint` console entry

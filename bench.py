@@ -9,7 +9,7 @@ converted to tokens/s at this model's FLOPs/token; the citation is emitted
 in the JSON. The line also reports achieved model TFLOP/s and MFU against
 the chip's bf16 peak.
 
-The ``configs`` section covers the driver's north-star milestone configs
+The suite ``entries`` cover the driver's north-star milestone configs
 (BASELINE.json): ZeRO-2 + FusedAdam BERT-large fp16, ZeRO-3 llama-style
 (largest fitting 16G HBM single-chip), AutoTP-style inference generate,
 FastGen paged/planned serving, MoE + Ulysses SP (dropless ragged dispatch),
@@ -31,6 +31,17 @@ Tuned defaults (measured on v5e, see PROFILE.md): micro-batch 32, remat=full,
 Pallas flash attention 512/1024 blocks, bf16 head matmul with fp32
 accumulation. BENCH_* env vars override; BENCH_SUITE=0 runs the headline
 only; BENCH_CEILING=0 skips the ceiling measurement.
+
+The output is schema v2 (``deepspeed_tpu/bench/schema.py``): a structured
+``headline`` block + normalized per-entry ``{metrics, trace_phases,
+memory, elapsed_s, skipped_reason}`` rows, validated before printing
+(invalid output is a refusal, exit 1 — the r03–r05 ``"parsed": null``
+failure mode is structurally closed). After printing, the result is
+appended to ``bench_history/history.jsonl`` (``BENCH_RECORD=0`` skips)
+and gated against the latest recorded round: a >5% headline or per-entry
+regression exits 1 with phase attribution on stderr (``BENCH_GATE=0`` /
+``BENCH_GATE_THRESHOLD=`` override; see README "Perf trajectory" and
+``tools/bench-diff``).
 """
 import gc
 import json
@@ -930,6 +941,35 @@ SUITE_ENTRIES = {name: fn for name, fn, _, _ in
 SUITE_ENTRIES["headline"] = lambda: headline_entry()
 
 
+def _entry_memory_stats() -> dict:
+    """Peak host RSS for THIS entry — each suite entry is its own
+    subprocess, so ``ru_maxrss`` is a clean per-row peak (Linux reports
+    KB) — plus device allocator stats where the backend exposes them, so
+    memory regressions are diffable next to speed ones (bench-diff treats
+    ``memory.*`` as lower-is-better)."""
+    out = {}
+    try:
+        import resource
+
+        out["peak_host_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+    except (ImportError, ValueError, OSError):
+        pass
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        keep = {k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "largest_alloc_size")}
+        if keep:
+            out["device"] = keep
+    except (ImportError, IndexError, AttributeError, RuntimeError,
+            TypeError, ValueError):
+        pass   # CPU/older PJRT backends have no memory_stats
+    return out
+
+
 def _run_entry_subprocess(name: str, timeout: float):
     """Run one suite entry in a child process so an XLA OOM/abort in a
     deliberately-HBM-tight config can't take the headline JSON down with it,
@@ -1027,10 +1067,15 @@ def headline_entry():
     baseline_tps = (BASELINE_TFLOPS_CITED * headline["tokens_per_sec_chip"]
                     / tfl) if tfl >= 0.1 else None
     win = headline.get("window_samples_tokens_per_sec") or []
+    dev = jax.devices()[0]
     return {
         "metric": f"tokens/sec/chip {model} zero1 bf16",
         "value": headline["tokens_per_sec_chip"],
         "unit": "tokens/s/chip",
+        # platform/device identity: the gate refuses to baseline a TPU
+        # round against a CPU what-if run (and vice versa)
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
         # the run-to-run tunnel variance as a FIRST-CLASS band (round-4
         # verdict paper-cut b): value is the best window, the band is what
         # repeated runs should reproduce
@@ -1121,6 +1166,9 @@ def main():
                         row["trace_phases"] = phases
                 except Exception:
                     pass
+                mem = _entry_memory_stats()
+                if mem:
+                    row["memory"] = mem
             print(json.dumps(row))
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:200]}))
@@ -1148,7 +1196,27 @@ def main():
         t0 = time.monotonic()
         row = _run_entry_subprocess(name, timeout=min(cap, rem))
         elapsed[name] = round(time.monotonic() - t0, 1)
+        if rem < cap and isinstance(row, dict) \
+                and str(row.get("error", "")).startswith("entry timed out"):
+            # timed out at a BUDGET-clamped cap (not its nominal one):
+            # that's starvation, not breakage — it must diff as a budget
+            # skip, not a measured->error gate regression
+            return {"skipped": f"budget (timed out at clamped {int(rem)}s"
+                               f" < {cap}s cap)"}
         return row
+
+    # the observatory is auxiliary like every other bench subsystem: a
+    # broken deepspeed_tpu/bench package must degrade to an ungated
+    # legacy line, not kill the run AFTER the chip time was spent (the
+    # r04 husk failure mode this package exists to close)
+    try:
+        from deepspeed_tpu.bench import gate as bench_gate
+        from deepspeed_tpu.bench import history as bench_history
+        from deepspeed_tpu.bench import schema as bench_schema
+    except Exception as e:
+        print(f"bench: observatory unavailable ({type(e).__name__}: {e});"
+              " emitting ungated legacy line", file=sys.stderr)
+        bench_gate = bench_history = bench_schema = None
 
     # headline first — it owns the metric line; a failure degrades to an
     # error row with value 0 (the driver contract needs the line either way)
@@ -1158,37 +1226,116 @@ def main():
         head = {"metric": f"tokens/sec/chip {_m} zero1 bf16",
                 "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
                 "error": head.get("error", head.get("skipped", "unknown"))}
-    result = dict(head)
+    headline = dict(head)
+    if "headline" in elapsed:
+        headline["elapsed_s"] = elapsed["headline"]
 
+    rows = {}
     if os.environ.get("BENCH_SUITE", "1") != "0":
         schedule = list(SUITE_SCHEDULE)
         if os.environ.get("BENCH_LONG", "0") != "0":
             schedule += LONG_SCHEDULE
-        result["configs"] = {
-            name: run_timed(name, cap, floor)
-            for name, _, cap, floor in schedule}
+        for name, _, cap, floor in schedule:
+            rows[name] = run_timed(name, cap, floor)
 
-    # surface the best-utilization training row at top level: the 125M
-    # headline keeps cross-round comparability, but its small-shape MFU is
-    # architecture-bound (PROFILE.md ceiling ladder) — the framework's
-    # utilization story is the north-star-scale rows below it
-    best = {"name": "headline", "mfu": result.get("mfu") or 0,
+    if bench_schema is None:
+        result = dict(head)
+        if rows:
+            result["configs"] = rows
+        result["budget_s"] = BENCH_BUDGET_S
+        result["total_runtime_s"] = round(time.monotonic() - BENCH_T0, 1)
+        result["entry_elapsed_s"] = elapsed
+        print(json.dumps(result))
+        return 0
+
+    # schema v2 (deepspeed_tpu/bench/schema.py): driver-contract keys stay
+    # top-level, everything else lives in the structured headline block +
+    # normalized entries — and the result is VALIDATED before it prints,
+    # so "parsed": null (r03–r05) can't silently happen again
+    result = {
+        "schema_version": bench_schema.SCHEMA_VERSION,
+        "metric": headline["metric"],
+        "value": headline["value"],
+        "unit": headline["unit"],
+        "vs_baseline": headline.get("vs_baseline", 0),
+        "headline": headline,
+    }
+    entries = {
+        name: bench_schema.normalize_entry_row(row, elapsed.get(name))
+        for name, row in rows.items()}
+    result["entries"] = entries
+
+    # surface the best-utilization training row in the headline block: the
+    # 125M headline keeps cross-round comparability, but its small-shape
+    # MFU is architecture-bound (PROFILE.md ceiling ladder) — the
+    # framework's utilization story is the north-star-scale rows below it
+    best = {"name": "headline", "mfu": headline.get("mfu") or 0,
             "model_tflops_per_sec_chip":
-                result.get("model_tflops_per_sec_chip")}
-    for name, row in (result.get("configs") or {}).items():
-        if isinstance(row, dict) and (row.get("mfu") or 0) > best["mfu"]:
-            best = {"name": name, "mfu": row["mfu"],
+                headline.get("model_tflops_per_sec_chip")}
+    for name, entry in entries.items():
+        metrics = entry.get("metrics") or {}
+        if (metrics.get("mfu") or 0) > best["mfu"]:
+            best = {"name": name, "mfu": metrics["mfu"],
                     "model_tflops_per_sec_chip":
-                        row.get("model_tflops_per_sec_chip")}
+                        metrics.get("model_tflops_per_sec_chip")}
     if best.get("model_tflops_per_sec_chip"):
         best["vs_baseline"] = round(
             best["model_tflops_per_sec_chip"] / BASELINE_TFLOPS_CITED, 3)
-    result["best_mfu_row"] = best
+    headline["best_row"] = best
 
     result["budget_s"] = BENCH_BUDGET_S
     result["total_runtime_s"] = round(time.monotonic() - BENCH_T0, 1)
-    result["entry_elapsed_s"] = elapsed
+
+    # same refusal posture as the dslint gate: a result that fails its own
+    # schema is not recordable evidence — print an explicit refusal line
+    # (the driver contract still gets ONE JSON line) and exit nonzero
+    errors = bench_schema.validate_result(result)
+    if errors:
+        for err in errors[:20]:
+            print(f"bench: schema: {err}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench refused: result failed schema validation",
+            "value": 0, "unit": "schema errors",
+            "error": f"schema v{bench_schema.SCHEMA_VERSION}: "
+                     f"{len(errors)} validation error(s) — first: "
+                     f"{errors[0][:160]}"}))
+        return 1
+
+    # regression gate (deepspeed_tpu/bench/gate.py): fresh result vs the
+    # latest bench_history record; >threshold headline/per-entry drops fail
+    # the run (exit 1) with per-phase attribution on stderr. A broken gate
+    # must not kill benchmarking — GATE_ERROR degrades to ungated.
+    gate_rc, gate_info = bench_gate.run_gate(result)
+    result["gate"] = gate_info
+
     print(json.dumps(result))
+
+    if os.environ.get("BENCH_RECORD", "1") != "0":
+        try:
+            # record rc = did THIS run pass (baseline-worthiness): only a
+            # real regression disqualifies it; a gate-internal error does
+            # not taint an otherwise valid round
+            bench_history.append_record(bench_history.record_from_result(
+                result,
+                rc=1 if gate_rc == bench_gate.GATE_REGRESSED else 0))
+        except OSError as e:
+            print(f"bench: history append failed: {e}", file=sys.stderr)
+    if gate_rc == bench_gate.GATE_REGRESSED:
+        for reg in gate_info.get("regressions", [])[:10]:
+            print(f"bench: GATE: {reg.get('where')}.{reg.get('metric')} "
+                  f"{reg.get('old')} -> {reg.get('new')} "
+                  f"({reg.get('delta_frac')})", file=sys.stderr)
+        for line in gate_info.get("attribution", [])[:5]:
+            print(f"bench: GATE: {line}", file=sys.stderr)
+        print(f"bench: GATE: regression vs {gate_info.get('baseline')} "
+              f"past {gate_info.get('threshold')} — exit 1 "
+              "(BENCH_GATE=0 or BENCH_GATE_THRESHOLD= override)",
+              file=sys.stderr)
+        return 1
+    if gate_rc == bench_gate.GATE_ERROR:
+        print(f"bench: gate unavailable ({gate_info.get('error')}); "
+              "proceeding ungated", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,172 @@
+"""``bench-diff`` — diff two bench rounds with regression attribution.
+
+Round specs (either positional argument):
+
+* ``r05`` / ``r3``       — a round id: resolved from ``bench_history/``
+  first, else recovered live from the committed ``BENCH_rNN.json``
+* ``latest``             — the newest comparable ``bench_history`` record
+* a file path            — a driver round artifact (``{rc, tail,
+  parsed}``), a history record, a raw bench result (v1 or v2), or a
+  plain log whose last JSON line / fragments are recovered tolerantly
+
+Exit codes (dslint-shaped, see ``deepspeed_tpu.bench.gate``): 0 = no
+past-threshold regressions, 1 = regressions found, 2 = usage/internal
+error. ``--no-gate`` forces exit 0 on a successful diff. Unlike
+``bench.py``'s automated self-gate, an explicit diff exits 1 on ANY
+regression it shows — including the CPU-mesh noisy lanes the automated
+gate ignores; you asked for this exact comparison, so you get all of it
+(``--no-gate`` if you only want the report).
+
+Examples::
+
+    bench-diff r04 r05
+    bench-diff r05 /tmp/fresh_bench.json --format markdown
+    bench-diff latest /tmp/fresh_bench.json --threshold 0.10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.bench import history as history_mod
+from deepspeed_tpu.bench import legacy
+from deepspeed_tpu.bench.diff import (
+    diff_results,
+    render_markdown,
+    render_text,
+)
+from deepspeed_tpu.bench.gate import GATE_ERROR, GATE_OK, GATE_REGRESSED
+
+
+class SpecError(ValueError):
+    pass
+
+
+def _from_loaded_json(obj: Any, label: str
+                      ) -> Tuple[str, Dict[str, Any], List[str]]:
+    if not isinstance(obj, dict):
+        raise SpecError(f"{label}: not a JSON object")
+    if "tail" in obj and "parsed" in obj:        # driver round artifact
+        rec = legacy.recover_round_data(obj, legacy.round_id_from_path(
+            label), label)
+        return rec["round"], rec["result"], rec.get("notes", [])
+    if "record_version" in obj and isinstance(obj.get("result"), dict):
+        return obj.get("round", label), obj["result"], obj.get("notes", [])
+    if "metric" in obj or "schema_version" in obj:
+        return label, legacy.upgrade_legacy_result(obj), []
+    raise SpecError(f"{label}: unrecognized JSON shape (neither a round "
+                    "artifact, a history record, nor a bench result)")
+
+
+def resolve_spec(spec: str, history_file: Optional[str],
+                 repo_root: Optional[str]
+                 ) -> Tuple[str, Dict[str, Any], List[str]]:
+    """Resolve a round spec to ``(label, result, notes)``."""
+    root = repo_root or history_mod.default_repo_root()
+    if spec == "latest":
+        rec = history_mod.latest_record(path=history_file)
+        if rec is None:
+            raise SpecError("no comparable record in bench_history")
+        return rec.get("round", "latest"), rec["result"], \
+            rec.get("notes", [])
+    m = re.fullmatch(r"r?(\d+)", spec)
+    if not os.path.exists(spec) and m:
+        # canonical zero-padded id first ("r5" and "r05" are the same
+        # round; history and artifacts store the padded form)
+        candidates = [f"r{int(m.group(1)):02d}", f"r{m.group(1)}"]
+        for round_id in dict.fromkeys(candidates):
+            rec = history_mod.record_for_round(round_id, path=history_file)
+            if rec is not None:
+                return round_id, rec["result"], rec.get("notes", [])
+        # not ingested yet — recover live from the committed artifact
+        for round_id in dict.fromkeys(candidates):
+            path = os.path.join(root, f"BENCH_{round_id}.json")
+            if os.path.exists(path):
+                rec = legacy.recover_round_file(path)
+                return rec["round"], rec["result"], rec.get("notes", [])
+        raise SpecError(f"round {candidates[0]!r} not in bench_history "
+                        f"and no BENCH_{candidates[0]}.json under {root}")
+    if os.path.exists(spec):
+        with open(spec, encoding="utf-8") as f:
+            text = f.read()
+        label = os.path.basename(spec)
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            result, notes = legacy.recover_from_text(text)
+            return label, result, notes
+        return _from_loaded_json(obj, label)
+    raise SpecError(f"cannot resolve spec {spec!r}: not a round id, "
+                    "'latest', or an existing file")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bench-diff",
+        description="diff two bench rounds (headline, per-entry metrics, "
+                    "per-phase trace spans) with regression attribution")
+    p.add_argument("old", help="baseline round (rNN | latest | file)")
+    p.add_argument("new", help="candidate round (rNN | latest | file)")
+    p.add_argument("--format", choices=("text", "json", "markdown"),
+                   default="text")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="regression threshold as a fraction (default 0.05)")
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help="bench_history dir or .jsonl file (default: the "
+                        "checkout's bench_history/, or $BENCH_HISTORY)")
+    p.add_argument("--repo", default=None, metavar="DIR",
+                   help="checkout root holding BENCH_rNN.json artifacts")
+    p.add_argument("--verbose", action="store_true",
+                   help="show every compared metric, not just movers")
+    p.add_argument("--no-gate", action="store_true",
+                   help="always exit 0 on a successful diff")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        old_label, old_result, old_notes = resolve_spec(
+            args.old, args.history, args.repo)
+        new_label, new_result, new_notes = resolve_spec(
+            args.new, args.history, args.repo)
+    except (OSError, ValueError) as e:
+        # SpecError subclasses ValueError; unreadable files / corrupt
+        # artifacts are internal errors (2), never "regressions" (1)
+        print(f"bench-diff: error: {e}", file=sys.stderr)
+        return GATE_ERROR
+    try:
+        diff = diff_results(old_result, new_result,
+                            threshold=args.threshold,
+                            old_label=old_label, new_label=new_label)
+        seen = set(diff["notes"])
+        for label, notes in ((old_label, old_notes),
+                             (new_label, new_notes)):
+            for note in notes:
+                line = f"{label}: {note}"
+                if line not in seen:
+                    seen.add(line)
+                    diff["notes"].append(line)
+        if args.format == "json":
+            print(json.dumps(diff, indent=2))
+        elif args.format == "markdown":
+            print(render_markdown(diff, verbose=args.verbose))
+        else:
+            print(render_text(diff, verbose=args.verbose))
+    except Exception as e:
+        # exit 1 is reserved for "regressions found"; a diff/render
+        # failure on degenerate input is the contract's 2
+        print(f"bench-diff: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return GATE_ERROR
+    if args.no_gate:
+        return GATE_OK
+    return GATE_OK if diff["ok"] else GATE_REGRESSED
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,442 @@
+"""Diff two bench rounds: headline, per-entry metrics, per-phase spans.
+
+The comparison is direction-aware — ``tokens_per_sec`` falling is a
+regression, ``ttft_p95_s`` falling is an improvement — and only metrics
+with a known direction are compared at all (config echoes like ``batch``
+or ``max_new`` and convergence losses are not perf trajectories).
+
+When a throughput metric regresses past the threshold, the entry's
+``trace_phases`` (per-phase p50/p95/p99 span percentiles, PR 5) are
+diffed too and the regression is ATTRIBUTED: the phase whose per-
+occurrence p50 grew the most, weighted by how often it ran, is named
+with before/after numbers — "tokens/sec dropped 12%: 'train_window' p50
+grew 15% (0.800s -> 0.920s)" instead of a bare red number.
+
+Inputs are schema-v2 results (``deepspeed_tpu.bench.schema``) or the
+partial results the legacy recovery produces — anything missing on one
+side degrades to a status note, never a crash.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.bench.schema import is_number
+
+HIGHER_IS_BETTER = 1
+LOWER_IS_BETTER = -1
+
+_HIGHER_SUBSTR = ("tokens_per_sec", "tflops")
+_HIGHER_EXACT = ("value", "mfu", "vs_baseline", "vs_ceiling",
+                 "vs_ceiling_hardware", "wire_reduction", "speedup_vs_slot",
+                 "baseline_tokens_per_sec")
+# NOT compared: tuner_score (the autotuner's internal RANKING measure,
+# explicitly uncalibrated — bench.py autotune_smoke), loss (convergence
+# evidence, not a perf trajectory), config echoes (batch, max_new, ...)
+_HIGHER_SUFFIX = ("gbps",)
+_LOWER_PREFIX = ("ttft_", "tpot_", "e2e_")
+_LOWER_EXACT = ("rel_err", "overhead_factor", "moe_dropped_frac",
+                "peak_host_rss_mb", "peak_bytes_in_use")
+# bytes_in_use is an END-OF-ENTRY allocator snapshot, not a peak — it
+# moves with GC/donation timing run-to-run, so it is shown in rows but
+# never direction-compared (peaks are; they're reproducible)
+_LOWER_SUFFIX = ("_phase_s", "time_ms")
+
+
+def metric_direction(name: str) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = not a perf
+    metric (not compared). ``name`` is the LEAF key of a flattened path."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _HIGHER_EXACT or any(s in leaf for s in _HIGHER_SUBSTR):
+        return HIGHER_IS_BETTER
+    if leaf.endswith(_HIGHER_SUFFIX):
+        return HIGHER_IS_BETTER
+    if leaf in _LOWER_EXACT or leaf.startswith(_LOWER_PREFIX) \
+            or leaf.endswith(_LOWER_SUFFIX):
+        return LOWER_IS_BETTER
+    return None
+
+
+def flatten_metrics(obj: Any, prefix: str = "",
+                    out: Optional[Dict[str, float]] = None,
+                    depth: int = 0) -> Dict[str, float]:
+    """Flatten a metrics tree to ``dotted.path -> number``, keeping only
+    leaves with a known direction. Lists of dicts keyed by an ``"op"``
+    field (comm tables) flatten per-op; other lists are samples, skipped."""
+    if out is None:
+        out = {}
+    if depth > 8:
+        return out
+    if isinstance(obj, dict):
+        for key, val in obj.items():
+            path = f"{prefix}.{key}" if prefix else str(key)
+            flatten_metrics(val, path, out, depth + 1)
+    elif isinstance(obj, list):
+        for item in obj:
+            if isinstance(item, dict) and isinstance(item.get("op"), str):
+                flatten_metrics(
+                    {k: v for k, v in item.items() if k != "op"},
+                    f"{prefix}.{item['op']}" if prefix else item["op"],
+                    out, depth + 1)
+    elif is_number(obj) and prefix and metric_direction(prefix) is not None:
+        out[prefix] = float(obj)
+    return out
+
+
+def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
+    """Extract the diffable view of a (possibly partial) v2 result."""
+    head = result.get("headline") or {}
+    head_metrics = flatten_metrics(
+        {k: v for k, v in head.items()
+         if k not in ("trace_phases", "telemetry", "best_row", "memory")})
+    if "memory" in head:
+        head_metrics.update(flatten_metrics(head["memory"], "memory"))
+    out = {
+        "headline": {
+            "metric_name": head.get("metric"),
+            "metrics": head_metrics,
+            "phases": head.get("trace_phases") or {},
+            "error": head.get("error"),
+        },
+        "entries": {},
+    }
+    for name, entry in (result.get("entries") or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        metrics = flatten_metrics(entry.get("metrics") or {})
+        if "memory" in entry:
+            metrics.update(flatten_metrics(entry["memory"], "memory"))
+        out["entries"][name] = {
+            "metrics": metrics,
+            "phases": entry.get("trace_phases") or {},
+            "skipped_reason": entry.get("skipped_reason"),
+            "error": entry.get("error"),
+        }
+    return out
+
+
+def _field_diffs(old: Dict[str, float], new: Dict[str, float],
+                 threshold: float) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(set(old) & set(new)):
+        a, b = old[name], new[name]
+        direction = metric_direction(name)
+        if direction is None:
+            continue
+        if a == 0:
+            # no relative delta exists, but dropping the row would hide
+            # a 0 -> nonzero move (e.g. rel_err appearing); show it
+            # un-verdicted instead
+            rows.append({
+                "name": name, "old": a, "new": b,
+                "delta_frac": None,
+                "direction": ("higher_is_better" if direction > 0
+                              else "lower_is_better"),
+                "regressed": False, "improved": False,
+                "note": "zero baseline — no relative delta",
+            })
+            continue
+        delta = (b - a) / abs(a)
+        regressed = direction * delta < -threshold
+        improved = direction * delta > threshold
+        rows.append({
+            "name": name, "old": a, "new": b,
+            "delta_frac": round(delta, 4),
+            "direction": ("higher_is_better" if direction > 0
+                          else "lower_is_better"),
+            "regressed": regressed, "improved": improved,
+        })
+    return rows
+
+
+def _phase_diffs(old: Dict[str, Any],
+                 new: Dict[str, Any]) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for phase in sorted(set(old) & set(new)):
+        a, b = old[phase], new[phase]
+        if not (isinstance(a, dict) and isinstance(b, dict)):
+            continue
+        p50_a, p50_b = a.get("p50_s"), b.get("p50_s")
+        if not (is_number(p50_a) and is_number(p50_b)) or p50_a <= 0:
+            continue
+        rows.append({
+            "phase": phase,
+            "p50_old_s": p50_a, "p50_new_s": p50_b,
+            "p50_delta_frac": round((p50_b - p50_a) / p50_a, 4),
+            "p95_old_s": a.get("p95_s"), "p95_new_s": b.get("p95_s"),
+            "count_old": a.get("count"), "count_new": b.get("count"),
+            "total_old_s": a.get("total_s"), "total_new_s": b.get("total_s"),
+        })
+    return rows
+
+
+def _attribute(fields: List[Dict[str, Any]],
+               phase_rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Name the phase responsible for a throughput regression. Only fires
+    when a higher-is-better throughput-class metric regressed."""
+    culprit_metric = None
+    for row in fields:
+        if row["regressed"] and row["direction"] == "higher_is_better" \
+                and ("tokens_per_sec" in row["name"]
+                     or row["name"] == "value"):
+            if culprit_metric is None \
+                    or row["delta_frac"] < culprit_metric["delta_frac"]:
+                culprit_metric = row
+    if culprit_metric is None:
+        return None
+    base = {
+        "regressed_metric": culprit_metric["name"],
+        "metric_delta_frac": culprit_metric["delta_frac"],
+    }
+    grown = [r for r in phase_rows if r["p50_delta_frac"] > 0]
+    if not grown:
+        base["phase"] = None
+        base["summary"] = (
+            f"{culprit_metric['name']} "
+            f"{culprit_metric['delta_frac'] * 100:+.1f}% — no overlapping "
+            "trace_phases grew; phase attribution unavailable")
+        return base
+    # weight per-occurrence p50 growth by how often the phase ran: the
+    # phase contributing the most wall seconds to the slowdown wins
+    def score(r: Dict[str, Any]) -> float:
+        count = r.get("count_new") or r.get("count_old") or 1
+        return (r["p50_new_s"] - r["p50_old_s"]) * float(count)
+
+    top = max(grown, key=score)
+    base.update({
+        "phase": top["phase"],
+        "p50_old_s": top["p50_old_s"], "p50_new_s": top["p50_new_s"],
+        "p50_growth_frac": top["p50_delta_frac"],
+        "est_growth_s": round(score(top), 6),
+    })
+    base["summary"] = (
+        f"{culprit_metric['name']} "
+        f"{culprit_metric['delta_frac'] * 100:+.1f}%: phase "
+        f"'{top['phase']}' p50 grew {top['p50_delta_frac'] * 100:+.1f}% "
+        f"({top['p50_old_s']:.4g}s -> {top['p50_new_s']:.4g}s)")
+    return base
+
+
+def diff_results(old_result: Dict[str, Any], new_result: Dict[str, Any],
+                 threshold: float = 0.05,
+                 old_label: str = "old",
+                 new_label: str = "new") -> Dict[str, Any]:
+    """Structured diff of two (possibly partial) schema-v2 results."""
+    old_c, new_c = comparables(old_result), comparables(new_result)
+    diff: Dict[str, Any] = {
+        "old": old_label, "new": new_label,
+        "threshold": threshold,
+        "headline": {}, "entries": {},
+        "regressions": [], "improvements": [], "notes": [],
+    }
+
+    def collect(where: str, fields: List[Dict[str, Any]]) -> None:
+        for row in fields:
+            bucket = (diff["regressions"] if row["regressed"] else
+                      diff["improvements"] if row["improved"] else None)
+            if bucket is not None:
+                bucket.append({"where": where, "metric": row["name"],
+                               "old": row["old"], "new": row["new"],
+                               "delta_frac": row["delta_frac"]})
+
+    old_name = old_c["headline"]["metric_name"]
+    new_name = new_c["headline"]["metric_name"]
+    old_plat = (old_result.get("headline") or {}).get("platform")
+    new_plat = (new_result.get("headline") or {}).get("platform")
+    old_err = old_c["headline"]["error"]
+    new_err = new_c["headline"]["error"]
+    if (old_name and new_name and old_name != new_name) or \
+            (old_plat and new_plat and old_plat != new_plat):
+        # different model/config headline (BENCH_MODEL override) or
+        # different backend (CPU what-if vs TPU round): a cross
+        # comparison of the headline would be a fake regression
+        diff["notes"].append(
+            f"headline not comparable ({old_name!r}@{old_plat or '?'} vs "
+            f"{new_name!r}@{new_plat or '?'}) — entries still diff "
+            "like-for-like")
+        head_fields: List[Dict[str, Any]] = []
+        head_phases: List[Dict[str, Any]] = []
+    elif old_err or new_err:
+        # an errored headline carries value=0 by schema contract —
+        # numeric-comparing it would read as a fake -100%. Measured ->
+        # error IS a regression (like entries), but an honest one —
+        # UNLESS the error is budget starvation (the headline can't carry
+        # skipped_reason, so bench.py folds budget skips into error):
+        # budget skips are noted, never flagged, same as entries.
+        head_fields = []
+        head_phases = []
+        fresh_budget = isinstance(new_err, str) \
+            and new_err.startswith("budget")
+        if new_err and not old_err and not fresh_budget \
+                and old_c["headline"]["metrics"].get("value"):
+            diff["regressions"].append({
+                "where": "headline", "metric": "(headline)",
+                "old": "measured", "new": "error",
+                "delta_frac": None, "note": str(new_err)[:160]})
+        diff["notes"].append(
+            "headline errored in "
+            + (" and ".join(lbl for lbl, err in ((old_label, old_err),
+                                                 (new_label, new_err))
+                            if err))
+            + " — numeric headline not compared")
+    else:
+        head_fields = _field_diffs(old_c["headline"]["metrics"],
+                                   new_c["headline"]["metrics"], threshold)
+        head_phases = _phase_diffs(old_c["headline"]["phases"],
+                                   new_c["headline"]["phases"])
+    diff["headline"] = {
+        "metric_name": (new_c["headline"]["metric_name"]
+                        or old_c["headline"]["metric_name"]),
+        "fields": head_fields, "phases": head_phases,
+        "attribution": _attribute(head_fields, head_phases),
+    }
+    collect("headline", head_fields)
+    if not old_c["headline"]["metrics"]:
+        diff["notes"].append(f"{old_label}: headline not comparable "
+                             "(missing or recovered without it)")
+    if not new_c["headline"]["metrics"]:
+        diff["notes"].append(f"{new_label}: headline not comparable")
+
+    for name in sorted(set(old_c["entries"]) | set(new_c["entries"])):
+        o = old_c["entries"].get(name)
+        n = new_c["entries"].get(name)
+        if o is None or n is None:
+            diff["entries"][name] = {
+                "status": "only_old" if n is None else "only_new"}
+            continue
+        old_state = ("skipped" if o["skipped_reason"] else
+                     "error" if o["error"] else "ok")
+        new_state = ("skipped" if n["skipped_reason"] else
+                     "error" if n["error"] else "ok")
+        if old_state == "ok" and new_state == "ok":
+            status = "compared"
+        elif old_state == new_state:
+            # skipped/errored on BOTH sides is not a fresh breakage
+            status = f"{old_state}_both"
+        elif new_state != "ok":
+            status = f"{new_state}_new"
+        else:
+            status = f"{old_state}_old"
+        entry_diff: Dict[str, Any] = {"status": status}
+        if status == "compared" or (o["metrics"] and n["metrics"]):
+            fields = _field_diffs(o["metrics"], n["metrics"], threshold)
+            phases = _phase_diffs(o["phases"], n["phases"])
+            entry_diff.update({
+                "fields": fields, "phases": phases,
+                "attribution": _attribute(fields, phases),
+            })
+            collect(name, fields)
+        if status == "error_new" and o["metrics"]:
+            # a measured entry turning into an error row IS a regression
+            diff["regressions"].append({
+                "where": name, "metric": "(entry)",
+                "old": "measured", "new": "error",
+                "delta_frac": None,
+                "note": (n["error"] or "")[:160]})
+        elif status.startswith("skipped"):
+            diff["notes"].append(
+                f"{name}: {status.replace('_', ' in ')} — not compared")
+        diff["entries"][name] = entry_diff
+    diff["ok"] = not diff["regressions"]
+    return diff
+
+
+# --------------------------------------------------------------------- #
+# renderers
+# --------------------------------------------------------------------- #
+def _fmt(x: Any) -> str:
+    if is_number(x):
+        # magnitude guard first: int(inf)/int(nan) raise
+        if abs(x) < 1e15 and x == int(x):
+            return str(int(x))
+        return f"{x:.4g}"
+    return str(x)
+
+
+def _fmt_delta(delta_frac: Any) -> str:
+    if delta_frac is None:
+        return "    n/a "
+    return f"{delta_frac * 100:+7.1f}%"
+
+
+def _field_line(row: Dict[str, Any]) -> str:
+    flag = ("REGRESSED" if row["regressed"]
+            else "improved" if row["improved"] else row.get("note") or "")
+    return (f"{row['name']:42s} {_fmt(row['old']):>12s} -> "
+            f"{_fmt(row['new']):>12s}  {_fmt_delta(row['delta_frac'])}  "
+            f"{flag}").rstrip()
+
+
+def render_text(diff: Dict[str, Any], verbose: bool = False) -> str:
+    lines: List[str] = []
+    th = diff["threshold"]
+    lines.append(f"bench-diff {diff['old']} -> {diff['new']}  "
+                 f"(threshold {th * 100:g}%)")
+    head = diff["headline"]
+    if head.get("fields"):
+        lines.append(f"headline: {head.get('metric_name')}")
+        for row in head["fields"]:
+            if verbose or row["regressed"] or row["improved"]:
+                lines.append("  " + _field_line(row))
+        if head.get("attribution"):
+            lines.append(f"  attribution: {head['attribution']['summary']}")
+    for name, entry in diff["entries"].items():
+        fields = entry.get("fields") or []
+        shown = [r for r in fields
+                 if verbose or r["regressed"] or r["improved"]]
+        if not shown and entry.get("status") == "compared" \
+                and not entry.get("attribution"):
+            continue
+        lines.append(f"{name} [{entry['status']}]")
+        for row in shown:
+            lines.append("  " + _field_line(row))
+        if entry.get("attribution"):
+            lines.append(f"  attribution: {entry['attribution']['summary']}")
+    for note in diff["notes"]:
+        lines.append(f"note: {note}")
+    lines.append(
+        f"summary: {len(diff['regressions'])} regression(s), "
+        f"{len(diff['improvements'])} improvement(s) past "
+        f"{th * 100:g}%")
+    return "\n".join(lines)
+
+
+def render_markdown(diff: Dict[str, Any], verbose: bool = False) -> str:
+    lines: List[str] = []
+    lines.append(f"### bench-diff `{diff['old']}` → `{diff['new']}` "
+                 f"(threshold {diff['threshold'] * 100:g}%)")
+    lines.append("")
+    lines.append("| where | metric | old | new | Δ | verdict |")
+    lines.append("|---|---|---:|---:|---:|---|")
+
+    def md_rows(where: str, fields: List[Dict[str, Any]]) -> None:
+        for row in fields:
+            if not (verbose or row["regressed"] or row["improved"]):
+                continue
+            verdict = ("**regressed**" if row["regressed"]
+                       else "improved" if row["improved"] else "")
+            lines.append(
+                f"| {where} | `{row['name']}` | {_fmt(row['old'])} | "
+                f"{_fmt(row['new'])} | {_fmt_delta(row['delta_frac']).strip()}"
+                f" | {verdict} |")
+
+    md_rows("headline", diff["headline"].get("fields") or [])
+    for name, entry in diff["entries"].items():
+        md_rows(name, entry.get("fields") or [])
+    attributions = []
+    if diff["headline"].get("attribution"):
+        attributions.append(("headline", diff["headline"]["attribution"]))
+    attributions += [(n, e["attribution"]) for n, e in
+                     diff["entries"].items() if e.get("attribution")]
+    if attributions:
+        lines.append("")
+        lines.append("**Attribution**")
+        for where, attr in attributions:
+            lines.append(f"- {where}: {attr['summary']}")
+    if diff["notes"]:
+        lines.append("")
+        for note in diff["notes"]:
+            lines.append(f"- note: {note}")
+    lines.append("")
+    lines.append(f"{len(diff['regressions'])} regression(s), "
+                 f"{len(diff['improvements'])} improvement(s)")
+    return "\n".join(lines)

@@ -1,0 +1,170 @@
+"""Append-only bench history store: ``bench_history/history.jsonl``.
+
+One JSONL line per bench round. Records are written by ``bench.py`` after
+a schema-valid run, and by ``python -m deepspeed_tpu.bench recover`` when
+re-ingesting committed ``BENCH_rNN.json`` artifacts. The file is
+append-only by convention AND by API — there is no rewrite call; a bad
+record is superseded by appending a corrected one with the same round id
+(the LAST record for a round wins on read).
+
+Reading is tolerant: a corrupt line is skipped with a note, never a
+crash — history must stay readable after a partial append (preempted
+writer, merge damage).
+
+``BENCH_HISTORY`` overrides the location (a directory containing
+``history.jsonl``, or a file path ending in ``.jsonl``); the default is
+``<repo root>/bench_history/history.jsonl``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.bench.schema import RECORD_VERSION, is_number
+
+HISTORY_DIRNAME = "bench_history"
+HISTORY_FILENAME = "history.jsonl"
+
+
+def default_repo_root() -> str:
+    """The checkout root: parent of the ``deepspeed_tpu`` package dir."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def history_path(path: Optional[str] = None) -> str:
+    """Resolve the history file path from an explicit argument, the
+    ``BENCH_HISTORY`` env var, or the repo default — in that order. A
+    directory argument means ``<dir>/history.jsonl``."""
+    path = path or os.environ.get("BENCH_HISTORY") or os.path.join(
+        default_repo_root(), HISTORY_DIRNAME, HISTORY_FILENAME)
+    if path.endswith(".jsonl"):
+        return path
+    return os.path.join(path, HISTORY_FILENAME)
+
+
+def load_history(path: Optional[str] = None
+                 ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Read all records (file order) plus notes for skipped lines."""
+    path = history_path(path)
+    records: List[Dict[str, Any]] = []
+    notes: List[str] = []
+    if not os.path.exists(path):
+        return records, notes
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                notes.append(f"{path}:{i}: unparseable line skipped")
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("result"), dict):
+                records.append(rec)
+            else:
+                notes.append(f"{path}:{i}: not a bench record, skipped")
+    return records, notes
+
+
+def append_record(record: Dict[str, Any],
+                  path: Optional[str] = None) -> str:
+    """Append one record as a single JSONL line; returns the path."""
+    path = history_path(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(record, sort_keys=False) + "\n")
+    return path
+
+
+def record_from_result(result: Dict[str, Any],
+                       round_id: Optional[str] = None,
+                       source: str = "bench.py",
+                       rc: int = 0) -> Dict[str, Any]:
+    """Wrap a fresh schema-v2 result in a history record."""
+    return {
+        "record_version": RECORD_VERSION,
+        "round": round_id or os.environ.get("BENCH_ROUND") or "local",
+        "source": source,
+        "rc": rc,
+        "recovered": False,
+        "complete": True,
+        # export timestamp for ordering fresh local records between
+        # committed rounds (never used as an interval)
+        "recorded_unix_s": round(time.time(), 3),  # dslint: disable=wall-clock
+        "result": result,
+        "notes": [],
+    }
+
+
+def _has_comparables(record: Dict[str, Any]) -> bool:
+    result = record.get("result") or {}
+    head = result.get("headline") or {}
+    if is_number(head.get("value")) and head.get("value", 0) > 0:
+        return True
+    entries = result.get("entries") or {}
+    return any(isinstance(e, dict) and e.get("metrics")
+               for e in entries.values())
+
+
+def record_platform(record: Dict[str, Any]) -> Optional[str]:
+    head = (record.get("result") or {}).get("headline") or {}
+    plat = head.get("platform")
+    return plat if isinstance(plat, str) else None
+
+
+def latest_record(records: Optional[List[Dict[str, Any]]] = None,
+                  path: Optional[str] = None,
+                  comparable_only: bool = True,
+                  exclude_failed: bool = False,
+                  platform: Optional[str] = None,
+                  metric: Optional[str] = None,
+                  predicate: Optional[Any] = None
+                  ) -> Optional[Dict[str, Any]]:
+    """The most recent record (file order; last line wins), optionally
+    restricted to records that carry something diffable — a recovered
+    r04-style husk (rc=124, nothing parsed) can't be a gate baseline.
+
+    ``exclude_failed`` skips records whose run exited nonzero (its own
+    gate regression or a driver timeout) — a failed round is evidence
+    but not a baseline. ``platform`` / ``metric`` skip records that
+    declare a DIFFERENT platform or headline metric — a recorded
+    BENCH_MODEL=tiny what-if must not become the gpt2 trajectory's
+    baseline (its incomparable headline would silently disarm the
+    headline gate). Records without one — all legacy rounds — match
+    anything. ``predicate`` is an extra per-record filter (e.g. the
+    gate's gate-grade checks)."""
+    if records is None:
+        records, _ = load_history(path)
+    for rec in reversed(records):
+        if comparable_only and not _has_comparables(rec):
+            continue
+        if exclude_failed and rec.get("rc") not in (0, None):
+            continue
+        rec_plat = record_platform(rec)
+        if platform and rec_plat and rec_plat != platform:
+            continue
+        rec_metric = ((rec.get("result") or {}).get("headline")
+                      or {}).get("metric")
+        if metric and isinstance(rec_metric, str) and rec_metric != metric:
+            continue
+        if predicate is not None and not predicate(rec):
+            continue
+        return rec
+    return None
+
+
+def record_for_round(round_id: str,
+                     records: Optional[List[Dict[str, Any]]] = None,
+                     path: Optional[str] = None
+                     ) -> Optional[Dict[str, Any]]:
+    """Last record carrying ``round_id`` (later appends supersede)."""
+    if records is None:
+        records, _ = load_history(path)
+    for rec in reversed(records):
+        if rec.get("round") == round_id:
+            return rec
+    return None

@@ -1,0 +1,289 @@
+"""Versioned bench-result schema + stdlib validator.
+
+BENCH_r03–r05 carried ``"parsed": null`` because the headline-metric
+extractor silently broke when the bench JSON grew past the driver's
+2000-char tail window. The fix is structural, not a regex: ``bench.py``
+now emits ``schema_version`` 2 with a top-level ``headline`` block and
+normalized per-entry rows, VALIDATES the result before printing (an
+invalid result is a refusal, not a recorded artifact), and appends the
+full record to ``bench_history/`` so no future truncation can eat the
+trajectory again.
+
+Schema v2 (what ``python bench.py`` prints as its one JSON line)::
+
+    {
+      "schema_version": 2,
+      # driver contract — unchanged since r01, always top-level:
+      "metric": str, "value": number, "unit": str, "vs_baseline": number,
+      "headline": {
+        "metric": str, "value": number, "unit": str,
+        "vs_baseline": number, "mfu": number, ...,   # full headline row
+        "trace_phases": {phase: {count, total_s, p50_s, p95_s, p99_s}},
+        "memory": {"peak_host_rss_mb": number, "device": {...}},
+        "best_row": {...},            # best-MFU row across the suite
+        "error": str,                 # only when the headline run failed
+      },
+      "entries": {
+        name: {
+          "metrics": {...},           # the entry's measured row
+          "trace_phases": {...},      # per-phase span percentiles
+          "telemetry": {...},         # registry snapshot (optional)
+          "memory": {...},            # peak host RSS + device stats
+          "elapsed_s": number,
+          "skipped_reason": str,      # e.g. "budget (90s left < 120s floor)"
+          "error": str,
+        }, ...
+      },
+      "gate": {...},                  # regression-gate verdict (optional)
+      "budget_s": number, "total_runtime_s": number, ...
+    }
+
+Every entry must carry at least one of ``metrics`` / ``skipped_reason`` /
+``error`` — a row can be measured, explicitly skipped, or failed, but it
+can never be silently absent-but-present. ``validate_result`` returns a
+list of human-readable errors (empty = valid); it never raises on weird
+input.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 2
+
+#: history records (one JSONL line each) wrap a result with provenance
+RECORD_VERSION = 1
+
+# keys an entry row may carry besides the measured metrics; everything
+# else inside an entry dict is treated as a metric
+ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
+                         "elapsed_s", "skipped_reason", "error", "note")
+
+_PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
+
+
+def is_number(x: Any) -> bool:
+    """JSON number: int/float but NOT bool (bool subclasses int)."""
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _is_jsonable(x: Any, depth: int = 0) -> bool:
+    if depth > 12:
+        return False
+    if x is None or isinstance(x, (str, bool, int, float)):
+        return True
+    if isinstance(x, (list, tuple)):
+        return all(_is_jsonable(v, depth + 1) for v in x)
+    if isinstance(x, dict):
+        return all(isinstance(k, str) and _is_jsonable(v, depth + 1)
+                   for k, v in x.items())
+    return False
+
+
+def validate_trace_phases(phases: Any, where: str) -> List[str]:
+    errs: List[str] = []
+    if not isinstance(phases, dict):
+        return [f"{where}: trace_phases must be a dict, got "
+                f"{type(phases).__name__}"]
+    for name, stats in phases.items():
+        if not isinstance(stats, dict):
+            errs.append(f"{where}: trace_phases[{name!r}] must be a dict")
+            continue
+        for key in _PHASE_STAT_KEYS:
+            if key not in stats:
+                errs.append(f"{where}: trace_phases[{name!r}] missing "
+                            f"{key!r}")
+            elif not is_number(stats[key]):
+                errs.append(f"{where}: trace_phases[{name!r}][{key!r}] "
+                            "must be a number")
+    return errs
+
+
+def validate_memory(mem: Any, where: str) -> List[str]:
+    errs: List[str] = []
+    if not isinstance(mem, dict):
+        return [f"{where}: memory must be a dict"]
+    if "peak_host_rss_mb" in mem and not is_number(mem["peak_host_rss_mb"]):
+        errs.append(f"{where}: memory.peak_host_rss_mb must be a number")
+    if "device" in mem and mem["device"] is not None \
+            and not isinstance(mem["device"], dict):
+        errs.append(f"{where}: memory.device must be a dict or null")
+    return errs
+
+
+def validate_entry(entry: Any, name: str) -> List[str]:
+    where = f"entries[{name!r}]"
+    if not isinstance(entry, dict):
+        return [f"{where}: must be a dict, got {type(entry).__name__}"]
+    errs: List[str] = []
+    if not any(k in entry for k in ("metrics", "skipped_reason", "error")):
+        errs.append(f"{where}: needs at least one of metrics / "
+                    "skipped_reason / error")
+    for key in entry:
+        if key not in ENTRY_STRUCTURAL_KEYS:
+            errs.append(f"{where}: unexpected key {key!r} (metrics belong "
+                        "under 'metrics')")
+    if "metrics" in entry:
+        if not isinstance(entry["metrics"], dict):
+            errs.append(f"{where}: metrics must be a dict")
+        elif not _is_jsonable(entry["metrics"]):
+            errs.append(f"{where}: metrics must be JSON-serializable")
+    if "trace_phases" in entry:
+        errs += validate_trace_phases(entry["trace_phases"], where)
+    if "memory" in entry:
+        errs += validate_memory(entry["memory"], where)
+    if "elapsed_s" in entry and not is_number(entry["elapsed_s"]):
+        errs.append(f"{where}: elapsed_s must be a number")
+    for key in ("skipped_reason", "error", "note"):
+        if key in entry and not isinstance(entry[key], str):
+            errs.append(f"{where}: {key} must be a string")
+    if "telemetry" in entry and not isinstance(entry["telemetry"], dict):
+        errs.append(f"{where}: telemetry must be a dict")
+    return errs
+
+
+def validate_headline(head: Any) -> List[str]:
+    if not isinstance(head, dict):
+        return [f"headline: must be a dict, got {type(head).__name__}"]
+    errs: List[str] = []
+    for key, typ in (("metric", str), ("unit", str)):
+        if not isinstance(head.get(key), typ):
+            errs.append(f"headline: {key!r} must be a {typ.__name__}")
+    if not is_number(head.get("value")):
+        errs.append("headline: 'value' must be a number (a null/absent "
+                    "headline value is exactly the r03–r05 failure mode)")
+    elif head.get("value", 0) <= 0 and "error" not in head:
+        errs.append("headline: value <= 0 without an 'error' field — a "
+                    "dead headline must say why")
+    if "error" in head and not isinstance(head["error"], str):
+        errs.append("headline: 'error' must be a string")
+    for key in ("vs_baseline", "mfu", "model_tflops_per_sec_chip",
+                "peak_tflops", "matmul_ceiling_tflops", "vs_ceiling",
+                "hardware_tflops_per_sec_chip", "vs_ceiling_hardware",
+                "baseline_tokens_per_sec", "loss"):
+        if key in head and head[key] is not None and not is_number(head[key]):
+            errs.append(f"headline: {key!r} must be a number or null")
+    if "trace_phases" in head:
+        errs += validate_trace_phases(head["trace_phases"], "headline")
+    if "memory" in head:
+        errs += validate_memory(head["memory"], "headline")
+    return errs
+
+
+def validate_result(result: Any) -> List[str]:
+    """Validate a full schema-v2 bench result. Returns a list of errors
+    (empty list = valid). Never raises."""
+    if not isinstance(result, dict):
+        return [f"result must be a dict, got {type(result).__name__}"]
+    errs: List[str] = []
+    if result.get("schema_version") != SCHEMA_VERSION:
+        errs.append(f"schema_version must be {SCHEMA_VERSION}, got "
+                    f"{result.get('schema_version')!r}")
+    # driver contract: the four keys the round extractor has read since r01
+    if not isinstance(result.get("metric"), str) or not result.get("metric"):
+        errs.append("'metric' must be a non-empty string")
+    if not is_number(result.get("value")):
+        errs.append("'value' must be a number")
+    if not isinstance(result.get("unit"), str):
+        errs.append("'unit' must be a string")
+    if "vs_baseline" in result and not is_number(result["vs_baseline"]):
+        errs.append("'vs_baseline' must be a number")
+    errs += validate_headline(result.get("headline"))
+    # headline block and driver-contract fields must agree — two sources
+    # of truth drifting apart is how extractors rot
+    head = result.get("headline")
+    if isinstance(head, dict) and not errs:
+        for key in ("metric", "value", "unit"):
+            if head.get(key) != result.get(key):
+                errs.append(f"headline.{key} != top-level {key} "
+                            f"({head.get(key)!r} vs {result.get(key)!r})")
+    entries = result.get("entries")
+    if entries is None:
+        errs.append("'entries' must be present (may be {})")
+    elif not isinstance(entries, dict):
+        errs.append("'entries' must be a dict")
+    else:
+        for name, entry in entries.items():
+            errs += validate_entry(entry, name)
+    for key in ("budget_s", "total_runtime_s"):
+        if key in result and not is_number(result[key]):
+            errs.append(f"{key!r} must be a number")
+    if "gate" in result and not isinstance(result["gate"], dict):
+        errs.append("'gate' must be a dict")
+    return errs
+
+
+def validate_record(record: Any) -> List[str]:
+    """Validate a bench_history record (one JSONL line). Recovered partial
+    results validate structurally only — a truncated round keeps whatever
+    it still has."""
+    if not isinstance(record, dict):
+        return ["record must be a dict"]
+    errs: List[str] = []
+    if record.get("record_version") != RECORD_VERSION:
+        errs.append(f"record_version must be {RECORD_VERSION}")
+    if not isinstance(record.get("round"), str) or not record.get("round"):
+        errs.append("record 'round' must be a non-empty string")
+    if not isinstance(record.get("source"), str):
+        errs.append("record 'source' must be a string")
+    for key in ("complete", "recovered"):
+        if not isinstance(record.get(key), bool):
+            errs.append(f"record {key!r} must be a bool")
+    result = record.get("result")
+    if not isinstance(result, dict):
+        errs.append("record 'result' must be a dict")
+        return errs
+    if record.get("complete"):
+        errs += validate_result(result)
+    else:
+        if not isinstance(result.get("headline"), dict):
+            errs.append("partial record result.headline must be a dict "
+                        "(may be {})")
+        if not isinstance(result.get("entries"), dict):
+            errs.append("partial record result.entries must be a dict "
+                        "(may be {})")
+        else:
+            for name, entry in result["entries"].items():
+                errs += validate_entry(entry, name)
+    return errs
+
+
+def normalize_entry_row(row: Any,
+                        elapsed_s: Optional[float] = None) -> Dict[str, Any]:
+    """Normalize a raw suite-entry row (what ``bench.py --entry`` prints, or
+    a v1 ``configs`` value) into the schema-v2 entry shape.
+
+    Raw rows are flat measured dicts with ``telemetry`` / ``trace_phases``
+    mixed in, or ``{"skipped": reason}`` / ``{"error": msg}`` markers; some
+    legacy entries are bare lists (comm tables).
+    """
+    out: Dict[str, Any] = {}
+    if elapsed_s is not None:
+        out["elapsed_s"] = round(float(elapsed_s), 1)
+    if isinstance(row, list):
+        out["metrics"] = {"rows": row}
+        return out
+    if not isinstance(row, dict):
+        out["metrics"] = {"value": row}
+        return out
+    row = dict(row)
+    if "skipped" in row:
+        out["skipped_reason"] = str(row.pop("skipped"))
+    if "skipped_reason" in row:
+        out["skipped_reason"] = str(row.pop("skipped_reason"))
+    if "error" in row:
+        out["error"] = str(row.pop("error"))
+    for key in ("trace_phases", "telemetry", "memory"):
+        if key in row:
+            val = row.pop(key)
+            if val:
+                out[key] = val
+    if "note" in row:
+        out["note"] = str(row.pop("note"))
+    if "metrics" in row and isinstance(row["metrics"], dict):
+        # already normalized (idempotent)
+        out["metrics"] = row.pop("metrics")
+        out.update({k: v for k, v in row.items()
+                    if k in ENTRY_STRUCTURAL_KEYS and k not in out})
+    elif row:
+        out["metrics"] = row
+    return out

@@ -26,10 +26,12 @@ setup(
             "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
             "dslint=deepspeed_tpu.analysis.__main__:main",
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
+            "bench-diff=deepspeed_tpu.bench.cli:main",
         ],
     },
-    # tools/dslint is a checkout-only shim; the `dslint` console entry
-    # point covers installs (listing both would collide on bin/dslint)
+    # tools/dslint + tools/bench-diff are checkout-only shims; the
+    # matching console entry points cover installs (listing both would
+    # collide on the bin/ names)
     scripts=["bin/dstpu", "bin/dstpu_report", "bin/dstpu_bench",
              "bin/dstpu_elastic", "bin/dstpu_io"],
 )

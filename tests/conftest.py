@@ -71,6 +71,12 @@ def pytest_configure(config):
         "device work; the self-enforcement pass runs the full linter over "
         "deepspeed_tpu/ and fails tier-1 on any non-baselined finding)")
     config.addinivalue_line(
+        "markers", "bench: perf-trajectory observatory tests (schema "
+        "validator, legacy-round recovery, bench-diff attribution, "
+        "regression-gate exit codes — stdlib-level, tier-1-eligible "
+        "under JAX_PLATFORMS=cpu; the committed BENCH_r0*.json and "
+        "bench_history/ records are the fixtures)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

@@ -232,6 +232,24 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     # in the bench row, not just the engine log (under EP the "dropless"
     # ragged path is only dropless per destination shard)
     moe_drop_frac = getattr(engine, "_moe_drop_frac", 0.0)
+    # schema v2.1: the compiled-collective ledger totals + overlap estimate
+    # ride next to trace_phases in every train row, so quantized-collective
+    # rounds diff WIRE BYTES, not just tokens/s (README "Execution
+    # observatory"). A ledger failure must not cost the measured row.
+    # Ledgered BEFORE the snapshot: the lowering seeds the MFU flops cache
+    # so the scrape below doesn't pay a second compile of the same step.
+    comms_block = {}
+    try:
+        from deepspeed_tpu.profiling.observatory import bench_comms_block
+
+        # the ledger legs are one-step quantities: hand the estimator the
+        # measured per-step wall (best window / steps), at the seq the
+        # window actually trained
+        comms_block = bench_comms_block(engine, wall_s=dt / steps,
+                                        seq_len=seq_len)
+    except Exception as e:
+        print(f"bench: collective ledger unavailable for this entry "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
     # price the scrape-time gauges (tokens/s from the fenced window, measured
     # MFU via XLA cost analysis) while the engine is still alive — the
     # --entry wrapper then embeds the full snapshot in this row's JSON
@@ -254,6 +272,7 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     }
     if report_moe_drops:
         out["moe_dropped_frac"] = round(float(moe_drop_frac), 5)
+    out.update(comms_block)
     if note:
         out["note"] = note
     return out
@@ -1108,6 +1127,11 @@ def headline_entry():
         "window_samples_tokens_per_sec": win,
         "loss": headline.get("loss"),
         "n_chips": n_chips,
+        # v2.1: ledger totals + overlap ride in the headline block too —
+        # the round-over-round wire-byte diff reads them from here
+        **({"comms": headline["comms"]} if "comms" in headline else {}),
+        **({"overlap_fraction": headline["overlap_fraction"]}
+           if "overlap_fraction" in headline else {}),
     }
 
 

@@ -54,13 +54,14 @@ from deepspeed_tpu.bench.legacy import (
 from deepspeed_tpu.bench.schema import (
     RECORD_VERSION,
     SCHEMA_VERSION,
+    SUPPORTED_SCHEMA_VERSIONS,
     normalize_entry_row,
     validate_record,
     validate_result,
 )
 
 __all__ = [
-    "SCHEMA_VERSION", "RECORD_VERSION",
+    "SCHEMA_VERSION", "RECORD_VERSION", "SUPPORTED_SCHEMA_VERSIONS",
     "validate_result", "validate_record", "normalize_entry_row",
     "recover_from_text", "recover_round_file", "recover_rounds",
     "upgrade_legacy_result",

@@ -44,8 +44,24 @@ _LOWER_SUFFIX = ("_phase_s", "time_ms")
 
 def metric_direction(name: str) -> Optional[int]:
     """+1 = higher is better, -1 = lower is better, None = not a perf
-    metric (not compared). ``name`` is the LEAF key of a flattened path."""
+    metric (not compared). ``name`` is a flattened dotted path; most rules
+    key on its LEAF, but ``comms.*`` byte totals are path-scoped (the leaf
+    ``bytes`` is too generic to claim globally)."""
     leaf = name.rsplit(".", 1)[-1]
+    if name.startswith("comms."):
+        # compiled-collective ledger totals: wire bytes falling is the
+        # quantized-collective win (ROADMAP item 1) — lower is better.
+        # counts/link echoes carry no direction; predicted_busbw_gbps is
+        # the link constant (leaf gbps rule would no-op compare it anyway)
+        if leaf in ("bytes", "bus_bytes", "total_bytes"):
+            return LOWER_IS_BETTER
+        if leaf in ("count", "unparsed", "link_gbps",
+                    "predicted_busbw_gbps"):
+            return None
+    if leaf == "overlap_fraction":
+        # fraction of collective time hidden under compute — the ROADMAP
+        # item 2 before/after metric
+        return HIGHER_IS_BETTER
     if leaf in _HIGHER_EXACT or any(s in leaf for s in _HIGHER_SUBSTR):
         return HIGHER_IS_BETTER
     if leaf.endswith(_HIGHER_SUFFIX):
@@ -87,9 +103,12 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
     head = result.get("headline") or {}
     head_metrics = flatten_metrics(
         {k: v for k, v in head.items()
-         if k not in ("trace_phases", "telemetry", "best_row", "memory")})
+         if k not in ("trace_phases", "telemetry", "best_row", "memory",
+                      "comms")})
     if "memory" in head:
         head_metrics.update(flatten_metrics(head["memory"], "memory"))
+    if "comms" in head:
+        head_metrics.update(flatten_metrics(head["comms"], "comms"))
     out = {
         "headline": {
             "metric_name": head.get("metric"),
@@ -105,6 +124,10 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
         metrics = flatten_metrics(entry.get("metrics") or {})
         if "memory" in entry:
             metrics.update(flatten_metrics(entry["memory"], "memory"))
+        if "comms" in entry:
+            metrics.update(flatten_metrics(entry["comms"], "comms"))
+        if is_number(entry.get("overlap_fraction")):
+            metrics["overlap_fraction"] = float(entry["overlap_fraction"])
         out["entries"][name] = {
             "metrics": metrics,
             "phases": entry.get("trace_phases") or {},

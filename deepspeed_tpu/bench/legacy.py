@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from deepspeed_tpu.bench.schema import (
     RECORD_VERSION,
     SCHEMA_VERSION,
+    SUPPORTED_SCHEMA_VERSIONS,
     normalize_entry_row,
     validate_result,
 )
@@ -144,7 +145,7 @@ def _match_headline_key(key: str, val: Any) -> Optional[str]:
 def upgrade_legacy_result(parsed: Dict[str, Any]) -> Dict[str, Any]:
     """Upgrade a complete v1 (flat) bench result to schema v2. v2 input is
     returned unchanged. Idempotent."""
-    if parsed.get("schema_version") == SCHEMA_VERSION:
+    if parsed.get("schema_version") in SUPPORTED_SCHEMA_VERSIONS:
         return parsed
     rest = dict(parsed)
     headline: Dict[str, Any] = {}

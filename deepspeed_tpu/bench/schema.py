@@ -43,12 +43,31 @@ Every entry must carry at least one of ``metrics`` / ``skipped_reason`` /
 can never be silently absent-but-present. ``validate_result`` returns a
 list of human-readable errors (empty = valid); it never raises on weird
 input.
+
+Schema v2.1 adds two OPTIONAL per-entry (and headline) keys next to
+``trace_phases`` — older v2 records, which simply don't carry them, load
+and validate unchanged::
+
+    "comms": {              # compiled-collective ledger totals
+      "program": str, "total_bytes": int, "unparsed": int,
+      "link_gbps": number,
+      "by_kind": {kind: {"count": int, "bytes": int, "bus_bytes": number,
+                         "predicted_busbw_gbps": number}},
+    },
+    "overlap_fraction": number in [0, 1],
+
+``bench-diff`` compares ``comms`` byte totals lower-is-better (quantized
+collectives shrink wire bytes) and ``overlap_fraction`` higher-is-better.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 2.1
+
+#: versions validate_result accepts — v2 records predate the ``comms``
+#: block but are otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -56,7 +75,8 @@ RECORD_VERSION = 1
 # keys an entry row may carry besides the measured metrics; everything
 # else inside an entry dict is treated as a metric
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
-                         "elapsed_s", "skipped_reason", "error", "note")
+                         "elapsed_s", "skipped_reason", "error", "note",
+                         "comms", "overlap_fraction")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -110,6 +130,45 @@ def validate_memory(mem: Any, where: str) -> List[str]:
     return errs
 
 
+def validate_comms(comms: Any, where: str) -> List[str]:
+    """Validate a v2.1 ``comms`` block (ledger totals by collective kind)."""
+    if not isinstance(comms, dict):
+        return [f"{where}: comms must be a dict"]
+    errs: List[str] = []
+    for key in ("total_bytes", "unparsed"):
+        if key in comms and (not isinstance(comms[key], int)
+                             or isinstance(comms[key], bool)
+                             or comms[key] < 0):
+            errs.append(f"{where}: comms.{key} must be a non-negative int")
+    by_kind = comms.get("by_kind")
+    if by_kind is None:
+        errs.append(f"{where}: comms.by_kind must be present (may be {{}})")
+    elif not isinstance(by_kind, dict):
+        errs.append(f"{where}: comms.by_kind must be a dict")
+    else:
+        for kind, row in by_kind.items():
+            if not isinstance(row, dict):
+                errs.append(f"{where}: comms.by_kind[{kind!r}] must be a "
+                            "dict")
+                continue
+            for key in ("count", "bytes"):
+                if not isinstance(row.get(key), int) \
+                        or isinstance(row.get(key), bool) \
+                        or row[key] < 0:
+                    errs.append(f"{where}: comms.by_kind[{kind!r}].{key} "
+                                "must be a non-negative int")
+            if "bus_bytes" in row and not is_number(row["bus_bytes"]):
+                errs.append(f"{where}: comms.by_kind[{kind!r}].bus_bytes "
+                            "must be a number")
+    return errs
+
+
+def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
+    if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
+        return [f"{where}: overlap_fraction must be a number in [0, 1]"]
+    return []
+
+
 def validate_entry(entry: Any, name: str) -> List[str]:
     where = f"entries[{name!r}]"
     if not isinstance(entry, dict):
@@ -138,6 +197,10 @@ def validate_entry(entry: Any, name: str) -> List[str]:
             errs.append(f"{where}: {key} must be a string")
     if "telemetry" in entry and not isinstance(entry["telemetry"], dict):
         errs.append(f"{where}: telemetry must be a dict")
+    if "comms" in entry:
+        errs += validate_comms(entry["comms"], where)
+    if "overlap_fraction" in entry:
+        errs += validate_overlap_fraction(entry["overlap_fraction"], where)
     return errs
 
 
@@ -166,6 +229,11 @@ def validate_headline(head: Any) -> List[str]:
         errs += validate_trace_phases(head["trace_phases"], "headline")
     if "memory" in head:
         errs += validate_memory(head["memory"], "headline")
+    if "comms" in head:
+        errs += validate_comms(head["comms"], "headline")
+    if "overlap_fraction" in head and head["overlap_fraction"] is not None:
+        errs += validate_overlap_fraction(head["overlap_fraction"],
+                                          "headline")
     return errs
 
 
@@ -175,8 +243,9 @@ def validate_result(result: Any) -> List[str]:
     if not isinstance(result, dict):
         return [f"result must be a dict, got {type(result).__name__}"]
     errs: List[str] = []
-    if result.get("schema_version") != SCHEMA_VERSION:
-        errs.append(f"schema_version must be {SCHEMA_VERSION}, got "
+    if result.get("schema_version") not in SUPPORTED_SCHEMA_VERSIONS:
+        errs.append(f"schema_version must be one of "
+                    f"{SUPPORTED_SCHEMA_VERSIONS}, got "
                     f"{result.get('schema_version')!r}")
     # driver contract: the four keys the round extractor has read since r01
     if not isinstance(result.get("metric"), str) or not result.get("metric"):
@@ -272,11 +341,16 @@ def normalize_entry_row(row: Any,
         out["skipped_reason"] = str(row.pop("skipped_reason"))
     if "error" in row:
         out["error"] = str(row.pop("error"))
-    for key in ("trace_phases", "telemetry", "memory"):
+    for key in ("trace_phases", "telemetry", "memory", "comms"):
         if key in row:
             val = row.pop(key)
             if val:
                 out[key] = val
+    if "overlap_fraction" in row:
+        # 0.0 (nothing hidden) is a real measurement — keep falsy numbers
+        val = row.pop("overlap_fraction")
+        if is_number(val):
+            out["overlap_fraction"] = val
     if "note" in row:
         out["note"] = str(row.pop("note"))
     if "metrics" in row and isinstance(row["metrics"], dict):

@@ -412,6 +412,18 @@ class FastGenEngine:
 
         return jax.jit(decode_n, donate_argnums=(1,))
 
+    def collective_ledger(self, n_tokens: Optional[int] = None,
+                          fold: bool = True):
+        """Compiled-collective ledger of one mixed tick at the given
+        token-budget bucket (execution-observatory hook): under TP this
+        enumerates the row/col-parallel collectives GSPMD inserted into
+        the tick program; single-replica serving legitimately ledgers
+        empty. ``fold=True`` publishes ``comm_ledger_*`` metrics under
+        ``program="fastgen_tick"``. Cached per engine."""
+        from deepspeed_tpu.profiling.observatory import ledger_for_fastgen
+
+        return ledger_for_fastgen(self, n_tokens=n_tokens, fold=fold)[0]
+
     def _blocks_needed(self, seq: _Seq, upto_pos: int) -> int:
         return max(0, upto_pos // self.block_size + 1 - len(seq.blocks))
 

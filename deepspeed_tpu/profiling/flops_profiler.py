@@ -50,25 +50,52 @@ def _note_compile(name: str, compile_s: float,
         flops=entry["flops"], bytes_accessed=entry["bytes_accessed"])
 
 
+def normalize_costs(raw: Any) -> Dict[str, float]:
+    """Normalize ``compiled.cost_analysis()`` across jax versions: a dict,
+    a [dict] list (older jax), an empty list, or None all become a plain
+    dict (possibly empty). Never raises on weird shapes."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    try:
+        return dict(raw or {})
+    except (TypeError, ValueError):
+        return {}
+
+
+def cost_analysis_available(costs: Dict[str, float]) -> bool:
+    """True when the normalized costs actually carry a FLOP count. Some
+    jax/jaxlib builds return an empty dict or a list without 'flops' —
+    reporting those as 0 FLOPs silently poisons every measured-MFU gauge
+    downstream, so callers must branch on this instead."""
+    return bool(costs) and "flops" in costs
+
+
 def _cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
     t0 = time.perf_counter()
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
     compile_s = time.perf_counter() - t0
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):  # older jax returns [dict]
-        costs = costs[0] if costs else {}
-    costs = dict(costs or {})
+    try:
+        raw = compiled.cost_analysis()
+    except (RuntimeError, NotImplementedError, TypeError):
+        # some backends/builds don't implement cost analysis at all —
+        # degrade to the explicit unavailable flag, same as an empty dict
+        raw = None
+    costs = normalize_costs(raw)
     _note_compile(getattr(fn, "__name__", "<fn>"), compile_s, costs)
     return costs
 
 
 def profile_fn(fn: Callable, *args, **kwargs) -> Dict[str, float]:
-    """→ {'flops': ..., 'bytes_accessed': ..., ...} for fn(*args)."""
+    """→ {'flops': ..., 'bytes_accessed': ..., 'cost_analysis_unavailable':
+    bool, ...} for fn(*args). When the backend's cost analysis yields no
+    usable costs the numeric fields are 0 AND the flag is set — callers
+    must not treat the zeros as measurements."""
     costs = _cost_analysis(fn, *args, **kwargs)
     return {
         "flops": float(costs.get("flops", 0.0)),
         "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
         "transcendentals": float(costs.get("transcendentals", 0.0)),
+        "cost_analysis_unavailable": not cost_analysis_available(costs),
     }
 
 
@@ -90,6 +117,10 @@ class FlopsProfiler:
         self.elapsed: float = 0.0
         self.flops: float = 0.0
         self.params: Optional[int] = None
+        # set by profile_train_step when XLA's cost analysis yields no
+        # usable costs on this jax/jaxlib build — flops 0.0 then means
+        # "unknown", NOT "measured zero"
+        self.cost_analysis_unavailable: bool = False
 
     # -- lifecycle (reference API names) --------------------------------- #
     def start_profile(self) -> None:
@@ -134,6 +165,7 @@ class FlopsProfiler:
 
         with eng.mesh:
             costs = _cost_analysis(train_step, eng.state, batch)
+        self.cost_analysis_unavailable = not cost_analysis_available(costs)
         return float(costs.get("flops", 0.0))
 
     # -- reporting -------------------------------------------------------- #

@@ -1,0 +1,60 @@
+"""XLA execution observatory: what happens *inside* the compiled step.
+
+The rest of the observability stack watches the host side — span
+percentiles (PR 5), fenced phase timers, CommsLogger counts of eagerly-
+issued collectives. Everything the ZeRO-2/3 step actually puts on the
+wire is emitted by XLA's SPMD partitioner *below* the jit boundary, where
+none of those instruments can see. This package reads the compiled
+artifact itself:
+
+* :mod:`~deepspeed_tpu.profiling.observatory.hlo` — parse compiled HLO
+  text into :class:`CollectiveOp` records (kind, dtype, bytes, replica
+  groups, issuing-subsystem attribution from op metadata);
+* :mod:`~deepspeed_tpu.profiling.observatory.ledger` — the
+  **compiled-collective ledger**: per-program totals by kind/subsystem,
+  predicted wire time per the shared busbw convention
+  (``comm/bandwidth.py``), folded into telemetry as ``comm_ledger_*``;
+* :mod:`~deepspeed_tpu.profiling.observatory.overlap` — the
+  **compute/comm overlap meter**: a programmatic ``jax.profiler`` capture
+  parsed into busy intervals, with a documented fenced-timer fallback
+  estimator so the CPU tier exercises the full path;
+* :mod:`~deepspeed_tpu.profiling.observatory.report` — the **roofline
+  step report**: cost-analysis flops/bytes + ledger + memory analysis +
+  trace-phase percentiles → a compute/comm/host-bound verdict per phase.
+
+CLI: ``tools/step-report`` / ``python -m deepspeed_tpu.profiling.observatory``
+(= the ``step-report`` console entry). Worked example:
+``docs/tutorials/step-report.md``; metric catalog: README
+"Execution observatory".
+"""
+from __future__ import annotations
+
+from deepspeed_tpu.profiling.observatory.hlo import (
+    CollectiveOp,
+    parse_hlo_collectives,
+)
+from deepspeed_tpu.profiling.observatory.ledger import (
+    CollectiveLedger,
+    build_ledger,
+    ledger_for_engine,
+    ledger_for_fastgen,
+)
+from deepspeed_tpu.profiling.observatory.overlap import (
+    OverlapResult,
+    estimate_overlap,
+    measure_overlap,
+    overlap_from_intervals,
+)
+from deepspeed_tpu.profiling.observatory.report import (
+    bench_comms_block,
+    step_report,
+    validate_report,
+)
+
+__all__ = [
+    "CollectiveOp", "CollectiveLedger", "OverlapResult",
+    "parse_hlo_collectives", "build_ledger",
+    "ledger_for_engine", "ledger_for_fastgen",
+    "estimate_overlap", "measure_overlap", "overlap_from_intervals",
+    "step_report", "validate_report", "bench_comms_block",
+]

@@ -1,0 +1,209 @@
+"""``step-report`` CLI — roofline step reports from the command line.
+
+Three modes::
+
+    # 1) live: build a tiny engine, run fenced steps, report (tier-1 CPU)
+    step-report --model tiny --zero-stage 3 --steps 3
+
+    # 2) offline: ledger a committed/captured HLO text dump
+    step-report --hlo-file zero3_step.hlo.txt --world 8 --zero-stage 3
+
+    # 3) pretty-print an existing report
+    step-report --read report.json
+
+Same entry as ``python -m deepspeed_tpu.profiling.observatory`` and
+``tools/step-report``. Output is the schema-validated report JSON
+(``--format text`` for a terminal summary); an invalid report is a
+refusal (exit 2), not an artifact. Worked example:
+``docs/tutorials/step-report.md``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+
+def _text_summary(report: Dict[str, Any]) -> str:
+    lines = []
+    lines.append(
+        f"step-report: {report['program']} @ {report['platform']} "
+        f"(zero_stage={report.get('zero_stage')}, "
+        f"world={report.get('world')})")
+    ca = report.get("cost_analysis") or {}
+    if ca.get("available"):
+        lines.append(f"  cost analysis: {ca['flops'] / 1e9:.2f} GFLOP, "
+                     f"{ca['bytes_accessed'] / 1e6:.1f} MB accessed")
+    else:
+        lines.append("  cost analysis: unavailable on this jax build")
+    led = report.get("ledger") or {}
+    lines.append(f"  collectives: {sum(r['count'] for r in led.get('by_kind', {}).values())} ops, "
+                 f"{led.get('total_bytes', 0) / 1e6:.2f} MB full-tensor bytes"
+                 + (f", {led['unparsed']} unparsed" if led.get("unparsed")
+                    else ""))
+    for kind, row in (led.get("by_kind") or {}).items():
+        lines.append(f"    {kind:<20} x{row['count']:<4} "
+                     f"{row['bytes'] / 1e6:>10.3f} MB")
+    for sub, row in (led.get("by_subsystem") or {}).items():
+        lines.append(f"    [{sub}] x{row['count']} "
+                     f"{row['bytes'] / 1e6:.3f} MB")
+    mem = report.get("memory") or {}
+    if mem.get("measured"):
+        m = mem["measured"]
+        lines.append(
+            f"  memory: args {m.get('argument_size_in_bytes', 0) / 1e6:.1f} MB"
+            f" | temp {m.get('temp_size_in_bytes', 0) / 1e6:.1f} MB"
+            f" | out {m.get('output_size_in_bytes', 0) / 1e6:.1f} MB")
+    if mem.get("predicted"):
+        lines.append(
+            f"  predicted resident state (ZeRO math): "
+            f"{mem['predicted']['state_bytes_per_device'] / 1e6:.1f} MB"
+            + (f" (args/predicted = {mem['args_vs_predicted_state']})"
+               if "args_vs_predicted_state" in mem else ""))
+    for phase, row in (report.get("phases") or {}).items():
+        dom = (f", dominant: {row['dominant_collective']}"
+               if row.get("dominant_collective") else "")
+        lines.append(
+            f"  {phase:<6} wall {row['wall_s'] * 1e3:8.2f} ms  "
+            f"comm~{row['predicted_comm_s'] * 1e3:7.2f} ms  "
+            f"overlap {row['overlap_fraction']:.2f}  -> {row['verdict']}"
+            f"{dom}")
+    lines.append(f"  overlap_fraction={report['overlap_fraction']} "
+                 f"verdict={report['verdict']}")
+    return "\n".join(lines)
+
+
+def _live_report(args) -> Dict[str, Any]:
+    import jax
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+    config = {
+        "train_batch_size": args.batch * jax.device_count(),
+        "train_micro_batch_size_per_gpu": args.batch,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": args.zero_stage},
+        "wall_clock_breakdown": True,   # fenced fwd/bwd/step walls
+        "steps_per_print": 10 ** 9,
+        "telemetry": {"enabled": True, "http_port": -1, "tracing": True},
+    }
+    if args.precision == "bf16":
+        config["bf16"] = {"enabled": True}
+        spec = dst.causal_lm_spec(args.model)
+    else:
+        spec = dst.causal_lm_spec(args.model, dtype="float32")
+    engine, *_ = dst.initialize(model=spec, config=config)
+    vocab = getattr(getattr(engine.model_spec, "config", None),
+                    "vocab_size", 512)
+    data = synthetic_lm_data(
+        engine.train_micro_batch_size() * engine.dp_world_size,
+        args.seq_len, vocab, seed=0)
+    # the eager path populates the fenced fwd/bwd/step timers; one fused
+    # train_batch warms + exercises the hot-path program the ledger lowers
+    loss = engine.train_batch(data)
+    float(loss)
+    for _ in range(max(args.steps, 1) + 1):
+        engine.forward(next(data))
+        engine.backward()
+        engine.step()
+    for name in ("fwd", "bwd", "step"):
+        # drop the first (compile-bearing) sample so phase walls reflect
+        # the warm program, same policy as bench warm windows
+        if engine.timers.has_timer(name) and \
+                len(engine.timers(name)._record) > 1:
+            del engine.timers(name)._record[0]
+    from deepspeed_tpu.profiling.observatory.report import step_report
+
+    # on device backends the profiler capture around one more fused step
+    # supplies the MEASURED overlap; a lane-less capture (CPU) falls back
+    # to the fenced-timer estimator
+    report = step_report(
+        engine, link_gbps=args.link_gbps, seq_len=args.seq_len,
+        measure_with=lambda: engine.train_batch(data))
+    engine.shutdown_telemetry()
+    return report
+
+
+def _hlo_report(args) -> Dict[str, Any]:
+    from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+    with open(args.hlo_file) as f:
+        text = f.read()
+    ledger = build_ledger(text, program=args.program or "hlo_file",
+                          world=args.world, zero_stage=args.zero_stage)
+    link = args.link_gbps or 0
+    return {"report_version": 1, "program": ledger.program,
+            "mode": "ledger_only",
+            "ledger": ledger.to_dict(link_gbps=link or None)}
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="step-report",
+        description="roofline step report: compiled-collective ledger + "
+                    "overlap + memory + bound verdicts")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--zero-stage", type=int, default=3)
+    p.add_argument("--precision", choices=("fp32", "bf16"), default="fp32")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--steps", type=int, default=2,
+                   help="eager fenced micro-steps to time")
+    p.add_argument("--link-gbps", type=float, default=None,
+                   help="override the datasheet per-chip link bandwidth")
+    p.add_argument("--hlo-file", default=None,
+                   help="ledger an HLO text dump instead of a live engine")
+    p.add_argument("--world", type=int, default=8,
+                   help="replica-group hint for --hlo-file parsing")
+    p.add_argument("--program", default=None,
+                   help="program label for --hlo-file reports")
+    p.add_argument("--read", default=None,
+                   help="pretty-print an existing report JSON")
+    p.add_argument("--format", choices=("json", "text"), default="json")
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args(argv)
+
+    try:
+        if args.read:
+            with open(args.read) as f:
+                report = json.load(f)
+        elif args.hlo_file:
+            report = _hlo_report(args)
+        else:
+            report = _live_report(args)
+    except Exception as e:
+        # the documented contract is 0 = report emitted, 2 = refused/
+        # failed — a live-engine RuntimeError (no backend, XLA abort)
+        # must not leak an undefined exit code through a traceback
+        print(f"step-report: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    # full reports must validate — refusing beats recording a broken
+    # artifact (bench schema v2's posture); ledger-only mode validates
+    # its ledger block shape implicitly
+    if "phases" in report:
+        from deepspeed_tpu.profiling.observatory.report import (
+            validate_report,
+        )
+
+        errors = validate_report(report)
+        if errors:
+            for err in errors[:20]:
+                print(f"step-report: schema: {err}", file=sys.stderr)
+            return 2
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    if args.format == "text":
+        print(_text_summary(report) if "phases" in report
+              else json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

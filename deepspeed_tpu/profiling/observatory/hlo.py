@@ -1,0 +1,238 @@
+"""HLO-text collective parser — the ledger's front end.
+
+Input is the compiled module text from
+``jax.jit(fn).lower(...).compile().as_text()`` (or ``lower(...).as_text
+("hlo")``): one op per line, e.g.::
+
+    %all-reduce.1 = f32[8,4]{1,0} all-reduce(f32[8,4]{1,0} %param),
+        channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}},
+        use_global_device_ids=true, to_apply=%region_0.7,
+        metadata={op_name="jit(f)/.../psum" source_file="..." source_line=11}
+
+The parser is line-oriented and regex-based on purpose: HLO text is a
+stable debug format, the collective vocabulary is small, and a parser
+that imports nothing heavier than ``re`` can run over committed fixture
+files in tier-1 without a device. Anything that *looks* like a collective
+but isn't in the known vocabulary degrades to ``kind="unknown"`` and is
+counted, never raised on — a new XLA opcode must not break telemetry.
+
+Byte convention (shared with ``comm/bandwidth.py``): ``size_bytes`` is
+the FULL logical tensor — ``max(result bytes, first-operand bytes)``,
+which yields the gathered size for all-gather (shard in, full out), the
+pre-reduce size for reduce-scatter (full in, shard out), and the tensor
+size for all-reduce / all-to-all / collective-permute (in == out).
+Async pairs count once: ``*-start`` carries the payload, ``*-done`` is
+skipped.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.comm.bandwidth import UNKNOWN, canonical_kind
+
+#: HLO primitive type → bytes per element
+DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e4m3b11fnuz": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "f8e5m2fnuz": 1, "f8e4m3fnuz": 1, "f8e3m4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# opcode families that ARE (or smell like) cross-device collectives.
+# Known ones map through comm/bandwidth.canonical_kind; the rest of the
+# family (collective-broadcast, ragged-all-to-all, whatever XLA grows
+# next) parses with kind="unknown".
+_COLLECTIVE_OPCODE = re.compile(
+    r"^(all-reduce|all-gather|reduce-scatter|all-to-all"
+    r"|collective-permute|collective-broadcast|ragged-all-to-all"
+    r"|all-[a-z0-9-]+|collective-[a-z0-9-]+)"
+    r"(-start|-done)?$")
+
+# one typed array: f32[8,4]{1,0} or bf16[64,2] or f32[] (scalar)
+_TYPED = r"([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})?"
+
+# op line:  %name = <type-or-tuple> opcode(operands...), attrs
+_OP_LINE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<result>[\w.\-]+)\s*=\s*"
+    r"(?P<rtype>\(.*?\)|" + _TYPED + r")\s+"
+    r"(?P<opcode>[a-z][a-z0-9\-]*)\(")
+
+_REPLICA_GROUPS_EXPLICIT = re.compile(
+    r"replica_groups=\{(?P<groups>\{[^=]*?\})\}")
+_REPLICA_GROUPS_IOTA = re.compile(
+    r"replica_groups=\[(?P<ngroups>\d+),(?P<gsize>\d+)\]<=\[")
+_CHANNEL_ID = re.compile(r"channel_id=(\d+)")
+_SOURCE_TARGET = re.compile(r"source_target_pairs=\{(.*?)\}\}")
+_OP_NAME = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
+_SOURCE_FILE = re.compile(r'source_file="([^"]*)"')
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective op lifted from compiled HLO text."""
+
+    kind: str                 # canonical (comm/bandwidth) or "unknown"
+    hlo_opcode: str           # raw opcode, e.g. "all-reduce-start"
+    result: str               # HLO result name
+    dtype: str                # payload element type, e.g. "f32"
+    shape: Tuple[int, ...]    # payload shape (full logical tensor)
+    size_bytes: int           # full-tensor bytes (see module docstring)
+    group_size: int           # participants per replica group
+    n_groups: int             # concurrent replica groups
+    channel_id: Optional[int]
+    op_name: str              # metadata op_name path ("" when absent)
+    source_file: str = ""     # metadata source_file (attribution input)
+    subsystem: str = ""       # filled by the ledger's attribution pass
+    line_no: int = 0          # 1-based line in the HLO text
+
+
+def _parse_typed(text: str) -> Optional[Tuple[str, Tuple[int, ...], int]]:
+    """``f32[8,4]{1,0}`` → (dtype, shape, bytes); None when not an array."""
+    m = re.match(r"^\s*" + _TYPED, text)
+    if not m:
+        return None
+    dtype, dims = m.group(1), m.group(2)
+    if dtype not in DTYPE_BYTES:
+        return None   # token[] etc. — not a data payload
+    shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+    n = 1
+    for d in shape:
+        n *= d
+    return dtype, shape, n * DTYPE_BYTES[dtype]
+
+
+def _operand_span(rest_of_line: str) -> int:
+    """Index of the ``)`` closing the operand list. TPU dumps print tiled
+    layouts with nested parens — ``f32[4096]{0:T(8,128)}`` — so the first
+    ``)`` is NOT the list close; count depth from the opening paren."""
+    depth = 0
+    for i, ch in enumerate(rest_of_line):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth <= 0:
+                return i
+    return -1
+
+
+def _payload(rtype: str, rest_of_line: str) -> Tuple[str, Tuple[int, ...], int]:
+    """Pick the payload for the byte convention. Array result: the larger
+    of result and first operand (all-gather grows out, reduce-scatter
+    shrinks out). Tuple result: the larger of the operand SUM (tuple-form
+    all-to-all carries one chunk per destination, each a separate
+    operand) and the largest result element (an async ``all-gather-start``
+    tuple is ``(shard_in, full_out)`` — the operand alone would
+    undercount by the world factor)."""
+    operands = []
+    close = _operand_span(rest_of_line)
+    if close != -1:
+        for m in re.finditer(_TYPED + r"\s+%", rest_of_line[:close + 1]):
+            parsed = _parse_typed(m.group(0))
+            if parsed:
+                operands.append(parsed)
+    if rtype.startswith("("):
+        elems = []
+        for m in re.finditer(_TYPED, rtype):
+            parsed = _parse_typed(m.group(0))
+            if parsed:
+                elems.append(parsed)
+        best_elem = max(elems, key=lambda c: c[2]) if elems else None
+        op_sum = sum(o[2] for o in operands)
+        if best_elem is not None and best_elem[2] > op_sum:
+            return best_elem
+        if not operands:
+            return best_elem or ("", (), 0)
+        dtype, shape, _ = operands[0]
+        return dtype, shape, op_sum
+    candidates = operands[:1]
+    parsed = _parse_typed(rtype)
+    if parsed:
+        candidates.append(parsed)
+    if not candidates:
+        return "", (), 0
+    return max(candidates, key=lambda c: c[2])
+
+
+def _replica_groups(line: str, world_hint: int) -> Tuple[int, int]:
+    """→ (group_size, n_groups). Handles both the explicit
+    ``{{0,1},{2,3}}`` form and the iota ``[n_groups,gsize]<=[world]``
+    form; falls back to ``world_hint`` × 1 when absent (flattened-id
+    collectives over the whole program)."""
+    m = _REPLICA_GROUPS_EXPLICIT.search(line)
+    if m:
+        groups = re.findall(r"\{([0-9, ]*)\}", m.group("groups"))
+        if groups:
+            sizes = [len([t for t in g.split(",") if t.strip()])
+                     for g in groups]
+            return max(sizes[0], 1), len(groups)
+    m = _REPLICA_GROUPS_IOTA.search(line)
+    if m:
+        return max(int(m.group("gsize")), 1), max(int(m.group("ngroups")), 1)
+    m = _SOURCE_TARGET.search(line)  # collective-permute has pairs instead
+    if m:
+        pairs = m.group(1).count("{")
+        return max(pairs, 1), 1
+    return max(world_hint, 1), 1
+
+
+def parse_hlo_collectives(hlo_text: str,
+                          world_hint: int = 1) -> Tuple[List[CollectiveOp], int]:
+    """Walk compiled HLO text and return ``(ops, unparsed)``.
+
+    ``ops`` is every collective found (``-done`` halves of async pairs
+    excluded); ``unparsed`` counts collective-family lines that either
+    didn't map to a known kind (they still appear in ``ops`` with
+    ``kind="unknown"``) or failed to parse at all (they don't). The
+    caller feeds ``unparsed`` into ``comm_ledger_unparsed_total`` —
+    degradation is counted, never raised.
+    """
+    ops: List[CollectiveOp] = []
+    unparsed = 0
+    for line_no, line in enumerate(hlo_text.splitlines(), start=1):
+        m = _OP_LINE.match(line)
+        if m is None:
+            continue
+        opcode = m.group("opcode")
+        if not _COLLECTIVE_OPCODE.match(opcode):
+            continue
+        if opcode.endswith("-done"):
+            continue   # the payload was counted at the matching -start
+        try:
+            dtype, shape, size_bytes = _payload(
+                m.group("rtype"), line[m.end("opcode"):])
+            group_size, n_groups = _replica_groups(line, world_hint)
+            name_m = _OP_NAME.search(line)
+            kind = canonical_kind(opcode)
+            op = CollectiveOp(
+                kind=kind, hlo_opcode=opcode, result=m.group("result"),
+                dtype=dtype, shape=shape, size_bytes=size_bytes,
+                group_size=group_size, n_groups=n_groups,
+                channel_id=(int(_CHANNEL_ID.search(line).group(1))
+                            if _CHANNEL_ID.search(line) else None),
+                op_name=name_m.group(1) if name_m else "",
+                source_file=(_SOURCE_FILE.search(line).group(1)
+                             if _SOURCE_FILE.search(line) else ""),
+                line_no=line_no)
+            ops.append(op)
+            if kind == UNKNOWN:
+                unparsed += 1
+        except (ValueError, IndexError, AttributeError):
+            # a malformed/novel line in the collective family: count it,
+            # keep walking — the ledger must survive any HLO dialect
+            unparsed += 1
+    return ops, unparsed
+
+
+def iter_collective_lines(hlo_text: str) -> Iterable[str]:
+    """The collective-bearing lines of an HLO dump (fixture-trimming
+    helper: committed test fixtures keep these plus the module header)."""
+    for line in hlo_text.splitlines():
+        m = _OP_LINE.match(line)
+        if m and _COLLECTIVE_OPCODE.match(m.group("opcode")):
+            yield line

@@ -1,0 +1,226 @@
+"""Compute/communication overlap meter.
+
+Two paths to one number — ``overlap_fraction``: the fraction of
+collective busy time that ran concurrently with compute. This is the
+before/after metric for the overlap-scheduling arc (ROADMAP item 2,
+T3 2401.16677 / Big Send-off 2504.18658): exposed comm time is
+``comm_busy × (1 − overlap_fraction)``.
+
+**Measured path** (:func:`measure_overlap`): a programmatic
+``jax.profiler`` capture around the step. The trace lands as Chrome
+trace-event JSON (``*.trace.json.gz`` under ``plugins/profile``); device-
+lane complete events whose names match the collective vocabulary are comm
+intervals, every other device-lane op is compute, and
+:func:`overlap_from_intervals` does exact interval-union math. Returns
+``None`` whenever the capture yields no device lanes (CPU backends,
+stripped jaxlib builds) — callers fall back.
+
+**Fallback estimator** (:func:`estimate_overlap`): from the fenced
+fwd/bwd/step timers (``utils/timer.py``) the wall time of a phase is
+real; with a compute estimate (cost-analysis FLOPs / chip peak) and a
+comm estimate (ledger bytes / link bandwidth) the identity
+
+    wall = compute + comm − overlap        (phase ⊆ {compute, comm})
+
+gives ``overlap_s = clamp(compute_s + comm_s − wall_s, 0,
+min(compute_s, comm_s))``. It is a *lower bound* (host gaps inside the
+phase deflate it) and is exact when the phase contains only those two
+activities. On CPU hosts there is no peak-FLOPs referent: pass
+``compute_s=None`` and the estimator assumes serial execution
+(``compute = wall − comm``), reporting overlap 0 — honest for software
+collectives, and exactly what tier-1 exercises.
+
+Convention: a phase with **zero comm** reports ``overlap_fraction = 1.0``
+(vacuously fully hidden — nothing is exposed), so "1.0 everywhere" reads
+as "nothing to hide", not as a measurement artifact; the result carries
+``comm_busy_s`` so the two cases are distinguishable.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_COLLECTIVE_NAME = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute"
+    r"|collective-broadcast|ragged-all-to-all|fusion.*all_reduce", re.I)
+
+
+@dataclasses.dataclass
+class OverlapResult:
+    overlap_fraction: float      # in [0, 1]
+    compute_busy_s: float
+    comm_busy_s: float
+    overlap_s: float
+    wall_s: Optional[float] = None
+    source: str = "estimated"    # "profiler" | "estimated"
+
+    def to_dict(self) -> Dict[str, float]:
+        out = {
+            "overlap_fraction": round(self.overlap_fraction, 4),
+            "compute_busy_s": round(self.compute_busy_s, 6),
+            "comm_busy_s": round(self.comm_busy_s, 6),
+            "overlap_s": round(self.overlap_s, 6),
+            "source": self.source,
+        }
+        if self.wall_s is not None:
+            out["wall_s"] = round(self.wall_s, 6)
+        return out
+
+
+# ------------------------------------------------------------------ #
+# interval math (exact path)
+# ------------------------------------------------------------------ #
+def _union(intervals: Sequence[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for lo, hi in sorted((lo, hi) for lo, hi in intervals if hi > lo):
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _busy(intervals: Sequence[Tuple[float, float]]) -> float:
+    return sum(hi - lo for lo, hi in intervals)
+
+
+def _intersect(a: Sequence[Tuple[float, float]],
+               b: Sequence[Tuple[float, float]]) -> float:
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_from_intervals(
+        compute: Sequence[Tuple[float, float]],
+        comm: Sequence[Tuple[float, float]],
+        source: str = "profiler") -> OverlapResult:
+    """Exact overlap from (start, end) interval lists (any time unit —
+    the fraction is unitless, busy seconds assume seconds in = seconds
+    out)."""
+    cu, mu = _union(compute), _union(comm)
+    compute_busy, comm_busy = _busy(cu), _busy(mu)
+    overlap_s = _intersect(cu, mu)
+    frac = 1.0 if comm_busy <= 0 else min(overlap_s / comm_busy, 1.0)
+    return OverlapResult(overlap_fraction=frac,
+                         compute_busy_s=compute_busy,
+                         comm_busy_s=comm_busy, overlap_s=overlap_s,
+                         source=source)
+
+
+# ------------------------------------------------------------------ #
+# fallback estimator (fenced timers + roofline legs)
+# ------------------------------------------------------------------ #
+def estimate_overlap(wall_s: float, comm_s: float,
+                     compute_s: Optional[float] = None) -> OverlapResult:
+    """The documented fenced-timer estimator (module docstring).
+
+    ``wall_s``: fenced wall time of the phase; ``comm_s``: predicted
+    (or measured) collective busy time inside it; ``compute_s``: compute
+    busy estimate, or None for the serial assumption (CPU tier)."""
+    wall_s = max(float(wall_s), 0.0)
+    comm_s = min(max(float(comm_s), 0.0), wall_s) if wall_s else 0.0
+    if compute_s is None:
+        compute_s = max(wall_s - comm_s, 0.0)
+    compute_s = min(max(float(compute_s), 0.0), wall_s) if wall_s else 0.0
+    if comm_s <= 0:
+        return OverlapResult(1.0, compute_s, 0.0, 0.0, wall_s, "estimated")
+    overlap_s = compute_s + comm_s - wall_s
+    overlap_s = max(0.0, min(overlap_s, compute_s, comm_s))
+    return OverlapResult(
+        overlap_fraction=min(overlap_s / comm_s, 1.0),
+        compute_busy_s=compute_s, comm_busy_s=comm_s,
+        overlap_s=overlap_s, wall_s=wall_s, source="estimated")
+
+
+# ------------------------------------------------------------------ #
+# measured path (jax.profiler capture)
+# ------------------------------------------------------------------ #
+def _load_trace_events(logdir: str) -> List[dict]:
+    events: List[dict] = []
+    pattern = os.path.join(logdir, "**", "*.trace.json*")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        opener = gzip.open if path.endswith(".gz") else open
+        try:
+            with opener(path, "rt") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        events.extend(doc.get("traceEvents", []))
+    return events
+
+
+def _device_intervals(events: Iterable[dict]) -> Tuple[
+        List[Tuple[float, float]], List[Tuple[float, float]]]:
+    """Split device-lane complete events into (compute, comm) interval
+    lists (microseconds). Device lanes are pids whose process_name
+    metadata mentions a device; host/python lanes are ignored."""
+    device_pids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = str((ev.get("args") or {}).get("name", "")).lower()
+            if any(k in name for k in ("device", "tpu", "gpu", "/device:",
+                                       "xla")):
+                device_pids.add(ev.get("pid"))
+    compute: List[Tuple[float, float]] = []
+    comm: List[Tuple[float, float]] = []
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+            continue
+        ts, dur = ev.get("ts"), ev.get("dur")
+        if ts is None or dur is None or dur <= 0:
+            continue
+        name = str(ev.get("name", ""))
+        (comm if _COLLECTIVE_NAME.search(name) else compute).append(
+            (float(ts), float(ts) + float(dur)))
+    return compute, comm
+
+
+def measure_overlap(fn, *args, logdir: Optional[str] = None,
+                    **kwargs) -> Optional[OverlapResult]:
+    """Run ``fn(*args, **kwargs)`` under a ``jax.profiler`` capture and
+    compute overlap from the device lanes. Returns None when the capture
+    is unusable (no profiler, no device lanes — e.g. CPU backends); the
+    caller then uses :func:`estimate_overlap`. Never raises."""
+    try:
+        import jax
+
+        tmp = logdir or tempfile.mkdtemp(prefix="dstpu_overlap_")
+        jax.profiler.start_trace(tmp)
+        try:
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        finally:
+            jax.profiler.stop_trace()
+        events = _load_trace_events(tmp)
+        compute, comm = _device_intervals(events)
+        if not compute and not comm:
+            return None
+        res = overlap_from_intervals(compute, comm, source="profiler")
+        # trace timestamps are microseconds — rescale the busy seconds
+        for field in ("compute_busy_s", "comm_busy_s", "overlap_s"):
+            setattr(res, field, getattr(res, field) / 1e6)
+        return res
+    except Exception as e:
+        # a broken/absent profiler must degrade to the estimator, not
+        # break the report path that wraps a live training step
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"profiler overlap capture failed "
+                     f"({type(e).__name__}: {e}); using the fenced-timer "
+                     "estimator")
+        return None

@@ -645,8 +645,25 @@ class DeepSpeedTPUEngine:
                             FlopsProfiler,
                         )
 
-                        self._tm_flops_cache = \
-                            FlopsProfiler(self).profile_train_step()
+                        prof = FlopsProfiler(self)
+                        self._tm_flops_cache = prof.profile_train_step()
+                        if prof.cost_analysis_unavailable:
+                            # this jax build's cost_analysis() yields no
+                            # usable costs: the cached 0.0 means "unknown"
+                            # — say so once instead of silently leaving
+                            # train_mfu/train_model_flops_per_sec unset
+                            logger.warning(
+                                "telemetry MFU pricing: XLA cost analysis "
+                                "unavailable on this jax build — "
+                                "train_mfu/train_model_flops_per_sec stay "
+                                "unset (not 0)")
+                            from deepspeed_tpu import telemetry
+
+                            telemetry.counter(
+                                "telemetry_collector_errors_total",
+                                "collector callbacks that raised during a "
+                                "scrape").inc(
+                                    error="cost_analysis_unavailable")
                     except Exception as e:
                         # cache the failure (retrying an expensive broken
                         # compile every scrape would be worse) but say so —
@@ -722,6 +739,30 @@ class DeepSpeedTPUEngine:
                     telemetry.gauge(
                         "train_mfu", "model FLOPS utilization vs chip bf16 "
                         "peak").set(flops * steps_per_sec / peak)
+
+    def collective_ledger(self, fold: bool = True,
+                          seq_len: Optional[int] = None):
+        """Compiled-collective ledger of the live fused train step (the
+        execution-observatory hook): every all-reduce / reduce-scatter /
+        all-gather / all-to-all / collective-permute XLA's partitioner
+        emitted for this engine's ZeRO stage, with bytes, replica groups,
+        and issuing-subsystem attribution. ``fold=True`` publishes the
+        ``comm_ledger_*`` metrics (README "Execution observatory").
+        Cached per engine — the one-off lowering compile is priced on the
+        first call only. Returns a
+        :class:`~deepspeed_tpu.profiling.observatory.CollectiveLedger`.
+        """
+        from deepspeed_tpu.profiling.observatory import ledger_for_engine
+
+        return ledger_for_engine(self, fold=fold, seq_len=seq_len)[0]
+
+    def step_report(self, **kwargs) -> Dict[str, Any]:
+        """Roofline step report (ledger + overlap + memory vs the ZeRO
+        partitioning prediction + per-phase bound verdicts) — the
+        ``tools/step-report`` CLI in library form."""
+        from deepspeed_tpu.profiling.observatory import step_report
+
+        return step_report(self, **kwargs)
 
     @staticmethod
     def _count_tokens(stacked: PyTree) -> int:

@@ -46,6 +46,11 @@ def bench_collectives(mesh: Optional[Mesh] = None, axis: str = "data",
         return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_spec,
                                  out_specs=out_spec, check_vma=False))
 
+    # busbw correction factors come from the ONE shared table
+    # (comm/bandwidth.py) — the same convention calc_bw_log and the
+    # compiled-collective ledger report
+    from deepspeed_tpu.comm.bandwidth import busbw_factor
+
     for mb in sizes_mb:
         n = int(mb * 1e6 / 4)
         n = (n // (world * world)) * world * world or world * world
@@ -54,27 +59,27 @@ def bench_collectives(mesh: Optional[Mesh] = None, axis: str = "data",
         bytes_ = n * 4
 
         ops = {
-            # busbw factors per the NCCL-tests convention
             "all_reduce": (sm(lambda v: lax.psum(v, axis), P(axis, None), P(axis, None)),
-                           xs, 2 * (world - 1) / world),
+                           xs),
             "all_gather": (sm(lambda v: lax.all_gather(v, axis, tiled=True),
                               P(axis), P(None)),
-                           x, (world - 1) / world),
+                           x),
             "reduce_scatter": (sm(lambda v: lax.psum_scatter(v, axis, tiled=True),
                                   P(None), P(axis)),
-                               x, (world - 1) / world),
+                               x),
             "all_to_all": (sm(lambda v: lax.all_to_all(
                 v.reshape(world, -1), axis, split_axis=0, concat_axis=0,
                 tiled=True).reshape(1, -1),
                 P(axis, None), P(axis, None)),
-                xs, (world - 1) / world),
+                xs),
         }
-        for name, (fn, arg, factor) in ops.items():
+        for name, (fn, arg) in ops.items():
             t = _timeit(fn, arg, trials)
             algbw = bytes_ / t / 1e9
             rows.append({
                 "op": name, "size_bytes": bytes_, "time_s": t,
-                "algbw_gbps": algbw, "busbw_gbps": algbw * factor,
+                "algbw_gbps": algbw,
+                "busbw_gbps": algbw * busbw_factor(name, world),
             })
     return rows
 
@@ -94,6 +99,7 @@ def bench_compressed_wire(mesh: Optional[Mesh] = None, axis: str = "data",
     collective (payload dtype × shape — analytic, same convention for all
     three); ``rel_err`` is vs the exact fp32 sum of the same per-rank
     contributions."""
+    from deepspeed_tpu.comm.bandwidth import busbw_factor
     from deepspeed_tpu.comm.mesh import get_mesh_manager
     from deepspeed_tpu.ops.quantization import packed_sign_allreduce
     from deepspeed_tpu.parallel.compressed import _q_reduce_scatter
@@ -122,7 +128,8 @@ def bench_compressed_wire(mesh: Optional[Mesh] = None, axis: str = "data",
     rows.append({"op": "allreduce_exact_fp32", "size_bytes": n * 4,
                  "wire_bytes_per_rank": n * 4, "wire_reduction": 1.0,
                  "time_s": t, "rel_err": 0.0,
-                 "logical_busbw_gbps": n * 4 * 2 * (world - 1) / world / t / 1e9})
+                 "logical_busbw_gbps":
+                     n * 4 * busbw_factor("all_reduce", world) / t / 1e9})
 
     # 2) qgZ int8 wire: all_to_all moves int8 payload + per-block fp32
     #    scales. Each rank holds its per-rank contribution row [n] (in the
@@ -141,7 +148,8 @@ def bench_compressed_wire(mesh: Optional[Mesh] = None, axis: str = "data",
                  "wire_bytes_per_rank": wire_q,
                  "wire_reduction": round(n * 4 / wire_q, 2),
                  "time_s": t, "rel_err": err_q,
-                 "logical_busbw_gbps": n * 4 * (world - 1) / world / t / 1e9})
+                 "logical_busbw_gbps":
+                     n * 4 * busbw_factor("reduce_scatter", world) / t / 1e9})
 
     # 3) 1-bit packed-sign allreduce (error feedback zeroed: single-shot
     #    fidelity — training carries the error across steps)
@@ -163,7 +171,8 @@ def bench_compressed_wire(mesh: Optional[Mesh] = None, axis: str = "data",
                          "preserved); training accuracy comes from the "
                          "per-step error feedback, not per-call fidelity "
                          "(1-bit Adam loss-parity tests)",
-                 "logical_busbw_gbps": n * 4 * 2 * (world - 1) / world / t / 1e9})
+                 "logical_busbw_gbps":
+                     n * 4 * busbw_factor("all_reduce", world) / t / 1e9})
     return rows
 
 

@@ -54,18 +54,13 @@ def convert_size(size_bytes: float) -> str:
 def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> Dict[str, float]:
     """Algorithmic + bus bandwidth, matching the reference's formulas
     (``comms_logging.py`` ``calc_bw_log``): allreduce busbw scales by 2(n-1)/n,
-    all_gather/reduce_scatter by (n-1)/n."""
-    duration_s = max(duration_s, 1e-9)
-    n = max(n, 1)
-    tput = size_bytes / duration_s
-    if comm_op in ("all_reduce", "inference_all_reduce", "all_reduce_coalesced"):
-        busbw = tput * (2 * (n - 1) / n)
-    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
-                     "reduce_scatter_tensor", "all_to_all", "all_to_all_single"):
-        busbw = tput * ((n - 1) / n)
-    else:
-        busbw = tput
-    return {"tput_GBps": tput / 1e9, "busbw_GBps": busbw / 1e9}
+    all_gather/reduce_scatter/all_to_all by (n-1)/n. The factor table lives
+    in ``comm/bandwidth.py`` — ONE copy shared with ``utils/comm_bench`` and
+    the compiled-collective ledger, so "busbw" means the same quantity in a
+    CommsLogger summary, a bench row, and a step report."""
+    from deepspeed_tpu.comm.bandwidth import bw_log
+
+    return bw_log(comm_op, size_bytes, duration_s, max(n, 1))
 
 
 class CommsLogger:

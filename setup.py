@@ -27,6 +27,7 @@ setup(
             "dslint=deepspeed_tpu.analysis.__main__:main",
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
             "bench-diff=deepspeed_tpu.bench.cli:main",
+            "step-report=deepspeed_tpu.profiling.observatory.__main__:main",
         ],
     },
     # tools/dslint + tools/bench-diff are checkout-only shims; the

@@ -77,6 +77,12 @@ def pytest_configure(config):
         "under JAX_PLATFORMS=cpu; the committed BENCH_r0*.json and "
         "bench_history/ records are the fixtures)")
     config.addinivalue_line(
+        "markers", "observatory: XLA execution-observatory tests "
+        "(compiled-collective ledger over committed HLO fixtures, "
+        "overlap-meter estimator math, roofline step reports — tier-1-"
+        "eligible under JAX_PLATFORMS=cpu; the live e2e tests lower the "
+        "real zero2/zero3 tiny-model step on the 8-device virtual mesh)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

@@ -195,6 +195,12 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10 ** 9,
     }
+    if os.environ.get("BENCH_OVERLAP", "1") == "0":
+        # A/B switch for the bucketed overlap scheduler (README "Overlap
+        # scheduler", docs/tutorials/overlap.md): the bucketed step is
+        # numerics-identical, so two runs differing only in this knob
+        # isolate the scheduler's wall-clock effect for bench-diff
+        config["zero_optimization"]["overlap_comm"] = False
     if precision == "bf16":
         config["bf16"] = {"enabled": True}
     elif precision == "fp16":
@@ -1199,6 +1205,22 @@ def main():
         return 0
 
     # ---- budget-orchestrated run: every entry is a bounded subprocess ----
+    # domino overlap flags (runtime/domino.py): probe-gated against this
+    # jaxlib, applied to the environment every entry SUBPROCESS inherits
+    # (the parent never builds a backend, so the children get them before
+    # their first jax use). On builds without the flags — e.g. the CPU
+    # tier — they're logged and skipped, never a hard abort.
+    if os.environ.get("BENCH_OVERLAP_FLAGS", "1") != "0":
+        try:
+            from deepspeed_tpu.runtime.domino import apply_overlap_flags
+
+            applied = apply_overlap_flags()
+            if applied:
+                print(f"bench: overlap XLA flags armed: {applied}",
+                      file=sys.stderr)
+        except Exception as e:   # flags are an optimization, never a gate
+            print(f"bench: overlap-flag probe unavailable "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
     findings = _dslint_gate()
     if findings:
         for f in findings[:20]:

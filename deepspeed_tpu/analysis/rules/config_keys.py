@@ -21,6 +21,17 @@ and writes, where ``<config>`` is a name matching ``config`` /
 ``cfg`` / ``ds_config`` / ``base_config`` / ``config_dict`` etc. —
 dict-shaped locals with other names are out of scope by design (zero
 false positives beats exhaustiveness here).
+
+Dead-key bookkeeping: :data:`DEAD_KEYS` is the explicit ledger of
+schema fields that are ACCEPTED for reference-JSON compatibility but
+intentionally unconsumed (the config parses them; nothing reads them).
+The rule flags any declared-dead key that IS read as an attribute
+somewhere in the tree — a stale declaration misleads exactly the way a
+silent no-op key does, in the other direction. When a PR starts
+consuming a key (as the overlap scheduler did for ``reduce_bucket_size``
+/ ``allgather_bucket_size`` / ``stage3_prefetch_bucket_size``), its
+entry must be REMOVED here — the self-enforcement test pins that those
+three stay consumed and undeclared.
 """
 from __future__ import annotations
 
@@ -44,6 +55,27 @@ EXTRA_KEYS = {
     "compression_training",  # compression/compress.plan_compression
     "elasticity",            # elasticity/elasticity.compute_elastic_config
     "micro_batch",           # autotuning candidate dicts share the name
+}
+
+#: schema fields accepted for reference-JSON compatibility but
+#: intentionally NOT consumed anywhere (each entry says why). A key in
+#: this ledger that IS read as an attribute is a finding — remove the
+#: stale entry. Keys absent from the ledger are presumed consumed.
+DEAD_KEYS = {
+    # ZeroConfig: CUDA-runtime partition bookkeeping knobs with no TPU
+    # analog — XLA's SPMD partitioner owns the layouts these tune
+    "contiguous_gradients": "IPG buffer layout is XLA's, not ours",
+    "reduce_scatter": "stage>=2 always reduce-scatters (sharding policy)",
+    "allgather_partitions": "gather strategy is the SPMD partitioner's",
+    "sub_group_size": "CUDA optimizer sub-grouping; no TPU analog",
+    "stage3_max_live_parameters": "XLA schedules gather lifetimes",
+    "stage3_max_reuse_distance": "XLA schedules gather lifetimes",
+    "stage3_param_persistence_threshold": "no per-param residency control",
+    "stage3_gather_16bit_weights_on_model_save":
+        "checkpoints save the fp32 master tree",
+    "round_robin_gradients": "CUDA rank-round-robin; meshes don't need it",
+    "ignore_unused_parameters": "autodiff has no unused-param hooks",
+    "mics_hierarchical_params_gather": "hierarchical gather is XLA's call",
 }
 
 _CONFIG_NAME_RE = re.compile(
@@ -98,10 +130,54 @@ def _config_base_name(node: ast.AST):
     return None
 
 
+def _config_like_value(node: ast.AST) -> bool:
+    """Does this attribute's base look like a config object? True for
+    ``cfg.X`` / ``zcfg.X`` / ``self.config.X`` / ``...zero_optimization.X``
+    — a plain method carrier (``comm.reduce_scatter``) is not one, so a
+    collective helper sharing a dead key's NAME never false-positives."""
+    if isinstance(node, ast.Name):
+        return node.id.endswith(("cfg", "config")) \
+            or node.id in ("zero", "zero_optimization")
+    if isinstance(node, ast.Attribute):
+        return node.attr.endswith(("cfg", "config")) \
+            or node.attr == "zero_optimization"
+    return False
+
+
+def consumed_attr_keys(project: Project, keys) -> Set[str]:
+    """The subset of ``keys`` read as ``<config-ish>.<key>`` anywhere
+    outside the schema module itself. Exposed for the self-enforcement
+    test pinning that the overlap bucket keys stay consumed."""
+    wanted = set(keys)
+    found: Set[str] = set()
+    for src in project.files:
+        if src.rel_path.endswith("runtime/config.py"):
+            continue   # the schema module names its own fields
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) and node.attr in wanted \
+                    and _config_like_value(node.value):
+                found.add(node.attr)
+                if found == wanted:
+                    return found
+    return found
+
+
 def check(project: Project):
     schema = _schema_keys(project)
     for src in project.files:
+        dead_exempt = src.rel_path.endswith("runtime/config.py")
         for node in ast.walk(src.tree):
+            if not dead_exempt and isinstance(node, ast.Attribute) \
+                    and node.attr in DEAD_KEYS \
+                    and _config_like_value(node.value):
+                yield Finding(
+                    RULE_ID, src.rel_path, node.lineno,
+                    f"config key {node.attr!r} is declared DEAD in "
+                    "analysis/rules/config_keys.DEAD_KEYS but is consumed "
+                    "here — remove the stale dead-key entry (or stop "
+                    "reading an intentionally-inert key)",
+                    anchor=f"deadkey/{node.attr}",
+                    end_line=node.end_lineno or node.lineno)
             key = None
             base = None
             if isinstance(node, ast.Call) and \

@@ -56,7 +56,9 @@ def metric_direction(name: str) -> Optional[int]:
         if leaf in ("bytes", "bus_bytes", "total_bytes"):
             return LOWER_IS_BETTER
         if leaf in ("count", "unparsed", "link_gbps",
-                    "predicted_busbw_gbps"):
+                    "predicted_busbw_gbps", "async_pairs"):
+            # async_pairs is a program-structure echo (how many
+            # collectives lowered async), not a perf trajectory
             return None
     if leaf == "overlap_fraction":
         # fraction of collective time hidden under compute — the ROADMAP

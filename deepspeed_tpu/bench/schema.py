@@ -50,6 +50,7 @@ and validate unchanged::
 
     "comms": {              # compiled-collective ledger totals
       "program": str, "total_bytes": int, "unparsed": int,
+      "async_pairs": int,   # matched -start/-done pairs (0 = sync-only)
       "link_gbps": number,
       "by_kind": {kind: {"count": int, "bytes": int, "bus_bytes": number,
                          "predicted_busbw_gbps": number}},
@@ -135,7 +136,7 @@ def validate_comms(comms: Any, where: str) -> List[str]:
     if not isinstance(comms, dict):
         return [f"{where}: comms must be a dict"]
     errs: List[str] = []
-    for key in ("total_bytes", "unparsed"):
+    for key in ("total_bytes", "unparsed", "async_pairs"):
         if key in comms and (not isinstance(comms[key], int)
                              or isinstance(comms[key], bool)
                              or comms[key] < 0):

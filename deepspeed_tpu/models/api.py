@@ -128,6 +128,7 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
                    loss_impl: str = "fused",
                    pipeline_schedule: str = "1f1b",
                    pipeline_micro_batches: Optional[int] = None,
+                   param_sync_fn=None,
                    **overrides) -> ModelSpec:
     """Build a ModelSpec for a causal-LM transformer preset or config.
 
@@ -143,7 +144,10 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
     (autodiff-reversed wavefront, O(microbatches)); only used when the mesh
     has a 'pipe' axis > 1. ``pipeline_micro_batches`` sets the schedule's
     microbatch count M (reference ``pipeline.micro_batches``): the fill/
-    drain bubble is (P-1)/(M+P-1), so M ≫ P amortizes it; default M = P."""
+    drain bubble is (P-1)/(M+P-1), so M ≫ P amortizes it; default M = P.
+    ``param_sync_fn`` (engine-injected; ``parallel/overlap.make_grad_sync``)
+    wraps each layer-scan chunk's params so gradient sync is emitted
+    mid-backward — pair with the ``scan_chunks`` config override."""
     if attention_fn is not None and attention is not None:
         raise ValueError("pass either attention_fn or attention=, not both")
     if loss_impl not in ("fused", "exact"):
@@ -185,7 +189,8 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
         hidden, head, aux = T.forward_hidden(
             params, tokens, cfg, attention_fn=attention_fn,
             activation_constraint=activation_constraint,
-            pld_keep=pld_keep, random_ltd_idx=ltd_idx)
+            pld_keep=pld_keep, random_ltd_idx=ltd_idx,
+            param_sync=param_sync_fn)
         if loss_tiles > 1:
             from deepspeed_tpu.sequence.tiled import tiled_lm_loss
 
@@ -221,28 +226,37 @@ def causal_lm_spec(cfg: Union[str, T.TransformerConfig],
     user_attention_fn = attention_fn is not None and attention is None
     orig_loss_tiles = loss_tiles
     orig_attention = attention
+    orig_param_sync = param_sync_fn
 
     def _rebuild(attention: Optional[str] = None,
                  loss_tiles: int = 0,
                  remat: Optional[str] = None,
-                 act_quant_bits: Optional[int] = None) -> "ModelSpec":
+                 act_quant_bits: Optional[int] = None,
+                 scan_chunks: Optional[int] = None,
+                 param_sync_fn=None) -> "ModelSpec":
         # keep the stronger loss tiling of (original, requested) — AutoSP
         # must not untile a loss the user tiled to avoid full logits; an
         # unspecified attention keeps the original named mechanism.
         # act_quant_bits threads QAT activation quantization into the block
         # forward (compression/compress.py init_compression).
+        # scan_chunks/param_sync_fn: the engine's overlap-scheduler rebuild
+        # (chunked layer scan + mid-backward grad sync); None keeps the
+        # original spec's values.
         cfg_over = {}
         if remat:
             cfg_over["remat"] = remat
         if act_quant_bits is not None:
             cfg_over["act_quant_bits"] = act_quant_bits
+        if scan_chunks is not None:
+            cfg_over["scan_chunks"] = int(scan_chunks)
         cfg2 = dataclasses.replace(cfg, **cfg_over) if cfg_over else cfg
         return causal_lm_spec(cfg2,
                               attention=attention or orig_attention,
                               loss_tiles=max(loss_tiles, orig_loss_tiles),
                               loss_impl=loss_impl,
                               activation_constraint=activation_constraint,
-                              pipeline_schedule=pipeline_schedule)
+                              pipeline_schedule=pipeline_schedule,
+                              param_sync_fn=param_sync_fn or orig_param_sync)
 
     return ModelSpec(
         init_fn=lambda rng: T.init_params(cfg, rng),
@@ -284,9 +298,11 @@ def spec_from_hf(model, arch: Optional[str] = None, attention: Optional[str] = N
 
     def _rebuild(attention: Optional[str] = None,
                  loss_tiles: int = 0,
-                 remat: Optional[str] = None) -> ModelSpec:
+                 remat: Optional[str] = None, **kwargs) -> ModelSpec:
+        # **kwargs: scan_chunks / param_sync_fn etc. — forwarded so the
+        # engine's overlap rebuild works on imported-weight specs too
         nb = base.builder(attention=attention, loss_tiles=loss_tiles,
-                          remat=remat)
+                          remat=remat, **kwargs)
         return _dc.replace(nb, init_fn=lambda rng: init_params,
                            name=str(name))
 

@@ -168,6 +168,13 @@ class TransformerConfig:
     # TP axes unchanged); the concat happens per layer inside the step and
     # XLA materializes it once per weight version.
     fuse_qkv: bool = False
+    # overlap scheduler (parallel/overlap.py; reference stage3 prefetch +
+    # IPG buckets): split the layer scan into this many sequential chunk
+    # scans so ZeRO-3 gathers one chunk ahead of compute and each chunk's
+    # gradient sync is final mid-backward. 0/1 = single scan (today's
+    # program). Numerics are identical either way; the engine sets this
+    # from stage3_prefetch_bucket_size / reduce_bucket_size.
+    scan_chunks: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -936,7 +943,8 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
                    attention_fn: Optional[AttentionFn] = None,
                    activation_constraint: Optional[Callable[[jax.Array], jax.Array]] = None,
                    pld_keep: Optional[jax.Array] = None,
-                   random_ltd_idx: Optional[jax.Array] = None
+                   random_ltd_idx: Optional[jax.Array] = None,
+                   param_sync: Optional[Callable[[PyTree], PyTree]] = None
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """tokens [B, S] int32 → (final hidden [B, S, H], lm head [H, vocab],
     moe aux loss — summed over layers, 0.0 for dense models).
@@ -949,7 +957,17 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
     (all but first and last) run on only these K tokens; dropped tokens skip
     the middle stack via gather/scatter (reference ``data_routing/`` +
     ``csrc/random_ltd``; here the drop set is shared across the middle stack
-    so the scan keeps uniform shapes)."""
+    so the scan keeps uniform shapes).
+
+    ``cfg.scan_chunks > 1`` splits the layer scan into that many
+    sequential chunk scans (``parallel/overlap.py`` even-split) so the
+    ZeRO-3 gather of chunk k+1 and the gradient sync of chunk k can
+    overlap chunk-adjacent compute; ``param_sync`` (engine-injected,
+    ``make_grad_sync``) wraps each chunk's sliced params so its gradient
+    sharding constraint is emitted mid-backward. Both are identities —
+    the chunked forward is numerically the single-scan forward. The
+    random-LTD path keeps its own first/middle/last split and ignores
+    chunking (its stacks are already scan-segmented)."""
     attention_fn = attention_fn or dot_product_attention
     constrain = activation_constraint or (lambda x: x)
     dt = cfg.compute_dtype
@@ -990,13 +1008,31 @@ def forward_hidden(params: PyTree, tokens: jax.Array, cfg: TransformerConfig,
         xs = (blocks, keep) if with_pld else blocks
         return lax.scan(make_body(cos_b, sin_b, with_pld), x, xs)
 
+    def run_chunked(x, blocks, cos_b, sin_b, keep):
+        """Sequential per-chunk scans (overlap scheduler granularity).
+        Exactly ``run`` when one chunk and no sync hook."""
+        from deepspeed_tpu.parallel.overlap import even_chunk_bounds
+
+        bounds = even_chunk_bounds(L, max(cfg.scan_chunks, 1))
+        if len(bounds) <= 1 and param_sync is None:
+            return run(x, blocks, cos_b, sin_b, keep)
+        aux_parts = []
+        for start, stop in bounds:
+            blk = jax.tree.map(lambda p: p[start:stop], blocks)
+            if param_sync is not None:
+                blk = param_sync(blk)
+            kk = keep[start:stop] if keep is not None else None
+            x, aux = run(x, blk, cos_b, sin_b, kk)
+            aux_parts.append(aux)
+        return x, jnp.concatenate([a.reshape(-1) for a in aux_parts])
+
     if random_ltd_idx is not None and cfg.pos_emb == "alibi":
         raise NotImplementedError(
             "random-LTD with ALiBi positions is unsupported: the middle-stack "
             "bias would be computed from compacted indices (rope tables are "
             "index-gathered; ALiBi distances cannot be)")
     if random_ltd_idx is None or L < 3:
-        x, auxes = run(x, params["blocks"], cos, sin, pld_keep)
+        x, auxes = run_chunked(x, params["blocks"], cos, sin, pld_keep)
         aux_total = jnp.sum(auxes)
     else:
         blk = params["blocks"]

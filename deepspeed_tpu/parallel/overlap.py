@@ -1,0 +1,242 @@
+"""Bucketed compute/collective overlap scheduling for the ZeRO step.
+
+Parity: the reference hides gradient sync under backward with the
+IPG-bucket machinery (``stage_1_and_2.py:1125`` ``reduce_bucket_size`` /
+``allgather_bucket_size``) and prefetches ZeRO-3 parameters with the
+partitioned-parameter coordinator (``stage3_prefetch_bucket_size``,
+``partitioned_param_coordinator.py``). Under SPMD those knobs were
+decorative until now: XLA emitted the whole gradient tree's sync after
+the backward and gathered ZeRO-3 params at first use, serialized against
+compute (PR 7's step-report names the backward comm-bound on exactly
+this). T3 (arXiv:2401.16677) and The Big Send-off (arXiv:2504.18658)
+locate the next MFU jump in fine-grained overlap of those collectives
+with adjacent compute.
+
+This module is the pure, mesh-free half of the scheduler — everything
+here is a plain function over shapes and element counts (the bucket
+keys count ELEMENTS, the reference's semantics), testable without a
+device:
+
+* :func:`plan_buckets` — partition gradient leaves into size-bounded
+  buckets in a deterministic issue order;
+* :func:`chunk_layers` — split the layer-scan into chunks whose stacked
+  parameters fit the prefetch bucket, the granularity at which ZeRO-3
+  all-gathers (one chunk ahead of compute = the double buffer) and
+  gradient reduce-scatters (one chunk behind the backward) are issued;
+* :func:`fenced_bucket_apply` — apply per-leaf sharding constraints
+  bucket by bucket with ``lax.optimization_barrier`` fences chaining the
+  buckets, so XLA cannot re-combine them into one step-end collective
+  and its async-collective pass (``runtime/domino.py`` flags) can hoist
+  each bucket's start under the remaining backward;
+* :func:`make_grad_sync` — a ``custom_vjp`` identity that applies the
+  gradient sharding constraint to the COTANGENT at the point it
+  materializes. Wrapped around each layer-chunk's parameters inside the
+  forward, it forces the chunk's reduce-scatter/psum to be emitted
+  mid-backward — as soon as that chunk's grads are final — instead of
+  after the whole backward.
+
+The engine half (``runtime/engine.py``) resolves
+:class:`OverlapConfig` from the ``zero_optimization`` section and wires
+these into the fused train step; numerics are exactly preserved
+(barriers and sync points are identities — the allclose tests in
+``tests/unit/test_overlap.py`` pin it per ZeRO stage).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+PyTree = Any
+
+#: cap on layer-scan chunks: each chunk compiles its own scan body, so an
+#: unbounded chunk count (a tiny prefetch bucket on a deep model) would
+#: trade dispatch-free overlap for minutes of XLA compile time. 8 chunks
+#: already gives the scheduler 8 independent gather/reduce windows —
+#: past that the returns are noise (classic DDP bucketing settles at a
+#: handful of buckets too).
+MAX_LAYER_CHUNKS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapConfig:
+    """Resolved overlap-scheduler knobs for one engine.
+
+    ``enabled`` gates the whole scheduler (``overlap_comm`` in the
+    ``zero_optimization`` section — default on, as in the reference).
+    Bucket sizes count ELEMENTS (tensor numel), exactly the reference's
+    semantics for these keys (``reduce_bucket_size`` = 5e8 means 5e8
+    gradient elements, not bytes) — so a ported reference config buckets
+    at the same granularity here."""
+
+    enabled: bool
+    reduce_bucket_elems: int
+    allgather_bucket_elems: int
+    prefetch_bucket_elems: int
+    zero_stage: int
+
+    @classmethod
+    def from_zero_config(cls, zcfg, zero_stage: int) -> "OverlapConfig":
+        return cls(
+            enabled=bool(zcfg.overlap_comm) and zero_stage >= 1,
+            reduce_bucket_elems=int(zcfg.reduce_bucket_size),
+            allgather_bucket_elems=int(zcfg.allgather_bucket_size),
+            prefetch_bucket_elems=int(zcfg.stage3_prefetch_bucket_size),
+            zero_stage=zero_stage)
+
+
+# --------------------------------------------------------------------- #
+# bucket assignment (pure)
+# --------------------------------------------------------------------- #
+def plan_buckets(sizes: Sequence[int], bucket_size: int,
+                 order: Optional[Sequence[int]] = None) -> List[List[int]]:
+    """Partition leaf indices into size-bounded buckets.
+
+    ``sizes[i]`` is leaf i's payload in any consistent unit — the engine
+    passes ELEMENT counts, the reference semantics of
+    ``reduce_bucket_size``. ``order`` is the issue order (default:
+    reversed index order — the engine passes reversed tree-flatten order
+    as its backward-completion approximation; the leaves a backward
+    finishes first should sync first). Greedy packing: a bucket closes
+    when adding the next leaf would exceed ``bucket_size``; a single
+    leaf larger than the bound gets its own bucket (never split — leaf
+    granularity is the constraint contract).
+
+    Deterministic, exact: every index appears in exactly one bucket, in
+    ``order``; same inputs always yield the same plan.
+    """
+    if bucket_size <= 0:
+        raise ValueError(f"bucket_size must be positive, got {bucket_size}")
+    idxs = list(order) if order is not None else list(
+        reversed(range(len(sizes))))
+    if sorted(idxs) != list(range(len(sizes))):
+        raise ValueError("order must be a permutation of range(len(sizes))")
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_total = 0
+    for i in idxs:
+        size = int(sizes[i])
+        if cur and cur_total + size > bucket_size:
+            buckets.append(cur)
+            cur, cur_total = [], 0
+        cur.append(i)
+        cur_total += size
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def chunk_layers(num_layers: int, per_layer_size: int, chunk_size: int,
+                 max_chunks: int = MAX_LAYER_CHUNKS
+                 ) -> List[Tuple[int, int]]:
+    """Split ``num_layers`` into contiguous ``(start, stop)`` chunks whose
+    stacked parameter payload stays within ``chunk_size`` (>= 1 layer per
+    chunk; at most ``max_chunks`` — see :data:`MAX_LAYER_CHUNKS`; sizes
+    in any consistent unit — the engine passes element counts, the
+    reference semantics of ``stage3_prefetch_bucket_size``).
+
+    This is the prefetch/sync granularity of the chunked layer scan: the
+    ZeRO-3 all-gather of chunk k+1 is independent of chunk k's compute
+    (XLA overlaps them), and chunk k's gradient sync is final as soon as
+    its backward completes. One chunk == today's behavior.
+    """
+    if num_layers <= 0:
+        return []
+    if per_layer_size <= 0 or chunk_size <= 0:
+        return [(0, num_layers)]
+    per_chunk = max(1, chunk_size // per_layer_size)
+    n_chunks = min((num_layers + per_chunk - 1) // per_chunk,
+                   max(1, max_chunks), num_layers)
+    return even_chunk_bounds(num_layers, n_chunks)
+
+
+def even_chunk_bounds(num_items: int, n_chunks: int) -> List[Tuple[int, int]]:
+    """Contiguous ``(start, stop)`` bounds splitting ``num_items`` into
+    ``n_chunks`` near-equal chunks (remainder spread one item at a time
+    from the front) — equal-sized scan bodies compile once when lengths
+    repeat and keep the overlap windows uniform. The ONE copy of the
+    split semantics: the model's chunked layer scan and
+    :func:`chunk_layers` both use it."""
+    if num_items <= 0:
+        return []
+    n_chunks = max(1, min(int(n_chunks), num_items))
+    base, rem = divmod(num_items, n_chunks)
+    bounds: List[Tuple[int, int]] = []
+    start = 0
+    for c in range(n_chunks):
+        stop = start + base + (1 if c < rem else 0)
+        bounds.append((start, stop))
+        start = stop
+    return bounds
+
+
+# --------------------------------------------------------------------- #
+# program-structuring transforms (jax; identity numerics)
+# --------------------------------------------------------------------- #
+def fenced_bucket_apply(leaves: Sequence[Any],
+                        buckets: Sequence[Sequence[int]],
+                        fns: Sequence[Callable[[Any], Any]]) -> List[Any]:
+    """Apply ``fns[i](leaves[i])`` grouped and ordered by ``buckets``.
+
+    Each bucket's outputs pass through one ``lax.optimization_barrier``
+    together with a token from the previous bucket, which (a) pins the
+    buckets' relative order in the lowered program and (b) puts a
+    dependency between consecutive buckets' collectives so XLA's
+    combiner cannot re-fuse them into a single step-end op — the
+    size-bounded collectives survive into the HLO where the async pass
+    can pipeline them. Values are returned in the ORIGINAL leaf order,
+    bit-identical to the unfenced ``fns[i](leaves[i])``.
+    """
+    import jax
+
+    out: List[Any] = list(leaves)
+    token = None
+    for bucket in buckets:
+        constrained = [fns[i](leaves[i]) for i in bucket]
+        # EVERY bucket passes through a barrier — including the first:
+        # an unfenced bucket's leaves carry no ordering edge, so the
+        # collective combiner could re-fuse them with the next bucket's
+        # ops past the size bound
+        group = tuple(constrained) + ((token,) if token is not None else ())
+        fenced = jax.lax.optimization_barrier(group)
+        constrained = list(fenced[:len(bucket)])
+        for pos, i in enumerate(bucket):
+            out[i] = constrained[pos]
+        token = constrained[0]
+    return out
+
+
+def make_grad_sync(constrain_fn: Callable[[PyTree], PyTree]
+                   ) -> Callable[[PyTree], PyTree]:
+    """Identity on the forward; applies ``constrain_fn`` to the cotangent.
+
+    Wrapped around a layer-chunk's parameters, the returned function
+    forces the chunk's gradient sharding constraint — and therefore the
+    reduce-scatter/psum XLA lowers it to — to be emitted at the point the
+    chunk's cotangent materializes in the backward, not after the whole
+    gradient tree is assembled. The forward value (and its sharding) is
+    untouched, so ZeRO-3's per-use gather layout is unaffected.
+    """
+    import jax
+
+    @jax.custom_vjp
+    def sync(tree: PyTree) -> PyTree:
+        return tree
+
+    def fwd(tree: PyTree):
+        return tree, None
+
+    def bwd(_, cotangent: PyTree):
+        return (constrain_fn(cotangent),)
+
+    sync.defvjp(fwd, bwd)
+    return sync
+
+
+def leaf_count(shape: Sequence[int]) -> int:
+    """Element count (numel) of one leaf — the ONE copy of the bucket
+    sizing unit (reference semantics: bucket keys count elements).
+    Scalars (empty shape) count 1."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n

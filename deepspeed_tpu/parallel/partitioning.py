@@ -142,6 +142,19 @@ class ShardingPolicy:
             return self.zero_spec(axes_tree, shape_tree)
         return self.tp_spec(axes_tree)
 
+    def leaf_grad_spec(self, logical_axes: Tuple[Optional[str], ...],
+                       shape: Tuple[int, ...]) -> P:
+        """Gradient spec for ONE leaf of the given shape — the overlap
+        scheduler's chunk-sync hook (``runtime/engine.py``) computes this
+        per layer-chunk slice, whose leading dim differs from the full
+        stacked leaf so the tree-level :meth:`grad_spec` can't be
+        reused directly."""
+        spec = logical_to_spec(logical_axes, self.tp_rules)
+        if self.zero_stage >= 2:
+            spec = _add_zero_axis(spec, tuple(shape), self.mesh,
+                                  self.zero_axes)
+        return spec
+
     # --- NamedSharding trees ---------------------------------------------- #
     def to_shardings(self, spec_tree: Any) -> Any:
         return jax.tree.map(

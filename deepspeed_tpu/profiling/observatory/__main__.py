@@ -27,19 +27,27 @@ from typing import Any, Dict, Optional
 
 def _text_summary(report: Dict[str, Any]) -> str:
     lines = []
-    lines.append(
-        f"step-report: {report['program']} @ {report['platform']} "
-        f"(zero_stage={report.get('zero_stage')}, "
-        f"world={report.get('world')})")
-    ca = report.get("cost_analysis") or {}
-    if ca.get("available"):
-        lines.append(f"  cost analysis: {ca['flops'] / 1e9:.2f} GFLOP, "
-                     f"{ca['bytes_accessed'] / 1e6:.1f} MB accessed")
+    if report.get("mode") == "ledger_only":
+        led0 = report.get("ledger") or {}
+        lines.append(
+            f"step-report: {report['program']} (ledger only, "
+            f"zero_stage={led0.get('zero_stage')}, "
+            f"world={led0.get('world')})")
     else:
-        lines.append("  cost analysis: unavailable on this jax build")
+        lines.append(
+            f"step-report: {report['program']} @ {report['platform']} "
+            f"(zero_stage={report.get('zero_stage')}, "
+            f"world={report.get('world')})")
+        ca = report.get("cost_analysis") or {}
+        if ca.get("available"):
+            lines.append(f"  cost analysis: {ca['flops'] / 1e9:.2f} GFLOP, "
+                         f"{ca['bytes_accessed'] / 1e6:.1f} MB accessed")
+        else:
+            lines.append("  cost analysis: unavailable on this jax build")
     led = report.get("ledger") or {}
     lines.append(f"  collectives: {sum(r['count'] for r in led.get('by_kind', {}).values())} ops, "
                  f"{led.get('total_bytes', 0) / 1e6:.2f} MB full-tensor bytes"
+                 f", async_pairs={led.get('async_pairs', 0)}"
                  + (f", {led['unparsed']} unparsed" if led.get("unparsed")
                     else ""))
     for kind, row in (led.get("by_kind") or {}).items():
@@ -69,8 +77,9 @@ def _text_summary(report: Dict[str, Any]) -> str:
             f"comm~{row['predicted_comm_s'] * 1e3:7.2f} ms  "
             f"overlap {row['overlap_fraction']:.2f}  -> {row['verdict']}"
             f"{dom}")
-    lines.append(f"  overlap_fraction={report['overlap_fraction']} "
-                 f"verdict={report['verdict']}")
+    if "verdict" in report:
+        lines.append(f"  overlap_fraction={report['overlap_fraction']} "
+                     f"verdict={report['verdict']}")
     return "\n".join(lines)
 
 
@@ -198,7 +207,8 @@ def main(argv: Optional[list] = None) -> int:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2, sort_keys=True)
     if args.format == "text":
-        print(_text_summary(report) if "phases" in report
+        print(_text_summary(report)
+              if "phases" in report or report.get("mode") == "ledger_only"
               else json.dumps(report, indent=2, sort_keys=True))
     else:
         print(json.dumps(report, sort_keys=True))

@@ -236,3 +236,88 @@ def iter_collective_lines(hlo_text: str) -> Iterable[str]:
         m = _OP_LINE.match(line)
         if m and _COLLECTIVE_OPCODE.match(m.group("opcode")):
             yield line
+
+
+# ------------------------------------------------------------------ #
+# async start/done pairs (the overlap scheduler's HLO-level evidence)
+# ------------------------------------------------------------------ #
+def count_async_pairs(hlo_text: str) -> int:
+    """Matched ``*-start``/``*-done`` collective pairs in the dump.
+
+    On backends whose async-collective pass runs (TPU, GPU) every
+    overlappable collective lowers to a start/done pair — the count is
+    direct evidence that the compiler can hoist the starts under
+    adjacent compute. Matched per opcode family (``min(starts, dones)``
+    summed), so a trimmed fixture missing one half never overcounts.
+    A sync-only dump (the CPU tier) honestly counts 0.
+    """
+    starts: dict = {}
+    dones: dict = {}
+    for line in hlo_text.splitlines():
+        m = _OP_LINE.match(line)
+        if m is None:
+            continue
+        opcode = m.group("opcode")
+        if not _COLLECTIVE_OPCODE.match(opcode):
+            continue
+        if opcode.endswith("-start"):
+            family = opcode[:-len("-start")]
+            starts[family] = starts.get(family, 0) + 1
+        elif opcode.endswith("-done"):
+            family = opcode[:-len("-done")]
+            dones[family] = dones.get(family, 0) + 1
+    return sum(min(n, dones.get(family, 0))
+               for family, n in starts.items())
+
+
+#: sync collective opcodes the TPU/GPU async pass rewrites (XLA
+#: AsyncCollectiveCreator); all-to-all stays sync on current TPU
+#: pipelines unless fused, but the rewrite accepts it for completeness.
+_ASYNCIFIABLE = ("all-reduce", "all-gather", "reduce-scatter",
+                 "all-to-all", "collective-permute")
+
+
+def asyncify_hlo(hlo_text: str) -> str:
+    """Rewrite sync collective ops into ``*-start``/``*-done`` pairs —
+    the same surface transform XLA's async-collective-creator pass
+    applies on TPU/GPU backends (the CPU backend has no such pass, so a
+    CPU ``compile().as_text()`` is always sync).
+
+    Used as a WHAT-IF predictor ("what would the TPU lowering's async
+    schedule look like for this program") and to generate the committed
+    async fixtures the ledger's pair-counting is pinned against. The
+    rewrite preserves the byte convention: the ``-start`` line keeps the
+    operands and gains a ``(operand, result)`` tuple type (exactly the
+    async wrapper's shape), the ``-done`` keeps the original result
+    name, so ``parse_hlo_collectives`` counts each payload once with
+    unchanged sizes.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_LINE.match(line)
+        opcode = m.group("opcode") if m else ""
+        if (m is None or opcode not in _ASYNCIFIABLE
+                or not _COLLECTIVE_OPCODE.match(opcode)):
+            out.append(line)
+            continue
+        indent = line[:len(line) - len(line.lstrip())]
+        result = m.group("result")
+        rtype = m.group("rtype")
+        rest = line[m.end("opcode"):]          # "(operands), attrs"
+        close = _operand_span(rest)
+        if close == -1:
+            out.append(line)                   # malformed: leave sync
+            continue
+        operands = rest[:close + 1]
+        attrs = rest[close + 1:]
+        first_operand = re.match(r"\(\s*" + _TYPED, operands)
+        op_type = first_operand.group(0)[1:].strip() if first_operand \
+            else rtype
+        root = "ROOT " if line.lstrip().startswith("ROOT ") else ""
+        out.append(
+            f"{indent}%{result}-start = ({op_type}, {rtype}) "
+            f"{opcode}-start{operands}{attrs}")
+        out.append(
+            f"{indent}{root}%{result} = {rtype} {opcode}-done("
+            f"({op_type}, {rtype}) %{result}-start)")
+    return "\n".join(out)

@@ -77,6 +77,9 @@ class CollectiveLedger:
     unparsed: int
     world: int                        # participants hint used for parsing
     zero_stage: int = 0
+    #: matched -start/-done pairs (async-collective pass evidence; 0 on
+    #: sync-only backends like the CPU tier — see hlo.count_async_pairs)
+    async_pairs: int = 0
     #: cost_analysis cross-check (None = unavailable on this build)
     cost_flops: Optional[float] = None
     cost_bytes_accessed: Optional[float] = None
@@ -139,6 +142,7 @@ class CollectiveLedger:
             "zero_stage": self.zero_stage,
             "total_bytes": self.total_bytes(),
             "unparsed": self.unparsed,
+            "async_pairs": self.async_pairs,
             "by_kind": by_kind,
             "by_subsystem": {
                 k: {"count": int(v["count"]), "bytes": int(v["bytes"])}
@@ -197,6 +201,11 @@ class CollectiveLedger:
                 "comm_ledger_unparsed_total",
                 "collective-family HLO ops the ledger could not map to a "
                 "known kind").inc(self.unparsed, program=self.program)
+        telemetry.gauge(
+            "comm_ledger_async_pairs_per_step",
+            "matched async collective start/done pairs in the compiled "
+            "program (0 = every collective lowered synchronous, e.g. the "
+            "CPU backend)").set(self.async_pairs, program=self.program)
         link = link_gbps or BW.chip_link_gbps(_device_kind())
         telemetry.gauge(
             "comm_ledger_predicted_comm_seconds",
@@ -221,11 +230,14 @@ def build_ledger(hlo_text: str, program: str = "program",
                  ) -> CollectiveLedger:
     """Parse + attribute: the pure-text entry point (fixtures, offline
     dumps, ``step-report --hlo-file``)."""
+    from deepspeed_tpu.profiling.observatory.hlo import count_async_pairs
+
     ops, unparsed = parse_hlo_collectives(hlo_text, world_hint=world)
     for op in ops:
         op.subsystem = attribute_subsystem(op, zero_stage)
     return CollectiveLedger(program=program, ops=ops, unparsed=unparsed,
                             world=world, zero_stage=zero_stage,
+                            async_pairs=count_async_pairs(hlo_text),
                             cost_flops=cost_flops,
                             cost_bytes_accessed=cost_bytes_accessed)
 

@@ -338,6 +338,10 @@ def validate_report(report: Any) -> List[str]:
     if not isinstance(led, dict) or not isinstance(led.get("by_kind"), dict):
         errs.append("ledger.by_kind must be a dict")
     else:
+        pairs = led.get("async_pairs", 0)
+        if not isinstance(pairs, int) or isinstance(pairs, bool) \
+                or pairs < 0:
+            errs.append("ledger.async_pairs must be a non-negative int")
         for kind, row in led["by_kind"].items():
             if not isinstance(row, dict) or \
                     not isinstance(row.get("bytes"), int) or \
@@ -402,7 +406,8 @@ def bench_comms_block(engine,
         else None
     led = ledger.to_dict(link_gbps=link, max_ops=0)
     comms = {key: led[key] for key in ("program", "total_bytes",
-                                       "unparsed", "link_gbps", "by_kind")}
+                                       "unparsed", "async_pairs",
+                                       "link_gbps", "by_kind")}
     out: Dict[str, Any] = {"comms": comms}
     if overlap is not None:
         out["overlap_fraction"] = round(overlap.overlap_fraction, 4)

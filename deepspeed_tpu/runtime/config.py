@@ -92,8 +92,20 @@ class ZeroConfig:
     On TPU the stages are sharding policies applied to the train state:
       0 = replicated; 1 = optimizer state sharded over data axes;
       2 = + gradients reduce-scattered; 3 = + parameters sharded (FSDP-style).
-    Bucket/overlap knobs are accepted for config compatibility; XLA's
-    latency-hiding scheduler plays the role of the overlap machinery.
+
+    Overlap scheduling (``parallel/overlap.py``; README "Overlap
+    scheduler"): ``overlap_comm`` gates the bucketed compute/collective
+    overlap scheduler inside the compiled step. ``reduce_bucket_size``
+    bounds each gradient-sync bucket (leaves grouped and fenced so each
+    bucket's reduce can start as soon as its grads are final);
+    ``allgather_bucket_size`` bounds the layer-chunk parameters at
+    stages 1-2; ``stage3_prefetch_bucket_size`` bounds the ZeRO-3
+    layer-chunk whose parameters are all-gathered one chunk ahead of
+    compute (the double-buffered prefetch). All three are the
+    reference's JSON spellings, semantics AND units — ELEMENT counts
+    (numel), not bytes, exactly as in ``stage_1_and_2.py`` IPG buckets
+    and ``partitioned_param_coordinator`` prefetch — so a ported
+    reference config buckets at the same granularity here.
     """
     stage: int = 0
     contiguous_gradients: bool = True
@@ -140,6 +152,26 @@ class ZeroConfig:
     def validate(self) -> None:
         if self.stage not in (0, 1, 2, 3):
             raise DeepSpeedConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        for key in ("reduce_bucket_size", "allgather_bucket_size",
+                    "stage3_prefetch_bucket_size"):
+            val = getattr(self, key)
+            # reference-ecosystem spellings normalize: JSON scientific
+            # notation (5e8 -> float) coerces to int, HF-integration
+            # "auto" falls back to the schema default
+            if val == "auto":
+                val = dataclasses.fields(type(self))
+                val = next(f.default for f in val if f.name == key)
+                setattr(self, key, val)
+            elif isinstance(val, float) and not isinstance(val, bool) \
+                    and float(val).is_integer():
+                val = int(val)
+                setattr(self, key, val)
+            if not isinstance(val, int) or isinstance(val, bool) or val <= 0:
+                # consumed by the overlap scheduler (parallel/overlap.py):
+                # a zero/negative bucket would plan nothing silently
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key} must be a positive int "
+                    f"(elements), got {val!r}")
 
 
 @dataclasses.dataclass

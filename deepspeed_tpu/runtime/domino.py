@@ -44,7 +44,13 @@ PyTree = Any
 
 # XLA flags that control collective/compute overlap on TPU (documented for
 # deployment parity with Domino's async-allreduce machinery; current libtpu
-# enables the scheduler by default).
+# enables the scheduler by default). Apply through
+# :func:`apply_overlap_flags` — NEVER by blindly appending to XLA_FLAGS:
+# the set spans jaxlib generations and an unknown ``--xla_*`` flag
+# hard-aborts backend creation (``F parse_flags_from_env``). The probe
+# (``utils/xla_compat.probe_xla_flags``, same machinery as
+# tests/conftest.py's collective-timeout flags) vets each flag in a
+# throwaway subprocess and the unsupported ones are logged and skipped.
 XLA_OVERLAP_FLAGS = (
     "--xla_tpu_enable_async_collective_fusion=true",
     "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
@@ -52,6 +58,62 @@ XLA_OVERLAP_FLAGS = (
     "--xla_enable_async_all_gather=true",
     "--xla_enable_async_collective_permute=true",
 )
+
+
+def supported_overlap_flags() -> tuple:
+    """The subset of :data:`XLA_OVERLAP_FLAGS` this jaxlib accepts
+    (probed once per jaxlib version, cached; see
+    ``utils/xla_compat.probe_xla_flags``)."""
+    from deepspeed_tpu.utils.xla_compat import probe_xla_flags
+
+    return probe_xla_flags(XLA_OVERLAP_FLAGS)
+
+
+def apply_overlap_flags() -> str:
+    """Append the PROBED overlap flags to ``XLA_FLAGS`` (idempotent).
+
+    Returns the flags actually APPENDED by this call, as one string —
+    empty when nothing changed: no flag supported, or every flag name
+    already present in ``XLA_FLAGS`` (a user's explicit ``=false`` is
+    respected, not overridden, and not reported as armed). Every
+    skipped flag is logged, not raised: an older jaxlib must degrade to
+    its default scheduler, not crash. Call BEFORE the first jax backend
+    use — once a backend exists the env change is inert, and this logs
+    a warning instead of pretending otherwise."""
+    import os
+
+    from deepspeed_tpu.utils.logging import logger
+
+    supported = supported_overlap_flags()
+    skipped = [f for f in XLA_OVERLAP_FLAGS if f not in supported]
+    if skipped:
+        logger.info(
+            f"domino overlap flags not supported by this jaxlib — "
+            f"skipped: {' '.join(skipped)}")
+    if not supported:
+        return ""
+    current = os.environ.get("XLA_FLAGS", "")
+    # compare flag NAMES, not full tokens: a user who explicitly set
+    # --xla_...=false must not have it silently overridden by appending
+    # our =true after it (XLA takes the last occurrence)
+    present = {tok.split("=", 1)[0] for tok in current.split()}
+    missing = [f for f in supported
+               if f.split("=", 1)[0] not in present]
+    if missing:
+        backend_up = False
+        try:
+            from jax._src import xla_bridge as _xb
+
+            backend_up = bool(getattr(_xb, "_backends", None))
+        except (ImportError, AttributeError):
+            pass   # private surface moved — best-effort warning only
+        if backend_up:
+            logger.warning(
+                "domino overlap flags applied AFTER jax backend "
+                "initialization — they take effect in subprocesses "
+                "(bench entries, launcher workers), not this process")
+        os.environ["XLA_FLAGS"] = (current + " " + " ".join(missing)).strip()
+    return " ".join(missing)
 
 
 def domino_lm_loss(params: PyTree, tokens: jax.Array, cfg: T.TransformerConfig,

@@ -26,6 +26,7 @@ a sharded buffer, ``step`` applies the (jitted) update at the GAS boundary.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
@@ -279,6 +280,12 @@ class DeepSpeedTPUEngine:
             self._offload_param and jax.default_backend() == "tpu"
             and not self._compressed and not self._onebit_wire)
 
+        # bucketed compute/collective overlap scheduler (ROADMAP item 2;
+        # parallel/overlap.py): chunk the layer scan at the prefetch-bucket
+        # granularity and emit each chunk's gradient sync mid-backward so
+        # XLA's async-collective pass can hide it under remaining compute
+        self._setup_overlap_scheduler(zcfg)
+
         # data-efficiency features (reference runtime/data_pipeline/ +
         # progressive_layer_drop.py — config-driven, engine-injected)
         self._setup_data_efficiency()
@@ -449,6 +456,132 @@ class DeepSpeedTPUEngine:
             logger.warning("qwZ/qgZ and 1-bit transport are mutually "
                            "exclusive — using 1-bit transport")
             self._compressed = None
+
+    # ------------------------------------------------------------------ #
+    # overlap scheduler (parallel/overlap.py — README "Overlap scheduler")
+    # ------------------------------------------------------------------ #
+    def _setup_overlap_scheduler(self, zcfg) -> None:
+        """Resolve the bucketed overlap scheduler and (when applicable)
+        rebuild the model spec with a chunked layer scan + mid-backward
+        grad-sync points.
+
+        Honors the reference bucket keys WITH the reference's units
+        (element counts): ``reduce_bucket_size`` bounds gradient-sync
+        buckets, ``stage3_prefetch_bucket_size`` (stage 3) /
+        ``allgather_bucket_size`` (stages 1-2) bound the layer-chunk
+        parameter elements. Gated by ``overlap_comm`` at stage >= 1. The
+        wire-compressed step builders (qwZ/qgZ, 1-bit) keep their own
+        transport — they run shard_map-MANUAL over the dp axes, where
+        named sharding constraints don't apply — and stay unbucketed."""
+        from deepspeed_tpu.parallel.overlap import OverlapConfig, chunk_layers
+
+        self._overlap = OverlapConfig.from_zero_config(zcfg, self.zero_stage)
+        self._overlap_plan: Dict[str, Any] = {
+            "enabled": self._overlap.enabled, "scan_chunks": 1,
+            "chunk_bounds": [], "grad_sync_points": False}
+        if not self._overlap.enabled:
+            return
+        if self._compressed or self._onebit_wire:
+            self._overlap = dataclasses.replace(self._overlap, enabled=False)
+            self._overlap_plan["enabled"] = False
+            log_dist("overlap scheduler: wire-compressed step keeps its own "
+                     "transport — bucketed sync not applied")
+            return
+        model = self.model_spec
+        spec_cfg = getattr(model, "config", None)
+        n_layers = getattr(spec_cfg, "num_layers", 0) or 0
+        can_chunk = (model.builder is not None and spec_cfg is not None
+                     and hasattr(spec_cfg, "scan_chunks") and n_layers > 1
+                     and self.mesh_manager.axis_size("pipe") == 1)
+        bounds = []
+        if can_chunk:
+            per_layer = self._blocks_elems_per_layer(n_layers)
+            # stage 3: the prefetch bucket IS the gather granularity;
+            # stages 1-2: allgather_bucket_size alone (the README
+            # contract — reduce_bucket_size governs grad buckets only)
+            chunk_elems = (self._overlap.prefetch_bucket_elems
+                           if self.zero_stage >= 3
+                           else self._overlap.allgather_bucket_elems)
+            bounds = chunk_layers(n_layers, per_layer, chunk_elems)
+        n_chunks = max(len(bounds), 1)
+        # mid-backward sync points need a sharded gradient layout to pin
+        # (stage >= 2); at stage 1 the chunked scan alone supplies the
+        # gather granularity
+        sync_fn = self._make_chunk_grad_sync() if (
+            can_chunk and self.zero_stage >= 2) else None
+        if can_chunk and (n_chunks > 1 or sync_fn is not None):
+            self.model_spec = model.builder(scan_chunks=n_chunks,
+                                            param_sync_fn=sync_fn)
+            self._overlap_plan.update(
+                scan_chunks=n_chunks, chunk_bounds=bounds,
+                grad_sync_points=sync_fn is not None)
+            log_dist(f"overlap scheduler active: {n_chunks} layer chunk(s), "
+                     f"grad sync {'per chunk mid-backward' if sync_fn else 'bucketed at step level'}, "
+                     f"reduce_bucket={self._overlap.reduce_bucket_elems} "
+                     f"prefetch_bucket={self._overlap.prefetch_bucket_elems}")
+
+    def _blocks_elems_per_layer(self, n_layers: int) -> int:
+        """Per-layer parameter ELEMENTS (what a ZeRO-3 chunk gather
+        moves per layer, in the bucket keys' reference unit)."""
+        from deepspeed_tpu.parallel.overlap import leaf_count
+
+        shapes = self._shapes.get("blocks") \
+            if isinstance(self._shapes, dict) else None
+        if shapes is None:
+            return 0
+        total = sum(leaf_count(s.shape) for s in jax.tree.leaves(shapes))
+        return max(total // max(n_layers, 1), 1)
+
+    def _make_chunk_grad_sync(self):
+        """Closure for ``parallel/overlap.make_grad_sync``: constrain a
+        layer-chunk's COTANGENT to its ZeRO gradient sharding so XLA
+        emits the chunk's reduce as soon as its backward completes.
+        Captures mesh/policy/axes — not the engine (no cycle)."""
+        from deepspeed_tpu.parallel.overlap import make_grad_sync
+        from deepspeed_tpu.parallel.partitioning import (
+            _is_axes_leaf,
+            logical_to_spec,
+        )
+
+        axes_blocks = self._axes.get("blocks") \
+            if isinstance(self._axes, dict) else None
+        if axes_blocks is None:
+            return None
+        mesh, policy = self.mesh, self.policy
+
+        def _norm(spec):
+            parts = list(spec)
+            while parts and parts[-1] is None:
+                parts.pop()
+            return tuple(parts)
+
+        def constrain(cotangent: PyTree) -> PyTree:
+            def one(axes, g):
+                spec = policy.leaf_grad_spec(axes, g.shape)
+                if _norm(spec) == _norm(logical_to_spec(axes,
+                                                        policy.tp_rules)):
+                    # the chunk slice has no zero-divisible dim at this
+                    # granularity — constraining would PIN a replicated
+                    # layout mid-backward (a full all-reduce plus a
+                    # reshard against the step-level sharded spec);
+                    # leave the leaf to the step-end constraint instead
+                    return g
+                return jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, spec))
+
+            return jax.tree.map(one, axes_blocks, cotangent,
+                                is_leaf=_is_axes_leaf)
+
+        return make_grad_sync(constrain)
+
+    def overlap_plan(self) -> Dict[str, Any]:
+        """The resolved overlap-scheduler plan (chunk bounds, bucket
+        sizes, sync-point installation) — step-report / test hook."""
+        plan = dict(self._overlap_plan)
+        plan.update(reduce_bucket_elems=self._overlap.reduce_bucket_elems,
+                    allgather_bucket_elems=self._overlap.allgather_bucket_elems,
+                    prefetch_bucket_elems=self._overlap.prefetch_bucket_elems)
+        return plan
 
     # ------------------------------------------------------------------ #
     # data efficiency (curriculum / random-LTD / PLD / variable batch)
@@ -1011,7 +1144,40 @@ class DeepSpeedTPUEngine:
 
     def _constrain_grads(self, grads: PyTree) -> PyTree:
         grad_sh = self.policy.to_shardings(self.grad_spec)
-        return jax.tree.map(jax.lax.with_sharding_constraint, grads, grad_sh)
+        if getattr(self, "_overlap", None) is None \
+                or not self._overlap.enabled:
+            return jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                                grad_sh)
+        return self._constrain_grads_bucketed(grads, grad_sh)
+
+    def _constrain_grads_bucketed(self, grads: PyTree,
+                                  grad_sh: PyTree) -> PyTree:
+        """Bucketed gradient sync: top-level leaves grouped into
+        ``reduce_bucket_size``-bounded buckets (element counts, the
+        reference's unit; reversed tree-flatten order — the
+        backward-completion approximation) and constrained
+        bucket-by-bucket behind ``optimization_barrier`` fences, so the
+        collectives stay size-bounded and ordered in the lowered program
+        instead of fusing into one step-end sync. Identical values —
+        the fences and constraints are identities (allclose-pinned in
+        tests/unit/test_overlap.py)."""
+        from deepspeed_tpu.parallel.overlap import (
+            fenced_bucket_apply,
+            leaf_count,
+            plan_buckets,
+        )
+
+        leaves, treedef = jax.tree.flatten(grads)
+        sh_leaves = jax.tree.leaves(grad_sh)
+        if len(leaves) != len(sh_leaves) or not leaves:
+            return jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                                grad_sh)
+        sizes = [leaf_count(x.shape) for x in leaves]
+        buckets = plan_buckets(sizes, self._overlap.reduce_bucket_elems)
+        fns = [lambda x, s=s: jax.lax.with_sharding_constraint(x, s)
+               for s in sh_leaves]
+        return jax.tree.unflatten(
+            treedef, fenced_bucket_apply(leaves, buckets, fns))
 
     def _loss_and_grads(self, master: PyTree, batch: PyTree, scale) -> Tuple[jax.Array, PyTree]:
         if self._offload_param:

@@ -7,13 +7,21 @@ unknown ``XLA_FLAGS`` at backend creation — which killed the whole test
 session on builds without them. Probe once per jaxlib version in a throwaway
 subprocess and cache the verdict in a temp marker so conftest/bench pay the
 ~2 s probe once per interpreter version, not per run.
+
+:func:`probe_xla_flags` is the generic form: any flag set can be vetted
+the same way (``runtime/domino.py`` gates its overlap flags through it —
+an unknown ``--xla_*`` on an older jaxlib is logged and skipped, never a
+hard abort).
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import subprocess
 import sys
 import tempfile
+from typing import Optional, Sequence, Tuple
 
 CPU_COLLECTIVE_TIMEOUT_FLAGS = (
     " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
@@ -29,47 +37,110 @@ def _jaxlib_version() -> str:
         return "unknown"
 
 
-def supports_cpu_collective_timeout_flags() -> bool:
-    marker = os.path.join(
-        tempfile.gettempdir(),
-        f".dstpu_xla_cc_timeout_flags_{_jaxlib_version()}")
-    try:
-        if os.path.exists(marker):
-            with open(marker) as f:
-                return f.read().strip() == "1"
-    except OSError:
-        pass
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               XLA_FLAGS=CPU_COLLECTIVE_TIMEOUT_FLAGS.strip())
+def _probe_once(flags: str, platforms: str = "") -> Tuple[bool, bool, bytes]:
+    """Spawn ``import jax; jax.devices()`` under ``XLA_FLAGS=flags``.
+
+    → ``(accepted, deterministic, stderr)``: ``deterministic`` is False
+    for transient failures (probe timeout, spawn error, OOM kill) which
+    must not be cached — only a clean start or XLA's explicit
+    unknown-flag abort is a verdict. ``stderr`` carries the abort text
+    (XLA names the rejected flags in it)."""
+    env = dict(os.environ, XLA_FLAGS=flags.strip())
+    if platforms:
+        env["JAX_PLATFORMS"] = platforms
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             capture_output=True, env=env, timeout=120)
     except Exception as e:
-        # transient failure (probe timeout on a loaded box, spawn error):
-        # assume unsupported for THIS session but do NOT cache the verdict —
-        # a permanent '0' would silently drop the rendezvous-timeout flags
-        # on jaxlibs that support them. Say so: a session running without
-        # the flags can flake with 'F rendezvous.cc:127' aborts, and that
-        # must be attributable to this probe.
-        import sys as _sys
+        print(f"[xla_compat] XLA flag probe failed transiently ({e}); "
+              f"treating {flags!r} as unsupported for THIS session",
+              file=sys.stderr)
+        return False, False, b""
+    err = proc.stderr or b""
+    if proc.returncode == 0:
+        return True, True, err
+    return False, b"Unknown flags in XLA_FLAGS" in err, err
 
-        print(f"[xla_compat] collective-timeout flag probe failed "
-              f"transiently ({e}); running this session WITHOUT the CPU "
-              "rendezvous-timeout flags", file=_sys.stderr)
-        return False
-    ok = proc.returncode == 0
-    # cache only deterministic outcomes: success, or XLA's explicit
-    # unknown-flag abort; any other nonzero exit (OOM kill, SIGTERM) is
-    # transient and must not poison future sessions
-    flag_rejected = b"Unknown flags in XLA_FLAGS" in (proc.stderr or b"")
-    if ok or flag_rejected:
-        try:
-            with open(marker, "w") as f:
-                f.write("1" if ok else "0")
-        except OSError:
-            pass
-    return ok
+
+def _verdicts_from_abort(flags: Sequence[str], stderr: bytes,
+                         platforms: str) -> Optional[dict]:
+    """Resolve per-flag verdicts from XLA's unknown-flag abort text.
+
+    The abort line names every rejected flag; flags it names are
+    unsupported, the rest are confirmed with ONE more whole-subset
+    probe (a mis-parse must not smuggle a bad flag past the probe).
+    Returns None when the line can't be matched to any flag name or the
+    confirmation disagrees — callers then bisect per flag."""
+    line = next((ln for ln in (stderr or b"").splitlines()
+                 if b"Unknown flags in XLA_FLAGS" in ln), b"")
+    rejected = [fl for fl in flags
+                if fl.split("=", 1)[0].encode() in line]
+    if not rejected:
+        return None
+    survivors = [fl for fl in flags if fl not in rejected]
+    if survivors:
+        ok, det, _ = _probe_once(" ".join(survivors), platforms)
+        if not (ok and det):
+            return None
+    return {fl: fl not in rejected for fl in flags}
+
+
+def probe_xla_flags(flags: Sequence[str],
+                    platforms: str = "") -> Tuple[str, ...]:
+    """Return the subset of ``flags`` this jaxlib's XLA accepts.
+
+    One optimistic probe tries the whole set (the common all-supported
+    case costs a single ~2 s subprocess). On an unknown-flag abort the
+    rejected flags are read out of XLA's own abort line ("Unknown flags
+    in XLA_FLAGS: ...") and the survivors re-probed ONCE to confirm —
+    two subprocesses total; only if the abort text can't be matched to
+    the flag names does it fall back to probing each flag individually.
+    Verdicts cache per (jaxlib version, flag set) in a temp-dir JSON
+    marker; transient probe failures return the empty set WITHOUT
+    caching (a permanent "unsupported" from a loaded box would silently
+    drop good flags forever)."""
+    flags = tuple(flags)
+    if not flags:
+        return ()
+    digest = hashlib.sha1(" ".join(flags).encode()).hexdigest()[:12]
+    marker = os.path.join(
+        tempfile.gettempdir(),
+        f".dstpu_xla_flag_probe_{_jaxlib_version()}_{digest}")
+    try:
+        if os.path.exists(marker):
+            with open(marker) as f:
+                cached = json.load(f)
+            return tuple(fl for fl in flags if cached.get(fl))
+    except (OSError, ValueError):
+        pass
+    ok_all, deterministic, err = _probe_once(" ".join(flags), platforms)
+    if ok_all:
+        verdicts = {fl: True for fl in flags}
+    elif not deterministic:
+        return ()   # transient: no verdict, no cache
+    else:
+        verdicts = _verdicts_from_abort(flags, err, platforms)
+        if verdicts is None:
+            verdicts = {}
+            for fl in flags:
+                ok, det, _ = _probe_once(fl, platforms)
+                if not det:
+                    return ()   # transient mid-bisect: bail uncached
+                verdicts[fl] = ok
+    try:
+        with open(marker, "w") as f:
+            json.dump(verdicts, f)
+    except OSError:
+        pass
+    return tuple(fl for fl in flags if verdicts[fl])
+
+
+def supports_cpu_collective_timeout_flags() -> bool:
+    flags = tuple(CPU_COLLECTIVE_TIMEOUT_FLAGS.split())
+    # the rendezvous-timeout flags only make sense as a pair — partial
+    # support (never observed in the wild) counts as unsupported
+    return probe_xla_flags(flags, platforms="cpu") == flags
 
 
 def cpu_collective_timeout_flags() -> str:

@@ -83,6 +83,12 @@ def pytest_configure(config):
         "eligible under JAX_PLATFORMS=cpu; the live e2e tests lower the "
         "real zero2/zero3 tiny-model step on the 8-device virtual mesh)")
     config.addinivalue_line(
+        "markers", "overlap: bucketed compute/collective overlap-scheduler "
+        "tests (pure bucket planning, bucketed-vs-unbucketed engine "
+        "allclose per ZeRO stage on the 8-device virtual mesh, async "
+        "start/done pair pinning over committed HLO fixtures — tier-1-"
+        "eligible under JAX_PLATFORMS=cpu)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

@@ -20,3 +20,15 @@ def suppressed(cfg):
 def not_config_shaped(payload):
     # base name doesn't match the config pattern: out of scope by design
     return payload.get("whatever_key")
+
+
+def consume_declared_dead_key(zero_cfg):
+    # finding: sub_group_size is in DEAD_KEYS (accepted-but-unconsumed
+    # ledger) — reading it means the declaration went stale
+    return zero_cfg.sub_group_size
+
+
+def dead_key_name_on_non_config(comm):
+    # ok: the base is not config-shaped — a collective helper sharing a
+    # dead key's NAME (comm.reduce_scatter) is out of scope
+    return comm.reduce_scatter

@@ -136,7 +136,42 @@ class TestConfigKeys:
             "fx_config_keys.py", "config-key",
             extra_paths=(os.path.join(PKG, "runtime", "config.py"),))
         anchors = sorted(f.anchor for f in fs)
-        assert anchors == ["key/trian_batch_size", "key/zero_optimizations"]
+        assert anchors == ["deadkey/sub_group_size", "key/trian_batch_size",
+                           "key/zero_optimizations"]
+
+    def test_overlap_bucket_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for the overlap scheduler (ISSUE 8): the three
+        # reference bucket keys were un-ignored — they must stay OUT of
+        # the dead-key ledger and stay actually consumed somewhere in the
+        # package (a future refactor that drops the read without
+        # re-declaring the key would silently turn them decorative again)
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        bucket_keys = {"reduce_bucket_size", "allgather_bucket_size",
+                       "stage3_prefetch_bucket_size"}
+        assert not bucket_keys & set(DEAD_KEYS), (
+            "overlap bucket keys re-declared dead — the scheduler "
+            "consumes them (parallel/overlap.py)")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, bucket_keys)
+        assert consumed == bucket_keys, (
+            f"bucket keys no longer consumed: {bucket_keys - consumed}")
+
+    def test_dead_key_ledger_entries_are_actually_dead(self):
+        # every DEAD_KEYS entry must be honest: not read as a config attr
+        # anywhere in the package (the rule flags per-site; this pins the
+        # aggregate so a stale entry can't hide behind a suppression)
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, set(DEAD_KEYS))
+        assert not consumed, f"DEAD_KEYS entries consumed: {consumed}"
 
 
 class TestMetricNames:

@@ -195,12 +195,6 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10 ** 9,
     }
-    if os.environ.get("BENCH_OVERLAP", "1") == "0":
-        # A/B switch for the bucketed overlap scheduler (README "Overlap
-        # scheduler", docs/tutorials/overlap.md): the bucketed step is
-        # numerics-identical, so two runs differing only in this knob
-        # isolate the scheduler's wall-clock effect for bench-diff
-        config["zero_optimization"]["overlap_comm"] = False
     if precision == "bf16":
         config["bf16"] = {"enabled": True}
     elif precision == "fp16":
@@ -209,6 +203,33 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     # mfu field stays the MFU source of record
     config["telemetry"] = _telemetry_section()
     config.update(config_extra or {})
+    if os.environ.get("BENCH_OVERLAP", "1") == "0":
+        # A/B switch for the bucketed overlap scheduler (README "Overlap
+        # scheduler", docs/tutorials/overlap.md): the bucketed step is
+        # numerics-identical, so two runs differing only in this knob
+        # isolate the scheduler's wall-clock effect for bench-diff.
+        # Applied AFTER config_extra — a row whose extra replaces the
+        # zero_optimization section (the qgz row) must still honor the A/B
+        config["zero_optimization"]["overlap_comm"] = False
+    wire = os.environ.get("BENCH_WIRE", "").lower()
+    if wire in ("exact", "qgz"):
+        # A/B switch for the quantized wire (mirrors BENCH_OVERLAP; README
+        # "Quantized wire", docs/tutorials/zeropp.md): BENCH_WIRE=exact
+        # strips the ZeRO++ flags from every row, BENCH_WIRE=qgz forces
+        # the full trio+LoCo on — two runs differing only in this knob
+        # isolate the wire format's wall-clock/byte effect for bench-diff
+        # (applied AFTER config_extra so the qgz row itself A/Bs too)
+        zero_section = config["zero_optimization"]
+        if wire == "exact":
+            for key in ("zero_quantized_weights", "zero_quantized_gradients",
+                        "loco_error_feedback"):
+                zero_section[key] = False
+        else:
+            zero_section.update(zero_quantized_weights=True,
+                                zero_quantized_gradients=True,
+                                loco_error_feedback=True)
+    elif wire:
+        raise ValueError(f"BENCH_WIRE must be exact|qgz, got {wire!r}")
     engine, *_ = dst.initialize(model=spec, config=config)
     cfg = PRESETS[model]
     data = synthetic_lm_data(batch * n_chips, seq_len, cfg.vocab_size, seed=0)
@@ -888,6 +909,35 @@ def llama_3b_bench():
              "degenerate")
 
 
+def qgz_llama_bench():
+    """The quantized-wire measured row NEXT TO the exact llama row: the
+    composed ZeRO++ pipeline (qgZ int8 gradient reduce-scatter + qwZ int8
+    param gathers + LoCo error feedback, bucketed/chunked by the overlap
+    scheduler) on the same llama-750m shape as ``zero3_llama_750m_bf16``.
+    Its ``comms`` block carries the int8 wire bytes — ``bench-diff``
+    prices the reduction lower-is-better against the exact row's.
+
+    At world=1 the dp-manual axes are degenerate and the engine would
+    silently fall back to exact collectives — a row LABELED qgz must not
+    measure the exact wire, so it skips explicitly there (the CPU tier);
+    on a mesh it measures. ``BENCH_WIRE=exact`` A/Bs this row too."""
+    import jax
+
+    if jax.device_count() < 2:
+        return {"skipped": "qgZ wire needs dp world > 1 (a single chip "
+                           "would silently measure exact collectives under "
+                           "a qgz label); run on a mesh"}
+    return train_bench(
+        "llama_750m", zero_stage=2, precision="bf16",
+        batch=4, seq_len=2048, gas=4, steps=4, windows=2,
+        config_extra={"zero_optimization": {
+            "stage": 2, "zero_quantized_weights": True,
+            "zero_quantized_gradients": True, "loco_error_feedback": True}},
+        note="composed quantized wire: qgZ+qwZ+LoCo under the bucketed "
+             "overlap scheduler (ISSUE 10); diff comms.* against "
+             "zero3_llama_750m_bf16 for the wire-byte reduction")
+
+
 # (name, fn, cap_s, floor_s) in PRIORITY order: when the remaining global
 # budget is below an entry's floor it is skipped with an explicit row. Caps
 # are worst-case guards (hung compile, wedged tunnel), not expectations.
@@ -925,6 +975,7 @@ SUITE_SCHEDULE = [
     ("zero3_llama_750m_bf16", lambda: train_bench(
         "llama_750m", zero_stage=3, precision="bf16",
         batch=4, seq_len=2048, gas=4, steps=4, windows=2), 300, 120),
+    ("zero2_qgz_llama_750m_bf16", qgz_llama_bench, 300, 120),
     ("autotp_inference_gpt2_generate", inference_bench, 240, 90),
     ("offload_param_memory", offload_param_memory_evidence, 240, 100),
     ("autotune_smoke", autotune_smoke, 300, 120),

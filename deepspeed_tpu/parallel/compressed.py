@@ -65,14 +65,20 @@ from deepspeed_tpu.ops.quantization import (  # noqa: F401  (re-export)
 # --------------------------------------------------------------------------- #
 
 def _q_allgather(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
-    """int8-wire all-gather of a local fp32/bf16 flat vector → [world, n]."""
-    n = flat.shape[0]
-    fp, _ = pad_to_block(flat.astype(jnp.float32), block)
-    q, s = quantize_int8(fp, block)
-    qg = lax.all_gather(q, axes, tiled=False)                   # [world, n_pad]
-    sg = lax.all_gather(s, axes, tiled=False)
-    rows = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qg, sg)
-    return rows[:, :n]
+    """int8-wire all-gather of a local fp32/bf16 flat vector → [world, n].
+
+    Traced under the ``qwz_wire`` name scope so the compiled collectives
+    carry the mark in ``metadata.op_name`` — the observatory ledger
+    attributes the int8 blocks AND their fp32 scale companions to
+    ``zero_param_gather`` instead of ``other``."""
+    with jax.named_scope("qwz_wire"):
+        n = flat.shape[0]
+        fp, _ = pad_to_block(flat.astype(jnp.float32), block)
+        q, s = quantize_int8(fp, block)
+        qg = lax.all_gather(q, axes, tiled=False)               # [world, n_pad]
+        sg = lax.all_gather(s, axes, tiled=False)
+        rows = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qg, sg)
+        return rows[:, :n]
 
 
 def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
@@ -82,19 +88,24 @@ def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
     the qgZ quant_reduce flow. ``return_sent`` additionally returns the
     locally-dequantized send rows [world, n] (what the wire actually
     carried — the LoCo error term needs it); ONE copy of the wire
-    protocol serves both the plain and error-compensated paths."""
-    n = rows.shape[1]
-    pad = (-n) % block
-    rp = jnp.pad(rows.astype(jnp.float32), ((0, 0), (0, pad)))
-    q, s = jax.vmap(lambda r: quantize_int8(r, block))(rp)      # [world, n_pad]
-    sent = None
-    if return_sent:
-        sent = jax.vmap(
-            lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)[:, :n]
-    qr = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
-    sr = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
-    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qr, sr)
-    mine = jnp.sum(deq, axis=0)[:n]
+    protocol serves both the plain and error-compensated paths.
+
+    Traced under the ``qgz_wire`` name scope (ledger attribution: the
+    int8 all-to-all and its scale companion price as
+    ``zero_grad_sync``, not ``other``)."""
+    with jax.named_scope("qgz_wire"):
+        n = rows.shape[1]
+        pad = (-n) % block
+        rp = jnp.pad(rows.astype(jnp.float32), ((0, 0), (0, pad)))
+        q, s = jax.vmap(lambda r: quantize_int8(r, block))(rp)  # [world, n_pad]
+        sent = None
+        if return_sent:
+            sent = jax.vmap(
+                lambda qq, ss: dequantize_int8(qq, ss, block))(q, s)[:, :n]
+        qr = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+        sr = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
+        deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, block))(qr, sr)
+        mine = jnp.sum(deq, axis=0)[:n]
     if return_sent:
         return mine, sent
     return mine
@@ -103,8 +114,11 @@ def _q_reduce_scatter(rows: jax.Array, axes: AxesT, world: int,
 def _q_allreduce(flat: jax.Array, axes: AxesT, block: int) -> jax.Array:
     """int8-wire allreduce (sum): quantized all-gather + local dequant-sum.
     The hpZ trio's second hop — replica axes the parameter is NOT sharded
-    over still contribute gradients."""
-    return jnp.sum(_q_allgather(flat, axes, block), axis=0)
+    over still contribute gradients. The outer ``qgz_wire`` scope wins
+    attribution over the inner gather's ``qwz_wire`` (this hop moves
+    GRADIENTS)."""
+    with jax.named_scope("qgz_wire"):
+        return jnp.sum(_q_allgather(flat, axes, block), axis=0)
 
 
 def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
@@ -150,15 +164,20 @@ def gather_with_compressed_vjp(dim: Optional[int], axes: AxesT, world: int,
 
     @jax.custom_vjp
     def gather(x_local):
-        m = jnp.moveaxis(x_local, dim, 0)
-        flat = m.reshape(-1)
-        if quant_weights:
-            rows = _q_allgather(flat, gather_axes, block)       # [gworld, n]
-        else:
-            rows = lax.all_gather(flat.astype(out_dtype), gather_axes,
-                                  tiled=False)
-        full_m = rows.reshape((gather_world * m.shape[0],) + m.shape[1:])
-        return jnp.moveaxis(full_m, 0, dim).astype(out_dtype)
+        # named scopes feed the observatory ledger's attribution: the
+        # quantized branch marks qwz_wire (int8 blocks + scale
+        # companions), the exact branch zpp_gather — either way this IS
+        # the ZeRO parameter gather, not partitioner resharding
+        with jax.named_scope("qwz_wire" if quant_weights else "zpp_gather"):
+            m = jnp.moveaxis(x_local, dim, 0)
+            flat = m.reshape(-1)
+            if quant_weights:
+                rows = _q_allgather(flat, gather_axes, block)   # [gworld, n]
+            else:
+                rows = lax.all_gather(flat.astype(out_dtype), gather_axes,
+                                      tiled=False)
+            full_m = rows.reshape((gather_world * m.shape[0],) + m.shape[1:])
+            return jnp.moveaxis(full_m, 0, dim).astype(out_dtype)
 
     def gather_fwd(x_local):
         return gather(x_local), x_local
@@ -206,15 +225,11 @@ def loco_reduce_leaf(g: jax.Array, err: jax.Array, spec: P,
     the subgroup hop carries the feedback and the replica-axis hop is an
     exact psum (one error buffer compensates one quantizer).
     """
-    dim = sharded_dim(spec, manual_axes)
+    dim, gaxes, gworld, replica_axes = _leaf_wire_plan(
+        spec, manual_axes, axis_sizes)
     if dim is None:
         red = lax.psum(g.astype(jnp.float32), manual_axes) / world
         return red.astype(g.dtype), jnp.zeros_like(err)
-    gaxes = leaf_gather_axes(spec, dim, manual_axes)
-    gworld = 1
-    for a in gaxes:
-        gworld *= axis_sizes.get(a, 1)
-    replica_axes = tuple(a for a in manual_axes if a not in gaxes)
 
     m = jnp.moveaxis(g, dim, 0).astype(jnp.float32)
     rows = m.reshape(gworld, -1)                          # [gw, n_loc]
@@ -235,19 +250,141 @@ def loco_reduce_tree(gfull_tree: PyTree, err_tree: PyTree,
                      spec_tree: PyTree, manual_axes: AxesT, world: int,
                      axis_sizes: dict, block: int = DEFAULT_BLOCK
                      ) -> Tuple[PyTree, PyTree]:
-    """Tree-level :func:`loco_reduce_leaf`. Returns (shard grads, new err)."""
-    # map over spec_tree first: P is a tuple subclass, so it must be the
-    # structure-defining tree with an explicit is_leaf
-    pairs = jax.tree.map(
-        lambda spec, g, e: loco_reduce_leaf(g, e, spec, manual_axes, world,
-                                            axis_sizes, block),
-        spec_tree, gfull_tree, err_tree,
-        is_leaf=lambda x: isinstance(x, P))
-    grads = jax.tree.map(lambda p: p[0], pairs,
-                         is_leaf=lambda x: isinstance(x, tuple))
-    errs = jax.tree.map(lambda p: p[1], pairs,
-                        is_leaf=lambda x: isinstance(x, tuple))
-    return grads, errs
+    """Tree-level :func:`loco_reduce_leaf` (unbucketed). ONE copy of the
+    semantics: delegates to :func:`reduce_tree_bucketed` with no bucket
+    bound. Returns (shard grads, new err)."""
+    return reduce_tree_bucketed(gfull_tree, spec_tree, manual_axes, world,
+                                axis_sizes, bucket_elems=None,
+                                err_tree=err_tree, block=block)
+
+
+# --------------------------------------------------------------------------- #
+# bucket/chunk-sliced wire entry points (compose with parallel/overlap.py)
+# --------------------------------------------------------------------------- #
+def _leaf_wire_plan(spec: P, manual_axes: AxesT, axis_sizes: dict
+                    ) -> Tuple[Optional[int], AxesT, int, AxesT]:
+    """ONE copy of the per-leaf wire routing math: → (sharded dim,
+    gather/reduce subgroup axes, subgroup world, replica axes). hpZ: a
+    leaf sharded over a 'zshard' subgroup reduces over that subgroup and
+    then hops the 'data' replicas."""
+    dim = sharded_dim(spec, manual_axes)
+    if dim is None:
+        return None, manual_axes, 1, ()
+    gaxes = leaf_gather_axes(spec, dim, manual_axes)
+    gworld = 1
+    for a in gaxes:
+        gworld *= axis_sizes.get(a, 1)
+    replica_axes = tuple(a for a in manual_axes if a not in gaxes)
+    return dim, gaxes, gworld, replica_axes
+
+
+def q_reduce_leaf(g: jax.Array, spec: P, manual_axes: AxesT, world: int,
+                  axis_sizes: dict, block: int = DEFAULT_BLOCK,
+                  quant_grads: bool = True) -> jax.Array:
+    """Gradient reduce for one FULL (unreduced) gradient leaf →
+    my MEAN-reduced local shard.
+
+    The same wire math the straight-through vjp emits
+    (:func:`gather_with_compressed_vjp`'s backward), callable OUTSIDE
+    autodiff so the bucketed step builder can group leaves into
+    ``reduce_bucket_size``-bounded fenced buckets. ``quant_grads``
+    selects the int8 qgZ wire vs the exact reduce-scatter — a
+    qwZ-only step buckets EXACT gradient reduces, mirroring the
+    straight-through path's ``quant_grads=False`` branch. Replicated
+    leaves reduce exactly (too small to quantize); under hpZ the
+    subgroup hop is the (int8 or exact) reduce-scatter and the replica
+    hop the matching allreduce — identical to the straight-through
+    path, so the two formulations agree to quantization-free
+    reassociation."""
+    dim, gaxes, gworld, replica_axes = _leaf_wire_plan(
+        spec, manual_axes, axis_sizes)
+    if dim is None:
+        red = lax.psum(g.astype(jnp.float32), manual_axes) / world
+        return red.astype(g.dtype)
+    m = jnp.moveaxis(g, dim, 0).astype(jnp.float32)
+    rows = m.reshape(gworld, -1)                          # [gw, n_loc]
+    if quant_grads:
+        mine = _q_reduce_scatter(rows, gaxes, gworld, block)
+        if replica_axes:
+            mine = _q_allreduce(mine, replica_axes, block)
+    else:
+        mine = lax.psum_scatter(rows, gaxes, scatter_dimension=0,
+                                tiled=False)
+        if replica_axes:
+            mine = lax.psum(mine, replica_axes)
+    mine = mine / world
+    m_shape = (g.shape[dim] // gworld,) + tuple(
+        s_ for i, s_ in enumerate(g.shape) if i != dim)
+    dx = jnp.moveaxis(mine.reshape(m_shape), 0, dim)
+    return dx.astype(g.dtype)
+
+
+def reduce_tree_bucketed(gfull_tree: PyTree, spec_tree: PyTree,
+                         manual_axes: AxesT, world: int, axis_sizes: dict,
+                         bucket_elems: Optional[int] = None,
+                         err_tree: Optional[PyTree] = None,
+                         block: int = DEFAULT_BLOCK,
+                         quant_grads: bool = True
+                         ) -> Tuple[PyTree, Optional[PyTree]]:
+    """Bucketed wire gradient reduce: THE composed qgZ×overlap entry point.
+
+    Leaves of the full-gradient tree are grouped into
+    ``bucket_elems``-bounded buckets (element counts, reversed-flatten
+    order — the same plan :func:`overlap.plan_buckets` gives the exact
+    step) and reduced bucket-by-bucket behind chained
+    ``optimization_barrier`` fences, so the int8 wire collectives stay
+    size-bounded and ordered in the lowered program exactly like the
+    exact path's sharding constraints. ``bucket_elems=None`` skips the
+    fences (the pre-overlap per-leaf semantics, one tree.map).
+
+    ``quant_grads=False`` buckets EXACT reduces (the qwZ-only step:
+    quantized weights, exact gradients — the flag mirrors the
+    straight-through path's). ``err_tree`` switches every SHARDED leaf
+    to the LoCo error-compensated reduce (which implies the quantized
+    wire — the engine only arms LoCo on an active qgZ path). Residuals
+    stay keyed PER LEAF (the bucket
+    plan only orders the sends), so re-bucketing — a different
+    ``reduce_bucket_size``, or toggling ``overlap_comm`` — never
+    relayouts LoCo state: a checkpointed ``loco_err`` tree resumes
+    exactly under any bucket plan. Returns ``(shard_grads, new_err)``
+    (``new_err=None`` without LoCo)."""
+    from deepspeed_tpu.parallel.overlap import (
+        fenced_bucket_apply,
+        leaf_count,
+        plan_buckets,
+    )
+
+    loco = err_tree is not None
+    g_leaves, treedef = jax.tree.flatten(gfull_tree)
+    spec_leaves = [s for s in jax.tree.leaves(
+        spec_tree, is_leaf=lambda x: isinstance(x, P))]
+    if loco:
+        err_leaves = jax.tree.leaves(err_tree)
+        items = list(zip(g_leaves, err_leaves))
+    else:
+        items = g_leaves
+
+    def leaf_fn(spec):
+        if loco:
+            return lambda ge, s=spec: loco_reduce_leaf(
+                ge[0], ge[1], s, manual_axes, world, axis_sizes, block)
+        return lambda g, s=spec: q_reduce_leaf(
+            g, s, manual_axes, world, axis_sizes, block,
+            quant_grads=quant_grads)
+
+    fns = [leaf_fn(s) for s in spec_leaves]
+    if bucket_elems:
+        sizes = [leaf_count(g.shape) for g in g_leaves]
+        buckets = plan_buckets(sizes, bucket_elems)
+        outs = fenced_bucket_apply(items, buckets, fns,
+                                   n_outputs=2 if loco else 1)
+    else:
+        outs = [fn(item) for fn, item in zip(fns, items)]
+    if loco:
+        grads = treedef.unflatten([o[0] for o in outs])
+        errs = treedef.unflatten([o[1] for o in outs])
+        return grads, errs
+    return treedef.unflatten(list(outs)), None
 
 
 def manual_spec(spec: P, manual_axes: AxesT) -> P:
@@ -286,6 +423,27 @@ def leaf_gather_axes(spec: P, dim: Optional[int], manual_axes: AxesT
     return tuple(a for a in manual_axes if a in names)
 
 
+def leaf_gather_fn(spec: P, manual_axes: AxesT, world: int, out_dtype,
+                   quant_weights: bool, quant_grads: bool,
+                   block: int = DEFAULT_BLOCK,
+                   axis_sizes: Optional[dict] = None):
+    """Per-leaf gather builder (the ONE copy both the whole-tree and the
+    chunk-sliced gathers use). ``axis_sizes`` enables the hpZ subgroup
+    math; omitted → the leaf gathers over all ``manual_axes``
+    (documented pre-hpZ fallback)."""
+    dim = sharded_dim(spec, manual_axes)
+    if axis_sizes is not None and dim is not None:
+        gaxes = leaf_gather_axes(spec, dim, manual_axes)
+        gworld = 1
+        for a in gaxes:
+            gworld *= axis_sizes.get(a, 1)
+    else:
+        gaxes, gworld = manual_axes, world
+    return gather_with_compressed_vjp(
+        dim, manual_axes, world, out_dtype, quant_weights, quant_grads,
+        block, gather_axes=gaxes, gather_world=gworld)
+
+
 def gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
                    out_dtype, quant_weights: bool, quant_grads: bool,
                    block: int = DEFAULT_BLOCK,
@@ -294,26 +452,124 @@ def gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
     compressed VJP per leaf. Returns f(master_local_tree) for use inside
     shard_map. ``axis_sizes`` (mesh axis → size) enables the hpZ subgroup
     math; omitted → every leaf gathers over all ``manual_axes``."""
-    def build(spec):
-        dim = sharded_dim(spec, manual_axes)
-        if axis_sizes is not None and dim is not None:
-            gaxes = leaf_gather_axes(spec, dim, manual_axes)
-            gworld = 1
-            for a in gaxes:
-                gworld *= axis_sizes.get(a, 1)
-        else:
-            # documented fallback: without axis sizes the subgroup math is
-            # impossible — gather over ALL manual axes (pre-hpZ behavior)
-            gaxes, gworld = manual_axes, world
-        return gather_with_compressed_vjp(
-            dim, manual_axes, world, out_dtype, quant_weights, quant_grads,
-            block, gather_axes=gaxes, gather_world=gworld)
-
-    gathers = jax.tree.map(build, spec_tree,
-                           is_leaf=lambda x: isinstance(x, P))
+    gathers = jax.tree.map(
+        lambda spec: leaf_gather_fn(spec, manual_axes, world, out_dtype,
+                                    quant_weights, quant_grads, block,
+                                    axis_sizes),
+        spec_tree, is_leaf=lambda x: isinstance(x, P))
 
     def gather_tree(master_local):
         return jax.tree.map(lambda fn, x: fn(x), gathers, master_local,
                             is_leaf=lambda x: callable(x) and not isinstance(x, jax.Array))
+
+    return gather_tree
+
+
+def chunked_gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
+                           out_dtype, quant_weights: bool,
+                           chunk_bounds: Sequence[Tuple[int, int]],
+                           block: int = DEFAULT_BLOCK,
+                           axis_sizes: Optional[dict] = None,
+                           blocks_key: str = "blocks"):
+    """Chunk-ahead (qwZ) parameter gather over the layer-chunk plan.
+
+    Like :func:`gather_tree_fn`, but the stacked ``blocks`` subtree is
+    gathered chunk by chunk along its stacking dim per ``chunk_bounds``
+    (the overlap scheduler's ZeRO-3 prefetch granularity,
+    ``overlap.chunk_layers``), with the work groups fenced in issue
+    order through ``overlap.fenced_bucket_apply``: first a head group
+    (every non-``blocks`` leaf, plus any blocks leaf ZeRO-sharded ON the
+    stacking dim — slicing its local dim 0 would tear the shard
+    layout), then chunk 0..k-1. Consecutive chunks are chained by the
+    fence token only, so chunk k+1's gather is independent of chunk k's
+    COMPUTE — with the model's chunked layer scan consuming exactly one
+    chunk's slice at a time, XLA's latency-hiding scheduler can start
+    the next chunk's (int8 when ``quant_weights``) all-gather under the
+    current chunk's forward: the double-buffered prefetch, on the
+    quantized wire. hpZ subgroup gathers ride the same plan — each
+    leaf's gather axes come from its own spec.
+
+    Built for the full-gradient (reduce-outside-vjp) formulation: the
+    gather vjps are unused, gradients travel through
+    :func:`reduce_tree_bucketed`. Chunk outputs are re-concatenated so
+    the returned tree is exactly the :func:`gather_tree_fn` result.
+    """
+    bounds = [tuple(b) for b in (chunk_bounds or [])]
+    plain = gather_tree_fn(spec_tree, manual_axes, world, out_dtype,
+                           quant_weights, False, block, axis_sizes)
+    if len(bounds) <= 1 or not isinstance(spec_tree, dict) \
+            or blocks_key not in spec_tree:
+        return plain
+
+    from deepspeed_tpu.parallel.overlap import fenced_bucket_apply
+
+    is_spec = lambda x: isinstance(x, P)                       # noqa: E731
+    head_specs = {k: v for k, v in spec_tree.items() if k != blocks_key}
+    blk_specs, blk_treedef = jax.tree.flatten(
+        spec_tree[blocks_key], is_leaf=is_spec)
+    # a blocks leaf whose ZeRO-sharded dim IS the stacking dim gathers
+    # whole in the head group; everything else is chunkable
+    chunkable = [sharded_dim(s, manual_axes) != 0 for s in blk_specs]
+
+    def fn_for(spec):
+        g = leaf_gather_fn(spec, manual_axes, world, out_dtype,
+                           quant_weights, False, block, axis_sizes)
+        return lambda x, g=g: g(x)
+
+    head_fns = jax.tree.map(fn_for, head_specs, is_leaf=is_spec)
+    blk_fns = [fn_for(s) for s in blk_specs]
+
+    def gather_tree(master_local):
+        head_vals = {k: v for k, v in master_local.items()
+                     if k != blocks_key}
+        blk_vals = blk_treedef.flatten_up_to(master_local[blocks_key])
+        leaves, fns, buckets = [], [], []
+        head_bucket = []
+        for fn, val in zip(jax.tree.leaves(
+                head_fns, is_leaf=callable),
+                jax.tree.leaves(head_vals)):
+            head_bucket.append(len(leaves))
+            leaves.append(val)
+            fns.append(fn)
+        whole_idx = {}
+        for j, (ok, fn, val) in enumerate(zip(chunkable, blk_fns,
+                                              blk_vals)):
+            if not ok:
+                whole_idx[j] = len(leaves)
+                head_bucket.append(len(leaves))
+                leaves.append(val)
+                fns.append(fn)
+        if head_bucket:
+            buckets.append(head_bucket)
+        chunk_idx: dict = {}
+        for c, (start, stop) in enumerate(bounds):
+            bucket = []
+            for j, (ok, fn, val) in enumerate(zip(chunkable, blk_fns,
+                                                  blk_vals)):
+                if not ok:
+                    continue
+                chunk_idx[(c, j)] = len(leaves)
+                bucket.append(len(leaves))
+                leaves.append(val[start:stop])
+                fns.append(fn)
+            if bucket:
+                buckets.append(bucket)
+        out = fenced_bucket_apply(leaves, buckets, fns)
+        # reassemble: head dict + per-leaf chunk concat along dim 0
+        n_head = len(jax.tree.leaves(head_vals))
+        head_flat = out[:n_head]
+        head_tree = jax.tree.unflatten(
+            jax.tree.structure(head_vals), head_flat)
+        blk_out = []
+        for j in range(len(blk_vals)):
+            if not chunkable[j]:
+                blk_out.append(out[whole_idx[j]])
+            else:
+                blk_out.append(jnp.concatenate(
+                    [out[chunk_idx[(c, j)]] for c in range(len(bounds))],
+                    axis=0))
+        full = dict(head_tree)
+        full[blocks_key] = blk_treedef.unflatten(blk_out)
+        return full
 
     return gather_tree

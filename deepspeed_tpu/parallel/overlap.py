@@ -174,7 +174,8 @@ def even_chunk_bounds(num_items: int, n_chunks: int) -> List[Tuple[int, int]]:
 # --------------------------------------------------------------------- #
 def fenced_bucket_apply(leaves: Sequence[Any],
                         buckets: Sequence[Sequence[int]],
-                        fns: Sequence[Callable[[Any], Any]]) -> List[Any]:
+                        fns: Sequence[Callable[[Any], Any]],
+                        n_outputs: int = 1) -> List[Any]:
     """Apply ``fns[i](leaves[i])`` grouped and ordered by ``buckets``.
 
     Each bucket's outputs pass through one ``lax.optimization_barrier``
@@ -185,23 +186,40 @@ def fenced_bucket_apply(leaves: Sequence[Any],
     size-bounded collectives survive into the HLO where the async pass
     can pipeline them. Values are returned in the ORIGINAL leaf order,
     bit-identical to the unfenced ``fns[i](leaves[i])``.
+
+    ``n_outputs`` makes the fence wire-format-aware: a wire-compressed
+    reduce returns more than one array per leaf (the LoCo
+    error-feedback path returns ``(shard_grad, new_residual)``), and
+    EVERY output must ride the same barrier — a residual left outside
+    the fence would let XLA sink its quantize back across the bucket
+    boundary. With ``n_outputs > 1`` each ``fns[i]`` returns a tuple of
+    that arity and the returned list holds those tuples, original leaf
+    order; ``n_outputs=1`` keeps the plain-array contract.
     """
     import jax
 
     out: List[Any] = list(leaves)
     token = None
     for bucket in buckets:
-        constrained = [fns[i](leaves[i]) for i in bucket]
+        results = [fns[i](leaves[i]) for i in bucket]
+        if n_outputs == 1:
+            flat = list(results)
+        else:
+            flat = [part for res in results for part in res]
         # EVERY bucket passes through a barrier — including the first:
         # an unfenced bucket's leaves carry no ordering edge, so the
         # collective combiner could re-fuse them with the next bucket's
         # ops past the size bound
-        group = tuple(constrained) + ((token,) if token is not None else ())
+        group = tuple(flat) + ((token,) if token is not None else ())
         fenced = jax.lax.optimization_barrier(group)
-        constrained = list(fenced[:len(bucket)])
+        fenced_flat = list(fenced[:len(flat)])
         for pos, i in enumerate(bucket):
-            out[i] = constrained[pos]
-        token = constrained[0]
+            if n_outputs == 1:
+                out[i] = fenced_flat[pos]
+            else:
+                out[i] = tuple(
+                    fenced_flat[pos * n_outputs:(pos + 1) * n_outputs])
+        token = fenced_flat[0]
     return out
 
 
@@ -230,6 +248,24 @@ def make_grad_sync(constrain_fn: Callable[[PyTree], PyTree]
 
     sync.defvjp(fwd, bwd)
     return sync
+
+
+def manual_chunk_sync() -> Callable[[PyTree], PyTree]:
+    """Wire-format-aware chunk sync point for shard_map-MANUAL steps.
+
+    The exact (GSPMD) step's chunk sync constrains the cotangent to its
+    ZeRO gradient sharding — but inside a shard_map manual region named
+    sharding constraints don't exist, so the wire-compressed step's
+    mid-backward sync point is a pure ordering fence instead:
+    ``lax.optimization_barrier`` on the chunk's cotangent pins the chunk
+    boundary in the lowered backward (XLA cannot re-fuse one chunk's
+    gradient math into the next), keeping the backward chunk-aligned for
+    the bucketed quantized reduce that follows. Numerically the
+    identity, like every transform in this module.
+    """
+    import jax
+
+    return make_grad_sync(lambda ct: jax.lax.optimization_barrier(ct))
 
 
 def leaf_count(shape: Sequence[int]) -> int:

@@ -12,9 +12,18 @@ The subsystem rules are substring heuristics over that path plus the
 engine's ZeRO stage — documented, testable, and honest about being
 heuristics (anything unmatched lands in ``"other"``, never dropped):
 
+* quantized wire (checked FIRST — most specific): the ZeRO++ wire
+  kernels trace under ``qgz_wire`` / ``qwz_wire`` name scopes
+  (``parallel/compressed.py``; the wire step's exact-branch parameter
+  gather marks ``zpp_gather``), so the int8 blocks AND their fp32
+  scale companions attribute to ``zero_grad_sync`` /
+  ``zero_param_gather``; an int8 (s8/u8) payload without the scope
+  still routes by dtype — all-to-all/reduce-scatter →
+  ``zero_grad_sync``, all-gather → ``zero_param_gather`` (nothing else
+  in the step moves int8);
 * ``moe_dispatch`` — path mentions moe/expert/router/dispatch/combine
-  (an all-to-all WITHOUT those marks is partitioner resharding or a
-  compressed-wire transport → ``other``);
+  (an all-to-all WITHOUT those marks and not on the quantized wire is
+  partitioner resharding → ``other``);
 * ``pipeline_handoff`` — collective-permute, or path mentions
   ppermute/pipeline;
 * ``zero_grad_sync`` — reduce-scatter / all-reduce on the backward path
@@ -45,6 +54,14 @@ SUBSYSTEMS = ("zero_grad_sync", "zero_param_gather", "moe_dispatch",
 _MOE_MARKS = ("moe", "expert", "router", "dispatch", "combine")
 _PIPE_MARKS = ("ppermute", "pipeline", "pipe_stage")
 _BWD_MARKS = ("transpose(", "/vjp", "backward", "grad")
+#: the ZeRO++ wire kernels' name scopes (parallel/compressed.py) — the
+#: deliberate attribution channel for the quantized transport, covering
+#: the fp32 scale companions dtype sniffing would miss
+_WIRE_GRAD_MARK = "qgz_wire"
+#: qwz_wire = quantized parameter gather; zpp_gather = the wire step's
+#: exact-branch parameter gather (same collective, uncompressed wire)
+_WIRE_PARAM_MARKS = ("qwz_wire", "zpp_gather")
+_INT8_DTYPES = ("s8", "u8")
 
 
 def attribute_subsystem(op: CollectiveOp, zero_stage: int = 0) -> str:
@@ -52,17 +69,34 @@ def attribute_subsystem(op: CollectiveOp, zero_stage: int = 0) -> str:
     rule table). Pure function of the op + ZeRO stage so fixtures test it
     without an engine."""
     path = f"{op.op_name or ''} {op.source_file or ''}".lower()
+    # quantized wire first — most specific. The qgZ mark outranks qwZ
+    # (the hpZ replica hop reuses the quantized gather for GRADIENTS,
+    # under an outer qgz_wire scope).
+    if _WIRE_GRAD_MARK in path:
+        return "zero_grad_sync"
+    if any(m in path for m in _WIRE_PARAM_MARKS):
+        return "zero_param_gather"
     if any(m in path for m in _MOE_MARKS):
         return "moe_dispatch"
+    # dtype fallback only at stage >= 1, where qgZ/qwZ can be active —
+    # at stage 0 the only int8 mover is the 1-bit transport's packed-sign
+    # all-gather (no ZeRO partitioning to attribute to; honest "other")
+    wire_int8 = op.dtype in _INT8_DTYPES and zero_stage >= 1
     if op.kind == BW.ALL_TO_ALL:
-        # an all-to-all with no MoE mark is partitioner resharding (or a
-        # compressed-wire transport) — honest bucket is "other"
+        if wire_int8:
+            # nothing else in a ZeRO step moves int8: a scope-less s8
+            # all-to-all is the qgZ chunk exchange, not resharding
+            return "zero_grad_sync"
+        # an all-to-all with no MoE/wire mark is partitioner resharding —
+        # honest bucket is "other"
         return "other"
     if op.kind == BW.COLLECTIVE_PERMUTE or any(m in path for m in _PIPE_MARKS):
         return "pipeline_handoff"
     if op.kind in (BW.REDUCE_SCATTER, BW.ALL_REDUCE):
         return "zero_grad_sync"
     if op.kind == BW.ALL_GATHER:
+        if wire_int8:
+            return "zero_param_gather"       # qwZ int8 parameter blocks
         if zero_stage >= 3 or any(m in path for m in _BWD_MARKS):
             return "zero_param_gather"
     return "other"
@@ -306,15 +340,11 @@ def ledger_for_engine(engine, fold: bool = True,
         key = ("train_step", gas)
         fn = engine._compiled.get(key)
         if fn is None:
-            # mirror _dispatch_train_step's builder selection: the wire-
-            # compressed variants move different bytes — ledgering the
-            # plain step for them would report the reduction away
-            if getattr(engine, "_onebit_wire", None):
-                fn = engine._build_train_step_onebit(gas)
-            elif getattr(engine, "_compressed", None):
-                fn = engine._build_train_step_qz(gas)
-            else:
-                fn = engine._build_train_step(gas)
+            # the engine's ONE builder-selection point (wire format ×
+            # overlap compose inside it): the ledgered program is always
+            # the program _dispatch_train_step runs — ledgering the plain
+            # step for a wire variant would report the reduction away
+            fn = engine._select_step_builder(gas)
         batch = {"tokens": jnp.zeros((gas, mb, seq), jnp.int32)}
         with engine.mesh:
             hlo_text, costs, mem = _lower_compiled(fn, engine.state, batch)

@@ -172,6 +172,28 @@ class ZeroConfig:
                 raise DeepSpeedConfigError(
                     f"zero_optimization.{key} must be a positive int "
                     f"(elements), got {val!r}")
+        # the subgroup keys follow the same normalization contract but
+        # both have an OFF spelling the reference schema allows (hpZ:
+        # ge=0 — 0 and 1 both mean no secondary partition; MiCS: 0) —
+        # non-negative, never positive-only. Anything else raises loudly:
+        # a malformed subgroup silently degrading to exact full-world
+        # collectives is the config-no-op class of bug. The mesh-
+        # dependent half (must divide and fit the device world) lives in
+        # the engine, which knows the world.
+        for key in ("zero_hpz_partition_size", "mics_shard_size"):
+            val = getattr(self, key)
+            if val == "auto":
+                val = dataclasses.fields(type(self))
+                val = next(f.default for f in val if f.name == key)
+                setattr(self, key, val)
+            elif isinstance(val, float) and not isinstance(val, bool) \
+                    and float(val).is_integer():
+                val = int(val)
+                setattr(self, key, val)
+            if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key} must be a non-negative int "
+                    f"(ranks; 0 = off), got {val!r}")
 
 
 @dataclasses.dataclass

@@ -26,7 +26,6 @@ a sharded buffer, ``step`` applies the (jitted) update at the GAS boundary.
 """
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 import threading
@@ -85,12 +84,44 @@ class DeepSpeedTPUEngine:
         self.model_spec = model
         self.config: DeepSpeedTPUConfig = load_config(config)
         # MiCS / ZeRO++ hpZ: replica-group sharding resolves onto the 'zshard'
-        # mesh axis (shard within the subgroup, replicate across 'data')
+        # mesh axis (shard within the subgroup, replicate across 'data').
+        # zero_hpz_partition_size is VALIDATED like the bucket keys (PR 8):
+        # type/spelling normalization lives in ZeroConfig.validate(); the
+        # mesh-dependent checks — the subgroup must divide the device world
+        # and must not contradict an explicit mesh.zshard — are here, and
+        # they RAISE: a mis-sized subgroup silently degrading to exact
+        # full-world collectives is the config no-op class of bug
         zcfg = self.config.zero_optimization
         subgroup = zcfg.mics_shard_size or (
             zcfg.zero_hpz_partition_size if zcfg.zero_hpz_partition_size > 1 else 0)
-        if subgroup and self.config.mesh.zshard == 1:
+        if subgroup:
+            key = ("mics_shard_size" if zcfg.mics_shard_size
+                   else "zero_hpz_partition_size")
+            if self.config.mesh.zshard not in (1, subgroup):
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key}={subgroup} conflicts with "
+                    f"mesh.zshard={self.config.mesh.zshard} — the subgroup IS "
+                    "the 'zshard' axis; set one of them, or make them agree")
+            n_dev = jax.device_count()
+            if n_dev % subgroup != 0:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key}={subgroup} must divide the "
+                    f"device world ({n_dev} devices) — a non-dividing hpZ "
+                    "subgroup cannot form replica groups, and falling back "
+                    "to exact full-world collectives would silently drop "
+                    "the secondary partition")
             self.config.mesh.zshard = subgroup
+            try:
+                # dividing the raw device count is necessary, not
+                # sufficient: other fixed mesh axes (tensor/pipe/seq)
+                # consume devices too — resolve the full mesh NOW so a
+                # non-fitting subgroup names the config key instead of
+                # failing later with a generic mesh-shape error
+                self.config.mesh.to_mesh_config().resolve(n_dev)
+            except ValueError as e:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key}={subgroup} does not fit the "
+                    f"mesh: {e}") from None
         if not dist.is_initialized():
             dist.init_distributed(mesh_config=self.config.mesh.to_mesh_config())
         if mesh_manager is None:
@@ -469,24 +500,33 @@ class DeepSpeedTPUEngine:
         (element counts): ``reduce_bucket_size`` bounds gradient-sync
         buckets, ``stage3_prefetch_bucket_size`` (stage 3) /
         ``allgather_bucket_size`` (stages 1-2) bound the layer-chunk
-        parameter elements. Gated by ``overlap_comm`` at stage >= 1. The
-        wire-compressed step builders (qwZ/qgZ, 1-bit) keep their own
-        transport — they run shard_map-MANUAL over the dp axes, where
-        named sharding constraints don't apply — and stay unbucketed."""
-        from deepspeed_tpu.parallel.overlap import OverlapConfig, chunk_layers
+        parameter elements. Gated by ``overlap_comm`` at stage >= 1.
+
+        Wire format and overlap are ORTHOGONAL axes of the step-builder
+        pipeline (ISSUE 10): the qwZ/qgZ step composes — its chunk sync
+        point is the manual-region-safe ordering fence
+        (``overlap.manual_chunk_sync``; named sharding constraints don't
+        exist inside shard_map), its grad buckets fence the int8
+        reduces (``compressed.reduce_tree_bucketed``) and its ZeRO-3
+        chunk gathers follow the same chunk plan on the quantized wire
+        (``compressed.chunked_gather_tree_fn``). Only the 1-bit
+        transport stays outside the scheduler, structurally: it is a
+        stage-0 optimizer-side transport and the scheduler gates at
+        stage >= 1."""
+        from deepspeed_tpu.parallel.overlap import (
+            OverlapConfig,
+            chunk_layers,
+            manual_chunk_sync,
+        )
 
         self._overlap = OverlapConfig.from_zero_config(zcfg, self.zero_stage)
         self._overlap_plan: Dict[str, Any] = {
             "enabled": self._overlap.enabled, "scan_chunks": 1,
-            "chunk_bounds": [], "grad_sync_points": False}
+            "chunk_bounds": [], "grad_sync_points": False,
+            "wire_format": self._wire_format()}
         if not self._overlap.enabled:
             return
-        if self._compressed or self._onebit_wire:
-            self._overlap = dataclasses.replace(self._overlap, enabled=False)
-            self._overlap_plan["enabled"] = False
-            log_dist("overlap scheduler: wire-compressed step keeps its own "
-                     "transport — bucketed sync not applied")
-            return
+        wire = self._compressed is not None
         model = self.model_spec
         spec_cfg = getattr(model, "config", None)
         n_layers = getattr(spec_cfg, "num_layers", 0) or 0
@@ -507,8 +547,10 @@ class DeepSpeedTPUEngine:
         # mid-backward sync points need a sharded gradient layout to pin
         # (stage >= 2); at stage 1 the chunked scan alone supplies the
         # gather granularity
-        sync_fn = self._make_chunk_grad_sync() if (
-            can_chunk and self.zero_stage >= 2) else None
+        sync_fn = None
+        if can_chunk and self.zero_stage >= 2:
+            sync_fn = manual_chunk_sync() if wire \
+                else self._make_chunk_grad_sync()
         if can_chunk and (n_chunks > 1 or sync_fn is not None):
             self.model_spec = model.builder(scan_chunks=n_chunks,
                                             param_sync_fn=sync_fn)
@@ -516,6 +558,7 @@ class DeepSpeedTPUEngine:
                 scan_chunks=n_chunks, chunk_bounds=bounds,
                 grad_sync_points=sync_fn is not None)
             log_dist(f"overlap scheduler active: {n_chunks} layer chunk(s), "
+                     f"wire={self._overlap_plan['wire_format']}, "
                      f"grad sync {'per chunk mid-backward' if sync_fn else 'bucketed at step level'}, "
                      f"reduce_bucket={self._overlap.reduce_bucket_elems} "
                      f"prefetch_bucket={self._overlap.prefetch_bucket_elems}")
@@ -1413,24 +1456,81 @@ class DeepSpeedTPUEngine:
                        donate_argnums=donate)
 
     # ------------------------------------------------------------------ #
-    # compressed-collective step builders
+    # wire-format step builders (ZeRO++ qwZ/qgZ/LoCo, 1-bit transport)
     # ------------------------------------------------------------------ #
     def _manual_batch_spec(self, ndim: int) -> P:
         axes = self._dp_manual_axes
         row = axes if len(axes) > 1 else axes[0]
         return P(None, row, *([None] * (ndim - 2)))
 
-    def _build_train_step_loco(self, gas: int):
-        """qgZ with LoCo error feedback (reference
-        ``coalesced_collectives.py:81 all_to_all_loco_quant_reduce``).
+    def _wire_format(self) -> str:
+        """The resolved wire format of the fused step — one of ``exact``
+        / ``qz`` / ``qz+loco`` / ``onebit``. With the overlap scheduler
+        this is the OTHER axis of the step-builder pipeline; the single
+        source for builder selection (``_select_step_builder``) and the
+        overlap plan's ``wire_format`` field."""
+        if self._onebit_wire:
+            return "onebit"
+        if self._compressed:
+            return "qz+loco" if self._compressed.get("loco") else "qz"
+        return "exact"
 
-        The residual must persist across reduces, which the straight-
-        through-vjp formulation can't thread — so this step differentiates
-        w.r.t. the FULL gathered params (no collective inside autodiff)
-        and runs the wire reduce OUTSIDE the vjp, with the per-rank error
-        buffers carried through the micro scan and the engine state.
+    def _select_step_builder(self, gas: int):
+        """ONE selection point of the step-builder pipeline: wire format
+        × overlap compose inside each builder rather than forking here.
+        Mirrored by the observatory's ``ledger_for_engine`` so the
+        ledgered program is always the dispatched program."""
+        wire = self._wire_format()
+        if wire == "onebit":
+            return self._build_train_step_onebit(gas)
+        if wire != "exact":
+            return self._build_train_step_wire(gas)
+        return self._build_train_step(gas)
+
+    def _build_train_step_wire(self, gas: int):
+        """ZeRO++ wire-compressed step (qwZ/qgZ, optional LoCo).
+
+        Two formulations share ONE wire protocol
+        (``parallel/compressed.py``):
+
+        * **straight-through** — the param gather's ``custom_vjp`` emits
+          the per-leaf quantized reduce inside autodiff; lowest memory.
+          Used when neither LoCo nor the overlap scheduler needs the
+          reduce outside the vjp.
+        * **bucketed** — grads w.r.t. the FULL gathered params, reduce
+          outside the vjp through ``reduce_bucket_size``-bounded fenced
+          buckets; composes with the overlap scheduler and carries the
+          LoCo residuals.
+        """
+        if not self._compressed.get("loco") and not self._overlap.enabled:
+            return self._build_train_step_qz(gas)
+        return self._build_train_step_bucketed_wire(gas)
+
+    def _build_train_step_bucketed_wire(self, gas: int):
+        """The composed wire×overlap step (and the LoCo home; reference
+        ``coalesced_collectives.py:31/:81`` + the PR-8 scheduler).
+
+        Grads are taken w.r.t. the FULL gathered params (no collective
+        inside autodiff) and the wire reduce runs OUTSIDE the vjp — the
+        formulation LoCo already required (its residual must persist
+        across reduces), now also the seam where overlap composes:
+
+        * gradient leg: ``compressed.reduce_tree_bucketed`` — per-bucket
+          qgZ int8 reduce-scatter, LoCo residual slices riding the SAME
+          chained ``optimization_barrier`` fences as the exact path's
+          bucketed constraints (residuals stay keyed per leaf, so
+          re-bucketing never relayouts LoCo state);
+        * parameter leg: ``compressed.chunked_gather_tree_fn`` — the
+          qwZ all-gathers follow the layer-chunk plan one fence apart,
+          so the chunked scan's next chunk can gather (int8 when qwZ,
+          hpZ subgroups riding each leaf's spec) under the current
+          chunk's compute;
+        * mid-backward sync: the model spec was rebuilt with
+          ``overlap.manual_chunk_sync`` (ordering fence — named
+          constraints don't exist in a shard_map manual region).
+
         Memory: a transient full-gradient tree per rank (stage-2-like)
-        plus the fp32 residual buffers."""
+        plus the fp32 residual buffers when LoCo."""
         from jax import shard_map
 
         from deepspeed_tpu.parallel import compressed as C
@@ -1439,65 +1539,115 @@ class DeepSpeedTPUEngine:
         world = self._dp_manual_world
         dtype = jnp.dtype(self.precision)
         mode = self._compressed
+        loco = bool(mode.get("loco"))
         sizes = dict(self.mesh.shape)
-        gather_tree = C.gather_tree_fn(
-            self.master_spec, axes, world, dtype,
-            quant_weights=mode["quant_weights"], quant_grads=False,
-            axis_sizes=sizes)   # bwd unused: grads are taken w.r.t. FULL params
+        overlap_on = self._overlap.enabled
+        bucket_elems = self._overlap.reduce_bucket_elems if overlap_on \
+            else None
+        bounds = (self._overlap_plan.get("chunk_bounds") or []) \
+            if overlap_on else []
+        if len(bounds) > 1:
+            gather_tree = C.chunked_gather_tree_fn(
+                self.master_spec, axes, world, dtype,
+                quant_weights=mode["quant_weights"], chunk_bounds=bounds,
+                axis_sizes=sizes)
+        else:
+            gather_tree = C.gather_tree_fn(
+                self.master_spec, axes, world, dtype,
+                quant_weights=mode["quant_weights"], quant_grads=False,
+                axis_sizes=sizes)  # bwd unused: grads w.r.t. FULL params
         master_manual = jax.tree.map(
             lambda s: C.manual_spec(s, axes), self.master_spec,
             is_leaf=lambda x: isinstance(x, P))
         row = axes if len(axes) > 1 else axes[0]
 
-        acc_dt_loco = self._grad_accum_dtype()
+        acc_dt = self._grad_accum_dtype()
 
-        def local(master_local, err_local, batch_local, scale):
-            err0 = jax.tree.map(lambda e: e[0], err_local)   # drop world row
+        def core(master_local, err0, batch_local, scale):
             zeros = jax.tree.map(
-                lambda x: jnp.zeros(x.shape, acc_dt_loco), master_local)
-            # loop-invariant: ONE (possibly quantized) param gather per
-            # step, not per micro — its VJP is unused here
+                lambda x: jnp.zeros(x.shape, acc_dt), master_local)
+            # loop-invariant: ONE (possibly quantized, possibly chunk-
+            # fenced) param gather per step, not per micro
             params = gather_tree(master_local)
 
             def full_loss(pf, b):
                 return self.model_spec.loss_fn(pf, b) * scale
 
-            def micro(b, err):
-                loss, gfull = jax.value_and_grad(full_loss)(params, b)
-                gl, err = C.loco_reduce_tree(
-                    gfull, err, self.master_spec, axes, world, sizes)
-                return loss, gl, err
+            if loco:
+                def micro(b, err):
+                    loss, gfull = jax.value_and_grad(full_loss)(params, b)
+                    gl, err = C.reduce_tree_bucketed(
+                        gfull, self.master_spec, axes, world, sizes,
+                        bucket_elems=bucket_elems, err_tree=err)
+                    return loss, gl, err
 
-            grads_sum, losses_mean, err = self.accumulate_microbatches(
-                micro, zeros, batch_local, gas, extra0=err0)
+                grads_sum, losses_mean, err = self.accumulate_microbatches(
+                    micro, zeros, batch_local, gas, extra0=err0)
+            else:
+                def micro(b):
+                    loss, gfull = jax.value_and_grad(full_loss)(params, b)
+                    # quant_grads honored: a qwZ-only config buckets
+                    # EXACT gradient reduces, same as the straight-
+                    # through path's quant_grads=False backward
+                    gl, _ = C.reduce_tree_bucketed(
+                        gfull, self.master_spec, axes, world, sizes,
+                        bucket_elems=bucket_elems,
+                        quant_grads=mode["quant_grads"])
+                    return loss, gl
+
+                grads_sum, losses_mean = self.accumulate_microbatches(
+                    micro, zeros, batch_local, gas)
+                err = None
             mean_loss = jax.lax.pmean(losses_mean, axes) / scale
+            return grads_sum, err, mean_loss
+
+        def local_loco(master_local, err_local, batch_local, scale):
+            err0 = jax.tree.map(lambda e: e[0], err_local)   # drop world row
+            grads_sum, err, mean_loss = core(master_local, err0,
+                                             batch_local, scale)
             err_out = jax.tree.map(lambda e: e[None], err)
             return grads_sum, err_out, mean_loss
+
+        def local_plain(master_local, batch_local, scale):
+            grads_sum, _, mean_loss = core(master_local, None,
+                                           batch_local, scale)
+            return grads_sum, mean_loss
 
         def train_step(state, batch):
             scale = state["scaler"].scale if self.fp16_enabled \
                 else jnp.float32(1.0)
             b_specs = jax.tree.map(
                 lambda x: self._manual_batch_spec(x.ndim), batch)
-            err_specs = jax.tree.map(
-                lambda s: P(row, *([None] * len(s.shape))), self._shapes)
-            fn = shard_map(
-                local, mesh=self.mesh,
-                in_specs=(master_manual, err_specs, b_specs, P()),
-                out_specs=(master_manual, err_specs, P()),
-                axis_names=set(axes), check_vma=False)
-            grads_sum, new_err, mean_loss = fn(
-                state["master"], state["loco_err"], batch, scale)
+            if loco:
+                err_specs = jax.tree.map(
+                    lambda s: P(row, *([None] * len(s.shape))), self._shapes)
+                fn = shard_map(
+                    local_loco, mesh=self.mesh,
+                    in_specs=(master_manual, err_specs, b_specs, P()),
+                    out_specs=(master_manual, err_specs, P()),
+                    axis_names=set(axes), check_vma=False)
+                grads_sum, new_err, mean_loss = fn(
+                    state["master"], state["loco_err"], batch, scale)
+            else:
+                fn = shard_map(
+                    local_plain, mesh=self.mesh,
+                    in_specs=(master_manual, b_specs, P()),
+                    out_specs=(master_manual, P()),
+                    axis_names=set(axes), check_vma=False)
+                grads_sum, mean_loss = fn(state["master"], batch, scale)
+                new_err = None
             grad_scale = jnp.float32(gas) * scale
             new_state, metrics = self._apply_update(state, grads_sum,
                                                     grad_scale)
-            # fp16 overflow: _apply_update skips the weight update, and the
-            # residuals computed from inf/NaN gradients must not poison the
-            # persistent state — reset them so recovery matches plain qgZ
-            overflow = metrics["overflow"] > 0
-            new_state["loco_err"] = jax.tree.map(
-                lambda n: jnp.where(overflow, jnp.zeros_like(n), n),
-                new_err)
+            if loco:
+                # fp16 overflow: _apply_update skips the weight update, and
+                # the residuals computed from inf/NaN gradients must not
+                # poison the persistent state — reset them so recovery
+                # matches plain qgZ
+                overflow = metrics["overflow"] > 0
+                new_state["loco_err"] = jax.tree.map(
+                    lambda n: jnp.where(overflow, jnp.zeros_like(n), n),
+                    new_err)
             metrics["loss"] = mean_loss
             return new_state, metrics
 
@@ -1506,16 +1656,16 @@ class DeepSpeedTPUEngine:
                        donate_argnums=(0,))
 
     def _build_train_step_qz(self, gas: int):
-        """ZeRO++ qwZ/qgZ step: shard_map manual over the ZeRO axes; the
-        parameter all-gather (fwd) and gradient reduce-scatter (bwd) are one
-        straight-through primitive with an int8 wire format
-        (``parallel/compressed.py``)."""
+        """ZeRO++ qwZ/qgZ straight-through step: shard_map manual over the
+        ZeRO axes; the parameter all-gather (fwd) and gradient
+        reduce-scatter (bwd) are one straight-through primitive with an
+        int8 wire format (``parallel/compressed.py``). The overlap-
+        composed / LoCo variants route through
+        ``_build_train_step_bucketed_wire`` instead (see
+        ``_build_train_step_wire``)."""
         from jax import shard_map
 
         from deepspeed_tpu.parallel import compressed as C
-
-        if self._compressed.get("loco"):
-            return self._build_train_step_loco(gas)
 
         axes = self._dp_manual_axes
         world = self._dp_manual_world
@@ -1831,12 +1981,7 @@ class DeepSpeedTPUEngine:
         if self._host_runner is None:
             key = ("train_step", gas)
             if key not in self._compiled:
-                if self._onebit_wire:
-                    self._compiled[key] = self._build_train_step_onebit(gas)
-                elif self._compressed:
-                    self._compiled[key] = self._build_train_step_qz(gas)
-                else:
-                    self._compiled[key] = self._build_train_step(gas)
+                self._compiled[key] = self._select_step_builder(gas)
             step_fn = self._compiled[key]
 
         batch = self._shard_batch(stacked, leading=True)

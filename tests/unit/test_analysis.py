@@ -150,11 +150,14 @@ class TestConfigKeys:
             consumed_attr_keys,
         )
 
+        # zero_hpz_partition_size joined the validated-and-consumed set in
+        # ISSUE 10 (hpZ subgroup resolution + the quantized-wire pipeline)
         bucket_keys = {"reduce_bucket_size", "allgather_bucket_size",
-                       "stage3_prefetch_bucket_size"}
+                       "stage3_prefetch_bucket_size",
+                       "zero_hpz_partition_size"}
         assert not bucket_keys & set(DEAD_KEYS), (
-            "overlap bucket keys re-declared dead — the scheduler "
-            "consumes them (parallel/overlap.py)")
+            "overlap/hpZ keys re-declared dead — the scheduler/engine "
+            "consume them (parallel/overlap.py, runtime/engine.py)")
         proj, _ = dsl_core.load_project([PKG])
         consumed = consumed_attr_keys(proj, bucket_keys)
         assert consumed == bucket_keys, (

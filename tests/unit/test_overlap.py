@@ -300,12 +300,17 @@ class TestEngineGating:
         e = _engine(0, True)
         assert not e.overlap_plan()["enabled"]
 
-    def test_wire_compressed_step_stays_unbucketed(self):
-        # compose, don't conflict: qgZ keeps its shard_map transport
+    def test_wire_compressed_step_composes_with_overlap(self):
+        # ISSUE 10 flips PR 8's compose-exclusion: wire format and
+        # overlap are orthogonal axes of ONE step-builder pipeline — the
+        # qgZ step now buckets/chunks too (the deep pins live in
+        # tests/unit/test_wire_overlap.py)
         e = _engine(2, True, zero_quantized_gradients=True)
         assert e._compressed is not None
-        assert not e.overlap_plan()["enabled"]
-        # and the compressed step still trains
+        plan = e.overlap_plan()
+        assert plan["enabled"]
+        assert plan["wire_format"] == "qz"
+        # and the composed step still trains
         d = synthetic_lm_data(batch_size=8, seq_len=32,
                               vocab_size=512, seed=3)
         loss = float(jax.device_get(e.train_batch(d)))

@@ -20,6 +20,12 @@ Pass criteria (committed with the artifact):
 
 Usage: python tools/converge_lane.py [out.json]
 Env: CONVERGE_STEPS (default 1000), CONVERGE_EVAL_EVERY (100).
+     CONVERGE_WIRE=exact|qgz (default exact): ``qgz`` runs the COMPOSED
+     quantized-wire lane — ZeRO-2 + qgZ int8 gradient reduce + LoCo error
+     feedback under the bucketed overlap scheduler (ISSUE 10) — against
+     the SAME pass criteria, so wire compression proves convergence
+     parity on real text, not just synthetic-loss closeness. The lane
+     name is recorded in the artifact (``wire`` field).
 """
 import json
 import os
@@ -50,6 +56,11 @@ def main(out_path: str) -> int:
     steps = int(os.environ.get("CONVERGE_STEPS", 1000))
     eval_every = int(os.environ.get("CONVERGE_EVAL_EVERY", 100))
     eval_every = max(1, min(eval_every, steps))   # smoke runs: >= 1 window
+    wire = os.environ.get("CONVERGE_WIRE", "exact").lower()
+    if wire not in ("exact", "qgz"):
+        print(f"CONVERGE_WIRE must be exact|qgz, got {wire!r}",
+              file=sys.stderr)
+        return 2
 
     raw = open(os.path.join(REPO, "data", "real_text_corpus.txt"), "rb").read()
     toks = np.frombuffer(raw, np.uint8)
@@ -66,11 +77,24 @@ def main(out_path: str) -> int:
         "scheduler": {"type": "WarmupCosineLR",
                       "params": {"warmup_num_steps": 50,
                                  "total_num_steps": steps}},
-        "zero_optimization": {"stage": 1},
+        "zero_optimization": (
+            # the composed quantized-wire lane: qgZ int8 gradient reduce +
+            # LoCo residuals, bucketed/chunked by the overlap scheduler —
+            # same pass band as the exact lane (wire parity ON REAL TEXT)
+            {"stage": 2, "zero_quantized_gradients": True,
+             "loco_error_feedback": True, "overlap_comm": True}
+            if wire == "qgz" else {"stage": 1}),
         "bf16": {"enabled": True},
         "steps_per_print": 10 ** 9,
     }
     engine, *_ = dst.initialize(model=spec, config=config)
+    if wire == "qgz" and engine._compressed is None:
+        # a lane LABELED qgz must not silently measure exact collectives
+        # (the engine falls back at dp world 1) — refuse instead
+        print("CONVERGE_WIRE=qgz needs data-parallel width > 1 (the "
+              "engine fell back to exact collectives); run on a mesh or "
+              "with forced host devices", file=sys.stderr)
+        return 2
 
     rng = np.random.default_rng(0)
     ev_rng = np.random.default_rng(1)
@@ -97,6 +121,7 @@ def main(out_path: str) -> int:
                   "prose from image docs; tools/build_corpus.py)",
         "model": "gpt2_125m body, byte-level vocab 256 "
                  f"({spec.num_params / 1e6:.0f}M params)",
+        "wire": wire,
         "steps": steps, "batch": BATCH, "seq": SEQ,
         "tokens_seen": steps * BATCH * SEQ,
         "train_curve": train_curve,

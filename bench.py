@@ -535,6 +535,142 @@ def fastgen_sla_bench(model="gpt2_125m", n_req=24, max_new=48,
     return out
 
 
+def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
+                    n_replicas=3):
+    """Poisson SLA bench against a REPLICA FLEET with a mid-burst replica
+    kill (the fleet analog of ``fastgen_sla_poisson_gpt2``, which stays
+    in the suite as the single-replica diff referent).
+
+    Three frontends over three FastGen engines SHARING one parameter
+    tree (one model in host memory, three KV pools) behind a
+    ``FleetRouter``; Poisson arrivals are offered at 2× ONE replica's
+    measured capacity, and a third of the way into the burst one replica
+    is chaos-killed (every tick raises → its circuit opens → in-flight
+    work fails over). Reported: p50/p99 TTFT for surviving traffic,
+    terminal-outcome counts, failover count, and ``requests_lost`` —
+    the count of uids that reached NO terminal state, which the fleet's
+    zero-loss guarantee pins at 0."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.fastgen import FastGenEngine
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.serving.fleet import FleetRouter
+    from deepspeed_tpu.testing import chaos
+
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(16, 96, n_req)]
+    prompts = [rng.integers(0, 50000, n).tolist() for n in lens]
+
+    cfg = T.get_model_config(model, max_seq_len=512)
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    engines = [FastGenEngine(cfg, params=params, n_blocks=128,
+                             block_size=32, max_blocks_per_seq=8,
+                             token_budget=128, temperature=0.0, seed=0)
+               for _ in range(n_replicas)]
+    # replicas of the SAME model/config share ONE compiled-tick cache:
+    # the tick closures capture only cfg + sampling knobs (identical
+    # here), params/pool are arguments — so the fleet pays each
+    # (bucket, mb-tier) program's XLA compile once, not once per replica
+    for eng in engines[1:]:
+        eng._ticks = engines[0]._ticks
+    fleet = FleetRouter.build(
+        engines,
+        serving_config={"max_queue": 16,
+                        "default_max_new_tokens": max_new,
+                        "circuit_failure_threshold": 2,
+                        "circuit_backoff_s": 0.2,
+                        "circuit_backoff_max_s": 2.0},
+        fleet_config={"min_ready_replicas": 2, "max_attempts": 4,
+                      "retry_backoff_s": 0.05, "retry_backoff_max_s": 0.5})
+    try:
+        # warm the exact tick programs the fleet drives (step-path only —
+        # generate_all's fused decode scans never run under run_tick);
+        # the shared cache makes replicas 1..N-1 free
+        for i, fe in enumerate(fleet.replicas()):
+            fe.submit(900 + i, prompts[0][:90], max_new_tokens=max_new)
+            fe.run_until_drained(5_000, deadline_s=180.0)
+        # single-replica capacity probe, served the same way the fleet
+        # serves (mixed SplitFuse ticks)
+        fe0 = fleet.replicas()[0]
+        for i in range(4):
+            fe0.submit(500 + i, prompts[i], max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        fe0.run_until_drained(20_000, deadline_s=180.0)
+        cap_tps = 4 * max_new / (time.perf_counter() - t0)
+
+        lam = 2.0 * cap_tps / max_new       # 2× one replica, in req/s
+        arrival = np.cumsum(rng.exponential(1.0 / lam, n_req))
+        kill_at = float(arrival[n_req // 3])
+        uids = [1000 + i for i in range(n_req)]
+        first_tok, done_at, states = {}, {}, {}
+        submitted = set()
+        pending = list(zip(arrival, uids, prompts))
+        killed_name = None
+        t0 = time.perf_counter()
+        while len(done_at) < n_req and time.perf_counter() - t0 < 300.0:
+            now = time.perf_counter() - t0
+            if killed_name is None and now >= kill_at:
+                killed_name = fleet.replicas()[0].name
+                chaos.arm(f"serving/tick@{killed_name}=fail:1000000")
+            while pending and pending[0][0] <= now:
+                _, uid, pr = pending.pop(0)
+                fleet.submit(uid, pr, max_new_tokens=max_new)
+                submitted.add(uid)
+            fleet.run_tick()
+            now = time.perf_counter() - t0
+            for uid in submitted:
+                if uid in done_at:
+                    continue
+                res = fleet.result(uid)
+                if res.tokens and uid not in first_tok:
+                    first_tok[uid] = now
+                if res.state != "active":
+                    states[uid] = res.state
+                    done_at[uid] = now
+            if pending and not fleet.active_count():
+                time.sleep(max(0.0, min(0.005, pending[0][0] - now)))
+    finally:
+        chaos.disarm()
+        fleet.close()
+    del engines, params
+    gc.collect()
+
+    completed = [u for u, s in states.items() if s == "completed"]
+    tts = sorted(first_tok[u] - arrival[u - 1000] for u in completed
+                 if u in first_tok)
+    counts = {}
+    for s in states.values():
+        counts[s] = counts.get(s, 0) + 1
+    failovers = sum(
+        telemetry.counter("fleet_failovers_total").value(reason=r)
+        for r in ("replica_hung", "circuit_open", "drain", "shed",
+                  "failed", "rejected"))
+    out = {
+        "replicas": n_replicas,
+        "replica_killed_mid_burst": killed_name or "none",
+        "capacity_probe_tokens_per_sec": round(cap_tps, 1),
+        "offered_x_single_replica_capacity": 2.0,
+        "requests": n_req,
+        "submitted": len(submitted),
+        "completed": len(completed),
+        "failovers": int(failovers),
+        # the zero-loss guarantee: every submitted uid reached exactly
+        # one terminal state
+        "requests_lost": len(submitted) - len(states),
+        "single_replica_referent": "fastgen_sla_poisson_gpt2",
+    }
+    for s, n in sorted(counts.items()):
+        if s != "completed":
+            out[f"outcome_{s}"] = n
+    if tts:
+        out["ttft_p50_s"] = round(tts[len(tts) // 2], 3)
+        out["ttft_p99_s"] = round(tts[min(len(tts) - 1,
+                                          int(len(tts) * 0.99))], 3)
+    return out
+
+
 # prefix for CPU-mesh subprocess snippets: env alone is not enough where a
 # sitecustomize registers a TPU PJRT plugin — pin the platform via config too
 CPU_SNIPPET_PRELUDE = r'''
@@ -945,6 +1081,7 @@ SUITE_SCHEDULE = [
     ("zero3_llama_3b_adafactor", llama_3b_bench, 540, 300),
     ("fastgen_paged_splitfuse_gpt2", fastgen_bench, 360, 150),
     ("fastgen_sla_poisson_gpt2", fastgen_sla_bench, 360, 150),
+    ("fleet_sla_poisson_gpt2", fleet_sla_bench, 420, 150),
     ("moe_ulysses_moe_350m_bf16", lambda: train_bench(
         "moe_350m", zero_stage=2, precision="bf16",
         batch=16, seq_len=1024, gas=4, steps=8,

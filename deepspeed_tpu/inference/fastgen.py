@@ -1011,6 +1011,21 @@ class FastGenEngine:
         d = self.seqs[uid]
         return d.done, list(d.generated)
 
+    def rematerialize(self, uid: int) -> Optional[Dict[str, Any]]:
+        """Host-side request snapshot for resubmission on a DIFFERENT
+        engine (fleet failover/migration): the original prompt, the tokens
+        generated so far, and how much of the prompt was prefilled. All
+        host bookkeeping — KV blocks are device-local and stay behind; a
+        new engine re-prefills ``prompt + generated`` as its prompt, which
+        under greedy decoding continues the stream bit-identically. None
+        for unknown uids (already flushed — nothing left to carry)."""
+        seq = self.seqs.get(uid)
+        if seq is None:
+            return None
+        return {"prompt": list(seq.prompt),
+                "generated": list(seq.generated),
+                "prefilled": seq.prefilled}
+
     def flush(self, uids: Sequence[int]) -> None:
         for uid in uids:
             d = self.seqs.pop(uid, None)

@@ -280,6 +280,10 @@ class ServingSectionConfig:
     circuit_failure_threshold: int = 5
     circuit_backoff_s: float = 0.5
     circuit_backoff_max_s: float = 30.0
+    # open-window endpoint jitter (fraction of the ramp value, uniform,
+    # stretch-only): replicas that trip together must not probe in
+    # lockstep (fleet-level thundering herd); 0 disables
+    circuit_jitter_frac: float = 0.1
     heartbeat_timeout_s: float = 15.0
     # retry-after hint fallback when no decode-throughput sample exists
     # yet (cold engine): assumed seconds per generated token
@@ -339,6 +343,77 @@ class ServingSectionConfig:
                 "serving.heartbeat_timeout_s and assumed_token_seconds "
                 f"must be > 0, got {self.heartbeat_timeout_s} / "
                 f"{self.assumed_token_seconds}")
+        if not (0.0 <= self.circuit_jitter_frac < 1.0):
+            raise DeepSpeedConfigError(
+                "serving.circuit_jitter_frac must be in [0, 1), got "
+                f"{self.circuit_jitter_frac}")
+
+
+@dataclasses.dataclass
+class FleetSectionConfig:
+    """Multi-replica serving fleet (``deepspeed_tpu/serving/fleet.py``).
+
+    A :class:`~deepspeed_tpu.serving.fleet.FleetRouter` owns N serving
+    frontends and routes by measured decode throughput, KV headroom,
+    circuit state and queue depth. ``min_ready_replicas`` is the
+    readiness quorum (``/readyz`` is ready iff at least that many
+    replicas are routable). Failover resubmits a lost request up to
+    ``max_attempts`` total attempts with exponential backoff
+    (``retry_backoff_s`` doubling to ``retry_backoff_max_s``, stretched
+    by up to ``retry_jitter_frac`` of uniform jitter) and an
+    excluded-replica set; a replica whose last tick blocked longer than
+    ``heartbeat_stale_s`` (or whose heartbeat is that stale with work
+    pending) is treated as hung. Hedged dispatch (``hedge_enabled``)
+    duplicates a still-running request onto a second replica once its
+    age passes the ``hedge_percentile`` of observed completion
+    latencies (floored at ``hedge_min_s``); first completion wins and
+    the loser is cancelled. ``migrate_on_drain`` moves in-flight work
+    off a draining replica instead of waiting it out."""
+    min_ready_replicas: int = 1
+    max_attempts: int = 3
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter_frac: float = 0.25
+    heartbeat_stale_s: float = 5.0
+    hedge_enabled: bool = False
+    hedge_percentile: float = 0.95
+    hedge_min_s: float = 0.05
+    migrate_on_drain: bool = True
+    max_result_history: int = 4096
+
+    def validate(self) -> None:
+        if self.min_ready_replicas < 1:
+            raise DeepSpeedConfigError(
+                "fleet.min_ready_replicas must be >= 1, got "
+                f"{self.min_ready_replicas}")
+        if self.max_attempts < 1:
+            raise DeepSpeedConfigError(
+                f"fleet.max_attempts must be >= 1, got {self.max_attempts}")
+        if self.retry_backoff_s <= 0 \
+                or self.retry_backoff_max_s < self.retry_backoff_s:
+            raise DeepSpeedConfigError(
+                "fleet retry backoff must satisfy 0 < retry_backoff_s <= "
+                f"retry_backoff_max_s, got {self.retry_backoff_s} / "
+                f"{self.retry_backoff_max_s}")
+        if not (0.0 <= self.retry_jitter_frac < 1.0):
+            raise DeepSpeedConfigError(
+                "fleet.retry_jitter_frac must be in [0, 1), got "
+                f"{self.retry_jitter_frac}")
+        if self.heartbeat_stale_s <= 0:
+            raise DeepSpeedConfigError(
+                "fleet.heartbeat_stale_s must be > 0, got "
+                f"{self.heartbeat_stale_s}")
+        if not (0.0 < self.hedge_percentile <= 1.0):
+            raise DeepSpeedConfigError(
+                "fleet.hedge_percentile must be in (0, 1], got "
+                f"{self.hedge_percentile}")
+        if self.hedge_min_s < 0:
+            raise DeepSpeedConfigError(
+                f"fleet.hedge_min_s must be >= 0, got {self.hedge_min_s}")
+        if self.max_result_history < 1:
+            raise DeepSpeedConfigError(
+                "fleet.max_result_history must be >= 1, got "
+                f"{self.max_result_history}")
 
 
 @dataclasses.dataclass
@@ -594,6 +669,8 @@ class DeepSpeedTPUConfig:
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     serving: ServingSectionConfig = dataclasses.field(
         default_factory=ServingSectionConfig)
+    fleet: FleetSectionConfig = dataclasses.field(
+        default_factory=FleetSectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

@@ -11,7 +11,10 @@ pieces every production continuous-batching stack pairs with admission
 * a circuit breaker around the engine tick with poison-request
   isolation (``circuit.py``, ``frontend.py``),
 * ``/healthz`` / ``/readyz`` surfaces on the telemetry HTTP endpoint
-  (``health.py``).
+  (``health.py``),
+* and the layer above one replica: a health-aware fleet router with
+  failover, retries, hedging, and zero-loss draining (``fleet.py`` —
+  README "Serving fleet").
 
 Quick start::
 
@@ -41,6 +44,7 @@ from deepspeed_tpu.serving.circuit import (  # noqa: F401
     OPEN,
     CircuitBreaker,
 )
+from deepspeed_tpu.serving.fleet import FleetRouter  # noqa: F401
 from deepspeed_tpu.serving.frontend import (  # noqa: F401
     ACTIVE,
     COMPLETED,

@@ -11,7 +11,14 @@ queue at device-timeout speed. The breaker converts that into fail-fast:
 * **open** — after ``failure_threshold`` consecutive failures, ticks are
   rejected immediately (no engine call) for a backoff window. Each
   re-open doubles the backoff up to ``backoff_max_s`` (exponential
-  backoff against a persistently sick device).
+  backoff against a persistently sick device). The window endpoint is
+  stretched by up to ``jitter_frac`` of uniform jitter: N replicas of a
+  fleet that trip together on one shared fault would otherwise compute
+  identical ``_open_until`` windows and probe in lockstep — a
+  fleet-level thundering herd against whatever they share. The doubling
+  ramp itself stays un-jittered (deterministic severity), only the
+  window endpoint spreads. ``rng`` is injectable/seedable so tests with
+  an injected clock stay deterministic.
 * **half-open** — when the backoff window expires, exactly ONE probe
   tick is let through; success closes the circuit (and resets the
   backoff), failure re-opens it with the doubled window.
@@ -27,6 +34,7 @@ device runtime.
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Optional
 
@@ -49,10 +57,17 @@ class CircuitBreaker:
 
     def __init__(self, failure_threshold: int = 5, backoff_s: float = 0.5,
                  backoff_max_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 jitter_frac: float = 0.0,
+                 rng: Optional[random.Random] = None):
         self.failure_threshold = failure_threshold
         self.backoff_s = backoff_s
         self.backoff_max_s = backoff_max_s
+        self.jitter_frac = jitter_frac
+        # seedable so an injected-clock test path is deterministic; the
+        # frontend seeds it from the replica NAME so co-tripping replicas
+        # de-synchronize while each one's schedule stays reproducible
+        self._rng = rng if rng is not None else random.Random()
         self._clock = clock
         self.state = CLOSED
         self.failure_streak = 0
@@ -102,17 +117,27 @@ class CircuitBreaker:
             self._cur_backoff = self.backoff_s   # healthy again: reset ramp
             self._transition(CLOSED)
 
+    def _jittered(self, backoff: float) -> float:
+        """The open-window length actually applied: the ramp value
+        stretched by up to ``jitter_frac`` (never shortened — jitter must
+        not probe a sick device EARLIER than the ramp promises)."""
+        if self.jitter_frac <= 0.0:
+            return backoff
+        return backoff * (1.0 + self.jitter_frac * self._rng.random())
+
     def record_failure(self) -> None:
         self.failure_streak += 1
         if self.state == HALF_OPEN:
             # failed probe: re-open with doubled backoff (capped)
             self._cur_backoff = min(self._cur_backoff * 2,
                                     self.backoff_max_s)
-            self._open_until = self._clock() + self._cur_backoff
+            self._open_until = self._clock() + self._jittered(
+                self._cur_backoff)
             self._transition(OPEN)
         elif self.state == CLOSED and \
                 self.failure_streak >= self.failure_threshold:
-            self._open_until = self._clock() + self._cur_backoff
+            self._open_until = self._clock() + self._jittered(
+                self._cur_backoff)
             self._transition(OPEN)
 
     def retry_after_s(self) -> Optional[float]:

@@ -1,0 +1,840 @@
+"""FleetRouter: health-aware routing + failover over N serving replicas.
+
+``ServingFrontend`` gives ONE engine admission control, shedding, and a
+circuit breaker; this module is the layer the millions-of-users story
+needs above it — a router that owns N replicas and extends the same hard
+guarantees to the fleet:
+
+* **scored routing** — each admission goes to the replica with the least
+  projected wait: measured decode throughput (``est_token_seconds()``)
+  times its token backlog, inflated by projected KV-pool pressure. A
+  replica whose circuit is open inside its backoff window, whose last
+  tick hung past the staleness deadline, or which is draining is not a
+  candidate; a replica whose open window has expired is routable as a
+  last-resort probe vehicle (the same rule the frontend applies).
+* **failover + retries** — a replica that crashes (circuit opens) or
+  hangs (tick blocked past ``heartbeat_stale_s``) loses its in-flight
+  requests to the survivors: each is re-materialized (prompt + tokens
+  generated so far — greedy decode continues bit-identically), cancelled
+  on the sick replica (KV blocks released), and resubmitted elsewhere
+  with exponential backoff + jitter and an excluded-replica set. Bounded
+  attempts, then a structured terminal ``failed`` — never a raised
+  exception, never two terminal states for one uid, never a leaked KV
+  block on either replica.
+* **hedged dispatch** (optional) — a request still running past the
+  observed completion-latency percentile is duplicated onto a second
+  replica; first completion wins and the loser is cancelled.
+* **honest degradation** — when every candidate answers ``Overloaded``,
+  the fleet verdict aggregates them: the dominant reason and the
+  EARLIEST retry-after any replica offered.
+* **draining + quorum probes** — ``drain()`` stops routing to a replica
+  and migrates (or waits out) its in-flight work, enabling rolling
+  restarts via ``replace_replica``; the fleet registers ``/healthz`` /
+  ``/readyz`` probes on the exposition registry reporting quorum
+  (ready iff ≥ ``min_ready_replicas`` replicas are routable).
+
+Single-threaded like the frontends it owns: one loop calls ``submit`` /
+``run_tick``; the health probes are the only cross-thread readers and
+touch host scalars only. Chaos hooks: every replica tick passes through
+the ``serving/hang`` and ``serving/tick`` fault points scoped by replica
+name (``DSTPU_CHAOS="serving/tick@r1=fail:999"`` crashes one replica of
+a fleet; ``serving/hang@r2=hang:0.2:3`` hangs another), which is how the
+zero-loss tests in ``tests/unit/test_fleet.py`` prove the guarantees.
+
+Config: the ``"fleet"`` section of the runtime JSON config
+(``runtime/config.py:FleetSectionConfig``). Metrics: ``fleet_*`` in the
+README "Observability" catalog.
+"""
+from __future__ import annotations
+
+import collections
+import random
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.serving.admission import (
+    Admitted,
+    Overloaded,
+    Rejected,
+)
+from deepspeed_tpu.serving.circuit import CLOSED, OPEN
+from deepspeed_tpu.serving.frontend import (
+    ACTIVE,
+    COMPLETED,
+    EXPIRED,
+    FAILED,
+    REJECTED,
+    RequestResult,
+    ServingFrontend,
+)
+from deepspeed_tpu.utils.logging import logger
+
+#: fleet-level rejection reason when no replica is even a candidate
+REASON_NO_REPLICA = "no_ready_replica"
+
+
+class _Replica:
+    """Router-side view of one frontend (name, drain flag, hung flag)."""
+
+    __slots__ = ("frontend", "name", "draining", "hung")
+
+    def __init__(self, frontend: ServingFrontend):
+        self.frontend = frontend
+        self.name = frontend.name
+        self.draining = False
+        self.hung = False
+
+
+class _FleetRequest:
+    __slots__ = ("uid", "prompt", "deadline_s", "max_new_tokens",
+                 "submit_t", "dispatch_t", "attempts", "excluded",
+                 "replica", "hedge", "hedged", "next_retry_t", "carried",
+                 "last_reason")
+
+    def __init__(self, uid: int, prompt: List[int],
+                 deadline_s: Optional[float], max_new_tokens: int,
+                 submit_t: float):
+        self.uid = uid
+        self.prompt = prompt          # current payload (grows on remat)
+        self.deadline_s = deadline_s  # relative to submit_t; None = none
+        self.max_new_tokens = max_new_tokens
+        self.submit_t = submit_t
+        self.dispatch_t = submit_t    # last (re)dispatch time (hedge clock)
+        self.attempts = 0             # dispatches that were ADMITTED
+        self.excluded: set = set()    # replica names already tried & lost
+        self.replica: Optional[str] = None   # current primary copy
+        self.hedge: Optional[str] = None     # current hedge copy
+        self.hedged = False           # a hedge was ever spawned
+        self.next_retry_t: Optional[float] = None
+        self.carried: List[int] = []  # tokens folded into prompt by remat
+        self.last_reason = ""         # why the last copy was lost
+
+
+class FleetRouter:
+    """Routes requests across N ``ServingFrontend`` replicas with
+    health-aware failover. ``config`` is a ``FleetSectionConfig``, a
+    plain dict of its keys, or None (defaults); ``clock`` and ``seed``
+    are injectable for deterministic tests."""
+
+    def __init__(self, replicas: Sequence[ServingFrontend], config=None,
+                 clock=time.monotonic, register_health: bool = True,
+                 health_name: str = "fleet", seed: int = 0):
+        from deepspeed_tpu.runtime.config import FleetSectionConfig
+        from deepspeed_tpu.runtime.config_utils import config_from_dict
+
+        if config is None:
+            config = FleetSectionConfig()
+        elif isinstance(config, dict):
+            config = config_from_dict(FleetSectionConfig, config,
+                                      path="fleet.")
+        else:
+            config.validate()
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.cfg = config
+        self.clock = clock
+        self._rng = random.Random(seed)
+        self._replicas: List[_Replica] = [_Replica(fe) for fe in replicas]
+        names = [r.name for r in self._replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self._active: Dict[int, _FleetRequest] = {}
+        # terminal records, insertion-ordered and bounded (same contract
+        # as the frontend's result map — sustained overload must not grow
+        # router memory without limit)
+        self._results: Dict[int, RequestResult] = {}
+        # completion-latency samples feeding the hedge threshold
+        self._lat_samples: collections.deque = collections.deque(maxlen=256)
+        self._setup_telemetry()
+        self.health_name: Optional[str] = None
+        if register_health:
+            name = telemetry.unique_health_probe_name(health_name)
+            self.health_name = name
+            telemetry.register_health_probe("live", name, self.liveness)
+            telemetry.register_health_probe("ready", name, self.readiness)
+
+    @classmethod
+    def build(cls, engines: Sequence, serving_config=None, fleet_config=None,
+              replica_prefix: str = "replica", **kw) -> "FleetRouter":
+        """Convenience: wrap N engines in frontends named
+        ``{prefix}-{i}`` (distinct names scope per-replica chaos and
+        de-synchronize circuit jitter) and route over them. The replicas
+        do NOT register their own health probes — ``/readyz`` AND-folds
+        every registered probe, so a single dead replica would flip the
+        endpoint unready even with quorum intact; the fleet's quorum
+        probe is the readiness contract here. Callers composing their
+        own frontends can still register per-replica probes when each
+        replica is its own pod."""
+        fes = [ServingFrontend(eng, config=serving_config,
+                               register_health=False,
+                               health_name=f"{replica_prefix}-{i}")
+               for i, eng in enumerate(engines)]
+        return cls(fes, config=fleet_config, **kw)
+
+    # ------------------------------------------------------------------ #
+    def _setup_telemetry(self) -> None:
+        self._tm_submitted = telemetry.counter(
+            "fleet_submitted_total", "requests submitted to the fleet")
+        self._tm_routed = telemetry.counter(
+            "fleet_routed_total", "admissions placed, by replica")
+        self._tm_reject = telemetry.counter(
+            "fleet_rejected_total",
+            "fleet-level rejections by reason (aggregated replica "
+            "overloads, invalid requests, no_ready_replica)")
+        self._tm_resolved = telemetry.counter(
+            "fleet_resolved_total",
+            "requests reaching a fleet terminal state, by outcome")
+        self._tm_failover = telemetry.counter(
+            "fleet_failovers_total",
+            "in-flight copies lost to a sick/draining replica, by reason "
+            "(replica_hung / circuit_open / drain / shed / failed)")
+        self._tm_retries = telemetry.counter(
+            "fleet_retries_total",
+            "resubmissions of a lost request onto another replica")
+        self._tm_hedges = telemetry.counter(
+            "fleet_hedges_total",
+            "hedged dispatches by outcome (spawned / won / lost)")
+        self._tm_lost = telemetry.counter(
+            "fleet_requests_lost_total",
+            "in-flight requests force-failed at router shutdown (a clean "
+            "drain leaves this at 0 — the chaos tests pin it)")
+        self._tm_ready = telemetry.gauge(
+            "fleet_ready_replicas", "replicas currently routable")
+        self._tm_active = telemetry.gauge(
+            "fleet_active_requests", "fleet requests not yet terminal")
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def active_uids(self) -> List[int]:
+        return sorted(self._active)
+
+    def replicas(self) -> List[ServingFrontend]:
+        return [rep.frontend for rep in self._replicas]
+
+    def result(self, uid: int) -> RequestResult:
+        """Fleet terminal record for ``uid``, or its live ``active`` view
+        (tokens = carried + current copy's stream). Unknown uids raise
+        KeyError."""
+        r = self._active.get(uid)
+        if r is not None:
+            tokens = list(r.carried)
+            rep = self._by_name(r.replica) if r.replica else None
+            if rep is not None:
+                res = self._copy_result(rep, uid)
+                if res is not None:
+                    tokens += res.tokens
+            return RequestResult(uid, ACTIVE, tokens)
+        return self._results[uid]
+
+    def drop_result(self, uid: int) -> None:
+        self._results.pop(uid, None)
+
+    def _by_name(self, name: str) -> Optional[_Replica]:
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        return None
+
+    def _copy_result(self, rep: _Replica, uid: int
+                     ) -> Optional[RequestResult]:
+        try:
+            return rep.frontend.result(uid)
+        except KeyError:
+            # the frontend never saw (or already dropped) the uid — the
+            # caller treats the copy as gone
+            return None
+
+    # ------------------------------------------------------------------ #
+    # routing
+    # ------------------------------------------------------------------ #
+    def _routable(self, rep: _Replica, excluded=()) -> bool:
+        if rep.name in excluded or rep.draining or rep.hung:
+            return False
+        fe = rep.frontend
+        if fe.breaker.state != CLOSED:
+            retry = fe.breaker.retry_after_s()
+            # OPEN inside the window, or HALF_OPEN with the probe pending:
+            # the frontend would reject anyway — don't waste the attempt
+            if retry is None or retry > 0:
+                return False
+        return True
+
+    def _score(self, rep: _Replica, prompt_len: int, max_new: int) -> float:
+        """Projected seconds until this request would COMPLETE on the
+        replica: (backlog + its own work) at the measured per-token rate,
+        inflated by projected KV pressure (a near-full pool is about to
+        preempt). Lower is better."""
+        fe = rep.frontend
+        est = fe.engine.est_token_seconds()
+        tok_s = est if est is not None else fe.cfg.assumed_token_seconds
+        wait_s = (fe.backlog_tokens() + prompt_len + max_new) * tok_s
+        blocks = prompt_len // fe.engine.block_size + 1
+        kv = fe.engine.kv_utilization(blocks)
+        score = wait_s * (1.0 + kv)
+        if fe.breaker.state != CLOSED:
+            # expired-window probe vehicle: routable, but last resort
+            score += 1e9
+        return score
+
+    def _candidates(self, prompt_len: int, max_new: int,
+                    excluded=()) -> List[_Replica]:
+        cands = [rep for rep in self._replicas
+                 if self._routable(rep, excluded)]
+        cands.sort(key=lambda rep: (self._score(rep, prompt_len, max_new),
+                                    rep.name))
+        return cands
+
+    def _retry_hint_s(self) -> float:
+        """Honest retry-after when NO replica is a candidate: the earliest
+        probe window any open circuit offers, else one stale deadline."""
+        hints = []
+        for rep in self._replicas:
+            retry = rep.frontend.breaker.retry_after_s()
+            if retry is not None:
+                hints.append(retry)
+        return round(min(hints) if hints else self.cfg.heartbeat_stale_s, 3)
+
+    def _try_dispatch(self, r: _FleetRequest
+                      ) -> Union[Admitted, Overloaded, Rejected]:
+        """Place ``r`` on the best candidate. On success ``r.replica`` /
+        ``r.attempts`` / ``r.dispatch_t`` are updated; Overloaded /
+        Rejected leave ``r`` unplaced for the caller to act on."""
+        now = self.clock()
+        deadline = None
+        if r.deadline_s is not None:
+            deadline = r.deadline_s - (now - r.submit_t)
+        remaining = max(1, r.max_new_tokens - len(r.carried))
+        overloads: List[Overloaded] = []
+        rejected: Optional[Rejected] = None
+        for rep in self._candidates(len(r.prompt), remaining, r.excluded):
+            res = rep.frontend.submit(r.uid, r.prompt, deadline_s=deadline,
+                                      max_new_tokens=remaining)
+            if isinstance(res, Admitted):
+                r.replica = rep.name
+                r.attempts += 1
+                r.dispatch_t = now
+                r.next_retry_t = None
+                self._tm_routed.inc(replica=rep.name)
+                return res
+            if isinstance(res, Rejected):
+                # universal only when the PAYLOAD is invalid for EVERY
+                # replica (empty, or over every engine's max_len — the
+                # fleet is not required to be homogeneous). A
+                # duplicate-uid rejection is replica-LOCAL (someone
+                # submitted that uid to that frontend out of band) — try
+                # the next candidate
+                if not r.prompt or all(
+                        len(r.prompt) >= rr.frontend.engine.max_len
+                        for rr in self._replicas):
+                    return res
+                rejected = res
+                continue
+            overloads.append(res)
+        if overloads:
+            # one honest fleet verdict: the dominant reason, the EARLIEST
+            # retry-after any replica offered
+            reasons = collections.Counter(o.reason for o in overloads)
+            return Overloaded(
+                r.uid, reasons.most_common(1)[0][0],
+                round(min(o.retry_after_s for o in overloads), 3), "fleet",
+                detail=f"{len(overloads)} candidate replicas overloaded")
+        if rejected is not None:
+            # every candidate rejected replica-locally — surface the last
+            return rejected
+        return Overloaded(r.uid, REASON_NO_REPLICA, self._retry_hint_s(),
+                          "fleet", detail="no routable replica")
+
+    # ------------------------------------------------------------------ #
+    # admission
+    # ------------------------------------------------------------------ #
+    def submit(self, uid: int, prompt: Sequence[int],
+               deadline_s: Optional[float] = None,
+               max_new_tokens: Optional[int] = None
+               ) -> Union[Admitted, Overloaded, Rejected]:
+        """Admit one request to the fleet. Same contract as the frontend:
+        never raises for request-shaped problems; Overloaded/Rejected are
+        also recorded as fleet terminal results for ``result(uid)``."""
+        prompt = list(prompt)
+        self._tm_submitted.inc()
+        if uid in self._active:
+            # duplicate of a live fleet uid: reject WITHOUT clobbering the
+            # live request's lifecycle (mirror of the frontend rule)
+            self._tm_reject.inc(reason="invalid")
+            return Rejected(uid, detail=f"uid {uid} is still active")
+        if max_new_tokens is None:
+            # homogeneous-fleet assumption: the first replica's default
+            # grant stands in for all (the router needs a concrete number
+            # for remaining-token accounting across failovers)
+            max_new_tokens = self._replicas[0].frontend.cfg \
+                .default_max_new_tokens
+        self._results.pop(uid, None)   # resubmission of a terminal uid
+        r = _FleetRequest(uid, prompt, deadline_s, max_new_tokens,
+                          self.clock())
+        verdict = self._try_dispatch(r)
+        if isinstance(verdict, Admitted):
+            self._active[uid] = r
+        else:
+            self._tm_reject.inc(reason=verdict.reason)
+            self._record_result(RequestResult(
+                uid, REJECTED, [], verdict.reason,
+                getattr(verdict, "detail", "")))
+        self._refresh_gauges()
+        return verdict
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def _record_result(self, result: RequestResult) -> None:
+        """Exactly-one-terminal guard: the FIRST terminal state for a uid
+        wins; later resolution attempts are no-ops (a hedge completion
+        racing a failover must not produce two verdicts)."""
+        if result.uid in self._results:
+            return
+        self._active.pop(result.uid, None)
+        self._results[result.uid] = result
+        while len(self._results) > self.cfg.max_result_history:
+            self._results.pop(next(iter(self._results)))
+        self._tm_resolved.inc(outcome=result.state)
+
+    def _cancel_copy(self, r: _FleetRequest, name: Optional[str],
+                     reason: str) -> None:
+        if name is None:
+            return
+        rep = self._by_name(name)
+        if rep is not None:
+            rep.frontend.cancel(r.uid, reason=reason)
+            rep.frontend.drop_result(r.uid)
+
+    def _resolve(self, r: _FleetRequest, state: str, tokens: List[int],
+                 reason: str = "", detail: str = "") -> None:
+        """Fleet terminal resolution: cancel every remaining copy (KV
+        blocks released on every replica) then record once."""
+        for name in (r.replica, r.hedge):
+            self._cancel_copy(r, name, reason=f"fleet_{state}")
+        r.replica = r.hedge = None
+        self._record_result(RequestResult(r.uid, state,
+                                          tokens[:r.max_new_tokens],
+                                          reason, detail))
+
+    def _lose_copy(self, r: _FleetRequest, rep: _Replica, reason: str,
+                   count_attempt: bool = True, backoff: bool = True,
+                   tokens: Optional[List[int]] = None) -> None:
+        """One copy of ``r`` is gone (sick replica, drain migration, or
+        the replica itself shed/failed it). Re-materialize whatever it
+        generated, cancel it there, and either let the surviving hedge
+        copy carry on or schedule a resubmission — bounded attempts, then
+        a structured terminal ``failed``. ``tokens`` supplies the copy's
+        progress when the replica already resolved it (rematerialize only
+        answers for ACTIVE uids)."""
+        snap = rep.frontend.rematerialize(r.uid)
+        self._cancel_copy(r, rep.name, reason=f"fleet_failover_{reason}")
+        is_hedge = r.hedge == rep.name
+        if is_hedge:
+            r.hedge = None
+        if r.replica == rep.name:
+            r.replica = None
+        r.excluded.add(rep.name)
+        r.last_reason = reason
+        self._tm_failover.inc(reason=reason)
+        other = r.hedge if not is_hedge else r.replica
+        if other is not None:
+            # the surviving copy (same payload, greedy-deterministic
+            # stream) carries on; don't fold the loser's tokens — the
+            # survivor has its own copy of the same stream
+            if is_hedge:
+                self._tm_hedges.inc(outcome="lost")
+            else:
+                r.replica, r.hedge = r.hedge, None
+            return
+        # no survivor: fold the lost copy's progress into the payload so
+        # the next replica continues instead of restarting
+        gen = snap["generated"] if snap is not None else (tokens or [])
+        if gen:
+            r.carried.extend(gen)
+            r.prompt = list(r.prompt) + list(gen)
+        if len(r.carried) >= r.max_new_tokens:
+            # the lost copy had already generated the full grant
+            self._resolve(r, COMPLETED, list(r.carried))
+            return
+        if not count_attempt:
+            # drain migration is not a failure: hand the attempt back so
+            # moving a request off a healthy replica can never exhaust
+            # its failover budget
+            r.attempts = max(0, r.attempts - 1)
+        elif r.attempts >= self.cfg.max_attempts or all(
+                rr.name in r.excluded for rr in self._replicas):
+            # bounded: attempts spent, OR every replica in the fleet has
+            # already lost a copy of this request — a fleet smaller than
+            # max_attempts must still terminate, not spin on
+            # no_ready_replica forever
+            self._resolve(
+                r, FAILED, list(r.carried), reason=reason,
+                detail=f"{r.attempts} attempts exhausted "
+                       f"(excluded: {sorted(r.excluded)})")
+            return
+        if count_attempt and backoff:
+            ramp = min(self.cfg.retry_backoff_s * (2 ** (r.attempts - 1)),
+                       self.cfg.retry_backoff_max_s)
+            wait = ramp * (1.0 + self.cfg.retry_jitter_frac
+                           * self._rng.random())
+        else:
+            wait = 0.0   # migration redispatches immediately
+        r.next_retry_t = self.clock() + wait
+
+    def _detect_failures(self) -> None:
+        """Hang-vs-crash detection: a replica whose last tick blocked
+        past ``heartbeat_stale_s`` is hung; a replica whose circuit is
+        OPEN is crashed. Either way its in-flight fleet requests fail
+        over to the survivors.
+
+        Deliberately DURATION-based, not heartbeat-age-based: this
+        router shares the replicas' thread, so while one replica's tick
+        blocks, EVERY other replica's heartbeat ages — an age check here
+        would flag healthy replicas for their sick neighbor's stall. The
+        age signal (``last_tick_age_s()``) is for genuinely concurrent
+        observers: the health-probe thread, or a router driving replicas
+        on worker threads, sees age grow WHILE the tick is blocked."""
+        stale = self.cfg.heartbeat_stale_s
+        for rep in self._replicas:
+            fe = rep.frontend
+            was_hung = rep.hung
+            rep.hung = fe.last_tick_duration_s > stale
+            if rep.hung and not was_hung:
+                logger.warning(
+                    f"fleet: replica {rep.name} is hung (last tick "
+                    f"{fe.last_tick_duration_s:.3f}s, stale deadline "
+                    f"{stale}s) — failing over its in-flight requests")
+            if rep.hung:
+                self._failover_replica(rep, "replica_hung")
+            elif fe.breaker.state == OPEN:
+                self._failover_replica(rep, "circuit_open")
+
+    def _hung_probe_due(self, rep: _Replica) -> bool:
+        """Whether a hung replica has earned its next recovery probe:
+        at least ``heartbeat_stale_s`` since its last tick ENDED (entry
+        stamp + duration — the entry stamp alone would re-probe
+        immediately after every blocked tick returns)."""
+        fe = rep.frontend
+        if fe.last_tick_t is None:
+            return True
+        since_end = fe.clock() - (fe.last_tick_t + fe.last_tick_duration_s)
+        return since_end >= self.cfg.heartbeat_stale_s
+
+    def _failover_replica(self, rep: _Replica, reason: str,
+                          count_attempt: bool = True,
+                          backoff: bool = True) -> None:
+        for r in list(self._active.values()):
+            if rep.name in (r.replica, r.hedge):
+                self._lose_copy(r, rep, reason, count_attempt=count_attempt,
+                                backoff=backoff)
+
+    def _harvest(self) -> None:
+        """Fold replica-level terminal states into fleet lifecycle:
+        completion/expiry resolve the fleet request (first completion wins
+        under hedging, the loser is cancelled); a copy the replica shed or
+        failed (poison eviction) re-enters the failover path."""
+        now = self.clock()
+        for r in list(self._active.values()):
+            for name in (r.replica, r.hedge):
+                if name is None or r.uid not in self._active:
+                    continue
+                rep = self._by_name(name)
+                res = self._copy_result(rep, r.uid) if rep else None
+                if res is None:
+                    # replica replaced/record dropped under us: lost copy
+                    if rep is not None:
+                        self._lose_copy(r, rep, "failed")
+                    continue
+                if res.state == ACTIVE:
+                    continue
+                if res.state == COMPLETED:
+                    # hedge won/lost only means something while BOTH
+                    # copies are in play (a promoted hedge completing
+                    # solo is a failover rescue, not a race outcome)
+                    if name == r.hedge and r.replica is not None:
+                        self._tm_hedges.inc(outcome="won")
+                    elif name == r.replica and r.hedge is not None:
+                        self._tm_hedges.inc(outcome="lost")
+                    if name == r.hedge:
+                        r.hedge = None
+                    if name == r.replica:
+                        r.replica = None
+                    rep.frontend.drop_result(r.uid)
+                    self._lat_samples.append(now - r.submit_t)
+                    self._resolve(r, COMPLETED, r.carried + res.tokens)
+                elif res.state == EXPIRED:
+                    # the deadline is request-global: the other copy is on
+                    # the same clock — resolve unless it already finished
+                    if name == r.hedge:
+                        r.hedge = None
+                    if name == r.replica:
+                        r.replica = None
+                    rep.frontend.drop_result(r.uid)
+                    self._resolve(r, EXPIRED, r.carried + res.tokens,
+                                  reason=res.reason or "deadline")
+                else:
+                    # shed / failed / rejected on the replica: that copy
+                    # is lost — failover machinery decides retry/terminal
+                    self._lose_copy(r, rep, res.state, tokens=res.tokens)
+
+    def _hedge_threshold_s(self) -> float:
+        if not self._lat_samples:
+            return self.cfg.hedge_min_s
+        ordered = sorted(self._lat_samples)
+        idx = min(len(ordered) - 1,
+                  int(len(ordered) * self.cfg.hedge_percentile))
+        return max(self.cfg.hedge_min_s, ordered[idx])
+
+    def _hedge_scan(self) -> None:
+        if not self.cfg.hedge_enabled:
+            return
+        now = self.clock()
+        threshold = self._hedge_threshold_s()
+        for r in list(self._active.values()):
+            if r.replica is None or r.hedge is not None or r.hedged:
+                continue
+            if now - r.dispatch_t <= threshold:
+                continue
+            deadline = None
+            if r.deadline_s is not None:
+                deadline = r.deadline_s - (now - r.submit_t)
+                if deadline <= 0:
+                    continue   # expiry will resolve it; no point hedging
+            remaining = max(1, r.max_new_tokens - len(r.carried))
+            # the hedge goes to a replica OTHER than the primary (and not
+            # one this request already lost)
+            for rep in self._candidates(len(r.prompt), remaining,
+                                        r.excluded | {r.replica}):
+                res = rep.frontend.submit(r.uid, r.prompt,
+                                          deadline_s=deadline,
+                                          max_new_tokens=remaining)
+                if isinstance(res, Admitted):
+                    r.hedge = rep.name
+                    r.hedged = True
+                    self._tm_hedges.inc(outcome="spawned")
+                    self._tm_routed.inc(replica=rep.name)
+                break   # one placement attempt per scan — no storms
+
+    def _retry_due(self) -> None:
+        now = self.clock()
+        for r in list(self._active.values()):
+            if r.replica is not None or r.hedge is not None:
+                continue
+            if r.deadline_s is not None \
+                    and now - r.submit_t >= r.deadline_s:
+                self._resolve(r, EXPIRED, list(r.carried),
+                              reason="deadline",
+                              detail="expired waiting for failover")
+                continue
+            if all(rr.name in r.excluded for rr in self._replicas):
+                # belt-and-braces twin of the _lose_copy check: replica
+                # replacement can shrink the name set under a waiting
+                # request — an all-excluded request can never place
+                self._resolve(r, FAILED, list(r.carried),
+                              reason=r.last_reason or "failed",
+                              detail=f"{r.attempts} attempts exhausted "
+                                     f"(excluded: {sorted(r.excluded)})")
+                continue
+            if r.next_retry_t is not None and now < r.next_retry_t:
+                continue
+            verdict = self._try_dispatch(r)
+            if isinstance(verdict, Admitted):
+                self._tm_retries.inc()
+            elif isinstance(verdict, Rejected):
+                # re-materialized payload invalid (e.g. grew past the
+                # target engine's max_len): structured terminal, bounded
+                self._resolve(r, FAILED, list(r.carried),
+                              reason=r.last_reason or "invalid",
+                              detail=verdict.detail)
+            else:
+                # every candidate overloaded: wait out its retry-after
+                # hint (capped — the fleet loop must keep polling faster
+                # than coarse backlog estimates suggest)
+                r.next_retry_t = now + min(verdict.retry_after_s,
+                                           self.cfg.retry_backoff_max_s)
+
+    def run_tick(self) -> int:
+        """One fleet scheduling pass: detect hung/crashed replicas and
+        fail their work over, place due retries and hedges, tick every
+        replica (absorbing failures — the frontends never raise), and
+        fold completions. Returns the number of replica ticks attempted.
+
+        Placement runs BEFORE the ticks: an open circuit whose backoff
+        window just expired admits exactly one half-open probe, and the
+        fleet's own idle tick of that replica would otherwise consume it
+        — with every replica sick, retries waiting on an expired window
+        would starve forever behind empty probe ticks."""
+        self._detect_failures()
+        self._retry_due()
+        self._hedge_scan()
+        ticked = 0
+        for rep in self._replicas:
+            if rep.hung and not self._hung_probe_due(rep):
+                # a hung replica's tick BLOCKS this shared thread: probing
+                # it on every pass would stall the survivors the failover
+                # just rescued work onto — probe at most once per stale
+                # window instead
+                continue
+            rep.frontend.run_tick()
+            ticked += 1
+        self._harvest()
+        self._detect_failures()   # a tick may have just opened a circuit
+        self._retry_due()         # ...and its failed-over work can often
+        self._refresh_gauges()    # re-place on a survivor immediately
+        return ticked
+
+    def run_until_drained(self, max_ticks: int = 10_000,
+                          deadline_s: Optional[float] = None) -> int:
+        """Fleet ticks until no fleet request is active (or ``max_ticks``
+        / ``deadline_s``); returns passes consumed. Between passes where
+        no replica holds work but requests wait on retry backoff, sleeps
+        a hair under the real clock (an injected clock's owner advances
+        time itself)."""
+        passes = 0
+        t0 = self.clock()
+        while self._active and passes < max_ticks:
+            if deadline_s is not None and self.clock() - t0 >= deadline_s:
+                break
+            self.run_tick()
+            passes += 1
+            if self._active and self.clock is time.monotonic and not any(
+                    rep.frontend.active_count() for rep in self._replicas):
+                time.sleep(0.002)
+        return passes
+
+    # ------------------------------------------------------------------ #
+    # draining + rolling restart
+    # ------------------------------------------------------------------ #
+    def _resolve_replica(self, which: Union[int, str, ServingFrontend]
+                         ) -> _Replica:
+        if isinstance(which, int):
+            return self._replicas[which]
+        for rep in self._replicas:
+            if rep.name == which or rep.frontend is which:
+                return rep
+        raise KeyError(f"no replica {which!r} in this fleet")
+
+    def drain(self, which, migrate: Optional[bool] = None) -> None:
+        """Stop routing NEW work to a replica. ``migrate=True`` (default
+        from config) moves its in-flight fleet requests to the survivors
+        immediately (re-materialized, no attempt penalty); ``False`` lets
+        them finish in place. Either way the replica keeps ticking until
+        quiesced — rolling restarts wait on :meth:`quiesced`."""
+        rep = self._resolve_replica(which)
+        rep.draining = True
+        if migrate is None:
+            migrate = self.cfg.migrate_on_drain
+        if migrate:
+            self._failover_replica(rep, "drain", count_attempt=False,
+                                   backoff=False)
+            self._retry_due()
+        self._refresh_gauges()
+
+    def undrain(self, which) -> None:
+        rep = self._resolve_replica(which)
+        rep.draining = False
+        self._refresh_gauges()
+
+    def quiesced(self, which) -> bool:
+        """True when a (draining) replica holds no fleet request and its
+        frontend has nothing active — safe to close/replace."""
+        rep = self._resolve_replica(which)
+        if rep.frontend.active_count():
+            return False
+        return all(rep.name not in (r.replica, r.hedge)
+                   for r in self._active.values())
+
+    def replace_replica(self, which, new_frontend: ServingFrontend
+                        ) -> ServingFrontend:
+        """Rolling-restart swap: migrate any remaining in-flight work off
+        the old replica, close its frontend, and install the new one
+        (immediately routable). Returns the closed frontend."""
+        rep = self._resolve_replica(which)
+        # validate BEFORE any side effect: a collision must fail cleanly,
+        # not leave a closed frontend installed and routable
+        if any(r.name == new_frontend.name
+               for r in self._replicas if r is not rep):
+            raise ValueError(
+                f"replacement name {new_frontend.name!r} collides with a "
+                "live replica")
+        self._failover_replica(rep, "drain", count_attempt=False,
+                               backoff=False)
+        old = rep.frontend
+        old.close()
+        rep.frontend = new_frontend
+        rep.name = new_frontend.name
+        rep.draining = False
+        rep.hung = False
+        self._retry_due()
+        self._refresh_gauges()
+        return old
+
+    # ------------------------------------------------------------------ #
+    # health quorum
+    # ------------------------------------------------------------------ #
+    def _replica_ready(self, rep: _Replica) -> bool:
+        fe = rep.frontend
+        return (not rep.draining and not rep.hung
+                and fe.breaker.state == CLOSED
+                and fe.active_count() < fe.cfg.max_queue)
+
+    def ready_count(self) -> int:
+        return sum(1 for rep in self._replicas if self._replica_ready(rep))
+
+    def readiness(self):
+        """Quorum readiness: ok iff ≥ ``min_ready_replicas`` replicas are
+        routable — the load balancer's drain signal for the whole fleet."""
+        detail: Dict[str, Any] = {}
+        for rep in self._replicas:
+            detail[rep.name] = {
+                "ready": self._replica_ready(rep),
+                "circuit": rep.frontend.breaker.state,
+                "draining": rep.draining,
+                "hung": rep.hung,
+                "queue": rep.frontend.active_count(),
+            }
+        n = sum(1 for d in detail.values() if d["ready"])
+        return n >= self.cfg.min_ready_replicas, {
+            "ready_replicas": n,
+            "min_ready_replicas": self.cfg.min_ready_replicas,
+            "replicas": detail,
+        }
+
+    def liveness(self):
+        """The fleet is live while ANY replica is not hung — all replicas
+        wedged with work pending is the restart-the-pod signal."""
+        hung = [rep.name for rep in self._replicas if rep.hung]
+        return len(hung) < len(self._replicas), {
+            "replicas": len(self._replicas), "hung": hung}
+
+    def _refresh_gauges(self) -> None:
+        self._tm_ready.set(self.ready_count())
+        self._tm_active.set(len(self._active))
+
+    # ------------------------------------------------------------------ #
+    def close(self, close_replicas: bool = True) -> None:
+        """Unregister fleet probes and force-fail any still-active fleet
+        request (copies cancelled on their replicas — blocks released).
+        Force-failed in-flight requests count as ``fleet_requests_lost``:
+        a clean shutdown drains first."""
+        for r in list(self._active.values()):
+            self._tm_lost.inc()
+            self._resolve(r, FAILED, list(r.carried), reason="shutdown")
+        if self.health_name is not None:
+            telemetry.unregister_health_probe("live", self.health_name)
+            telemetry.unregister_health_probe("ready", self.health_name)
+            self.health_name = None
+        if close_replicas:
+            for rep in self._replicas:
+                rep.frontend.close()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

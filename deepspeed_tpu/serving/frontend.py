@@ -33,17 +33,23 @@ Single-threaded like the engine itself: one loop calls ``submit``/
 ``run_tick``; the health probes (``serving/health.py``) are the only
 cross-thread readers and touch host scalars only.
 
-Chaos hook: ``run_tick`` passes through the ``serving/tick`` fault point
-(``deepspeed_tpu/testing/chaos.py``) so tests and operators can inject
-tick failures (``DSTPU_CHAOS="serving/tick=fail:3"``) and watch the
-circuit react.
+Chaos hooks: ``run_tick`` passes through the ``serving/hang`` and
+``serving/tick`` fault points (``deepspeed_tpu/testing/chaos.py``) so
+tests and operators can inject tick failures
+(``DSTPU_CHAOS="serving/tick=fail:3"``) or tick HANGS
+(``serving/hang=hang:0.5:3`` — blocks without raising, the
+stale-heartbeat shape) and watch the circuit / staleness detectors
+react. Both points are scoped by the frontend's resolved ``name``, so a
+fleet can target one replica (``serving/tick@replica-1=fail:999``).
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 import time
-from typing import Dict, List, Optional, Sequence, Union
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.serving.admission import (
@@ -123,10 +129,21 @@ class ServingFrontend:
         self.engine = engine
         self.cfg = config
         self.clock = clock
+        # resolve the replica NAME first (unique against registered health
+        # probes when registering): it scopes this frontend's chaos points
+        # and seeds its breaker jitter — a fleet hands out distinct names
+        # itself when register_health is off
+        self.name = telemetry.unique_health_probe_name(health_name) \
+            if register_health else health_name
         self.breaker = CircuitBreaker(
             failure_threshold=config.circuit_failure_threshold,
             backoff_s=config.circuit_backoff_s,
-            backoff_max_s=config.circuit_backoff_max_s, clock=clock)
+            backoff_max_s=config.circuit_backoff_max_s, clock=clock,
+            jitter_frac=config.circuit_jitter_frac,
+            # per-NAME seed: deterministic per replica, distinct across
+            # replicas — seeding all replicas identically would recreate
+            # the lockstep-probe herd the jitter exists to break
+            rng=random.Random(zlib.crc32(self.name.encode())))
         self.ctrl = AdmissionController(
             max_queue=config.max_queue,
             kv_high_watermark=config.kv_high_watermark,
@@ -148,6 +165,10 @@ class ServingFrontend:
         # stamped by run_tick on the serving loop; the health-probe thread
         # only READS it (atomic float — tearing-tolerant by design)
         self.last_tick_t: Optional[float] = None   # guarded-by: single-writer
+        # wall duration of the last COMPLETED tick (any outcome): a router
+        # in the same thread can't observe a hang while it's blocked inside
+        # the tick, so post-hoc duration is its hang-vs-crash evidence
+        self.last_tick_duration_s: float = 0.0   # guarded-by: single-writer
         # the default tracer is a stable singleton (configure mutates it
         # in place) — cache the handle; every call is a no-op while
         # tracing is disabled
@@ -157,15 +178,9 @@ class ServingFrontend:
         if register_health:
             # a second frontend in one process (multi-model replica) must
             # not silently replace the first one's probes — and closing
-            # either must not unregister the survivor's — so suffix to a
-            # fresh name on collision
-            taken = set(telemetry.health_probe_names("live")) \
-                | set(telemetry.health_probe_names("ready"))
-            name, i = health_name, 1
-            while name in taken:
-                i += 1
-                name = f"{health_name}-{i}"
-            self.health = HealthSurface(self, name=name)
+            # either must not unregister the survivor's — so the collision
+            # suffix above picked a fresh name
+            self.health = HealthSurface(self, name=self.name)
 
     @classmethod
     def from_ds_config(cls, engine, config, **kw) -> "ServingFrontend":
@@ -271,6 +286,44 @@ class ServingFrontend:
             total += seq.prefill_remaining
             total += max(0, req.max_new_tokens - len(seq.generated))
         return total
+
+    def backlog_tokens(self) -> int:
+        """Public backlog estimate (tokens still to prefill + decode) —
+        what a fleet router multiplies by ``est_token_seconds()`` to score
+        this replica's projected wait."""
+        return self._outstanding_tokens()
+
+    # ------------------------------------------------------------------ #
+    # router hooks: cancellation + re-materialization
+    # ------------------------------------------------------------------ #
+    def cancel(self, uid: int, reason: str = "cancelled",
+               detail: str = "") -> bool:
+        """Resolve an ACTIVE uid as ``failed(reason)`` and release its KV
+        blocks — the router's hedge-cancel / migration / failover hook.
+        Returns False (no-op) for unknown or already-terminal uids, so a
+        cancel racing a completion never clobbers the real outcome."""
+        if uid not in self._reqs:
+            return False
+        self._resolve(uid, FAILED, self._tokens_of(uid), reason=reason,
+                      detail=detail)
+        return True
+
+    def rematerialize(self, uid: int) -> Optional[Dict[str, Any]]:
+        """Host-side snapshot of an active request for resubmission on
+        ANOTHER replica: the original prompt, tokens generated so far
+        (greedy decode continues bit-identically from prompt+generated),
+        and the remaining decode grant. None when the uid is not active
+        here or the engine no longer tracks it."""
+        req = self._reqs.get(uid)
+        if req is None:
+            return None
+        snap = self.engine.rematerialize(uid)
+        if snap is None:
+            return None
+        snap["max_new_tokens"] = req.max_new_tokens
+        snap["remaining_new_tokens"] = max(
+            0, req.max_new_tokens - len(snap["generated"]))
+        return snap
 
     def _kv_util(self, extra_blocks: int = 0) -> float:
         return self.engine.kv_utilization(extra_blocks)
@@ -471,11 +524,31 @@ class ServingFrontend:
                               detail=f"{type(exc).__name__}: {exc}")
                 return
 
+    def last_tick_age_s(self) -> Optional[float]:
+        """Monotonic seconds since the last ``run_tick`` ENTRY (None before
+        the first tick) — the router's staleness evidence. A concurrent
+        observer sees this grow while a tick is blocked inside a hung
+        device call; a same-thread router additionally reads
+        ``last_tick_duration_s`` after the call returns."""
+        if self.last_tick_t is None:
+            return None
+        return max(0.0, self.clock() - self.last_tick_t)
+
     def run_tick(self) -> bool:
         """One protected engine tick. Returns True when a tick ran and
         succeeded; False when the circuit rejected it or it failed (the
         failure is absorbed — the loop NEVER sees the exception)."""
-        self.last_tick_t = self.clock()    # heartbeat: the loop is alive
+        t0 = self.clock()
+        self.last_tick_t = t0              # heartbeat: the loop is alive
+        try:
+            return self._run_tick_guarded()
+        finally:
+            # every exit (success, rejection, absorbed failure, even a
+            # propagating KeyboardInterrupt) stamps the duration a router
+            # reads for post-hoc hang detection
+            self.last_tick_duration_s = self.clock() - t0
+
+    def _run_tick_guarded(self) -> bool:
         if not self.breaker.allow():
             return False
         # a half-open probe's failure is presumed DEVICE fault (the
@@ -485,7 +558,11 @@ class ServingFrontend:
         probing = self.breaker.state == HALF_OPEN
         try:
             with telemetry.span("serving_tick"):
-                chaos_point("serving/tick")
+                # hang FIRST (a stuck tick blocks before it fails), then
+                # the raise point; both scoped by replica name so fleet
+                # chaos can target one replica (point@name rules)
+                chaos_point("serving/hang", scope=self.name)
+                chaos_point("serving/tick", scope=self.name)
                 self.engine.step()
         except Exception as e:
             # always leave a trace: with no suspect to evict this branch
@@ -543,16 +620,25 @@ class ServingFrontend:
                               list(seq.generated)[:req.max_new_tokens])
 
     def run_until_drained(self, max_ticks: int = 10_000,
-                          open_wait_cap_s: float = 0.05) -> int:
-        """Tick until no request is active (or ``max_ticks``); returns
-        ticks consumed. While the circuit is open, each rejected tick
-        sleeps toward the probe window (capped at ``open_wait_cap_s``)
-        instead of busy-spinning a core through the backoff — so the
-        drain actually waits out an open circuit rather than burning its
-        whole tick budget in milliseconds. Callers writing their own
-        loop should do the same with ``breaker.retry_after_s()``."""
+                          open_wait_cap_s: float = 0.05,
+                          deadline_s: Optional[float] = None) -> int:
+        """Tick until no request is active (or ``max_ticks``, or
+        ``deadline_s`` of wall clock); returns ticks consumed. While the
+        circuit is open, each rejected tick sleeps toward the probe window
+        (capped at ``open_wait_cap_s``) instead of busy-spinning a core
+        through the backoff — so the drain actually waits out an open
+        circuit rather than burning its whole tick budget in milliseconds.
+        Callers writing their own loop should do the same with
+        ``breaker.retry_after_s()``. ``deadline_s`` is the wall-clock
+        escape the tick budget can no longer provide: with open-circuit
+        sleeps in the loop, ``max_ticks`` bounds iterations but not TIME —
+        a drain against a sick replica would otherwise wait out every
+        doubled backoff window before giving up."""
         ticks = 0
+        t0 = self.clock()
         while self._reqs and ticks < max_ticks:
+            if deadline_s is not None and self.clock() - t0 >= deadline_s:
+                break
             if not self.run_tick() and self.breaker.state == OPEN:
                 retry = self.breaker.retry_after_s()
                 # real wall sleep only under the real clock: with an
@@ -560,7 +646,11 @@ class ServingFrontend:
                 # time, which no amount of real sleeping advances — the
                 # test owns time and must advance it itself
                 if retry and self.clock is time.monotonic:
-                    time.sleep(min(retry, open_wait_cap_s))
+                    wait = min(retry, open_wait_cap_s)
+                    if deadline_s is not None:
+                        wait = min(wait, max(
+                            0.0, deadline_s - (self.clock() - t0)))
+                    time.sleep(wait)
             ticks += 1
         return ticks
 

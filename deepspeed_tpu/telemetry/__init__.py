@@ -36,6 +36,7 @@ from deepspeed_tpu.telemetry.exposition import (
     snapshot as _snapshot,
     start_metrics_server as _start_server,
     stop_metrics_server as _stop_server,
+    unique_health_probe_name,
     unregister_health_probe,
 )
 from deepspeed_tpu.telemetry.registry import (
@@ -59,7 +60,7 @@ __all__ = [
     "tracing", "span", "snapshot", "render_prometheus",
     "start_metrics_server", "stop_metrics_server", "add_collector", "reset",
     "register_health_probe", "unregister_health_probe", "health_report",
-    "health_probe_names", "clear_health_probes",
+    "health_probe_names", "clear_health_probes", "unique_health_probe_name",
 ]
 
 _default_registry = MetricsRegistry()

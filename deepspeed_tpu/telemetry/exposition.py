@@ -136,6 +136,21 @@ def health_probe_names(kind: str) -> list:
         return list(_health_probes.get(kind, {}))
 
 
+def unique_health_probe_name(base: str) -> str:
+    """First of ``base``, ``base-2``, ``base-3``… not registered on
+    EITHER endpoint — the one collision-suffix idiom shared by every
+    subsystem that registers probes (a second serving frontend, a fleet
+    router): registering must never silently replace someone else's
+    probe, and closing one registrant must not unregister a survivor's."""
+    with _health_lock:
+        taken = set(_health_probes["live"]) | set(_health_probes["ready"])
+    name, i = base, 1
+    while name in taken:
+        i += 1
+        name = f"{base}-{i}"
+    return name
+
+
 def clear_health_probes() -> None:
     """Tests only: drop every registered probe (telemetry.reset calls
     this so one test's frontend can't leak unreadiness into the next)."""

@@ -8,7 +8,9 @@ checkpoint hot paths that must stay importable everywhere):
   (``save/pre_write``, ``save/mid_write``, ``save/pre_commit``,
   ``save/pre_rename``, ``save/pre_latest``), and the serving loop calls
   it before every engine tick (``serving/tick`` — the circuit-breaker /
-  load-shed suite arms it to fake a sick device). Unarmed, a point is one
+  load-shed suite arms it to fake a sick device — and ``serving/hang``,
+  whose ``hang`` action blocks the tick so staleness detectors can tell
+  a hung replica from a crashed one). Unarmed, a point is one
   global-is-None check. Armed (via :func:`arm` in-process, or the
   ``DSTPU_CHAOS`` env var for subprocess kill tests), a point can raise a
   transient I/O error or hard-kill the process — exactly what a preempted
@@ -23,11 +25,23 @@ checkpoint hot paths that must stay importable everywhere):
   uids + random prompts) for slamming the serving front-end with N× its
   queue capacity and asserting clean shedding / zero KV leaks.
 
-``DSTPU_CHAOS`` grammar: ``point=action[:n][;point=action[:n]...]``
+``DSTPU_CHAOS`` grammar: ``point=action[;point=action...]``
   * ``fail:n``  — the first ``n`` hits of the point raise :class:`ChaosError`
     (default 1); later hits pass — the transient-I/O shape retry must absorb.
   * ``kill:n``  — the ``n``-th hit of the point calls ``os._exit(137)``
     (default 1): an un-catchable crash, the preemption/OOM-killer shape.
+  * ``hang:s:n`` — the first ``n`` hits (default 1) BLOCK for ``s`` seconds
+    (default 0.05) before returning: the tick-stuck-in-a-device-call shape,
+    distinct from a raise — nothing fails, the heartbeat just goes stale
+    (``serving/hang`` is armed this way for hang-vs-crash detection tests).
+
+Scoped points: a rule keyed ``point@scope`` fires only for hits that pass a
+matching ``scope=`` (the serving front-end passes its replica name), so a
+fleet test can crash replica ``r1`` while ``r0`` stays healthy::
+
+    DSTPU_CHAOS="serving/tick@r1=fail:999" python serve.py
+
+An unscoped rule still matches every hit of its point, scoped or not.
 
 Example (kill the writer between data write and commit marker)::
 
@@ -40,6 +54,7 @@ import contextlib
 import os
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 CHAOS_ENV = "DSTPU_CHAOS"
@@ -59,7 +74,7 @@ class FaultPlan:
     writers hit points from worker threads."""
 
     def __init__(self, rules: Dict[str, Any]):
-        # rules: point -> ("fail"|"kill", n)
+        # rules: point[@scope] -> ("fail"|"kill", n) | ("hang", n, stall_s)
         self.rules = dict(rules)
         self._hits: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -71,29 +86,50 @@ class FaultPlan:
             part = part.strip()
             if not part:
                 continue
-            point, _, action = part.partition("=")
-            action, _, n = action.partition(":")
-            if action not in ("fail", "kill"):
+            point, _, action_spec = part.partition("=")
+            args = action_spec.split(":")
+            action = args[0]
+            if action == "hang":
+                stall = float(args[1]) if len(args) > 1 and args[1] else 0.05
+                n = int(args[2]) if len(args) > 2 and args[2] else 1
+                rules[point.strip()] = ("hang", n, stall)
+            elif action in ("fail", "kill"):
+                n = int(args[1]) if len(args) > 1 and args[1] else 1
+                rules[point.strip()] = (action, n)
+            else:
                 raise ValueError(
-                    f"chaos action must be fail|kill, got {action!r} "
+                    f"chaos action must be fail|kill|hang, got {action!r} "
                     f"(spec {spec!r})")
-            rules[point.strip()] = (action, int(n) if n else 1)
         return cls(rules)
 
-    def hit(self, point: str) -> None:
+    def hit(self, point: str, scope: Optional[str] = None) -> None:
+        # a scoped rule (point@scope) outranks the unscoped one for hits
+        # that carry the matching scope; unscoped rules match every hit
+        keys = [f"{point}@{scope}"] if scope else []
+        keys.append(point)
         with self._lock:
-            rule = self.rules.get(point)
+            rule = key = None
+            for k in keys:
+                if k in self.rules:
+                    rule, key = self.rules[k], k
+                    break
             if rule is None:
                 return
-            self._hits[point] = count = self._hits.get(point, 0) + 1
-            action, n = rule
+            self._hits[key] = count = self._hits.get(key, 0) + 1
+            action, n = rule[0], rule[1]
         if action == "kill":
             if count == n:
                 # hard crash: no atexit, no finally blocks, no flushing —
                 # the honest model of preemption/OOM-kill
                 os._exit(KILL_EXIT_CODE)
+        elif action == "hang":
+            if count <= n:
+                # block (outside the lock) — the heartbeat goes stale but
+                # nothing raises; hang-vs-crash detection must tell these
+                # apart
+                time.sleep(rule[2])
         elif count <= n:
-            raise ChaosError(f"chaos: injected failure at {point!r} "
+            raise ChaosError(f"chaos: injected failure at {key!r} "
                              f"(hit {count}/{n})")
 
     def hits(self, point: str) -> int:
@@ -119,9 +155,11 @@ def disarm() -> None:
     _env_checked = True   # an explicit disarm also wins over the env
 
 
-def chaos_point(point: str) -> None:
+def chaos_point(point: str, scope: Optional[str] = None) -> None:
     """Production-code hook: no-op unless a plan is armed (in-process or
-    via ``DSTPU_CHAOS``)."""
+    via ``DSTPU_CHAOS``). ``scope`` narrows which instance is hitting the
+    point (e.g. a serving replica's name) so plans can target one replica
+    of a fleet via ``point@scope`` rules."""
     global _armed, _env_checked
     if _armed is None:
         if _env_checked:
@@ -131,7 +169,7 @@ def chaos_point(point: str) -> None:
         if not spec:
             return
         _armed = FaultPlan.parse(spec)
-    _armed.hit(point)
+    _armed.hit(point, scope=scope)
 
 
 class ChaosCheckpointEngine:

@@ -96,6 +96,12 @@ def pytest_configure(config):
         "test fast instead of eating the suite budget. A hang inside a "
         "C-level XLA call can't be interrupted this way — the outer "
         "tier-1 `timeout` still bounds those")
+    config.addinivalue_line(
+        "markers", "fleet: multi-replica serving-fleet tests (FleetRouter "
+        "failover/hedging/draining over chaos-killed and chaos-hung "
+        "replicas — CPU backend, tier-1-eligible under JAX_PLATFORMS=cpu; "
+        "the zero-lost-uid / zero-KV-leak invariants are the acceptance "
+        "criteria)")
 
 
 @pytest.hookimpl(wrapper=True)

@@ -190,6 +190,38 @@ class TestChaosHarness:
         chaos.chaos_point("p")   # second hit passes
         chaos.chaos_point("unarmed-point")
 
+    def test_hang_action_blocks_without_raising(self):
+        plan = chaos.FaultPlan.parse("serving/hang=hang:0.05:2")
+        assert plan.rules == {"serving/hang": ("hang", 2, 0.05)}
+        chaos.arm(plan)
+        t0 = time.monotonic()
+        chaos.chaos_point("serving/hang")       # blocks, never raises
+        chaos.chaos_point("serving/hang")
+        assert time.monotonic() - t0 >= 0.1
+        t0 = time.monotonic()
+        chaos.chaos_point("serving/hang")       # budget spent — instant
+        assert time.monotonic() - t0 < 0.04
+        assert plan.hits("serving/hang") == 3
+        # defaults: bare "hang" = one 0.05s stall
+        assert chaos.FaultPlan.parse("p=hang").rules == {"p": ("hang", 1,
+                                                               0.05)}
+
+    def test_scoped_rules_target_one_replica(self):
+        """A ``point@scope`` rule fires only for the matching scope —
+        how fleet tests crash replica r1 while r0 stays healthy — and a
+        scoped rule outranks an unscoped one for its scope."""
+        plan = chaos.arm("serving/tick@r1=fail:99")
+        chaos.chaos_point("serving/tick", scope="r0")     # healthy
+        chaos.chaos_point("serving/tick")                 # unscoped hit
+        with pytest.raises(chaos.ChaosError):
+            chaos.chaos_point("serving/tick", scope="r1")
+        assert plan.hits("serving/tick@r1") == 1
+        assert plan.hits("serving/tick") == 0
+        # unscoped rules still match every scope
+        plan = chaos.arm("serving/tick=fail:99")
+        with pytest.raises(chaos.ChaosError):
+            chaos.chaos_point("serving/tick", scope="anything")
+
     def test_failing_writes_shim(self, tmp_path):
         target = tmp_path / "f.txt"
         with chaos.failing_writes(str(tmp_path), first_n=1):

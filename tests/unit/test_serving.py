@@ -637,6 +637,27 @@ class TestServingConfig:
         assert ticks < 400                          # backoff window
         fe.close()
 
+    def test_run_until_drained_deadline_escape(self):
+        """``max_ticks`` bounds iterations, not TIME — with open-circuit
+        sleeps in the loop, only ``deadline_s`` bounds how long a drain
+        against a persistently sick replica can block."""
+        fe = _front(circuit_failure_threshold=2, circuit_backoff_s=0.2,
+                    circuit_backoff_max_s=5.0)
+        fe.submit(1, _prompt(8), max_new_tokens=2)
+        fe.run_tick()
+        chaos.arm("serving/tick=fail:1000")
+        fe.run_tick(), fe.run_tick()
+        assert fe.breaker.state == OPEN
+        t0 = time.monotonic()
+        fe.run_until_drained(10_000, deadline_s=0.3)
+        assert time.monotonic() - t0 < 2.0
+        assert fe.active_count() == 1       # gave up with work pending
+        chaos.disarm()
+        time.sleep(0.21)                    # wait out the open window
+        fe.run_until_drained(400)
+        assert fe.result(1).state == "completed"
+        fe.close()
+
     def test_two_frontends_get_distinct_health_probes(self):
         fe1 = _front()
         fe2 = _front()

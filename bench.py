@@ -277,6 +277,12 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     except Exception as e:
         print(f"bench: collective ledger unavailable for this entry "
               f"({type(e).__name__}: {e})", file=sys.stderr)
+    # hlolint gate (mirrors BENCH_DSLINT, compiled-program edition): a
+    # round whose LOWERED step violates its contract is refused, not
+    # recorded — the lint reuses the ledger lowering cached just above,
+    # so a clean step costs nothing extra. Raising here turns the row
+    # into an explicit error row (the --entry wrapper's contract).
+    _hlolint_entry_gate(engine, seq_len)
     # price the scrape-time gauges (tokens/s from the fenced window, measured
     # MFU via XLA cost analysis) while the engine is still alive — the
     # --entry wrapper then embeds the full snapshot in this row's JSON
@@ -1327,6 +1333,43 @@ def headline_entry():
         **({"overlap_fraction": headline["overlap_fraction"]}
            if "overlap_fraction" in headline else {}),
     }
+
+
+def _hlolint_entry_gate(engine, seq_len):
+    """Refuse to record a train row whose LOWERED step violates its
+    compiled-program contract (``deepspeed_tpu/analysis/hlolint``): the
+    structural rules always run against the engine's resolved config
+    (wire format, overlap plan, bucket plan), and
+    ``BENCH_HLOLINT_CONTRACT`` names a committed contract JSON to hold
+    the step to on top. A violating round's numbers are
+    unrepresentative by construction — the "optimization" being
+    measured isn't in the program. ``BENCH_HLOLINT=0`` opts out for
+    local what-if runs, mirroring ``BENCH_DSLINT``; a broken linter
+    degrades to ungated, never kills the measured row."""
+    if os.environ.get("BENCH_HLOLINT", "1") == "0":
+        return
+    contract = os.environ.get("BENCH_HLOLINT_CONTRACT") or None
+    try:
+        findings = engine.lint_step(contract=contract, seq_len=seq_len)
+    except Exception as e:
+        if contract and type(e).__name__ == "ContractError":
+            # the operator EXPLICITLY named a contract: a typo'd path or
+            # malformed file must fail the row, not silently disarm the
+            # gate the operator believes is armed
+            raise RuntimeError(
+                f"hlolint: cannot enforce BENCH_HLOLINT_CONTRACT="
+                f"{contract}: {e}") from e
+        print(f"bench: hlolint gate unavailable ({type(e).__name__}: {e});"
+              " proceeding ungated", file=sys.stderr)
+        return
+    if findings:
+        for f in findings[:20]:
+            print(f"bench: hlolint: {f.render()}", file=sys.stderr)
+        raise RuntimeError(
+            f"hlolint: {len(findings)} compiled-program contract "
+            f"violation(s) in the lowered step — refusing to record "
+            f"(first: {findings[0].render()[:160]}; BENCH_HLOLINT=0 "
+            "overrides locally)")
 
 
 def _dslint_gate():

@@ -1,0 +1,218 @@
+"""hlolint — contract checking for compiled XLA programs.
+
+The raw-speed arc's load-bearing invariants (async start/done pairs,
+quantized wire bytes, fenced bucket counts, no host transfers in the hot
+step) exist only in the LOWERED program — ``jit(...).lower().compile()
+.as_text()`` — so this package lints that artifact, dslint-style: ~7
+rule passes (``hlolint/rules.py``) plus a committed per-(program,
+config) **contract** system (``hlolint/contracts/*.json``) whose
+ceilings only shrink and floors only rise.
+
+Front ends:
+
+* ``python -m deepspeed_tpu.analysis.hlolint`` / ``tools/hlolint`` /
+  the ``hlolint`` console entry — lint a committed/captured ``.hlo.txt``
+  (``--contract``), every committed fixture+contract pair
+  (``--fixtures``), or a live-lowered engine step (``--live``);
+* ``engine.lint_step()`` — lints the SAME program
+  ``_dispatch_train_step`` runs (via ``ledger_for_engine``'s mirrored
+  builder selection), with the lint config derived from the engine's
+  resolved wire format, overlap plan, and bucket plan; the ``"hlolint"``
+  config section enforces it at initialize;
+* ``tools/step-report --lint`` — roofline report and contract check in
+  one pass over the same lowering;
+* ``bench.py`` — refuses to record a round whose lowered step violates
+  its contract (``BENCH_HLOLINT=0`` overrides locally, mirroring
+  ``BENCH_DSLINT``).
+
+Exit codes (CLI): 0 = clean, 1 = violation(s) — each named with the
+rule and before/after numbers on stderr — 2 = unreadable HLO/contract
+or usage error. Rule catalog: README "HLO contracts"; worked example:
+``docs/tutorials/hlolint.md``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.analysis.hlolint.core import (
+    CONTRACT_BOUNDS,
+    ContractError,
+    HloFinding,
+    HloLintViolation,
+    LintConfig,
+    bootstrap_contract,
+    check_contract,
+    contract_observations,
+    contracts_dir,
+    fixture_pairs,
+    iter_rule_findings,
+    load_contract,
+    program_stem,
+    write_contract,
+)
+from deepspeed_tpu.analysis.hlolint.rules import (
+    ALL_RULES,
+    RULE_IDS,
+    select_rules,
+)
+
+__all__ = [
+    "ALL_RULES", "RULE_IDS", "CONTRACT_BOUNDS", "ContractError",
+    "HloFinding", "HloLintViolation", "LintConfig",
+    "bootstrap_contract", "check_contract",
+    "contract_observations", "contracts_dir", "fixture_pairs",
+    "iter_rule_findings", "load_contract", "program_stem",
+    "select_rules", "write_contract", "lint_hlo", "lint_ledger",
+    "lint_fixture", "lint_engine", "default_fixtures_dir",
+]
+
+
+def lint_hlo(hlo_text: str, cfg: LintConfig,
+             rules=None) -> List[HloFinding]:
+    """Lint raw compiled-HLO text against ``cfg`` (and its contract, if
+    one is attached). The pure-text entry point — no device, no jax."""
+    from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+    ledger = build_ledger(hlo_text, program=cfg.program, world=cfg.world,
+                          zero_stage=cfg.zero_stage)
+    return iter_rule_findings(ledger, cfg, rules=rules)
+
+
+def lint_ledger(ledger, cfg: LintConfig,
+                rules=None) -> List[HloFinding]:
+    """Lint an already-built ledger (live engines reuse the cached
+    ``ledger_for_engine`` lowering — a lint never pays a second
+    compile)."""
+    return iter_rule_findings(ledger, cfg, rules=rules)
+
+
+def lint_fixture(hlo_path: str, contract_path: str,
+                 rules=None) -> List[HloFinding]:
+    """Lint one committed ``.hlo.txt`` against its committed contract —
+    the lint config comes from the contract's ``config`` block, the
+    program name from the fixture's file stem."""
+    data = load_contract(contract_path)
+    cfg = LintConfig.from_contract(data, program=program_stem(hlo_path))
+    try:
+        with open(hlo_path) as f:
+            text = f.read()
+    except OSError as e:
+        raise ContractError(f"cannot read HLO {hlo_path}: {e}")
+    return lint_hlo(text, cfg, rules=rules)
+
+
+def default_fixtures_dir(start: Optional[str] = None) -> Optional[str]:
+    """Locate the repo's committed ``tests/unit/observatory_fixtures``
+    by walking up from ``start`` (default: this package's checkout),
+    then from the CWD. None when not in a checkout (installed
+    package without the test tree)."""
+    roots = []
+    if start:
+        roots.append(os.path.abspath(start))
+    here = os.path.dirname(os.path.abspath(__file__))
+    roots.extend([here, os.getcwd()])
+    for root in roots:
+        cur = root
+        for _ in range(8):
+            cand = os.path.join(cur, "tests", "unit",
+                                "observatory_fixtures")
+            if os.path.isdir(cand):
+                return cand
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    return None
+
+
+def lint_engine(engine, contract: Optional[str] = None,
+                seq_len: Optional[int] = None,
+                rules=None) -> List[HloFinding]:
+    """Lint a live engine's lowered fused train step.
+
+    The program is the SAME one ``_dispatch_train_step`` runs
+    (``ledger_for_engine`` mirrors ``_select_step_builder`` and caches
+    the lowering), and the lint config is derived from the engine's
+    resolved state: wire format and quant flags from ``_wire_format()`` /
+    ``_compressed``, the async expectation from the overlap plan AND the
+    backend (the CPU tier lowers sync-only — honest ``expect_async=
+    False``), the fence-defeat floor from the live bucket plan, and the
+    replication budgets from the parameter tree + grad-accumulation
+    schedule. ``contract`` (a path) additionally applies the committed
+    contract rule.
+    """
+    import jax
+
+    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
+    from deepspeed_tpu.profiling.observatory.report import (
+        _zero_memory_prediction,
+    )
+
+    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=seq_len)
+    plan = engine.overlap_plan()
+    compressed = getattr(engine, "_compressed", None) or {}
+    planned = None
+    param_bytes = None
+    try:
+        leaves = jax.tree.leaves(engine._shapes)
+        sizes = [int(_leaf_elems(s)) for s in leaves]
+        param_bytes = sum(n * _leaf_itemsize(s)
+                          for n, s in zip(sizes, leaves))
+        # the fence-defeat floor only exists where grad-sync collectives
+        # exist: on a single-device data-parallel world GSPMD elides
+        # them entirely, and a floor of len(plan) would refuse every
+        # healthy 1-chip job
+        if plan.get("enabled") and engine.zero_stage >= 2 \
+                and engine.dp_world_size > 1:
+            from deepspeed_tpu.parallel.overlap import plan_buckets
+
+            planned = len(plan_buckets(sizes,
+                                       plan["reduce_bucket_elems"]))
+    except (TypeError, ValueError, AttributeError) as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"hlolint bucket-plan derivation skipped "
+                     f"({type(e).__name__}: {e})")
+    predicted = _zero_memory_prediction(engine) or {}
+    cdata = load_contract(contract) if contract else None
+    cfg = LintConfig(
+        program=ledger.program, world=ledger.world,
+        zero_stage=engine.zero_stage,
+        wire_format=engine._wire_format(),
+        quant_grads=bool(compressed.get("quant_grads")),
+        quant_weights=bool(compressed.get("quant_weights")),
+        expect_async=bool(plan.get("enabled"))
+        and jax.default_backend() in ("tpu", "gpu"),
+        planned_grad_sync_collectives=planned,
+        param_bytes=param_bytes,
+        # the bound is on the compiled TEXT (a rolled grad-accumulation
+        # loop shows its collectives once, so gas does not multiply):
+        # fwd gather + remat'd bwd regather + the step-boundary full
+        # gather + partitioner duplication measures 3.7-4.7x tree bytes
+        # on legitimate zero2/zero3 steps; a per-use no-reuse leak is
+        # O(layers)x — 6.0 splits those regimes with margin
+        max_full_gathers=6.0,
+        args_bytes=(mem or {}).get("argument_size_in_bytes"),
+        predicted_state_bytes=predicted.get("state_bytes_per_device"),
+        contract=(cdata or {}).get("contract"))
+    if cdata:
+        # live lints derive the structural expectations from the engine
+        # itself; the only config-block knob a contract adds on top is
+        # the memory-replication ceiling (engine state can't declare it)
+        ceiling = (cdata.get("config") or {}).get("args_vs_state_max")
+        if ceiling:
+            cfg.args_vs_state_max = float(ceiling)
+    return lint_ledger(ledger, cfg, rules=rules)
+
+
+def _leaf_elems(shape_struct) -> int:
+    n = 1
+    for d in getattr(shape_struct, "shape", ()) or ():
+        n *= int(d)
+    return n
+
+
+def _leaf_itemsize(shape_struct) -> int:
+    dtype = getattr(shape_struct, "dtype", None)
+    return int(getattr(dtype, "itemsize", 4) or 4)

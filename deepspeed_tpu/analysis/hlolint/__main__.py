@@ -1,0 +1,256 @@
+"""``python -m deepspeed_tpu.analysis.hlolint`` — the hlolint CLI.
+
+Exit codes (the dslint contract): 0 = clean, 1 = violation(s) — each
+printed to stderr as ``hlolint: [rule] program: message (contract=X,
+observed=Y)`` — 2 = unreadable HLO/contract, usage error, or a failed
+live lowering.
+
+Modes::
+
+    # lint a committed/captured HLO dump against its committed contract
+    hlolint tests/unit/observatory_fixtures/zero2_qgz_bucketed_async_step.hlo.txt \\
+        --contract deepspeed_tpu/analysis/hlolint/contracts/zero2_qgz_bucketed_async_step.json
+
+    # lint a dump with structural rules only (config from flags)
+    hlolint step.hlo.txt --world 8 --zero-stage 3 --expect-async
+
+    # every committed fixture against every committed contract (tier-1)
+    hlolint --fixtures
+
+    # live: lower the engine's real fused step and lint it
+    hlolint --live --model tiny --zero-stage 2
+
+    # bootstrap/retighten a contract from a dump (shrink-only:
+    # loosening an existing contract needs --allow-loosen)
+    hlolint step.hlo.txt --world 8 --zero-stage 2 --write-contract out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+from deepspeed_tpu.analysis.hlolint import (
+    ALL_RULES,
+    ContractError,
+    HloFinding,
+    LintConfig,
+    bootstrap_contract,
+    contracts_dir,
+    default_fixtures_dir,
+    fixture_pairs,
+    lint_fixture,
+    lint_hlo,
+    load_contract,
+    program_stem,
+    select_rules,
+    write_contract,
+)
+
+JSON_SCHEMA_VERSION = 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hlolint",
+        description="compiled-program contract checker: lints lowered "
+                    "XLA programs (HLO text) for the perf arc's "
+                    "invariants — async pairs, fenced buckets, wire "
+                    "dtypes, replication, host transfers — and enforces "
+                    "committed per-program contracts")
+    p.add_argument("hlo_file", nargs="?", default=None,
+                   help="compiled HLO text dump to lint")
+    p.add_argument("--contract", default=None, metavar="FILE",
+                   help="committed contract JSON (its config block "
+                        "supplies the lint config; flags override)")
+    p.add_argument("--fixtures", action="store_true",
+                   help="lint every committed observatory fixture "
+                        "against its committed contract")
+    p.add_argument("--fixtures-dir", default=None,
+                   help="fixture directory for --fixtures (default: "
+                        "the checkout's tests/unit/observatory_fixtures)")
+    p.add_argument("--contracts-dir", default=None,
+                   help="contract directory for --fixtures (default: "
+                        "the packaged analysis/hlolint/contracts)")
+    p.add_argument("--live", action="store_true",
+                   help="build a tiny engine, lower its REAL fused train "
+                        "step, and lint that program")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch", type=int, default=1)
+    # structural-config flags (fill/override the contract's config block)
+    p.add_argument("--world", type=int, default=None)
+    p.add_argument("--zero-stage", type=int, default=None)
+    p.add_argument("--wire-format", default=None,
+                   choices=("exact", "qz", "qz+loco", "onebit"))
+    p.add_argument("--quant-grads", action="store_true", default=None)
+    p.add_argument("--quant-weights", action="store_true", default=None)
+    p.add_argument("--expect-async", action="store_true", default=None)
+    p.add_argument("--planned-buckets", type=int, default=None,
+                   metavar="N", help="grad-sync collectives the bucket "
+                   "plan scheduled (fence-defeat floor)")
+    p.add_argument("--program", default=None,
+                   help="program label (default: the HLO file stem)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--write-contract", metavar="FILE", default=None,
+                   help="write the linted program's numbers as a "
+                        "contract (refuses to LOOSEN an existing one)")
+    p.add_argument("--allow-loosen", action="store_true",
+                   help="permit --write-contract to loosen committed "
+                        "bounds (deliberate regeneration only)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _config_from_args(args, program: str) -> LintConfig:
+    if args.contract:
+        cfg = LintConfig.from_contract(load_contract(args.contract),
+                                       program=program)
+    else:
+        cfg = LintConfig(program=program)
+    overrides = {
+        "world": args.world, "zero_stage": args.zero_stage,
+        "wire_format": args.wire_format, "quant_grads": args.quant_grads,
+        "quant_weights": args.quant_weights,
+        "expect_async": args.expect_async,
+        "planned_grad_sync_collectives": args.planned_buckets,
+    }
+    for key, val in overrides.items():
+        if val is not None:
+            setattr(cfg, key, val)
+    if args.wire_format in ("qz", "qz+loco") and args.quant_grads is None \
+            and not args.contract:
+        cfg.quant_grads = True
+    return cfg
+
+
+def _lint_one_file(args, rules) -> Tuple[List[HloFinding], LintConfig]:
+    program = args.program or program_stem(args.hlo_file)
+    cfg = _config_from_args(args, program)
+    try:
+        with open(args.hlo_file) as f:
+            text = f.read()
+    except OSError as e:
+        raise ContractError(f"cannot read HLO {args.hlo_file}: {e}")
+    return lint_hlo(text, cfg, rules=rules), cfg
+
+
+def _lint_fixtures(args, rules) -> Tuple[List[HloFinding], int]:
+    fdir = args.fixtures_dir or default_fixtures_dir()
+    if not fdir:
+        raise ContractError(
+            "--fixtures: no tests/unit/observatory_fixtures found from "
+            "here (pass --fixtures-dir)")
+    cdir = args.contracts_dir or contracts_dir()
+    findings: List[HloFinding] = []
+    pairs = fixture_pairs(fdir, cdir)
+    for hlo_path, contract_path in pairs:
+        findings.extend(lint_fixture(hlo_path, contract_path,
+                                     rules=rules))
+    return findings, len(pairs)
+
+
+def _lint_live(args, rules) -> List[HloFinding]:
+    import jax
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.analysis.hlolint import lint_engine
+
+    config = {
+        "train_batch_size": args.batch * jax.device_count(),
+        "train_micro_batch_size_per_gpu": args.batch,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": args.zero_stage
+                              if args.zero_stage is not None else 3},
+        "steps_per_print": 10 ** 9,
+    }
+    spec = dst.causal_lm_spec(args.model, dtype="float32")
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return lint_engine(engine, contract=args.contract,
+                       seq_len=args.seq_len, rules=rules)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.RULE_ID:24s} {rule.RULE_DOC}")
+        return 0
+    rules = None
+    programs = 1
+    try:
+        if args.rules:
+            rules = select_rules([r.strip()
+                                  for r in args.rules.split(",")])
+        if args.fixtures:
+            findings, programs = _lint_fixtures(args, rules)
+        elif args.live:
+            findings = _lint_live(args, rules)
+        elif args.hlo_file:
+            if args.write_contract:
+                return _write_contract_mode(args)
+            findings, _ = _lint_one_file(args, rules)
+        else:
+            print("hlolint: nothing to lint — pass an HLO file, "
+                  "--fixtures, or --live (see --help)", file=sys.stderr)
+            return 2
+    except (ContractError, KeyError) as e:
+        print(f"hlolint: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        # the --live leg can die inside jax/XLA; the documented contract
+        # is exit 2, never an undefined traceback code
+        print(f"hlolint: error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "programs": programs,
+            "findings": [f.to_json() for f in findings],
+            "counts": _counts(findings),
+            "ok": not findings,
+        }, indent=2))
+    else:
+        print(f"hlolint: {len(findings)} violation(s) across "
+              f"{programs} program(s)" if findings else
+              f"hlolint: clean ({programs} program(s))")
+    for f in findings:
+        print(f"hlolint: {f.render()}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+def _write_contract_mode(args) -> int:
+    from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+    program = args.program or program_stem(args.hlo_file)
+    cfg = _config_from_args(args, program)
+    with open(args.hlo_file) as f:
+        text = f.read()
+    ledger = build_ledger(text, program=program, world=cfg.world,
+                          zero_stage=cfg.zero_stage)
+    doc = bootstrap_contract(ledger, cfg,
+                             hlo_name=os.path.basename(args.hlo_file))
+    write_contract(args.write_contract, doc,
+                   allow_loosen=args.allow_loosen)
+    nbounds = len([k for k in doc["contract"] if k != "subsystems"]) \
+        + len(doc["contract"].get("subsystems", {}))
+    print(f"hlolint: wrote {nbounds} bound(s) for {program!r} to "
+          f"{args.write_contract}")
+    return 0
+
+
+def _counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,440 @@
+"""hlolint core: findings, lint configs, and the contract system.
+
+Vocabulary (dslint-shaped — ``analysis/core.py`` is the sibling for
+Python source; this package lints COMPILED XLA programs):
+
+* a **rule** is a callable ``check(ledger, cfg) -> Iterable[HloFinding]``
+  with ``RULE_ID`` / ``RULE_DOC`` attributes (see ``hlolint/rules.py``);
+* a **finding** is one diagnosed violation carrying the rule id, the
+  program name, and — wherever a numeric bound was crossed — the
+  ``limit`` (contract/expected) and ``observed`` numbers, so every
+  violation renders with before/after evidence;
+* a **lint config** (:class:`LintConfig`) declares what the program is
+  SUPPOSED to be (world, ZeRO stage, wire format, overlap expectation,
+  planned bucket count) — the structural rules judge the compiled
+  artifact against it;
+* a **contract** is a committed ``contracts/*.json`` per (program,
+  config) declaring ceilings (``*_max``: wire bytes, collective count,
+  unparsed ops, per-subsystem bytes) and floors (``*_min``: async
+  pairs, int8 transports) plus allowed dtypes by subsystem. Ceilings
+  only ever shrink and floors only ever rise — ``write_contract``
+  refuses a loosening rewrite (same posture as ``analysis/baseline.json``,
+  enforced in the other direction: a perf property once achieved is
+  committed, and a regression is a lint failure, not a silent drift).
+
+Everything here is stdlib-only: contracts and committed ``.hlo.txt``
+fixtures lint in tier-1 with no device and no jax import.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+CONTRACT_VERSION = 1
+
+#: dtypes that mean "the quantized wire was bypassed" when they carry
+#: the bulk of a supposedly-int8 subsystem's bytes
+WIDE_DTYPES = ("f32", "bf16", "f16", "f64")
+INT8_DTYPES = ("s8", "u8")
+
+
+class ContractError(ValueError):
+    """Unreadable/malformed contract or an illegal (loosening) rewrite."""
+
+
+class HloLintViolation(RuntimeError):
+    """A compiled program violated its contract where the caller asked
+    for enforcement (engine ``hlolint.fail_on_violation``, bench's
+    refuse-to-record gate)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class HloFinding:
+    """One diagnosed compiled-program violation.
+
+    ``limit`` is the contract/expected value, ``observed`` the number the
+    compiled artifact actually shows — every numeric violation renders
+    with both so a CI failure reads as evidence, not opinion.
+    """
+
+    rule: str
+    program: str
+    message: str
+    limit: Optional[float] = None
+    observed: Optional[float] = None
+
+    def render(self) -> str:
+        nums = ""
+        if self.limit is not None or self.observed is not None:
+            nums = (f" (contract={_fmt_num(self.limit)}, "
+                    f"observed={_fmt_num(self.observed)})")
+        return f"[{self.rule}] {self.program}: {self.message}{nums}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "program": self.program,
+                "message": self.message, "limit": self.limit,
+                "observed": self.observed}
+
+
+def program_stem(hlo_path: str) -> str:
+    """Program label of an HLO dump path: the basename minus the
+    ``.hlo.txt`` suffix (the fixture/contract naming convention — ONE
+    place, shared by lint_fixture and both CLI modes)."""
+    name = os.path.basename(hlo_path)
+    if name.endswith(".hlo.txt"):
+        name = name[:-len(".hlo.txt")]
+    return name
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """What the compiled program is SUPPOSED to be.
+
+    Built from a contract's ``config`` block (fixture lints), from CLI
+    flags (ad-hoc dumps), or from the live engine's resolved state
+    (``engine.lint_step``: wire format, overlap plan, bucket plan,
+    parameter-tree bytes, memory analysis).
+    """
+
+    program: str = "program"
+    world: int = 1
+    zero_stage: int = 0
+    #: engine ``_wire_format()`` vocabulary: exact / qz / qz+loco / onebit
+    wire_format: str = "exact"
+    quant_grads: bool = False
+    quant_weights: bool = False
+    #: True when the program SHOULD carry async start/done pairs — the
+    #: overlap scheduler is on AND the backend runs the async-collective
+    #: pass (TPU/GPU; the CPU tier lowers sync-only and must pass False)
+    expect_async: bool = False
+    #: grad-sync collectives the bucket plan scheduled (fence-defeat:
+    #: fewer in the HLO means XLA's combiner re-fused through the fences)
+    planned_grad_sync_collectives: Optional[int] = None
+    #: full parameter-tree bytes (accidental-replication leg A)
+    param_bytes: Optional[int] = None
+    #: full param-tree gathers per step the schedule legitimately needs
+    #: (fwd + remat'd bwd regather, times grad-accumulation micro-steps)
+    max_full_gathers: Optional[float] = None
+    #: memory_analysis args vs ZeRO-predicted resident state
+    #: (accidental-replication leg B; both sides + ceiling must be given)
+    args_bytes: Optional[float] = None
+    predicted_state_bytes: Optional[float] = None
+    args_vs_state_max: Optional[float] = None
+    #: fraction of a quantized subsystem's bytes the wide-dtype scale
+    #: companions may legitimately carry (qgZ f32 scales are ~1-2%)
+    wire_wide_dtype_max_frac: float = 0.5
+    #: the committed contract body (the ``"contract"`` block), if any
+    contract: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_contract(cls, data: Dict[str, Any],
+                      program: str = "") -> "LintConfig":
+        """LintConfig from a loaded contract document (``load_contract``
+        output): the ``config`` block supplies the structural-rule
+        expectations, the ``contract`` block the committed bounds."""
+        section = dict(data.get("config") or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(section) - known
+        if unknown:
+            raise ContractError(
+                f"contract config block has unknown key(s) "
+                f"{sorted(unknown)} (known: {sorted(known)})")
+        out = cls(**section)
+        out.program = program or data.get("program") or out.program
+        out.contract = data.get("contract") or None
+        return out
+
+
+# ------------------------------------------------------------------ #
+# observations: the numbers contracts bound
+# ------------------------------------------------------------------ #
+def contract_observations(ledger) -> Dict[str, Any]:
+    """Everything a contract can bound, measured from one ledger —
+    the shared vocabulary of ``check_contract`` and ``--write-contract``
+    (bootstrap writes exactly what checking later reads)."""
+    by_sub: Dict[str, Dict[str, Any]] = {}
+    for op in ledger.ops:
+        sub = op.subsystem or "other"
+        row = by_sub.setdefault(sub, {"bytes": 0, "count": 0,
+                                      "dtypes": set()})
+        row["bytes"] += op.size_bytes
+        row["count"] += 1
+        if op.dtype:
+            row["dtypes"].add(op.dtype)
+    return {
+        "async_pairs": ledger.async_pairs,
+        "wire_bytes": ledger.total_bytes(),
+        "collective_count": len(ledger.ops),
+        "unparsed": ledger.unparsed,
+        "int8_transports": sum(1 for op in ledger.ops
+                               if op.dtype in INT8_DTYPES),
+        "subsystems": {
+            sub: {"bytes": row["bytes"], "count": row["count"],
+                  "dtypes": sorted(row["dtypes"])}
+            for sub, row in sorted(by_sub.items())},
+    }
+
+
+#: top-level contract bounds: key -> (observation key, direction).
+#: ``min`` = floor (observed >= bound, bound may only rise on rewrite),
+#: ``max`` = ceiling (observed <= bound, bound may only fall). Counts
+#: and bytes carry BOTH directions: the ceiling pins the perf claim,
+#: the floor pins that the program (and the parser reading it) is still
+#: there at all — an empty/truncated dump or an op-regex regression
+#: yields zeros, which satisfy every ceiling and no floor.
+CONTRACT_BOUNDS = {
+    "async_pairs_min": ("async_pairs", "min"),
+    "wire_bytes_max": ("wire_bytes", "max"),
+    "wire_bytes_min": ("wire_bytes", "min"),
+    "collective_count_max": ("collective_count", "max"),
+    "collective_count_min": ("collective_count", "min"),
+    "unparsed_max": ("unparsed", "max"),
+    "int8_transports_min": ("int8_transports", "min"),
+}
+
+
+def check_contract(ledger, contract: Dict[str, Any],
+                   program: str) -> List[HloFinding]:
+    """The contract rule body: every committed bound against the
+    ledger's observations. Unknown bound keys are a loud error — a
+    typo'd ceiling that silently checks nothing is the config-key bug
+    class all over again."""
+    findings: List[HloFinding] = []
+    obs = contract_observations(ledger)
+    known = set(CONTRACT_BOUNDS) | {"subsystems"}
+    unknown = set(contract) - known
+    if unknown:
+        raise ContractError(
+            f"contract has unknown bound key(s) {sorted(unknown)} "
+            f"(known: {sorted(known)})")
+    for key, (obs_key, direction) in CONTRACT_BOUNDS.items():
+        bound = contract.get(key)
+        if bound is None:
+            continue
+        got = obs[obs_key]
+        bad = got < bound if direction == "min" else got > bound
+        if bad:
+            word = "floor" if direction == "min" else "ceiling"
+            findings.append(HloFinding(
+                "contract", program,
+                f"{obs_key} violates the committed {word} {key}",
+                limit=bound, observed=got))
+    for sub, bounds in (contract.get("subsystems") or {}).items():
+        got_row = obs["subsystems"].get(sub, {"bytes": 0, "count": 0,
+                                              "dtypes": []})
+        bmax = bounds.get("bytes_max")
+        if bmax is not None and got_row["bytes"] > bmax:
+            findings.append(HloFinding(
+                "contract", program,
+                f"subsystem {sub!r} bytes violate the committed ceiling",
+                limit=bmax, observed=got_row["bytes"]))
+        bmin = bounds.get("bytes_min")
+        if bmin is not None and got_row["bytes"] < bmin:
+            findings.append(HloFinding(
+                "contract", program,
+                f"subsystem {sub!r} bytes fell below the committed "
+                "floor — the collectives moved elsewhere (reattributed?)"
+                " or vanished from the program",
+                limit=bmin, observed=got_row["bytes"]))
+        allowed = bounds.get("allowed_dtypes")
+        if allowed is not None:
+            stray = sorted(set(got_row["dtypes"]) - set(allowed))
+            if stray:
+                findings.append(HloFinding(
+                    "contract", program,
+                    f"subsystem {sub!r} moves dtype(s) {stray} outside "
+                    f"the committed allowed_dtypes {sorted(allowed)}",
+                    limit=len(allowed), observed=len(got_row["dtypes"])))
+        unknown_sub = set(bounds) - {"bytes_max", "bytes_min",
+                                     "allowed_dtypes"}
+        if unknown_sub:
+            raise ContractError(
+                f"contract subsystem {sub!r} has unknown bound key(s) "
+                f"{sorted(unknown_sub)}")
+    return findings
+
+
+# ------------------------------------------------------------------ #
+# contract I/O
+# ------------------------------------------------------------------ #
+def contracts_dir() -> str:
+    """The committed per-fixture contracts shipping with the package."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "contracts")
+
+
+def load_contract(path: str) -> Dict[str, Any]:
+    """Contract file -> validated document. Malformed is a
+    :class:`ContractError` (the CLI's exit-2 class), never a silent
+    empty contract."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise ContractError(f"cannot read contract {path}: {e}")
+    except json.JSONDecodeError as e:
+        raise ContractError(f"malformed contract JSON {path}: {e}")
+    if not isinstance(data, dict) or \
+            data.get("version") != CONTRACT_VERSION or \
+            not isinstance(data.get("contract"), dict):
+        raise ContractError(
+            f"malformed contract {path}: expected "
+            '{"version": 1, "program": ..., "config": {...}, '
+            '"contract": {...}}')
+    return data
+
+
+def _loosenings(old: Dict[str, Any],
+                new: Dict[str, Any]) -> List[str]:
+    """Human-readable list of bounds ``new`` loosens relative to
+    ``old`` (empty = the rewrite only holds or tightens the line)."""
+    out: List[str] = []
+    for key, (_, direction) in CONTRACT_BOUNDS.items():
+        o, n = old.get(key), new.get(key)
+        if o is None or n is None:
+            if o is not None and n is None:
+                out.append(f"{key} dropped (was {_fmt_num(o)})")
+            continue
+        if (direction == "min" and n < o) or \
+                (direction == "max" and n > o):
+            out.append(f"{key} {_fmt_num(o)} -> {_fmt_num(n)}")
+    old_subs = old.get("subsystems") or {}
+    new_subs = new.get("subsystems") or {}
+    for sub, bounds in old_subs.items():
+        nb = new_subs.get(sub)
+        if nb is None:
+            out.append(f"subsystems.{sub} dropped")
+            continue
+        o, n = bounds.get("bytes_max"), nb.get("bytes_max")
+        if o is not None and (n is None or n > o):
+            out.append(f"subsystems.{sub}.bytes_max "
+                       f"{_fmt_num(o)} -> {_fmt_num(n)}")
+        o, n = bounds.get("bytes_min"), nb.get("bytes_min")
+        if o is not None and (n is None or n < o):
+            out.append(f"subsystems.{sub}.bytes_min "
+                       f"{_fmt_num(o)} -> {_fmt_num(n)}")
+        oa, na = bounds.get("allowed_dtypes"), nb.get("allowed_dtypes")
+        if oa is not None and (na is None or not set(na) <= set(oa)):
+            out.append(f"subsystems.{sub}.allowed_dtypes "
+                       f"{sorted(oa)} -> {sorted(na or [])}")
+    return out
+
+
+def bootstrap_contract(ledger, cfg: LintConfig,
+                       hlo_name: str = "") -> Dict[str, Any]:
+    """A fresh contract document pinning the ledger's CURRENT numbers
+    exactly (zero slack: committed fixtures are static artifacts — any
+    drift is a regeneration event that rewrites fixture and contract
+    together via ``tools/regen_hlo_fixtures.py``)."""
+    obs = contract_observations(ledger)
+    body: Dict[str, Any] = {
+        "wire_bytes_max": obs["wire_bytes"],
+        "wire_bytes_min": obs["wire_bytes"],
+        "collective_count_max": obs["collective_count"],
+        "collective_count_min": obs["collective_count"],
+        "unparsed_max": obs["unparsed"],
+    }
+    if cfg.expect_async or obs["async_pairs"]:
+        body["async_pairs_min"] = obs["async_pairs"]
+    if obs["int8_transports"]:
+        body["int8_transports_min"] = obs["int8_transports"]
+    body["subsystems"] = {
+        sub: {"bytes_max": row["bytes"],
+              "bytes_min": row["bytes"],
+              "allowed_dtypes": row["dtypes"]}
+        for sub, row in obs["subsystems"].items()}
+    section = {
+        "world": cfg.world, "zero_stage": cfg.zero_stage,
+        "wire_format": cfg.wire_format,
+        "quant_grads": cfg.quant_grads,
+        "quant_weights": cfg.quant_weights,
+        "expect_async": cfg.expect_async,
+    }
+    if cfg.planned_grad_sync_collectives is not None:
+        section["planned_grad_sync_collectives"] = \
+            cfg.planned_grad_sync_collectives
+    doc = {"version": CONTRACT_VERSION, "program": cfg.program,
+           "config": section, "contract": body}
+    if hlo_name:
+        doc["hlo"] = hlo_name
+    return doc
+
+
+def write_contract(path: str, doc: Dict[str, Any],
+                   allow_loosen: bool = False) -> None:
+    """Write a contract, refusing to LOOSEN an existing one: ceilings
+    only shrink, floors only rise (``allow_loosen=True`` is the explicit
+    regeneration escape hatch — fixture and contract rewritten together,
+    reviewed together)."""
+    if os.path.exists(path) and not allow_loosen:
+        old = load_contract(path)
+        loosened = _loosenings(old["contract"],
+                               doc.get("contract") or {})
+        if loosened:
+            raise ContractError(
+                f"refusing to loosen committed contract {path}: "
+                + "; ".join(loosened)
+                + " (pass --allow-loosen to regenerate deliberately)")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ #
+# fixture <-> contract pairing (the committed-artifact enforcement)
+# ------------------------------------------------------------------ #
+def fixture_pairs(fixtures_dir: str,
+                  contracts: Optional[str] = None
+                  ) -> List[Tuple[str, str]]:
+    """(hlo_path, contract_path) for every committed fixture. A fixture
+    with no contract (or vice versa) is an error — partial enforcement
+    is how invariants rot."""
+    contracts = contracts or contracts_dir()
+    if not os.path.isdir(fixtures_dir):
+        raise ContractError(f"fixtures dir {fixtures_dir!r} not found")
+    hlo = sorted(n for n in os.listdir(fixtures_dir)
+                 if n.endswith(".hlo.txt"))
+    pairs: List[Tuple[str, str]] = []
+    missing: List[str] = []
+    for name in hlo:
+        stem = name[:-len(".hlo.txt")]
+        cpath = os.path.join(contracts, stem + ".json")
+        if not os.path.exists(cpath):
+            missing.append(name)
+            continue
+        pairs.append((os.path.join(fixtures_dir, name), cpath))
+    if missing:
+        raise ContractError(
+            f"committed fixture(s) without a contract: {missing} — "
+            f"bootstrap with --write-contract (contracts dir: {contracts})")
+    claimed = {os.path.basename(h)[:-len('.hlo.txt')] for h, _ in pairs}
+    orphans = sorted(n[:-len('.json')] for n in os.listdir(contracts)
+                     if n.endswith(".json")
+                     and n[:-len('.json')] not in claimed)
+    if orphans:
+        raise ContractError(
+            f"contract(s) without a committed fixture: {orphans}")
+    return pairs
+
+
+def iter_rule_findings(ledger, cfg: LintConfig,
+                       rules: Optional[Iterable] = None
+                       ) -> List[HloFinding]:
+    """Run every rule pass over one ledger (the runner)."""
+    from deepspeed_tpu.analysis.hlolint.rules import ALL_RULES
+
+    findings: List[HloFinding] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        findings.extend(rule.check(ledger, cfg))
+    findings.sort(key=lambda f: (f.rule, f.message))
+    return findings

@@ -1,0 +1,320 @@
+"""hlolint rule passes: the perf arc's HLO invariants as checks.
+
+Each rule is ``check(ledger, cfg) -> Iterable[HloFinding]`` over one
+compiled program's :class:`~deepspeed_tpu.profiling.observatory.ledger.
+CollectiveLedger` (built from live lowering or a committed ``.hlo.txt``)
+plus the :class:`~deepspeed_tpu.analysis.hlolint.core.LintConfig`
+declaring what the program is supposed to be. The rationale (T3
+2401.16677, EQuARX 2506.17615): overlap structure and wire dtype/bytes
+ARE the optimization — they exist only in the lowered artifact, so the
+lowered artifact is the only place they can be checked exhaustively.
+
+Rule catalog (README "HLO contracts"):
+
+* **sync-collective** — the program claims overlap (``expect_async``)
+  but its async-eligible collectives (the ONE shared
+  ``observatory/hlo.ASYNC_FAMILIES`` table — same list
+  ``count_async_pairs`` matches) all lowered synchronous: nothing can
+  hide under compute.
+* **fence-defeat** — a bucketed config whose HLO shows FEWER grad-sync
+  collectives than ``plan_buckets`` planned: XLA's collective combiner
+  re-fused through the ``optimization_barrier`` fences and the size
+  bound is gone.
+* **wire-dtype** — a qgZ/qwZ config whose quantized subsystem moves
+  most of its bytes in wide dtypes: the quantization was silently
+  bypassed (config-plumbing regression), the f32 scale companions
+  alone never exceed ``wire_wide_dtype_max_frac``.
+* **accidental-replication** — param-gather bytes imply gathering the
+  full parameter tree more often than the schedule needs
+  (double-gather leak), or resident args exceed the
+  ``args_vs_predicted_state`` ceiling against the ZeRO
+  partitioning-math prediction.
+* **host-transfer** — infeed/outfeed/host sends/host custom-calls
+  inside the hot step: a host round-trip serializes the device.
+* **resharding-thrash** — a collective-permute/all-to-all directly
+  consuming the result of another op of the same family on the same
+  tensor: back-to-back resharding the partitioner should have
+  cancelled.
+* **contract** — the committed per-(program, config) bounds
+  (``contracts/*.json``): ceilings/floors on async pairs, wire bytes,
+  collective counts, int8 transports, per-subsystem bytes and allowed
+  dtypes (see ``core.check_contract``).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List
+
+from deepspeed_tpu.analysis.hlolint.core import (
+    HloFinding,
+    INT8_DTYPES,
+    LintConfig,
+    WIDE_DTYPES,
+    check_contract,
+)
+
+_QUANTIZED_SUBSYSTEM = {"quant_grads": "zero_grad_sync",
+                        "quant_weights": "zero_param_gather"}
+
+
+class _SyncCollective:
+    RULE_ID = "sync-collective"
+    RULE_DOC = ("overlap-enabled program whose async-eligible collectives "
+                "all lowered synchronous (no -start/-done pairs)")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        if not cfg.expect_async:
+            return
+        from deepspeed_tpu.profiling.observatory.hlo import async_family
+
+        eligible = [op for op in ledger.ops
+                    if async_family(op.hlo_opcode) is not None]
+        if eligible and ledger.async_pairs == 0:
+            kinds = sorted({op.kind for op in eligible})
+            yield HloFinding(
+                _SyncCollective.RULE_ID, ledger.program,
+                f"{len(eligible)} async-eligible collective(s) "
+                f"({', '.join(kinds)}) lowered with no -start/-done "
+                "async pair — the overlap scheduler's work cannot hide "
+                "under compute in this program",
+                limit=1, observed=0)
+
+
+class _FenceDefeat:
+    RULE_ID = "fence-defeat"
+    RULE_DOC = ("bucketed config whose HLO shows fewer grad-sync "
+                "collectives than plan_buckets planned (fences re-fused)")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        planned = cfg.planned_grad_sync_collectives
+        if not planned:
+            return
+        got = sum(1 for op in ledger.ops
+                  if (op.subsystem or "") == "zero_grad_sync")
+        if got < planned:
+            yield HloFinding(
+                _FenceDefeat.RULE_ID, ledger.program,
+                "grad-sync collectives in the compiled program fell "
+                "below the bucket plan — XLA's collective combiner "
+                "re-fused through the optimization_barrier fences, the "
+                "size bound no longer holds on the wire",
+                limit=planned, observed=got)
+
+
+class _WireDtype:
+    RULE_ID = "wire-dtype"
+    RULE_DOC = ("quantized-wire config whose grad-sync/param-gather "
+                "collectives move their bytes in f32/bf16 (quantization "
+                "bypassed)")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        for flag, sub in _QUANTIZED_SUBSYSTEM.items():
+            if not getattr(cfg, flag):
+                continue
+            ops = [op for op in ledger.ops
+                   if (op.subsystem or "") == sub]
+            total = sum(op.size_bytes for op in ops)
+            if not total:
+                continue
+            wide = sum(op.size_bytes for op in ops
+                       if op.dtype in WIDE_DTYPES)
+            ceiling = cfg.wire_wide_dtype_max_frac * total
+            if wide > ceiling:
+                narrow = sum(op.size_bytes for op in ops
+                             if op.dtype in INT8_DTYPES)
+                yield HloFinding(
+                    _WireDtype.RULE_ID, ledger.program,
+                    f"{flag} is on but subsystem {sub!r} moves "
+                    f"{wide} of {total} bytes in wide dtypes "
+                    f"({narrow} int8) — the quantized wire was "
+                    "silently bypassed (config-plumbing regression?); "
+                    "legit f32 scale companions stay under "
+                    f"{cfg.wire_wide_dtype_max_frac:.0%} of the "
+                    "subsystem",
+                    limit=round(ceiling), observed=wide)
+
+
+class _AccidentalReplication:
+    RULE_ID = "accidental-replication"
+    RULE_DOC = ("param-gather bytes imply gathering the full tree more "
+                "than the schedule needs, or resident args exceed the "
+                "ZeRO-predicted state ceiling")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        if cfg.param_bytes and cfg.max_full_gathers:
+            gathered = sum(op.size_bytes for op in ledger.ops
+                           if (op.subsystem or "") == "zero_param_gather")
+            budget = cfg.param_bytes * cfg.max_full_gathers
+            if gathered > budget:
+                yield HloFinding(
+                    _AccidentalReplication.RULE_ID, ledger.program,
+                    f"param-gather bytes exceed {cfg.max_full_gathers}x "
+                    f"the {cfg.param_bytes}-byte parameter tree — a "
+                    "double-gather / replication leak against the "
+                    "partitioning.leaf_grad_spec schedule",
+                    limit=round(budget), observed=gathered)
+        if cfg.args_bytes and cfg.predicted_state_bytes \
+                and cfg.args_vs_state_max:
+            ratio = cfg.args_bytes / cfg.predicted_state_bytes
+            if ratio > cfg.args_vs_state_max:
+                yield HloFinding(
+                    _AccidentalReplication.RULE_ID, ledger.program,
+                    "compiled-program resident args exceed the "
+                    "args_vs_predicted_state ceiling vs the ZeRO "
+                    "partitioning-math prediction — state is resident "
+                    "that stage "
+                    f"{cfg.zero_stage} promised to shard away",
+                    limit=cfg.args_vs_state_max, observed=round(ratio, 3))
+
+
+#: host-transfer vocabulary: opcodes that ARE host I/O, plus custom-call
+#: targets that smell like host callbacks (jax io_callback / debug
+#: callbacks lower to *python*callback custom-calls)
+_HOST_OPCODES = ("infeed", "outfeed")
+_HOST_TARGET = re.compile(r'custom_call_target="[^"]*'
+                          r'(?:host|callback|infeed|outfeed)[^"]*"',
+                          re.IGNORECASE)
+_HOST_TRANSFER_ATTR = "is_host_transfer=true"
+_MAX_SITE_FINDINGS = 8
+
+
+class _HostTransfer:
+    RULE_ID = "host-transfer"
+    RULE_DOC = ("infeed/outfeed/host custom-calls inside the hot step "
+                "(a host round-trip serializes the device)")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        from deepspeed_tpu.profiling.observatory.hlo import _OP_LINE
+
+        hits: List[str] = []
+        for line_no, line in enumerate(
+                (ledger.hlo_text or "").splitlines(), start=1):
+            m = _OP_LINE.match(line)
+            if m is None:
+                continue
+            opcode = m.group("opcode")
+            if opcode in _HOST_OPCODES:
+                hits.append(f"line {line_no}: {opcode}")
+            elif opcode in ("send", "recv", "send-done", "recv-done") \
+                    and _HOST_TRANSFER_ATTR in line:
+                hits.append(f"line {line_no}: host {opcode}")
+            elif opcode == "custom-call" and _HOST_TARGET.search(line):
+                target = _HOST_TARGET.search(line).group(0)
+                hits.append(f"line {line_no}: {target}")
+        for hit in hits[:_MAX_SITE_FINDINGS]:
+            yield HloFinding(
+                _HostTransfer.RULE_ID, ledger.program,
+                f"host transfer inside the compiled step ({hit}) — "
+                "the device stalls on the host every execution",
+                limit=0, observed=len(hits))
+        if len(hits) > _MAX_SITE_FINDINGS:
+            yield HloFinding(
+                _HostTransfer.RULE_ID, ledger.program,
+                f"... and {len(hits) - _MAX_SITE_FINDINGS} more host-"
+                "transfer site(s)",
+                limit=0, observed=len(hits))
+
+
+_THRASH_FAMILIES = ("collective-permute", "all-to-all")
+
+
+class _ReshardingThrash:
+    RULE_ID = "resharding-thrash"
+    RULE_DOC = ("a collective-permute/all-to-all directly consuming "
+                "another op of the same family (back-to-back resharding)")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        from deepspeed_tpu.profiling.observatory.hlo import (
+            _OP_LINE,
+            _operand_span,
+        )
+
+        def base_family(opcode: str):
+            for fam in _THRASH_FAMILIES:
+                if opcode == fam or opcode == fam + "-start" \
+                        or opcode == fam + "-done":
+                    return fam
+            return None
+
+        producers: Dict[str, str] = {}   # visible result name -> family
+        consumers = []                   # (result, family, operand names)
+        for line in (ledger.hlo_text or "").splitlines():
+            m = _OP_LINE.match(line)
+            if m is None:
+                continue
+            opcode = m.group("opcode")
+            fam = base_family(opcode)
+            if fam is None:
+                continue
+            result = m.group("result")
+            if not opcode.endswith("-start"):
+                # sync result or the -done half: the value later ops see
+                producers[result] = fam
+            if not opcode.endswith("-done"):
+                rest = line[m.end("opcode"):]
+                close = _operand_span(rest)
+                names = re.findall(r"%([\w.\-]+)", rest[:close + 1]) \
+                    if close != -1 else []
+                consumers.append((result, fam, names))
+        count = 0
+        for result, fam, names in consumers:
+            feeders = [n for n in names
+                       if producers.get(n) == fam and n != result]
+            for feeder in feeders:
+                count += 1
+                if count <= _MAX_SITE_FINDINGS:
+                    yield HloFinding(
+                        _ReshardingThrash.RULE_ID, ledger.program,
+                        f"%{result} ({fam}) directly consumes "
+                        f"%{feeder} ({fam}) — back-to-back resharding "
+                        "on the same tensor the partitioner should "
+                        "have cancelled",
+                        limit=0, observed=count)
+        if count > _MAX_SITE_FINDINGS:
+            yield HloFinding(
+                _ReshardingThrash.RULE_ID, ledger.program,
+                f"... and {count - _MAX_SITE_FINDINGS} more "
+                "back-to-back resharding pair(s)",
+                limit=0, observed=count)
+
+
+class _Contract:
+    RULE_ID = "contract"
+    RULE_DOC = ("committed per-(program, config) ceilings/floors: "
+                "async pairs, wire bytes, collective counts, int8 "
+                "transports, per-subsystem bytes + allowed dtypes")
+
+    @staticmethod
+    def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
+        if not cfg.contract:
+            return []
+        return check_contract(ledger, cfg.contract,
+                              cfg.program or ledger.program)
+
+
+ALL_RULES = (
+    _SyncCollective,
+    _FenceDefeat,
+    _WireDtype,
+    _AccidentalReplication,
+    _HostTransfer,
+    _ReshardingThrash,
+    _Contract,
+)
+
+RULE_IDS = tuple(r.RULE_ID for r in ALL_RULES)
+
+
+def select_rules(ids) -> List:
+    by_id = {r.RULE_ID: r for r in ALL_RULES}
+    unknown = [i for i in ids if i not in by_id]
+    if unknown:
+        raise KeyError(f"unknown hlolint rule(s) {unknown} "
+                       f"(known: {sorted(by_id)})")
+    return [by_id[i] for i in ids]

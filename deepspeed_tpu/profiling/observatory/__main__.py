@@ -131,21 +131,45 @@ def _live_report(args) -> Dict[str, Any]:
     report = step_report(
         engine, link_gbps=args.link_gbps, seq_len=args.seq_len,
         measure_with=lambda: engine.train_batch(data))
+    findings = []
+    if args.lint:
+        # --lint passthrough: hlolint the SAME cached lowering the
+        # ledger above just read — report and contract check in one pass
+        findings = engine.lint_step(contract=args.contract,
+                                    seq_len=args.seq_len)
     engine.shutdown_telemetry()
-    return report
+    return report, findings
 
 
-def _hlo_report(args) -> Dict[str, Any]:
+def _hlo_report(args):
     from deepspeed_tpu.profiling.observatory.ledger import build_ledger
 
     with open(args.hlo_file) as f:
         text = f.read()
     ledger = build_ledger(text, program=args.program or "hlo_file",
                           world=args.world, zero_stage=args.zero_stage)
+    findings = []
+    if args.lint:
+        # --lint passthrough over the same parsed ledger: the contract's
+        # config block supplies the lint expectations when given, else
+        # the CLI's world/zero-stage with structural rules only
+        from deepspeed_tpu.analysis.hlolint import (
+            LintConfig,
+            lint_ledger,
+            load_contract,
+        )
+
+        if args.contract:
+            cfg = LintConfig.from_contract(load_contract(args.contract),
+                                           program=ledger.program)
+        else:
+            cfg = LintConfig(program=ledger.program, world=args.world,
+                             zero_stage=args.zero_stage)
+        findings = lint_ledger(ledger, cfg)
     link = args.link_gbps or 0
     return {"report_version": 1, "program": ledger.program,
             "mode": "ledger_only",
-            "ledger": ledger.to_dict(link_gbps=link or None)}
+            "ledger": ledger.to_dict(link_gbps=link or None)}, findings
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -170,18 +194,35 @@ def main(argv: Optional[list] = None) -> int:
                    help="program label for --hlo-file reports")
     p.add_argument("--read", default=None,
                    help="pretty-print an existing report JSON")
+    p.add_argument("--lint", action="store_true",
+                   help="also run hlolint over the same lowering/ledger "
+                        "(exit 1 on violation, after printing the "
+                        "report; see tools/hlolint)")
+    p.add_argument("--contract", default=None, metavar="FILE",
+                   help="committed hlolint contract for --lint")
     p.add_argument("--format", choices=("json", "text"), default="json")
     p.add_argument("--out", default=None, help="also write the JSON here")
     args = p.parse_args(argv)
 
+    if args.contract:
+        # naming a contract IS asking for the check — silently ignoring
+        # it without --lint would read as "contract clean" unchecked
+        args.lint = True
+    if args.read and args.lint:
+        # --read has no HLO to lint; exiting 0 here would read as
+        # "contract clean" in a CI step that checked nothing
+        print("step-report: --lint needs an HLO source (--hlo-file or "
+              "live mode), not --read", file=sys.stderr)
+        return 2
+    findings = []
     try:
         if args.read:
             with open(args.read) as f:
                 report = json.load(f)
         elif args.hlo_file:
-            report = _hlo_report(args)
+            report, findings = _hlo_report(args)
         else:
-            report = _live_report(args)
+            report, findings = _live_report(args)
     except Exception as e:
         # the documented contract is 0 = report emitted, 2 = refused/
         # failed — a live-engine RuntimeError (no backend, XLA abort)
@@ -212,6 +253,10 @@ def main(argv: Optional[list] = None) -> int:
               else json.dumps(report, indent=2, sort_keys=True))
     else:
         print(json.dumps(report, sort_keys=True))
+    if findings:
+        for f in findings:
+            print(f"step-report: hlolint: {f.render()}", file=sys.stderr)
+        return 1
     return 0
 
 

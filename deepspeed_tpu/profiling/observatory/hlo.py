@@ -241,15 +241,42 @@ def iter_collective_lines(hlo_text: str) -> Iterable[str]:
 # ------------------------------------------------------------------ #
 # async start/done pairs (the overlap scheduler's HLO-level evidence)
 # ------------------------------------------------------------------ #
+#: THE one table of async-eligible collective opcode families — the
+#: opcodes XLA's AsyncCollectiveCreator pass rewrites into
+#: ``*-start``/``*-done`` pairs on TPU/GPU backends (all-to-all stays
+#: sync on current TPU pipelines unless fused, but the pass accepts it).
+#: Consumed by ``count_async_pairs``, ``asyncify_hlo``, AND hlolint's
+#: sync-collective rule (``analysis/hlolint/rules.py``) so the counter
+#: and the lint can never disagree about what counts as overlappable —
+#: e.g. ``collective-permute-start`` for the future compiled-pipeline
+#: lane is in or out for BOTH at once.
+ASYNC_FAMILIES = ("all-reduce", "all-gather", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+
+
+def async_family(opcode: str) -> Optional[str]:
+    """The async-eligible family of an HLO opcode (sync, ``-start`` and
+    ``-done`` spellings all map to the base family); None when the
+    opcode is not in :data:`ASYNC_FAMILIES`."""
+    base = opcode
+    for suffix in ("-start", "-done"):
+        if base.endswith(suffix):
+            base = base[:-len(suffix)]
+            break
+    return base if base in ASYNC_FAMILIES else None
+
+
 def count_async_pairs(hlo_text: str) -> int:
     """Matched ``*-start``/``*-done`` collective pairs in the dump.
 
     On backends whose async-collective pass runs (TPU, GPU) every
     overlappable collective lowers to a start/done pair — the count is
     direct evidence that the compiler can hoist the starts under
-    adjacent compute. Matched per opcode family (``min(starts, dones)``
-    summed), so a trimmed fixture missing one half never overcounts.
-    A sync-only dump (the CPU tier) honestly counts 0.
+    adjacent compute. Matched per :data:`ASYNC_FAMILIES` opcode family
+    (``min(starts, dones)`` summed), so a trimmed fixture missing one
+    half never overcounts, and a family the async pass can't produce
+    never counts at all (the hlolint sync-collective rule shares the
+    same table). A sync-only dump (the CPU tier) honestly counts 0.
     """
     starts: dict = {}
     dones: dict = {}
@@ -260,21 +287,20 @@ def count_async_pairs(hlo_text: str) -> int:
         opcode = m.group("opcode")
         if not _COLLECTIVE_OPCODE.match(opcode):
             continue
+        family = async_family(opcode)
+        if family is None:
+            continue
         if opcode.endswith("-start"):
-            family = opcode[:-len("-start")]
             starts[family] = starts.get(family, 0) + 1
         elif opcode.endswith("-done"):
-            family = opcode[:-len("-done")]
             dones[family] = dones.get(family, 0) + 1
     return sum(min(n, dones.get(family, 0))
                for family, n in starts.items())
 
 
-#: sync collective opcodes the TPU/GPU async pass rewrites (XLA
-#: AsyncCollectiveCreator); all-to-all stays sync on current TPU
-#: pipelines unless fused, but the rewrite accepts it for completeness.
-_ASYNCIFIABLE = ("all-reduce", "all-gather", "reduce-scatter",
-                 "all-to-all", "collective-permute")
+#: back-compat alias — the rewrite below and the counter above now share
+#: :data:`ASYNC_FAMILIES` as the single source of eligibility
+_ASYNCIFIABLE = ASYNC_FAMILIES
 
 
 def asyncify_hlo(hlo_text: str) -> str:

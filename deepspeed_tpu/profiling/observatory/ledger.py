@@ -117,6 +117,11 @@ class CollectiveLedger:
     #: cost_analysis cross-check (None = unavailable on this build)
     cost_flops: Optional[float] = None
     cost_bytes_accessed: Optional[float] = None
+    #: the raw HLO text this ledger was parsed from ("" when the caller
+    #: didn't keep it). hlolint's text-level rules (host-transfer,
+    #: resharding-thrash) re-scan it so a live lint never pays a second
+    #: lowering; deliberately NOT in ``to_dict`` — reports stay small.
+    hlo_text: str = ""
 
     # ---------------- aggregations ---------------- #
     def totals_by_kind(self) -> Dict[str, Dict[str, float]]:
@@ -273,7 +278,8 @@ def build_ledger(hlo_text: str, program: str = "program",
                             world=world, zero_stage=zero_stage,
                             async_pairs=count_async_pairs(hlo_text),
                             cost_flops=cost_flops,
-                            cost_bytes_accessed=cost_bytes_accessed)
+                            cost_bytes_accessed=cost_bytes_accessed,
+                            hlo_text=hlo_text)
 
 
 # ------------------------------------------------------------------ #
@@ -310,6 +316,37 @@ def memory_stats_dict(mem: Any) -> Optional[Dict[str, float]]:
         if val is not None:
             out[key] = float(val)
     return out or None
+
+
+#: opcodes hlolint's text-level rules scan (host-transfer vocabulary +
+#: the collective families resharding-thrash pairs up). The engine cache
+#: below trims the retained ``hlo_text`` to these lines — a real model's
+#: full dump is tens of MB and the observatory cache lives as long as
+#: the engine. Cross-reference: ``analysis/hlolint/rules.py``
+#: (_HOST_OPCODES / _THRASH_FAMILIES).
+_LINT_TEXT_OPCODES = ("infeed", "outfeed", "send", "recv", "send-done",
+                      "recv-done", "custom-call")
+_LINT_TEXT_PREFIXES = ("all-", "reduce-scatter", "collective-")
+
+
+def _trim_lint_text(hlo_text: str) -> str:
+    """The subset of op lines hlolint's text rules read, with every
+    dropped line replaced by an EMPTY line: line numbers in lint
+    findings must still point at the real dump (an operator re-dumping
+    the step and jumping to the cited line has to land on the cited
+    op). Memory stays bounded — the blanks cost one newline each."""
+    from deepspeed_tpu.profiling.observatory.hlo import _OP_LINE
+
+    keep = []
+    for i, line in enumerate(hlo_text.splitlines()):
+        if i == 0:   # module header identifies the program
+            keep.append(line)
+            continue
+        m = _OP_LINE.match(line)
+        op = m.group("opcode") if m else ""
+        keep.append(line if op in _LINT_TEXT_OPCODES
+                    or op.startswith(_LINT_TEXT_PREFIXES) else "")
+    return "\n".join(keep)
 
 
 def ledger_for_engine(engine, fold: bool = True,
@@ -354,6 +391,9 @@ def ledger_for_engine(engine, fold: bool = True,
             cost_flops=(float(costs["flops"]) if "flops" in costs else None),
             cost_bytes_accessed=(float(costs["bytes accessed"])
                                  if "bytes accessed" in costs else None))
+        # the cache outlives this call by the engine's lifetime: keep
+        # only the lines hlolint's text rules scan, not the full dump
+        ledger.hlo_text = _trim_lint_text(hlo_text)
         if ledger.cost_flops is not None and \
                 getattr(engine, "_tm_flops_cache", False) is None:
             # seed the measured-MFU pricing cache with this lowering's
@@ -410,6 +450,7 @@ def ledger_for_fastgen(engine, n_tokens: Optional[int] = None,
             cost_flops=(float(costs["flops"]) if "flops" in costs else None),
             cost_bytes_accessed=(float(costs["bytes accessed"])
                                  if "bytes accessed" in costs else None))
+        ledger.hlo_text = _trim_lint_text(hlo_text)   # cache-lifetime bound
         cached = cache[key] = (ledger, memory_stats_dict(mem))
     if fold:
         cached[0].fold_into_telemetry()

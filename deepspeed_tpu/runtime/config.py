@@ -251,6 +251,32 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class HlolintSectionConfig:
+    """Compiled-program contract enforcement at initialize
+    (``deepspeed_tpu/analysis/hlolint``).
+
+    ``enabled`` lowers the engine's REAL fused train step once at
+    initialize (the same lowering the observatory ledger caches — no
+    extra compile for jobs that also ledger/report) and runs the
+    hlolint rule passes over it: async-pair structure, fenced bucket
+    counts, wire dtypes, replication, host transfers. ``contract``
+    names a committed contract JSON to hold the step to on top of the
+    structural rules. With ``fail_on_violation`` (default) a violation
+    refuses the job before any chip time is spent — the same posture
+    bench.py takes before recording a round; off, violations log and
+    the job proceeds."""
+    enabled: bool = False
+    contract: str = ""
+    fail_on_violation: bool = True
+
+    def validate(self) -> None:
+        if self.contract and not isinstance(self.contract, str):
+            raise DeepSpeedConfigError(
+                f"hlolint.contract must be a path string, got "
+                f"{type(self.contract).__name__}")
+
+
+@dataclasses.dataclass
 class ServingSectionConfig:
     """Serving resilience front-end (``deepspeed_tpu/serving``).
 
@@ -671,6 +697,8 @@ class DeepSpeedTPUConfig:
         default_factory=ServingSectionConfig)
     fleet: FleetSectionConfig = dataclasses.field(
         default_factory=FleetSectionConfig)
+    hlolint: HlolintSectionConfig = dataclasses.field(
+        default_factory=HlolintSectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

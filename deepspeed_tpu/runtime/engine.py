@@ -389,6 +389,33 @@ class DeepSpeedTPUEngine:
             f"zero_stage={self.zero_stage} precision={self.precision} "
             f"mesh={self.mesh_manager} micro_bs={self.train_micro_batch_size()} "
             f"gas={self.gradient_accumulation_steps()}")
+        self._enforce_hlolint()
+
+    def _enforce_hlolint(self) -> None:
+        """Compiled-program contract enforcement at initialize (the
+        ``"hlolint"`` config section): lower the REAL fused step once
+        (the observatory cache keeps it — ledger/report calls reuse the
+        same lowering) and lint it; with ``fail_on_violation`` a
+        violation refuses the job BEFORE chip time is spent."""
+        hlolint_cfg = self.config.hlolint
+        if not hlolint_cfg.enabled:
+            return
+        findings = self.lint_step(contract=hlolint_cfg.contract or None)
+        if not findings:
+            log_dist("hlolint: compiled train step clean"
+                     + (f" (contract {hlolint_cfg.contract})"
+                        if hlolint_cfg.contract else ""))
+            return
+        for f in findings:
+            log_dist(f"hlolint: {f.render()}")
+        if hlolint_cfg.fail_on_violation:
+            from deepspeed_tpu.analysis.hlolint import HloLintViolation
+
+            raise HloLintViolation(
+                f"hlolint: {len(findings)} compiled-program contract "
+                f"violation(s) in the lowered train step — first: "
+                f"{findings[0].render()} (set hlolint.fail_on_violation "
+                "false to proceed anyway)")
 
     # ------------------------------------------------------------------ #
     # compressed collectives (ZeRO++ qwZ/qgZ, 1-bit transport)
@@ -939,6 +966,21 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.profiling.observatory import step_report
 
         return step_report(self, **kwargs)
+
+    def lint_step(self, contract: Optional[str] = None,
+                  seq_len: Optional[int] = None) -> List:
+        """hlolint over THIS engine's lowered fused train step — the
+        ``tools/hlolint --live`` path in library form. The linted
+        program is the one ``_dispatch_train_step`` runs (the
+        observatory's ``ledger_for_engine`` mirrors
+        ``_select_step_builder`` and caches the lowering), and the lint
+        config comes from the engine's resolved wire format, overlap
+        plan, and bucket plan. ``contract`` names a committed contract
+        JSON to enforce on top of the structural rules. Returns the
+        violations (empty = clean)."""
+        from deepspeed_tpu.analysis.hlolint import lint_engine
+
+        return lint_engine(self, contract=contract, seq_len=seq_len)
 
     @staticmethod
     def _count_tokens(stacked: PyTree) -> int:

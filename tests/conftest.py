@@ -89,6 +89,14 @@ def pytest_configure(config):
         "start/done pair pinning over committed HLO fixtures — tier-1-"
         "eligible under JAX_PLATFORMS=cpu)")
     config.addinivalue_line(
+        "markers", "hlolint: compiled-program contract-checker tests "
+        "(rule passes + committed contracts over the committed HLO "
+        "fixtures, CLI exit-code matrix, shrink-only contract rewrites, "
+        "live engine.lint_step + bench refuse-to-record — tier-1-"
+        "eligible under JAX_PLATFORMS=cpu; the six committed "
+        "observatory_fixtures/*.hlo.txt are enforced against "
+        "analysis/hlolint/contracts/ here)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

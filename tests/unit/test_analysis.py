@@ -163,6 +163,33 @@ class TestConfigKeys:
         assert consumed == bucket_keys, (
             f"bucket keys no longer consumed: {bucket_keys - consumed}")
 
+    def test_hlolint_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for the compiled-program contract checker
+        # (ISSUE 12): the "hlolint" config section's keys must stay OUT
+        # of the dead-key ledger and stay actually consumed (the engine
+        # reads them in _enforce_hlolint — a refactor that drops the
+        # read would silently turn contract enforcement decorative, the
+        # exact failure mode the wire-dtype rule exists to catch one
+        # layer down)
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        hlolint_keys = {"hlolint", "fail_on_violation"}
+        assert not hlolint_keys & set(DEAD_KEYS), (
+            "hlolint section keys declared dead — runtime/engine.py "
+            "consumes them (_enforce_hlolint/lint_step)")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, hlolint_keys)
+        assert consumed == hlolint_keys, (
+            f"hlolint keys no longer consumed: "
+            f"{hlolint_keys - consumed}")
+        # 'enabled'/'contract' are shared across sections; pin them as
+        # consumed too (they are — by this section among others)
+        generic = consumed_attr_keys(proj, {"enabled", "contract"})
+        assert generic == {"enabled", "contract"}
+
     def test_dead_key_ledger_entries_are_actually_dead(self):
         # every DEAD_KEYS entry must be honest: not read as a config attr
         # anywhere in the package (the rule flags per-site; this pins the

@@ -1,0 +1,841 @@
+"""hlolint — compiled-program contract checker (ISSUE 12).
+
+Four layers of coverage:
+
+1. Structural rule passes over synthetic HLO snippets and the committed
+   fixtures: sync-collective (sharing ``observatory/hlo.ASYNC_FAMILIES``
+   with ``count_async_pairs`` — the one eligibility table), fence-defeat,
+   wire-dtype, accidental-replication, host-transfer, resharding-thrash.
+2. The contract system: observation extraction, floor/ceiling checking
+   with before/after numbers, shrink-only rewrites (``write_contract``
+   refuses to loosen), and the committed six-fixture/six-contract
+   enforcement — the tier-1 teeth for the perf arc's invariants
+   (async_pairs >= 1, wire bytes <= 1/3 of exact, 16 int8 transports),
+   which used to live as ad-hoc asserts in test_overlap.py /
+   test_wire_overlap.py and now have exactly ONE enforcement path.
+3. The CLI exit-code matrix (subprocess): clean=0; violation=1 with the
+   rule named and contract/observed numbers on stderr (including a
+   seeded violation: a tightened ceiling on a real fixture); unreadable
+   HLO/contract=2; ``--write-contract`` bootstrap + loosen-refusal.
+4. Live enforcement: ``engine.lint_step`` over the real lowered step,
+   the ``"hlolint"`` config section refusing initialize on violation,
+   and bench.py's refuse-to-record gate (subprocess + in-process
+   ``BENCH_HLOLINT=0`` override).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.hlolint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "observatory_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+HLOLINT = os.path.join(REPO_ROOT, "tools", "hlolint")
+
+QGZ = "zero2_qgz_bucketed_async_step"
+EXACT = "zero2_exact_bucketed_step"
+
+
+def fixture_path(stem):
+    return os.path.join(FIXTURES, stem + ".hlo.txt")
+
+
+def fixture_text(stem):
+    with open(fixture_path(stem)) as f:
+        return f.read()
+
+
+def committed_contract(stem):
+    from deepspeed_tpu.analysis.hlolint import contracts_dir
+
+    return os.path.join(contracts_dir(), stem + ".json")
+
+
+def run_cli(*args, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, HLOLINT, *args],
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO_ROOT, timeout=300)
+
+
+# A minimal sync all-reduce line (grad-sync attributed at stage >= 1)
+_AR = ('  %%ar.%d = f32[1024]{0} all-reduce(f32[1024]{0} %%p%d), '
+       'replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%%add, '
+       'metadata={op_name="jit(f)/transpose(body)/psum"}')
+
+
+def sync_allreduce_text(n=3):
+    return "\n".join(_AR % (i, i) for i in range(n)) + "\n"
+
+
+# --------------------------------------------------------------------- #
+# structural rules (synthetic + fixture inputs)
+# --------------------------------------------------------------------- #
+class TestSyncCollectiveRule:
+    def _lint(self, text, **cfg_kwargs):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        return lint_hlo(text, LintConfig(world=8, zero_stage=2,
+                                         **cfg_kwargs))
+
+    def test_fires_on_sync_dump_when_async_expected(self):
+        found = self._lint(fixture_text("zero2_tiny_step"),
+                           expect_async=True)
+        rules = {f.rule for f in found}
+        assert "sync-collective" in rules
+        f = next(f for f in found if f.rule == "sync-collective")
+        assert f.observed == 0 and f.limit == 1
+
+    def test_silent_without_expectation_and_on_async_dump(self):
+        # the CPU tier lowers sync-only: expect_async=False is honest
+        assert self._lint(fixture_text("zero2_tiny_step")) == []
+        assert self._lint(fixture_text(QGZ), expect_async=True,
+                          wire_format="qz+loco", quant_grads=True) == []
+
+    def test_shares_the_async_family_table_with_count_async_pairs(self):
+        # the satellite contract: ONE table (hlo.ASYNC_FAMILIES) decides
+        # eligibility for BOTH the pair counter and the lint. A matched
+        # pair of a family outside the table (collective-broadcast)
+        # counts zero pairs; a collective-permute pair (the future
+        # compiled-pipeline lane) counts for both.
+        from deepspeed_tpu.profiling.observatory.hlo import (
+            ASYNC_FAMILIES,
+            async_family,
+            count_async_pairs,
+        )
+
+        assert "collective-permute" in ASYNC_FAMILIES
+        assert async_family("collective-permute-start") == \
+            "collective-permute"
+        assert async_family("all-gather-done") == "all-gather"
+        assert async_family("collective-broadcast-start") is None
+
+        foreign = (
+            "  %cb-start = (f32[8]{0}, f32[8]{0}) "
+            "collective-broadcast-start(f32[8]{0} %p), "
+            "replica_groups={{0,1}}\n"
+            "  %cb = f32[8]{0} collective-broadcast-done("
+            "(f32[8]{0}, f32[8]{0}) %cb-start)\n")
+        assert count_async_pairs(foreign) == 0
+        permute = (
+            "  %cp-start = (f32[8]{0}, f32[8]{0}) "
+            "collective-permute-start(f32[8]{0} %p), "
+            "source_target_pairs={{0,1},{1,0}}\n"
+            "  %cp = f32[8]{0} collective-permute-done("
+            "(f32[8]{0}, f32[8]{0}) %cp-start)\n")
+        assert count_async_pairs(permute) == 1
+        # and the lint sees the permute-only program as async-satisfied
+        found = self._lint(permute, expect_async=True)
+        assert all(f.rule != "sync-collective" for f in found)
+
+
+class TestFenceDefeatRule:
+    def _lint(self, text, planned):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        return [f for f in lint_hlo(
+            text, LintConfig(world=8, zero_stage=2,
+                             planned_grad_sync_collectives=planned))
+            if f.rule == "fence-defeat"]
+
+    def test_fewer_grad_syncs_than_planned_fires_with_numbers(self):
+        found = self._lint(sync_allreduce_text(3), planned=5)
+        assert len(found) == 1
+        assert found[0].limit == 5 and found[0].observed == 3
+        assert "re-fused" in found[0].message
+
+    def test_exact_or_more_is_clean(self):
+        assert self._lint(sync_allreduce_text(3), planned=3) == []
+        assert self._lint(sync_allreduce_text(5), planned=3) == []
+
+    def test_committed_bucketed_fixtures_hold_their_plan_floor(self):
+        # the two bucketed fixtures commit their grad-sync counts as the
+        # fence-defeat floor in their contracts' config blocks
+        from deepspeed_tpu.analysis.hlolint import load_contract
+
+        for stem in ("zero3_bucketed_async_step", QGZ):
+            section = load_contract(committed_contract(stem))["config"]
+            planned = section["planned_grad_sync_collectives"]
+            assert planned >= 1
+            assert self._lint(fixture_text(stem), planned) == []
+
+
+class TestWireDtypeRule:
+    def _lint(self, text, **kw):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        cfg = LintConfig(world=8, zero_stage=2, wire_format="qz",
+                         quant_grads=True, **kw)
+        return [f for f in lint_hlo(text, cfg) if f.rule == "wire-dtype"]
+
+    def test_all_wide_grad_sync_fires(self):
+        found = self._lint(sync_allreduce_text(3))
+        assert len(found) == 1
+        assert found[0].observed == 3 * 4096    # all bytes wide
+        assert "bypassed" in found[0].message
+
+    def test_committed_qgz_fixture_scales_stay_under_threshold(self):
+        # the real composed program: f32 scale companions are ~1.4% of
+        # the quantized subsystem — far under the 50% bypass threshold
+        assert self._lint(fixture_text(QGZ)) == []
+
+    def test_exact_fixture_with_qgz_config_fires(self):
+        found = self._lint(fixture_text(EXACT))
+        assert found and found[0].observed > found[0].limit
+
+    def test_quant_weights_checks_param_gather_lane(self):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        gather = (
+            '  %ag = f32[8,1024]{1,0} all-gather(f32[1,1024]{1,0} %p), '
+            'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, '
+            'metadata={op_name="jit(f)/qwz_wire/all_gather"}\n')
+        cfg = LintConfig(world=8, zero_stage=3, quant_weights=True)
+        found = [f for f in lint_hlo(gather, cfg)
+                 if f.rule == "wire-dtype"]
+        assert found and "zero_param_gather" in found[0].message
+
+
+class TestReplicationRule:
+    def _cfg(self, **kw):
+        from deepspeed_tpu.analysis.hlolint import LintConfig
+
+        return LintConfig(world=8, zero_stage=3, **kw)
+
+    def test_gather_bytes_over_budget_fires(self):
+        from deepspeed_tpu.analysis.hlolint import lint_hlo
+
+        gather = (
+            '  %ag = f32[8,1024]{1,0} all-gather(f32[1,1024]{1,0} %p), '
+            'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, '
+            'metadata={op_name="jit(f)/zpp_gather/all_gather"}\n') * 3
+        # 3 gathers x 32768 B = 98304 against a 16384-B tree, budget 2x
+        found = [f for f in lint_hlo(gather, self._cfg(
+            param_bytes=16384, max_full_gathers=2.0))
+            if f.rule == "accidental-replication"]
+        assert len(found) == 1
+        assert found[0].observed == 3 * 32768
+        assert found[0].limit == 2 * 16384
+
+    def test_args_vs_predicted_state_ceiling(self):
+        from deepspeed_tpu.analysis.hlolint import lint_hlo
+
+        found = [f for f in lint_hlo("", self._cfg(
+            args_bytes=10_000.0, predicted_state_bytes=1_000.0,
+            args_vs_state_max=4.0))
+            if f.rule == "accidental-replication"]
+        assert len(found) == 1
+        assert found[0].observed == 10.0 and found[0].limit == 4.0
+        # under the ceiling: clean
+        assert [f for f in lint_hlo("", self._cfg(
+            args_bytes=3_000.0, predicted_state_bytes=1_000.0,
+            args_vs_state_max=4.0))
+            if f.rule == "accidental-replication"] == []
+
+
+class TestHostTransferRule:
+    def _lint(self, text):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        return [f for f in lint_hlo(text, LintConfig(world=8))
+                if f.rule == "host-transfer"]
+
+    def test_infeed_outfeed_and_host_callbacks_fire(self):
+        text = (
+            "  %inf = (f32[8]{0}, token[]) infeed(token[] %tok)\n"
+            "  %cc = f32[8]{0} custom-call(f32[8]{0} %x), "
+            'custom_call_target="xla_ffi_python_cpu_callback"\n'
+            "  %snd = token[] send(f32[8]{0} %x, token[] %tok), "
+            "channel_id=3, is_host_transfer=true\n")
+        found = self._lint(text)
+        assert len(found) == 3
+        assert all("host" in f.message for f in found)
+
+    def test_device_custom_calls_and_fixtures_are_clean(self):
+        # a device-side custom-call (kernel library) is not host I/O
+        text = ('  %cc = f32[8,8]{1,0} custom-call(f32[8,8]{1,0} %x), '
+                'custom_call_target="__cublas$gemm"\n')
+        assert self._lint(text) == []
+        for stem in ("zero2_tiny_step", QGZ):
+            assert self._lint(fixture_text(stem)) == []
+
+
+class TestReshardingThrashRule:
+    def _lint(self, text):
+        from deepspeed_tpu.analysis.hlolint import LintConfig, lint_hlo
+
+        return [f for f in lint_hlo(text, LintConfig(world=8))
+                if f.rule == "resharding-thrash"]
+
+    def test_permute_of_permute_fires(self):
+        text = (
+            "  %cp1 = f32[8]{0} collective-permute(f32[8]{0} %p), "
+            "source_target_pairs={{0,1},{1,0}}\n"
+            "  %cp2 = f32[8]{0} collective-permute(f32[8]{0} %cp1), "
+            "source_target_pairs={{1,0},{0,1}}\n")
+        found = self._lint(text)
+        assert len(found) == 1
+        assert "cp1" in found[0].message and "cp2" in found[0].message
+
+    def test_async_pair_linkage_is_not_thrash(self):
+        # a -done consuming its own -start is the async wrapper, not a
+        # back-to-back reshard
+        text = (
+            "  %cp-start = (f32[8]{0}, f32[8]{0}) "
+            "collective-permute-start(f32[8]{0} %p), "
+            "source_target_pairs={{0,1},{1,0}}\n"
+            "  %cp = f32[8]{0} collective-permute-done("
+            "(f32[8]{0}, f32[8]{0}) %cp-start)\n")
+        assert self._lint(text) == []
+
+    def test_mixed_families_and_fixtures_are_clean(self):
+        # an all-to-all consuming a permute is a pipeline handoff into a
+        # dispatch, not an inverse pair — and the committed fixtures
+        # carry no thrash at all
+        text = (
+            "  %cp = f32[8]{0} collective-permute(f32[8]{0} %p), "
+            "source_target_pairs={{0,1},{1,0}}\n"
+            "  %a2a = f32[8]{0} all-to-all(f32[8]{0} %cp), "
+            "replica_groups={{0,1}}, dimensions={0}\n")
+        assert self._lint(text) == []
+        for stem in ("zero3_tiny_step", "moe_tiny_step", QGZ):
+            assert self._lint(fixture_text(stem)) == []
+
+
+# --------------------------------------------------------------------- #
+# the contract system
+# --------------------------------------------------------------------- #
+class TestContractChecks:
+    def _ledger(self, stem, world=8, stage=2):
+        from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+        return build_ledger(fixture_text(stem), program=stem,
+                            world=world, zero_stage=stage)
+
+    def test_observations_pin_the_converted_adhoc_numbers(self):
+        # the numbers the old bespoke asserts counted by hand, now in
+        # the one shared observation vocabulary
+        from deepspeed_tpu.analysis.hlolint import contract_observations
+
+        obs = contract_observations(self._ledger(QGZ))
+        assert obs["async_pairs"] == 99
+        assert obs["int8_transports"] == 16      # the 16 s8 transports
+        assert obs["unparsed"] == 0
+        assert "s8" in obs["subsystems"]["zero_grad_sync"]["dtypes"]
+
+    def test_floor_and_ceiling_directions(self):
+        from deepspeed_tpu.analysis.hlolint import check_contract
+
+        led = self._ledger(QGZ)
+        ok = check_contract(led, {"async_pairs_min": 99,
+                                  "wire_bytes_max": 905392}, "p")
+        assert ok == []
+        bad = check_contract(led, {"async_pairs_min": 100,
+                                   "wire_bytes_max": 905391}, "p")
+        assert len(bad) == 2
+        by_msg = {f.message.split()[0]: f for f in bad}
+        assert by_msg["async_pairs"].limit == 100
+        assert by_msg["async_pairs"].observed == 99
+        assert by_msg["wire_bytes"].limit == 905391
+        assert by_msg["wire_bytes"].observed == 905392
+
+    def test_unknown_bound_key_is_loud(self):
+        from deepspeed_tpu.analysis.hlolint import (
+            ContractError,
+            check_contract,
+        )
+
+        with pytest.raises(ContractError, match="unknown bound"):
+            check_contract(self._ledger(QGZ),
+                           {"wire_bytes_mxa": 1}, "p")
+        with pytest.raises(ContractError, match="unknown bound"):
+            check_contract(
+                self._ledger(QGZ),
+                {"subsystems": {"zero_grad_sync": {"byte_max": 1}}}, "p")
+
+    def test_subsystem_dtype_allowlist(self):
+        from deepspeed_tpu.analysis.hlolint import check_contract
+
+        led = self._ledger(QGZ)
+        found = check_contract(led, {"subsystems": {
+            "zero_grad_sync": {"allowed_dtypes": ["s8"]}}}, "p")
+        assert len(found) == 1
+        assert "'f32'" in found[0].message     # the scale companions
+
+    def test_empty_or_truncated_dump_violates_the_floors(self):
+        # review-hardened: contracts pin floors (collective_count_min,
+        # wire_bytes_min, per-subsystem bytes_min), so an empty dump, a
+        # truncated fixture, or an op-regex parser regression — all of
+        # which satisfy every ceiling with zeros — fail loudly instead
+        # of reading as "clean"
+        from deepspeed_tpu.analysis.hlolint import (
+            LintConfig,
+            lint_hlo,
+            load_contract,
+        )
+
+        cdata = load_contract(committed_contract("zero2_tiny_step"))
+        cfg = LintConfig.from_contract(cdata, program="empty")
+        found = lint_hlo("", cfg)
+        msgs = " ".join(f.message for f in found)
+        assert "collective_count" in msgs
+        assert "wire_bytes" in msgs
+        assert any(f.observed == 0 for f in found)
+        # half the fixture -> the byte floor catches it too
+        half = "\n".join(
+            fixture_text("zero2_tiny_step").splitlines()[:40])
+        assert any("floor" in f.message or "below" in f.message
+                   for f in lint_hlo(half, cfg))
+
+    def test_reattributed_subsystem_bytes_hit_the_floor(self):
+        # bytes leaving a pinned subsystem (e.g. an attribution change
+        # reclassifying grad-sync ops) violate that subsystem's
+        # bytes_min even though totals are unchanged
+        from deepspeed_tpu.analysis.hlolint import check_contract
+
+        led = self._ledger(QGZ)
+        for op in led.ops:
+            if op.subsystem == "zero_grad_sync":
+                op.subsystem = "mystery_lane"
+        found = check_contract(led, {"subsystems": {
+            "zero_grad_sync": {"bytes_min": 1}}}, "p")
+        assert len(found) == 1 and found[0].observed == 0
+
+    def test_write_contract_is_shrink_only(self, tmp_path):
+        from deepspeed_tpu.analysis.hlolint import (
+            ContractError,
+            LintConfig,
+            bootstrap_contract,
+            load_contract,
+            write_contract,
+        )
+
+        led = self._ledger(QGZ)
+        cfg = LintConfig(program=QGZ, world=8, zero_stage=2,
+                         expect_async=True, quant_grads=True)
+        doc = bootstrap_contract(led, cfg)
+        path = str(tmp_path / "c.json")
+        write_contract(path, doc)
+        saved = load_contract(path)
+        assert saved["contract"]["wire_bytes_max"] == 905392
+
+        # tightening is always allowed
+        tighter = json.loads(json.dumps(doc))
+        tighter["contract"]["wire_bytes_max"] -= 1
+        tighter["contract"]["async_pairs_min"] += 1
+        write_contract(path, tighter)
+
+        # loosening is refused naming the bound...
+        looser = json.loads(json.dumps(tighter))
+        looser["contract"]["wire_bytes_max"] += 100
+        with pytest.raises(ContractError, match="wire_bytes_max"):
+            write_contract(path, looser)
+        # ...dropping a bound is loosening too...
+        dropper = json.loads(json.dumps(tighter))
+        del dropper["contract"]["async_pairs_min"]
+        with pytest.raises(ContractError, match="async_pairs_min"):
+            write_contract(path, dropper)
+        # ...widening a dtype allowlist is loosening...
+        wider = json.loads(json.dumps(tighter))
+        wider["contract"]["subsystems"]["zero_grad_sync"][
+            "allowed_dtypes"].append("f64")
+        with pytest.raises(ContractError, match="allowed_dtypes"):
+            write_contract(path, wider)
+        # ...and --allow-loosen is the explicit regeneration hatch
+        write_contract(path, looser, allow_loosen=True)
+        assert load_contract(path)["contract"]["wire_bytes_max"] == \
+            tighter["contract"]["wire_bytes_max"] + 100
+
+
+class TestCommittedContracts:
+    """Tier-1 enforcement: all six committed fixtures hold their
+    committed contracts — THE enforcement path for the perf arc's HLO
+    invariants (converted from the ad-hoc asserts of test_overlap.py /
+    test_wire_overlap.py)."""
+
+    def test_every_fixture_has_a_contract_and_lints_clean(self):
+        from deepspeed_tpu.analysis.hlolint import (
+            fixture_pairs,
+            lint_fixture,
+        )
+
+        pairs = fixture_pairs(FIXTURES)
+        assert len(pairs) == 6
+        for hlo_path, contract_path in pairs:
+            found = lint_fixture(hlo_path, contract_path)
+            assert found == [], (os.path.basename(hlo_path),
+                                 [f.render() for f in found])
+
+    def test_unpaired_fixture_or_contract_is_loud(self, tmp_path):
+        from deepspeed_tpu.analysis.hlolint import (
+            ContractError,
+            fixture_pairs,
+        )
+
+        fdir = tmp_path / "fx"
+        fdir.mkdir()
+        (fdir / "orphan_step.hlo.txt").write_text("HloModule m\n")
+        with pytest.raises(ContractError, match="without a contract"):
+            fixture_pairs(str(fdir))
+        cdir = tmp_path / "contracts"
+        cdir.mkdir()
+        (cdir / "orphan_step.json").write_text(json.dumps(
+            {"version": 1, "program": "orphan_step", "contract": {}}))
+        (cdir / "ghost.json").write_text(json.dumps(
+            {"version": 1, "program": "ghost", "contract": {}}))
+        with pytest.raises(ContractError, match="without a committed"):
+            fixture_pairs(str(fdir), str(cdir))
+
+    def test_committed_ceilings_encode_the_wire_reduction(self):
+        # the old 0.20x/0.14x asserts, read from the COMMITTED numbers:
+        # the qgZ contract's byte ceilings are <= 1/3 of the exact
+        # companion's (total AND grad-sync) — hlolint enforces fixture
+        # <= ceiling above; this pins that the ceilings themselves keep
+        # telling the wire-reduction story
+        from deepspeed_tpu.analysis.hlolint import load_contract
+
+        q = load_contract(committed_contract(QGZ))["contract"]
+        e = load_contract(committed_contract(EXACT))["contract"]
+        assert q["wire_bytes_max"] * 3 <= e["wire_bytes_max"], (
+            q["wire_bytes_max"], e["wire_bytes_max"])
+        q_gs = q["subsystems"]["zero_grad_sync"]["bytes_max"]
+        e_gs = e["subsystems"]["zero_grad_sync"]["bytes_max"]
+        assert q_gs * 3 <= e_gs, (q_gs, e_gs)
+        # and the acceptance floors ride in the committed contracts
+        assert q["async_pairs_min"] >= 1
+        assert q["int8_transports_min"] >= 16
+        z3 = load_contract(committed_contract(
+            "zero3_bucketed_async_step"))["contract"]
+        assert z3["async_pairs_min"] >= 1
+
+
+# --------------------------------------------------------------------- #
+# CLI exit-code matrix (subprocess)
+# --------------------------------------------------------------------- #
+class TestCli:
+    def test_fixtures_mode_clean_exit_0(self):
+        # the acceptance invocation: all six committed fixtures against
+        # their committed contracts
+        proc = run_cli("--fixtures")
+        assert proc.returncode == 0, proc.stderr
+        assert "clean (6 program(s))" in proc.stdout
+
+    def test_single_fixture_with_contract_exit_0(self):
+        proc = run_cli(fixture_path(QGZ), "--contract",
+                       committed_contract(QGZ))
+        assert proc.returncode == 0, proc.stderr
+
+    def test_tightened_ceiling_seeds_violation_exit_1(self, tmp_path):
+        # seeded violation on a REAL fixture: tighten one committed
+        # ceiling by a single byte -> exit 1 naming the rule with
+        # before/after numbers on stderr
+        doc = json.load(open(committed_contract(QGZ)))
+        doc["contract"]["wire_bytes_max"] -= 1
+        tight = tmp_path / "tight.json"
+        tight.write_text(json.dumps(doc))
+        proc = run_cli(fixture_path(QGZ), "--contract", str(tight))
+        assert proc.returncode == 1, (proc.stdout, proc.stderr)
+        assert "[contract]" in proc.stderr
+        assert "contract=905391" in proc.stderr
+        assert "observed=905392" in proc.stderr
+
+    def test_cross_contract_exit_1_names_rules(self):
+        # the acceptance cross-check: the exact fixture against the qgZ
+        # contract violates byte ceilings AND the structural rules
+        proc = run_cli(fixture_path(EXACT), "--contract",
+                       committed_contract(QGZ))
+        assert proc.returncode == 1
+        for rule in ("[contract]", "[sync-collective]", "[wire-dtype]"):
+            assert rule in proc.stderr, proc.stderr
+        assert "contract=" in proc.stderr and "observed=" in proc.stderr
+
+    def test_unreadable_hlo_exit_2(self):
+        proc = run_cli("/nonexistent/step.hlo.txt")
+        assert proc.returncode == 2
+        assert "error" in proc.stderr
+
+    def test_unreadable_contract_exit_2(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        proc = run_cli(fixture_path(QGZ), "--contract", str(bad))
+        assert proc.returncode == 2
+        assert "malformed contract" in proc.stderr
+        # structurally-invalid contract document is the same refusal
+        empty = tmp_path / "empty.json"
+        empty.write_text("{}")
+        assert run_cli(fixture_path(QGZ), "--contract",
+                       str(empty)).returncode == 2
+
+    def test_nothing_to_lint_exit_2(self):
+        assert run_cli().returncode == 2
+
+    def test_write_contract_bootstrap_then_enforce(self, tmp_path):
+        out = tmp_path / "boot.json"
+        proc = run_cli(fixture_path(QGZ), "--world", "8", "--zero-stage",
+                       "2", "--wire-format", "qz+loco", "--expect-async",
+                       "--write-contract", str(out))
+        assert proc.returncode == 0, proc.stderr
+        assert "wrote" in proc.stdout
+        # the bootstrapped contract enforces cleanly on its own program
+        assert run_cli(fixture_path(QGZ), "--contract",
+                       str(out)).returncode == 0
+        # rewriting it from the BIGGER exact program would loosen every
+        # ceiling: refused (exit 2) without --allow-loosen
+        proc = run_cli(fixture_path(EXACT), "--world", "8",
+                       "--zero-stage", "2", "--program", QGZ,
+                       "--write-contract", str(out))
+        assert proc.returncode == 2
+        assert "refusing to loosen" in proc.stderr
+        proc = run_cli(fixture_path(EXACT), "--world", "8",
+                       "--zero-stage", "2", "--program", QGZ,
+                       "--write-contract", str(out), "--allow-loosen")
+        assert proc.returncode == 0, proc.stderr
+
+    def test_list_rules_and_json_format(self):
+        proc = run_cli("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("sync-collective", "fence-defeat", "wire-dtype",
+                     "accidental-replication", "host-transfer",
+                     "resharding-thrash", "contract"):
+            assert rule in proc.stdout
+        proc = run_cli(fixture_path(EXACT), "--contract",
+                       committed_contract(QGZ), "--format", "json")
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload["version"] == 1 and not payload["ok"]
+        assert payload["counts"]["contract"] >= 1
+        for f in payload["findings"]:
+            assert {"rule", "program", "message", "limit",
+                    "observed"} <= set(f)
+
+    def test_step_report_read_with_lint_refuses(self, tmp_path):
+        # review-hardened: --read has no HLO to lint; a silent 0 would
+        # read as "contract clean" in a CI step that checked nothing
+        report = tmp_path / "r.json"
+        report.write_text("{}")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "step-report"),
+             "--read", str(report), "--lint"],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO_ROOT,
+            timeout=300)
+        assert proc.returncode == 2
+        assert "--lint needs an HLO source" in proc.stderr
+
+    def test_step_report_lint_passthrough(self, tmp_path):
+        # tools/step-report --lint: report + contract check in one pass
+        sr = os.path.join(REPO_ROOT, "tools", "step-report")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        clean = subprocess.run(
+            [sys.executable, sr, "--hlo-file", fixture_path(QGZ),
+             "--world", "8", "--zero-stage", "2", "--lint", "--contract",
+             committed_contract(QGZ)],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=300)
+        assert clean.returncode == 0, clean.stderr
+        dirty = subprocess.run(
+            [sys.executable, sr, "--hlo-file", fixture_path(EXACT),
+             "--world", "8", "--zero-stage", "2", "--lint", "--contract",
+             committed_contract(QGZ)],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=300)
+        assert dirty.returncode == 1
+        assert "hlolint" in dirty.stderr
+        # the report itself still printed before the lint verdict
+        assert json.loads(dirty.stdout)["mode"] == "ledger_only"
+
+
+# --------------------------------------------------------------------- #
+# regen tool
+# --------------------------------------------------------------------- #
+class TestRegenTool:
+    REGEN = os.path.join(REPO_ROOT, "tools", "regen_hlo_fixtures.py")
+
+    def test_list_covers_every_committed_fixture(self):
+        proc = subprocess.run([sys.executable, self.REGEN, "--list"],
+                              capture_output=True, text=True,
+                              cwd=REPO_ROOT, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        committed = {n[:-len(".hlo.txt")] for n in os.listdir(FIXTURES)
+                     if n.endswith(".hlo.txt")}
+        listed = {line.split(":")[0] for line in
+                  proc.stdout.strip().splitlines()}
+        assert listed == committed
+
+    def test_unknown_stem_exit_2(self):
+        proc = subprocess.run(
+            [sys.executable, self.REGEN, "--only", "nope", "--list"],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+        assert proc.returncode == 2
+
+    @pytest.mark.slow
+    def test_regenerated_fixture_parses_and_contract_bootstraps(
+            self, tmp_path):
+        # regenerate ONE fixture from its pinned config end to end: it
+        # must parse with the same op count shape as the committed one
+        # and bootstrap a contract its own program satisfies
+        proc = subprocess.run(
+            [sys.executable, self.REGEN, "--only", "zero2_tiny_step",
+             "--out", str(tmp_path), "--write-contracts",
+             "--contracts-out", str(tmp_path / "contracts")],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=480)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        from deepspeed_tpu.analysis.hlolint import lint_fixture
+
+        hlo = tmp_path / "zero2_tiny_step.hlo.txt"
+        contract = tmp_path / "contracts" / "zero2_tiny_step.json"
+        assert hlo.exists() and contract.exists()
+        assert lint_fixture(str(hlo), str(contract)) == []
+        from deepspeed_tpu.profiling.observatory.ledger import (
+            build_ledger,
+        )
+
+        led = build_ledger(hlo.read_text(), world=8, zero_stage=2)
+        assert led.unparsed == 0 and len(led.ops) > 50
+
+
+# --------------------------------------------------------------------- #
+# live enforcement: engine.lint_step, the config section, bench's gate
+# --------------------------------------------------------------------- #
+def _tiny_cfg(zero, **extra):
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+           "zero_optimization": zero, "steps_per_print": 10 ** 9}
+    cfg.update(extra)
+    return cfg
+
+
+class TestLiveEngine:
+    def test_lint_step_clean_on_bucketed_zero2(self):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32")
+        engine, *_ = dst.initialize(model=spec, config=_tiny_cfg(
+            {"stage": 2, "overlap_comm": True,
+             "reduce_bucket_size": 4096, "allgather_bucket_size": 8192}))
+        assert engine.overlap_plan()["enabled"]
+        found = engine.lint_step()
+        assert found == [], [f.render() for f in found]
+        # a contract the live program violates names itself
+        found = engine.lint_step(
+            contract=committed_contract(QGZ))
+        assert found and any(f.rule == "contract" for f in found)
+
+    def test_hlolint_section_enforces_at_initialize(self, tmp_path):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.analysis.hlolint import HloLintViolation
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({
+            "version": 1, "program": "train_step", "config": {},
+            "contract": {"collective_count_max": 0}}))
+        spec_kw = dict(dtype="float32", hidden_size=32, num_layers=2,
+                       num_heads=2, max_seq_len=16, vocab_size=64)
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **spec_kw)
+        with pytest.raises(HloLintViolation, match="collective_count"):
+            dst.initialize(model=spec, config=_tiny_cfg(
+                {"stage": 2},
+                hlolint={"enabled": True, "contract": str(bad)}))
+        # fail_on_violation=False logs and proceeds
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **spec_kw)
+        engine, *_ = dst.initialize(model=spec, config=_tiny_cfg(
+            {"stage": 2},
+            hlolint={"enabled": True, "contract": str(bad),
+                     "fail_on_violation": False}))
+        assert engine is not None
+
+    def test_lint_step_no_fence_floor_on_dp_width_1(self):
+        # review-hardened: a single-device data-parallel world has NO
+        # grad-sync collectives (GSPMD elides them) — the fence-defeat
+        # floor must not arm, or every healthy 1-chip job is refused.
+        # The 8-device box fakes it with a data=1 x tensor=8 mesh.
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32")
+        engine, *_ = dst.initialize(model=spec, config=_tiny_cfg(
+            {"stage": 2, "overlap_comm": True,
+             "reduce_bucket_size": 4096},
+            mesh={"data": 1, "tensor": 8}))
+        assert engine.dp_world_size == 1
+        found = engine.lint_step()
+        assert all(f.rule != "fence-defeat" for f in found), [
+            f.render() for f in found]
+
+    def test_bench_gate_in_process_override(self, monkeypatch):
+        # the real bench.py gate function: violating contract raises the
+        # refuse-to-record error; BENCH_HLOLINT=0 disarms it
+        import importlib.util
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        spec_file = os.path.join(REPO_ROOT, "bench.py")
+        sp = importlib.util.spec_from_file_location("_bench_mod",
+                                                    spec_file)
+        bench = importlib.util.module_from_spec(sp)
+        sp.loader.exec_module(bench)
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", dtype="float32",
+                                  hidden_size=32, num_layers=2,
+                                  num_heads=2, max_seq_len=16,
+                                  vocab_size=64)
+        engine, *_ = dst.initialize(model=spec,
+                                    config=_tiny_cfg({"stage": 2}))
+        monkeypatch.setenv("BENCH_HLOLINT_CONTRACT",
+                           committed_contract(QGZ))
+        monkeypatch.delenv("BENCH_HLOLINT", raising=False)
+        with pytest.raises(RuntimeError, match="refusing to record"):
+            bench._hlolint_entry_gate(engine, 16)
+        monkeypatch.setenv("BENCH_HLOLINT", "0")
+        assert bench._hlolint_entry_gate(engine, 16) is None
+        # and with no contract env, the structural rules pass clean
+        monkeypatch.delenv("BENCH_HLOLINT", raising=False)
+        monkeypatch.delenv("BENCH_HLOLINT_CONTRACT", raising=False)
+        assert bench._hlolint_entry_gate(engine, 16) is None
+        # review-hardened: an EXPLICITLY-named contract that can't be
+        # read fails the row — it must not silently disarm the gate the
+        # operator believes is armed
+        monkeypatch.setenv("BENCH_HLOLINT_CONTRACT", "/nope/typo.json")
+        with pytest.raises(RuntimeError, match="cannot enforce"):
+            bench._hlolint_entry_gate(engine, 16)
+
+
+@pytest.mark.slow
+class TestBenchGateSubprocess:
+    def test_bench_refuses_to_record_violating_round(self):
+        # the acceptance leg: a REAL bench entry subprocess whose
+        # lowered step violates its contract emits an explicit error
+        # row (refusal), never measured metrics
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_MODEL="tiny",
+                   BENCH_SEQ="64", BENCH_BATCH="1", BENCH_STEPS="1",
+                   BENCH_GAS="1", BENCH_TRACING="0",
+                   BENCH_HLOLINT_CONTRACT=committed_contract(QGZ),
+                   PYTHONPATH=REPO_ROOT)
+        env.pop("BENCH_HLOLINT", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+             "--entry", "headline"],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=420)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert "error" in row, row
+        assert "hlolint" in row["error"]
+        assert "refusing to record" in row["error"]
+        assert "value" not in row
+        # the violations were named on stderr with numbers
+        assert "bench: hlolint: [contract]" in proc.stderr

@@ -321,23 +321,34 @@ class TestEngineGating:
 # HLO: async start/done pairs (fixture-pinned)
 # --------------------------------------------------------------------- #
 class TestAsyncPairs:
-    def test_bucketed_zero3_fixture_has_async_pairs(self):
-        from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+    def test_bucketed_zero3_fixture_enforced_by_committed_contract(self):
+        # converted from ad-hoc pair counting (ISSUE 12): the committed
+        # contract is THE enforcement path now — this test calls
+        # hlolint, it does not re-count the HLO by hand. The acceptance
+        # floor (async_pairs >= 1) and the all-pairs-matched shape both
+        # ride in analysis/hlolint/contracts/zero3_bucketed_async_step
+        # .json as shrink-only bounds.
+        from deepspeed_tpu.analysis.hlolint import (
+            contracts_dir,
+            lint_fixture,
+            load_contract,
+        )
 
-        led = build_ledger(
-            fixture_text("zero3_bucketed_async_step.hlo.txt"),
-            program="train_step", world=8, zero_stage=3)
-        assert led.async_pairs >= 1          # the acceptance pin
-        assert led.unparsed == 0
-        # every collective in the fixture lowered as a matched pair
-        d = led.to_dict()
-        assert led.async_pairs == sum(r["count"]
-                                      for r in d["by_kind"].values())
-        assert d["async_pairs"] == led.async_pairs
+        contract_path = os.path.join(
+            contracts_dir(), "zero3_bucketed_async_step.json")
+        found = lint_fixture(
+            os.path.join(FIXTURES, "zero3_bucketed_async_step.hlo.txt"),
+            contract_path)
+        assert found == [], [f.render() for f in found]
+        body = load_contract(contract_path)["contract"]
+        assert body["async_pairs_min"] >= 1       # the acceptance pin
+        assert body["unparsed_max"] == 0
+        # every collective lowered as a matched pair: the committed
+        # floor equals the committed op-count ceiling
+        assert body["async_pairs_min"] == body["collective_count_max"]
         # the bucketed program still tells the ZeRO-3 story
-        assert d["by_subsystem"]["zero_grad_sync"]["bytes"] > 0
-        assert d["by_subsystem"]["zero_param_gather"]["bytes"] > 0
-        assert {"all_reduce", "all_gather"} <= set(d["by_kind"])
+        assert body["subsystems"]["zero_grad_sync"]["bytes_max"] > 0
+        assert body["subsystems"]["zero_param_gather"]["bytes_max"] > 0
 
     def test_asyncify_preserves_bytes_and_counts(self):
         # the committed SYNC zero3 fixture asyncifies without changing a

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Reproducibly regenerate the committed ``observatory_fixtures/*.hlo.txt``.
+
+Every committed HLO fixture (and therefore every committed hlolint
+contract in ``deepspeed_tpu/analysis/hlolint/contracts/``) was generated
+from a PINNED engine config under ``JAX_PLATFORMS=cpu`` with 8 forced
+host devices. This tool is that generation path as a committed,
+re-runnable artifact: fixtures and contracts can be rebuilt TOGETHER
+after an intentional program change (new jax pin, scheduler rework)
+instead of by hand — and reviewed together, since loosening a committed
+contract is refused unless ``--allow-loosen`` is passed through.
+
+Each fixture is generated in its own subprocess (fresh backend, the
+pinned env) via this file's ``--_generate`` child mode:
+
+* build the pinned engine config;
+* lower the REAL fused train step through the observatory's
+  ``ledger_for_engine`` (the same mirrored builder selection the hot
+  path and ``engine.lint_step`` use);
+* trim to the module header + every collective-bearing line
+  (``hlo.iter_collective_lines`` — full dumps are ~1 MB, the ledger
+  parser is line-oriented);
+* for the ``*_async_*`` fixtures, pass the trimmed lines through
+  ``hlo.asyncify_hlo`` (the surface transform XLA's
+  async-collective-creator pass applies on TPU/GPU; CPU lowers
+  sync-only).
+
+Usage::
+
+    tools/regen_hlo_fixtures.py --list                 # what would run
+    tools/regen_hlo_fixtures.py --out /tmp/fx          # all six, elsewhere
+    tools/regen_hlo_fixtures.py --only zero2_tiny_step # one fixture
+    tools/regen_hlo_fixtures.py --write-contracts      # + retighten contracts
+    tools/regen_hlo_fixtures.py --write-contracts --allow-loosen  # regeneration
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+FIXTURES_DIR = os.path.join(REPO_ROOT, "tests", "unit",
+                            "observatory_fixtures")
+
+#: the pinned generation env every fixture was produced under
+PINNED_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    # conftest flips this for the test processes that consume the
+    # fixtures; generation must match or param-init PRNGs diverge
+    "JAX_THREEFRY_PARTITIONABLE": "true",
+}
+
+_FORCING = {"overlap_comm": True, "reduce_bucket_size": 4096,
+            "allgather_bucket_size": 8192,
+            "stage3_prefetch_bucket_size": 8192}
+
+#: the pinned per-fixture configs. ``spec``/``engine`` feed
+#: deepspeed_tpu.initialize; ``seq_len`` is the lowered batch shape;
+#: ``asyncify`` applies hlo.asyncify_hlo to the trimmed lines.
+FIXTURE_SPECS = {
+    "zero2_tiny_step": {
+        "spec": dict(model="tiny", num_layers=2, max_seq_len=64),
+        "zero": {"stage": 2, "overlap_comm": False},
+        "banner": "the REAL zero2 tiny-model train step (PR 7 ledger "
+                  "fixture; unbucketed — overlap_comm off)",
+    },
+    "zero3_tiny_step": {
+        "spec": dict(model="tiny", num_layers=2, max_seq_len=64),
+        "zero": {"stage": 3, "overlap_comm": False},
+        "banner": "the REAL zero3 tiny-model train step (PR 7 ledger "
+                  "fixture; unbucketed — overlap_comm off)",
+    },
+    "moe_tiny_step": {
+        "spec": dict(model="tiny_moe", max_seq_len=64),
+        "zero": {"stage": 2, "overlap_comm": False},
+        "mesh": {"data": 2, "expert": 4},
+        "banner": "the REAL tiny_moe train step on a data=2 x expert=4 "
+                  "mesh (PR 7 ledger fixture: tuple-form all-to-all "
+                  "dispatch)",
+    },
+    "zero3_bucketed_async_step": {
+        "spec": dict(model="tiny", num_layers=2, max_seq_len=64),
+        "zero": dict(_FORCING, stage=3),
+        "asyncify": True,
+        "banner": "the BUCKETED zero3 tiny train step (overlap_comm, "
+                  "reduce_bucket_size=4096 elements, "
+                  "stage3_prefetch_bucket_size=8192 -> 2 layer chunks + "
+                  "mid-backward grad-sync points), asyncified",
+    },
+    "zero2_exact_bucketed_step": {
+        "spec": dict(model="tiny", hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=64, vocab_size=512),
+        "zero": dict(_FORCING, stage=2),
+        "batch": dict(train_batch_size=32,
+                      train_micro_batch_size_per_gpu=2,
+                      gradient_accumulation_steps=2),
+        "banner": "the EXACT-wire bucketed zero2 tiny train step — the "
+                  "SAME config as zero2_qgz_bucketed_async_step minus "
+                  "the quantized-wire flags; the unquantized baseline "
+                  "the wire-byte-reduction contract divides against",
+    },
+    "zero2_qgz_bucketed_async_step": {
+        "spec": dict(model="tiny", hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=64, vocab_size=512),
+        "zero": dict(_FORCING, stage=2, zero_quantized_gradients=True,
+                     loco_error_feedback=True),
+        "batch": dict(train_batch_size=32,
+                      train_micro_batch_size_per_gpu=2,
+                      gradient_accumulation_steps=2),
+        "asyncify": True,
+        "banner": "the COMPOSED bucketed-quantized zero2 tiny train "
+                  "step (zero_quantized_gradients + loco_error_feedback "
+                  "+ overlap_comm -> fenced int8 qgZ buckets, 2 layer "
+                  "chunks), asyncified",
+    },
+}
+
+_SEQ_LEN = 32   # the lowered token shape every fixture pins
+
+
+def _generate_one(stem: str, out_dir: str) -> str:
+    """Child-mode body: runs under PINNED_ENV in a fresh process."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.profiling.observatory.hlo import (
+        asyncify_hlo,
+        iter_collective_lines,
+    )
+    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
+
+    fx = FIXTURE_SPECS[stem]
+    spec_kwargs = dict(fx["spec"])
+    model = spec_kwargs.pop("model")
+    spec = dst.causal_lm_spec(model, dtype="float32", **spec_kwargs)
+    config = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": dict(fx["zero"]),
+        "steps_per_print": 10 ** 9,
+    }
+    config.update(fx.get("batch") or {})
+    if fx.get("mesh"):
+        config["mesh"] = dict(fx["mesh"])
+    engine, *_ = dst.initialize(model=spec, config=config)
+    ledger, _ = ledger_for_engine(engine, fold=False, seq_len=_SEQ_LEN)
+    full_text = ledger.hlo_text
+    header = full_text.splitlines()[0]
+    body = "\n".join(iter_collective_lines(full_text))
+    if fx.get("asyncify"):
+        body = asyncify_hlo(body)
+    banner_lines = [
+        "// --- trimmed fixture: module header + every collective-bearing",
+        f"// --- line of {fx['banner']},",
+        "// --- regenerated by tools/regen_hlo_fixtures.py under",
+        "// --- JAX_PLATFORMS=cpu,",
+        "// --- XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        + ("," if fx.get("asyncify") else "."),
+    ]
+    if fx.get("asyncify"):
+        banner_lines.append(
+            "// --- then passed through hlo.asyncify_hlo (the surface "
+            "transform")
+        banner_lines.append(
+            "// --- XLA's async-collective-creator pass applies on "
+            "TPU/GPU).")
+    out_path = os.path.join(out_dir, stem + ".hlo.txt")
+    with open(out_path, "w") as f:
+        f.write(header + "\n\n" + "\n".join(banner_lines) + "\n\n"
+                + body + "\n")
+    return out_path
+
+
+def _regen_contract(stem: str, hlo_path: str, contracts_out: str,
+                    allow_loosen: bool) -> None:
+    from deepspeed_tpu.analysis.hlolint import (
+        LintConfig,
+        bootstrap_contract,
+        contracts_dir,
+        load_contract,
+        write_contract,
+    )
+    from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+    committed = os.path.join(contracts_dir(), stem + ".json")
+    if os.path.exists(committed):
+        # keep the committed config block — it IS the pinned lint config
+        cfg = LintConfig.from_contract(load_contract(committed),
+                                       program=stem)
+    else:
+        fx = FIXTURE_SPECS[stem]
+        cfg = LintConfig(program=stem, world=8,
+                         zero_stage=fx["zero"]["stage"],
+                         expect_async=bool(fx.get("asyncify")))
+    with open(hlo_path) as f:
+        text = f.read()
+    ledger = build_ledger(text, program=stem, world=cfg.world,
+                          zero_stage=cfg.zero_stage)
+    if cfg.planned_grad_sync_collectives is not None:
+        # re-pin the fence-defeat floor at what the regenerated program
+        # actually shows (the plan changed with the program)
+        cfg.planned_grad_sync_collectives = sum(
+            1 for op in ledger.ops if op.subsystem == "zero_grad_sync")
+    doc = bootstrap_contract(ledger, cfg, hlo_name=stem + ".hlo.txt")
+    out = os.path.join(contracts_out, stem + ".json")
+    write_contract(out, doc, allow_loosen=allow_loosen)
+    print(f"regen: contract {out}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="regen_hlo_fixtures",
+        description="regenerate the committed observatory HLO fixtures "
+                    "(and optionally their hlolint contracts) from "
+                    "their pinned configs")
+    p.add_argument("--out", default=FIXTURES_DIR,
+                   help="fixture output dir (default: the committed "
+                        "tests/unit/observatory_fixtures)")
+    p.add_argument("--only", action="append", default=None,
+                   metavar="STEM", help="regenerate just these fixtures")
+    p.add_argument("--list", action="store_true",
+                   help="print the fixture stems + pinned configs")
+    p.add_argument("--write-contracts", action="store_true",
+                   help="also rebootstrap each fixture's hlolint "
+                        "contract (shrink-only unless --allow-loosen)")
+    p.add_argument("--contracts-out", default=None,
+                   help="contract output dir (default: the committed "
+                        "analysis/hlolint/contracts)")
+    p.add_argument("--allow-loosen", action="store_true",
+                   help="permit contract regeneration to LOOSEN "
+                        "committed bounds (deliberate program changes)")
+    p.add_argument("--_generate", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args._generate:
+        print(_generate_one(args._generate, args.out))
+        return 0
+
+    stems = list(FIXTURE_SPECS)
+    if args.only:
+        unknown = set(args.only) - set(stems)
+        if unknown:
+            print(f"regen: unknown fixture(s) {sorted(unknown)} "
+                  f"(known: {stems})", file=sys.stderr)
+            return 2
+        stems = [s for s in stems if s in args.only]
+    if args.list:
+        for stem in stems:
+            fx = FIXTURE_SPECS[stem]
+            print(f"{stem}: zero={json.dumps(fx['zero'], sort_keys=True)}"
+                  + (f" mesh={fx['mesh']}" if fx.get("mesh") else "")
+                  + (" [asyncified]" if fx.get("asyncify") else ""))
+        return 0
+
+    os.makedirs(args.out, exist_ok=True)
+    contracts_out = args.contracts_out
+    if contracts_out is None:
+        from deepspeed_tpu.analysis.hlolint import contracts_dir
+
+        contracts_out = contracts_dir()
+    os.makedirs(contracts_out, exist_ok=True)
+    failures = 0
+    for stem in stems:
+        env = dict(os.environ, **PINNED_ENV)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--_generate", stem, "--out", args.out],
+            env=env, capture_output=True, text=True, cwd=REPO_ROOT)
+        if proc.returncode != 0:
+            failures += 1
+            print(f"regen: {stem} FAILED:\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+            continue
+        hlo_path = proc.stdout.strip().splitlines()[-1]
+        print(f"regen: {hlo_path}")
+        if args.write_contracts:
+            try:
+                _regen_contract(stem, hlo_path, contracts_out,
+                                args.allow_loosen)
+            except Exception as e:
+                failures += 1
+                print(f"regen: contract for {stem} FAILED: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1189,6 +1189,29 @@ def _entry_memory_stats() -> dict:
     return out
 
 
+def _entry_guardian_stats() -> dict:
+    """Training-guardian fault accounting for THIS entry (each entry is
+    its own subprocess, so the process-wide counters are a clean per-row
+    total). Embedded in every measured row so ``bench-diff`` can flag an
+    anomaly-ridden round (``guardian.*`` diffs lower-is-better)."""
+    try:
+        from deepspeed_tpu import telemetry
+
+        def total(name):
+            counter = telemetry.get_registry().counter(name)
+            return int(sum(v for _, v in counter.labels_items()))
+
+        return {
+            "skipped_steps": total("train_skipped_steps_total"),
+            "anomalies": total("guardian_anomalies_total"),
+            "rollbacks": total("guardian_rollbacks_total"),
+            "quarantined_batches": total(
+                "guardian_quarantined_batches_total"),
+        }
+    except Exception:
+        return {}
+
+
 def _run_entry_subprocess(name: str, timeout: float):
     """Run one suite entry in a child process so an XLA OOM/abort in a
     deliberately-HBM-tight config can't take the headline JSON down with it,
@@ -1430,6 +1453,9 @@ def main():
                 mem = _entry_memory_stats()
                 if mem:
                     row["memory"] = mem
+                guardian = _entry_guardian_stats()
+                if guardian:
+                    row["guardian"] = guardian
             print(json.dumps(row))
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:200]}))

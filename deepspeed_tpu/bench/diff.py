@@ -60,6 +60,11 @@ def metric_direction(name: str) -> Optional[int]:
             # async_pairs is a program-structure echo (how many
             # collectives lowered async), not a perf trajectory
             return None
+    if name.startswith("guardian."):
+        # training-guardian fault accounting: every count falling is
+        # health improving — an anomaly-ridden round flags loudly (a
+        # 0 -> nonzero move surfaces as the explicit zero-baseline row)
+        return LOWER_IS_BETTER
     if leaf == "overlap_fraction":
         # fraction of collective time hidden under compute — the ROADMAP
         # item 2 before/after metric
@@ -106,11 +111,13 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
     head_metrics = flatten_metrics(
         {k: v for k, v in head.items()
          if k not in ("trace_phases", "telemetry", "best_row", "memory",
-                      "comms")})
+                      "comms", "guardian")})
     if "memory" in head:
         head_metrics.update(flatten_metrics(head["memory"], "memory"))
     if "comms" in head:
         head_metrics.update(flatten_metrics(head["comms"], "comms"))
+    if "guardian" in head:
+        head_metrics.update(flatten_metrics(head["guardian"], "guardian"))
     out = {
         "headline": {
             "metric_name": head.get("metric"),
@@ -128,6 +135,8 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
             metrics.update(flatten_metrics(entry["memory"], "memory"))
         if "comms" in entry:
             metrics.update(flatten_metrics(entry["comms"], "comms"))
+        if "guardian" in entry:
+            metrics.update(flatten_metrics(entry["guardian"], "guardian"))
         if is_number(entry.get("overlap_fraction")):
             metrics["overlap_fraction"] = float(entry["overlap_fraction"])
         out["entries"][name] = {

@@ -59,16 +59,28 @@ and validate unchanged::
 
 ``bench-diff`` compares ``comms`` byte totals lower-is-better (quantized
 collectives shrink wire bytes) and ``overlap_fraction`` higher-is-better.
+
+Schema v2.2 adds one more OPTIONAL per-entry (and headline) key — v2/v2.1
+records load and validate unchanged::
+
+    "guardian": {           # training-guardian fault accounting
+      "skipped_steps": int, # device-side non-finite skip counter
+      "anomalies": int, "rollbacks": int, "quarantined_batches": int,
+    },
+
+All guardian counts diff lower-is-better, so ``bench-diff`` flags an
+anomaly-ridden round (a 0 → nonzero move surfaces as an explicit
+zero-baseline row).
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2.1
+SCHEMA_VERSION = 2.2
 
 #: versions validate_result accepts — v2 records predate the ``comms``
-#: block but are otherwise shape-identical
-SUPPORTED_SCHEMA_VERSIONS = (2, 2.1)
+#: block, v2.1 the ``guardian`` block; otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -77,7 +89,7 @@ RECORD_VERSION = 1
 # else inside an entry dict is treated as a metric
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
                          "elapsed_s", "skipped_reason", "error", "note",
-                         "comms", "overlap_fraction")
+                         "comms", "overlap_fraction", "guardian")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -164,6 +176,18 @@ def validate_comms(comms: Any, where: str) -> List[str]:
     return errs
 
 
+def validate_guardian(block: Any, where: str) -> List[str]:
+    """Validate a v2.2 ``guardian`` block (fault accounting counters)."""
+    if not isinstance(block, dict):
+        return [f"{where}: guardian must be a dict"]
+    errs: List[str] = []
+    for key, val in block.items():
+        if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+            errs.append(f"{where}: guardian.{key} must be a non-negative "
+                        "int")
+    return errs
+
+
 def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
     if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
         return [f"{where}: overlap_fraction must be a number in [0, 1]"]
@@ -200,6 +224,8 @@ def validate_entry(entry: Any, name: str) -> List[str]:
         errs.append(f"{where}: telemetry must be a dict")
     if "comms" in entry:
         errs += validate_comms(entry["comms"], where)
+    if "guardian" in entry:
+        errs += validate_guardian(entry["guardian"], where)
     if "overlap_fraction" in entry:
         errs += validate_overlap_fraction(entry["overlap_fraction"], where)
     return errs
@@ -232,6 +258,8 @@ def validate_headline(head: Any) -> List[str]:
         errs += validate_memory(head["memory"], "headline")
     if "comms" in head:
         errs += validate_comms(head["comms"], "headline")
+    if "guardian" in head:
+        errs += validate_guardian(head["guardian"], "headline")
     if "overlap_fraction" in head and head["overlap_fraction"] is not None:
         errs += validate_overlap_fraction(head["overlap_fraction"],
                                           "headline")
@@ -342,7 +370,7 @@ def normalize_entry_row(row: Any,
         out["skipped_reason"] = str(row.pop("skipped_reason"))
     if "error" in row:
         out["error"] = str(row.pop("error"))
-    for key in ("trace_phases", "telemetry", "memory", "comms"):
+    for key in ("trace_phases", "telemetry", "memory", "comms", "guardian"):
         if key in row:
             val = row.pop(key)
             if val:

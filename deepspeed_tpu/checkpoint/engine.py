@@ -102,7 +102,8 @@ def save_state(save_dir: str, tag: str, state: PyTree,
                async_save: bool = False, writer: str = "orbax",
                keep_n: int = 0, fsync: bool = True, checksums: bool = True,
                retries: int = 3, retry_backoff_s: float = 0.2,
-               retry_jitter_s: float = 0.2) -> None:
+               retry_jitter_s: float = 0.2,
+               protect: Tuple[str, ...] = ()) -> None:
     """Commit-protocol save. ``async_save=True`` returns with the orbax
     write in flight — the reference's decoupled/fast engines
     (``runtime/checkpoint_engine/decoupled_checkpoint_engine.py:78``,
@@ -111,18 +112,20 @@ def save_state(save_dir: str, tag: str, state: PyTree,
     drains, so ``latest`` never names an in-flight checkpoint.
     ``writer='fast'`` routes through the C++ aio thread-pool engine
     (``checkpoint/checkpoint_engine.py``). ``keep_n > 0`` prunes all but
-    the newest N committed tags after each successful commit."""
+    the newest N committed tags after each successful commit; tags named
+    in ``protect`` survive the prune regardless of age (the guardian's
+    rollback anchor must outlive the retention window)."""
     with _save_lock:
         return _save_state_locked(
             save_dir, tag, state, client_state, save_latest, async_save,
             writer, keep_n, fsync, checksums, retries, retry_backoff_s,
-            retry_jitter_s)
+            retry_jitter_s, protect)
 
 
 def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                        async_save, writer, keep_n, fsync, checksums,
-                       retries, retry_backoff_s,
-                       retry_jitter_s) -> None:   # locked: _save_lock
+                       retries, retry_backoff_s, retry_jitter_s,
+                       protect=()) -> None:   # locked: _save_lock
     import orbax.checkpoint as ocp
 
     global _async_ckptr, _async_thread
@@ -154,7 +157,7 @@ def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                     save_dir, tag, LATEST_FILE, fsync=fsync),
                     "write_latest", **retry_kw)
             ft.gc_tags(save_dir, keep_n,
-                       protect=(tag, os.path.basename(tmp)))
+                       protect=(tag, os.path.basename(tmp)) + tuple(protect))
 
     chaos_point("save/pre_write")
     if writer == "fast":

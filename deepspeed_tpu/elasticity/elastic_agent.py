@@ -22,7 +22,16 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 class RestartableFailure(Exception):
     """Raise inside the train step to request an agent-managed restart
-    (the analog of a worker failure reaching torch-elastic)."""
+    (the analog of a worker failure reaching torch-elastic).
+
+    ``reason`` labels the restart accounting
+    (``elastic_restarts_total{reason}``): ``"failure"`` for generic
+    faults, ``"guardian"`` when the training guardian escalates an
+    exhausted rollback budget (``runtime/guardian.py``)."""
+
+    def __init__(self, *args, reason: str = "failure"):
+        super().__init__(*args)
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -88,7 +97,8 @@ class ElasticAgent:
 
         tm_restarts = telemetry.counter(
             "elastic_restarts_total",
-            "supervised restarts performed by the elastic agent")
+            "supervised restarts performed by the elastic agent, by "
+            "failure reason (guardian = escalated rollback budget)")
         tm_exhausted = telemetry.counter(
             "elastic_restart_exhausted_total",
             "elastic-agent runs that gave up after max_restarts")
@@ -98,17 +108,30 @@ class ElasticAgent:
                 self.train_fn(engine, start_step)
                 return engine
             except RestartableFailure as e:
+                reason = getattr(e, "reason", None) or "failure"
                 self.restarts += 1
                 if self.restarts > self.config.max_restarts:
                     tm_exhausted.inc()
                     logger.error(
                         f"elastic agent: giving up after {self.restarts - 1} "
                         f"restarts: {e}")
+                    # terminal: the last seconds of timeline ride a flight
+                    # dump so the give-up is explained, then the STRUCTURED
+                    # failure propagates — never a crash loop, never a
+                    # swallowed error (no-op unless telemetry.tracing)
+                    from deepspeed_tpu.telemetry.tracing import (
+                        safe_dump_flight,
+                    )
+
+                    safe_dump_flight(
+                        "elastic_exhausted",
+                        note=f"restarts={self.restarts - 1} "
+                             f"reason={reason}: {e}")
                     raise
-                tm_restarts.inc()
+                tm_restarts.inc(reason=reason)
                 backoff = self.backoff_s(self.restarts)
                 logger.warning(
                     f"elastic agent: restart {self.restarts}/"
-                    f"{self.config.max_restarts} after: {e} "
-                    f"(backoff {backoff:.1f}s)")
+                    f"{self.config.max_restarts} (reason={reason}) "
+                    f"after: {e} (backoff {backoff:.1f}s)")
                 time.sleep(backoff)

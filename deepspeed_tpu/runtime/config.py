@@ -506,6 +506,57 @@ class FaultToleranceConfig:
 
 
 @dataclasses.dataclass
+class GuardianSectionConfig:
+    """Training-run guardian (``runtime/guardian.py``; README "Training
+    guardian").
+
+    ``enabled`` arms the whole subsystem. ``nonfinite_guard`` extends the
+    fp16 loss-scaler's device-side skip-update ``lax.cond`` to bf16/fp32:
+    a step whose gradients are non-finite never touches the weights (no
+    scaler — pure skip, counted in the same device-side ``skips``
+    counter). Host-side anomaly detection rides the metrics the engine
+    already device_gets each ``steps_per_print`` cadence — zero extra
+    host syncs on the hot path: ``z_threshold`` standard deviations
+    outside the EMA/variance band of loss or grad-norm (after
+    ``warmup_observations`` samples; ``ema_decay`` is the band's memory)
+    flags an anomaly. On a confirmed anomaly the guardian dumps a flight
+    trace, rolls engine+optimizer+scaler+loader back to the last
+    committed checkpoint tag, bisects the offending window microbatch by
+    microbatch (``bisect_microbatches``), quarantines the culprit batch
+    (``quarantine``) and continues. More than ``max_rollbacks`` rollbacks
+    within ``rollback_window_steps`` escalates a structured
+    ``RestartableFailure`` into the ``ElasticAgent`` backoff path.
+    ``checkpoint_every`` > 0 makes ``TrainingGuardian.run`` write its own
+    rollback anchors at that step cadence (0 = the caller checkpoints)."""
+    enabled: bool = False
+    nonfinite_guard: bool = True
+    z_threshold: float = 6.0
+    warmup_observations: int = 8
+    ema_decay: float = 0.7
+    max_rollbacks: int = 2
+    rollback_window_steps: int = 500
+    checkpoint_every: int = 0
+    bisect_microbatches: bool = True
+    quarantine: bool = True
+
+    def validate(self) -> None:
+        if self.z_threshold <= 0:
+            raise DeepSpeedConfigError(
+                f"guardian.z_threshold must be > 0, got {self.z_threshold}")
+        if not 0.0 < self.ema_decay < 1.0:
+            raise DeepSpeedConfigError(
+                "guardian.ema_decay must be in (0, 1), got "
+                f"{self.ema_decay}")
+        for key in ("warmup_observations", "max_rollbacks",
+                    "rollback_window_steps", "checkpoint_every"):
+            val = getattr(self, key)
+            if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+                raise DeepSpeedConfigError(
+                    f"guardian.{key} must be a non-negative int, got "
+                    f"{val!r}")
+
+
+@dataclasses.dataclass
 class ActivationCheckpointingConfig:
     """Reference ``runtime/activation_checkpointing`` config. On TPU this selects a
     ``jax.checkpoint`` (remat) policy applied to the per-layer scan."""
@@ -720,6 +771,8 @@ class DeepSpeedTPUConfig:
         default_factory=CheckpointSectionConfig)
     fault_tolerance: FaultToleranceConfig = dataclasses.field(
         default_factory=FaultToleranceConfig)
+    guardian: GuardianSectionConfig = dataclasses.field(
+        default_factory=GuardianSectionConfig)
     data_efficiency: DataEfficiencyConfig = dataclasses.field(
         default_factory=DataEfficiencyConfig)
     # legacy top-level section (reference supports both placements)

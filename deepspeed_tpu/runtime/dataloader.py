@@ -4,15 +4,34 @@ Parity: reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader``,
 ``RepeatingLoader``). SPMD twist: a batch is ONE global ``jax.Array`` sharded
 over the mesh, not per-rank tensors — each host feeds its addressable shard via
 ``jax.make_array_from_process_local_data``.
+
+Checkpointable pipeline (README "Training guardian"): the loaders carry
+explicit position state — ``state_dict()`` / ``load_state_dict()`` with
+epoch, within-epoch offset, shuffle RNG, and a **quarantine list** of
+batch ids the stream must skip — so ``auto_resume`` after a preemption
+(and the guardian's anomaly rollback) replays the EXACT batch sequence an
+uninterrupted run would have seen, minus quarantined culprits. A batch id
+is the ``(epoch, offset)`` occurrence pair: ``offset`` counts batches READ
+from the source this epoch (quarantined reads included), so ids are stable
+across replays and fast-forwards.
+
+The ``data/poison_batch`` chaos injection point lives on the host read
+path here: when an armed ``fail`` window covers the read, the batch's
+token leaves are re-rolled from a poison RNG — the bad-disk/bad-shard
+shape the guardian's bisect must localize. The poisoned occurrence id is
+remembered on the loader instance (NOT in ``state_dict``) so a rollback
+replay re-reads the same corruption until the batch is quarantined,
+which is how real on-disk corruption behaves.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
+
+from deepspeed_tpu.testing.chaos import chaos_should_fire
 
 PyTree = Any
 
@@ -23,6 +42,12 @@ class RepeatingLoader:
     Generators cannot be restarted — ``iter()`` on an exhausted generator returns
     the same exhausted object — so they are rejected with a clear error rather
     than silently raising StopIteration mid-epoch.
+
+    Stateful: ``state_dict()`` records ``(epoch, offset)`` — epochs completed
+    and items yielded this epoch — and ``load_state_dict()`` fast-forwards a
+    fresh pass to the exact position (delegating to the inner loader's own
+    ``state_dict`` when it has one, so a stateful inner stream is restored
+    natively instead of replayed).
     """
 
     def __init__(self, loader):
@@ -33,16 +58,62 @@ class RepeatingLoader:
                 "RepeatingLoader needs a re-iterable source (list, DataLoader, ...); "
                 "got a one-shot iterator/generator. Make the source infinite instead "
                 "(e.g. synthetic_lm_data(num_batches=None)) or pass a sequence.")
+        self.epoch = 0
+        self.offset = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         try:
-            return next(self.data_iter)
+            item = next(self.data_iter)
         except StopIteration:
             self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            self.epoch += 1
+            self.offset = 0
+            item = next(self.data_iter)
+        self.offset += 1
+        return item
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd: Dict[str, Any] = {"epoch": self.epoch, "offset": self.offset}
+        inner = getattr(self.loader, "state_dict", None)
+        if callable(inner):
+            sd["inner"] = inner()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.epoch = int(sd.get("epoch", 0))
+        self.offset = int(sd.get("offset", 0))
+        inner = getattr(self.loader, "load_state_dict", None)
+        if callable(inner) and sd.get("inner") is not None:
+            inner(sd["inner"])
+            self.data_iter = iter(self.loader)
+            return
+        # fast-forward exact: a fresh pass discards `offset` items so the
+        # next __next__ yields the same batch the interrupted run would have
+        self.data_iter = iter(self.loader)
+        for _ in range(self.offset):
+            next(self.data_iter)
+
+
+def _poison_tokens(host_batch: PyTree, batch_id: Tuple[int, int]) -> PyTree:
+    """``data/poison_batch`` corruption: re-roll every integer leaf from a
+    poison RNG seeded by the batch id (deterministic — a replay of the same
+    occurrence reproduces the same corruption, like real disk rot). Float
+    leaves are scrambled with seeded noise."""
+    rng = np.random.default_rng((0xBAD, batch_id[0], batch_id[1]))
+
+    def corrupt(x):
+        x = np.asarray(x)
+        if np.issubdtype(x.dtype, np.integer):
+            hi = max(int(x.max()) + 1, 2)
+            return rng.integers(0, hi, x.shape).astype(x.dtype)
+        if np.issubdtype(x.dtype, np.floating):
+            return rng.standard_normal(x.shape).astype(x.dtype) * 1e3
+        return x
+
+    return jax.tree.map(corrupt, host_batch)
 
 
 class DeepSpeedTPUDataLoader:
@@ -51,20 +122,197 @@ class DeepSpeedTPUDataLoader:
     ``source`` yields numpy pytrees with a leading *global* batch dim (single
     process) or the process-local slice (multi-host) — ``make_array_from_
     process_local_data`` assembles the global array either way.
+
+    The loader is ONE logical stream across epochs: each ``__iter__`` pass
+    continues from the current position (a fresh loader starts at epoch 0,
+    offset 0; exhausting the source ends the epoch, and the next pass is
+    the next epoch). ``shuffle=True`` (sequence sources only) draws a
+    deterministic permutation per epoch from the seeded shuffle RNG.
+    Quarantined batch ids are skipped on read; ``state_dict()`` /
+    ``load_state_dict()`` round-trip epoch, offset, shuffle RNG, and the
+    quarantine list so resume replays the exact remaining sequence.
     """
 
     def __init__(self, source, batch_sharding: NamedSharding,
-                 drop_last: bool = True):
+                 drop_last: bool = True, shuffle: bool = False,
+                 seed: int = 0):
         self.source = source
         self.batch_sharding = batch_sharding
         self.drop_last = drop_last
+        self.shuffle = shuffle
+        if shuffle and not (hasattr(source, "__len__")
+                            and hasattr(source, "__getitem__")):
+            raise TypeError("shuffle=True needs a sequence source "
+                            "(__len__ + __getitem__)")
+        self.epoch = 0
+        self.offset = 0          # batches READ this epoch (incl. quarantined)
+        self.quarantined: List[Tuple[int, int]] = []
+        self._rng = np.random.default_rng(seed)
+        # RNG state snapshot taken before the CURRENT epoch's permutation
+        # draw — load_state_dict restores it and redraws, so a mid-epoch
+        # resume sees the same shuffle order
+        self._epoch_rng_state = self._rng.bit_generator.state
+        self._perm: Optional[np.ndarray] = None
+        # chaos bookkeeping (instance-level, NOT checkpointed: corruption
+        # is a property of the storage, not of the reader's position)
+        self._chaos_poisoned: List[Tuple[int, int]] = []
+
+    # -------------------------------------------------------------- #
+    # position state
+    # -------------------------------------------------------------- #
+    @property
+    def last_batch_id(self) -> Tuple[int, int]:
+        """Id of the most recently yielded batch: ``(epoch, offset - 1)``
+        where offset counts source reads this epoch."""
+        return (self.epoch, self.offset - 1)
+
+    def quarantine(self, batch_id) -> None:
+        """Skip this ``(epoch, offset)`` occurrence on any future read
+        (the guardian calls this with the bisected culprit's id)."""
+        bid = (int(batch_id[0]), int(batch_id[1]))
+        if bid not in self.quarantined:
+            self.quarantined.append(bid)
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd: Dict[str, Any] = {
+            "epoch": self.epoch,
+            "offset": self.offset,
+            "quarantined": [list(b) for b in self.quarantined],
+            "shuffle_rng": self._epoch_rng_state if self.shuffle else None,
+        }
+        inner = getattr(self.source, "state_dict", None)
+        if callable(inner):
+            sd["source"] = inner()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.epoch = int(sd.get("epoch", 0))
+        self.offset = int(sd.get("offset", 0))
+        self.quarantined = [
+            (int(b[0]), int(b[1])) for b in sd.get("quarantined") or []]
+        if self.shuffle and sd.get("shuffle_rng"):
+            self._epoch_rng_state = sd["shuffle_rng"]
+            self._rng.bit_generator.state = self._epoch_rng_state
+        self._perm = None   # redrawn (from the restored state) on next pass
+        inner = getattr(self.source, "load_state_dict", None)
+        if callable(inner) and sd.get("source") is not None:
+            inner(sd["source"])
+
+    # -------------------------------------------------------------- #
+    # the stream
+    # -------------------------------------------------------------- #
+    def _epoch_perm(self, n: int) -> np.ndarray:
+        if self._perm is None or len(self._perm) != n:
+            self._rng.bit_generator.state = self._epoch_rng_state
+            self._perm = self._rng.permutation(n)
+        return self._perm
+
+    def _host_batches(self) -> Iterator[Tuple[Tuple[int, int], PyTree]]:
+        """One epoch's worth of (batch_id, host_batch) from the current
+        offset, reading the source directly (no sharding)."""
+        if self.shuffle:
+            n = len(self.source)
+            perm = self._epoch_perm(n)
+            while self.offset < n:
+                idx = int(perm[self.offset])
+                bid = (self.epoch, self.offset)
+                self.offset += 1
+                yield bid, self.source[idx]
+        else:
+            it = iter(self.source)
+            if not callable(getattr(self.source, "state_dict", None)):
+                # fast-forward after load_state_dict by re-reading and
+                # discarding; a STATEFUL source restored its own position
+                # natively, so its fresh iterator already continues there
+                for _ in range(self.offset):
+                    next(it)
+            for host_batch in it:
+                bid = (self.epoch, self.offset)
+                self.offset += 1
+                yield bid, host_batch
+
+    def _end_epoch(self) -> None:
+        self.epoch += 1
+        self.offset = 0
+        if self.shuffle:
+            # snapshot BEFORE the next epoch's draw so a checkpoint taken
+            # any time during that epoch can reproduce its permutation
+            self._epoch_rng_state = self._rng.bit_generator.state
+            self._perm = None
+
+    def host_stream(self) -> Iterator[Tuple[Tuple[int, int], PyTree]]:
+        """One epoch of ``(batch_id, host_batch)`` with chaos poison
+        injection and quarantine filtering applied, NO device sharding —
+        the guardian's pull path (``engine.train_batch`` stacks + shards
+        host windows itself)."""
+        for bid, host_batch in self._host_batches():
+            if chaos_should_fire("data/poison_batch") \
+                    and bid not in self._chaos_poisoned:
+                self._chaos_poisoned.append(bid)
+            if bid in self._chaos_poisoned:
+                host_batch = _poison_tokens(host_batch, bid)
+            if bid in self.quarantined:
+                continue
+            yield bid, host_batch
+        self._end_epoch()
 
     def __iter__(self) -> Iterator[PyTree]:
-        for host_batch in self.source:
+        for _, host_batch in self.host_stream():
             yield shard_host_batch(host_batch, self.batch_sharding)
 
     def __len__(self):
         return len(self.source)
+
+
+class SyntheticLMLoader:
+    """Re-iterable, checkpointable synthetic token stream.
+
+    Batch ``i`` of the stream is a pure function of ``(seed, i %
+    num_distinct)`` — random access, so ``state_dict`` is just the emitted
+    count. ``num_distinct`` bounds the vocabulary of batches: a small value
+    makes the stream memorizable (loss falls), which is what the guardian's
+    loss-spike detection tests need — a poisoned batch then stands out
+    against a learnable baseline instead of hiding in uniform noise.
+    An epoch is ``num_batches`` batches (``None`` = one infinite epoch).
+    """
+
+    def __init__(self, batch_size: int, seq_len: int, vocab_size: int,
+                 seed: int = 0, num_batches: Optional[int] = None,
+                 num_distinct: Optional[int] = None, dtype=np.int32):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+        self.num_batches = num_batches
+        self.num_distinct = num_distinct
+        self.dtype = dtype
+        self.emitted = 0   # absolute ordinal of the next batch
+
+    def batch_at(self, i: int) -> Dict[str, np.ndarray]:
+        key = i if self.num_distinct is None else i % self.num_distinct
+        rng = np.random.default_rng((self.seed, key))
+        return {"tokens": rng.integers(
+            0, self.vocab_size, (self.batch_size, self.seq_len),
+            dtype=self.dtype)}
+
+    def __iter__(self):
+        start = self.emitted
+        while self.num_batches is None \
+                or self.emitted - start < self.num_batches:
+            batch = self.batch_at(self.emitted)
+            self.emitted += 1
+            yield batch
+
+    def __len__(self):
+        if self.num_batches is None:
+            raise TypeError("infinite SyntheticLMLoader has no len()")
+        return self.num_batches
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"emitted": self.emitted}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.emitted = int(sd.get("emitted", 0))
 
 
 def shard_host_batch(host_batch: PyTree, sharding: NamedSharding) -> PyTree:

@@ -311,6 +311,27 @@ class DeepSpeedTPUEngine:
             self._offload_param and jax.default_backend() == "tpu"
             and not self._compressed and not self._onebit_wire)
 
+        # training-run guardian (config "guardian"; README "Training
+        # guardian"): device-side non-finite skip for bf16/fp32 — the fp16
+        # loss-scaler's lax.cond branch, minus the scaler. Resolved BEFORE
+        # _init_state so the state tree carries the `skips` counter.
+        gcfg = self.config.guardian
+        self._nonfinite_guard = bool(
+            gcfg.enabled and gcfg.nonfinite_guard and not self.fp16_enabled)
+        if self._nonfinite_guard and self._host_step:
+            logger.warning(
+                "guardian.nonfinite_guard is unavailable with "
+                "offload_optimizer.host_step (the host-executed update has "
+                "no device-side skip branch) — host-side anomaly detection "
+                "still runs")
+            self._nonfinite_guard = False
+        self._guardian = None          # attached by TrainingGuardian
+        self._gc_protect_tags: set = set()   # rollback anchors keep_n must keep
+        self._gc_protect_root: Optional[str] = None
+        self._gc_pin_stale = False   # superseded by an in-flight async commit
+        self._restored_client_state: Optional[Dict] = None
+        self._tm_skips_lock = threading.Lock()
+
         # bucketed compute/collective overlap scheduler (ROADMAP item 2;
         # parallel/overlap.py): chunk the layer scan at the prefetch-bucket
         # granularity and emit each chunk's gradient sync mid-backward so
@@ -356,6 +377,7 @@ class DeepSpeedTPUEngine:
         # and the last save_checkpoint dir as the emergency fallback root
         self._preempt_requested = False
         self._in_step = False
+        self._guard_busy = False   # defer_preemption scope (guardian)
         self._saving = False
         self._ft_lock = threading.Lock()
         self._last_save_dir: Optional[str] = None
@@ -731,6 +753,10 @@ class DeepSpeedTPUEngine:
         self._watchdog = None
         self._tm_bridge = None
         self._tm_tokens_per_step = 0
+        # device-side overflow/non-finite skip counter, delta-folded into
+        # the monotone train_skipped_steps_total (set before the enabled
+        # gate: the guardian folds through this path too)
+        self._tm_skips_seen = 0
         self._tm_fenced_best_s: Optional[float] = None
         self._tm_flops_cache: Optional[float] = None
         self._tm_flops_lock = threading.Lock()
@@ -824,6 +850,35 @@ class DeepSpeedTPUEngine:
             self._watchdog = telemetry.StallWatchdog(
                 tcfg.stall_deadline_s, self._tm, on_stall=on_stall).start()
 
+    def _fold_skipped_steps(self, skips: int, resync: bool = False) -> None:
+        """Fold the device-side skip counter into the monotone
+        ``train_skipped_steps_total`` (delta-based). Fed from two paths:
+        the scrape-time collector (``resync=True`` — a guardian rollback
+        restores an OLDER device counter, and the watermark must follow
+        it down or post-rollback skips go uncounted) and the guardian's
+        log-cadence observe (no resync — a skip must reach the metric
+        even if a rollback rewinds the device counter before the next
+        scrape)."""
+        # locked: the scrape-time collector runs on the /metrics HTTP
+        # thread concurrently with the guardian's training-thread fold —
+        # an unlocked read-modify-write of the watermark double-counts
+        with self._tm_skips_lock:
+            self._fold_skips_locked(skips, resync=resync)
+
+    def _fold_skips_locked(self, skips: int,
+                           resync: bool = False) -> None:   # locked: _tm_skips_lock
+        from deepspeed_tpu import telemetry
+
+        delta = skips - self._tm_skips_seen
+        if delta > 0:
+            telemetry.counter(
+                "train_skipped_steps_total",
+                "optimizer steps skipped by the device-side "
+                "non-finite guard (fp16 overflow + guardian "
+                "bf16/fp32 sentinel)").inc(delta)
+        if delta > 0 or resync:
+            self._tm_skips_seen = skips
+
     def _chip_peak_flops(self) -> Optional[float]:
         from deepspeed_tpu.utils.chip_specs import chip_peak_tflops
 
@@ -909,6 +964,19 @@ class DeepSpeedTPUEngine:
             for k in ("loss", "grad_norm", "lr", "loss_scale", "overflow"):
                 if k in host:
                     telemetry.gauge(f"train_{k}").set(host[k])
+        if "skips" in self.state:
+            # device read + fold under ONE lock acquisition: a guardian
+            # rollback resyncing the watermark between an unlocked read
+            # and the fold would double-count the restored skips
+            with self._tm_skips_lock:
+                try:
+                    skips = int(jax.device_get(self.state["skips"]))
+                except Exception as e:   # deleted buffers: skip this scrape
+                    logger.debug(f"skip-counter device_get failed "
+                                 f"({type(e).__name__}: {e})")
+                    skips = None
+                if skips is not None:
+                    self._fold_skips_locked(skips, resync=True)
         expensive = getattr(self._tm, "collecting_expensive", True)
         if expensive and threading.get_ident() == self._tm_owner_thread:
             # only the engine's own thread may close the fenced throughput
@@ -1094,6 +1162,8 @@ class DeepSpeedTPUEngine:
             rep = NamedSharding(self.mesh, P())
             sh["scaler"] = jax.tree.map(lambda _: rep, self.scaler.init_state())
             sh["skips"] = rep
+        elif self._nonfinite_guard:
+            sh["skips"] = NamedSharding(self.mesh, P())
         if self._compressed is not None and self._compressed.get("loco"):
             axes = self._dp_manual_axes
             row = axes if len(axes) > 1 else axes[0]
@@ -1134,6 +1204,9 @@ class DeepSpeedTPUEngine:
                 state["opt"]["worker_error"])
         if self.fp16_enabled:
             state["scaler"] = self.scaler.init_state()
+            state["skips"] = jnp.zeros((), jnp.int32)
+        elif self._nonfinite_guard:
+            # bf16/fp32 sentinel: same device-side skip counter as fp16
             state["skips"] = jnp.zeros((), jnp.int32)
         if self._compressed is not None and self._compressed.get("loco"):
             # per-rank LoCo residuals: leading sharded world dim (same
@@ -1347,6 +1420,19 @@ class DeepSpeedTPUEngine:
                 overflow, skip_update, do_update,
                 (state["master"], state["opt"], grads))
             new_scaler = self.scaler.update(state["scaler"], overflow)
+        elif self._nonfinite_guard:
+            # guardian numerics sentinel (config "guardian"): the fp16
+            # skip-update lax.cond extended to bf16/fp32 — no scaler, pure
+            # skip. A non-finite gradient step must never touch the
+            # weights; the same device-side isfinite reduction (the norm
+            # is already computed for clipping) decides, the same
+            # device-side `skips` counter records it, and no host sync is
+            # added to the hot path.
+            overflow = jnp.logical_not(jnp.isfinite(norm))
+            new_master, new_opt = jax.lax.cond(
+                overflow, skip_update, do_update,
+                (state["master"], state["opt"], grads))
+            new_scaler = None
         else:
             overflow = jnp.asarray(False)
             new_master, new_opt = do_update((state["master"], state["opt"], grads))
@@ -1355,6 +1441,7 @@ class DeepSpeedTPUEngine:
         new_state = {"step": state["step"] + 1, "master": new_master, "opt": new_opt}
         if new_scaler is not None:
             new_state["scaler"] = new_scaler
+        if "skips" in state:
             new_state["skips"] = state["skips"] + overflow.astype(jnp.int32)
         metrics = {"grad_norm": norm, "lr": lr,
                    "overflow": overflow.astype(jnp.float32)}
@@ -1422,9 +1509,25 @@ class DeepSpeedTPUEngine:
                 lambda s: jnp.zeros(s.shape, acc_dt), self._shapes)
             zeros = self._constrain_grads(zeros)
 
+            def micro_fn(mb):
+                # chaos train/nan_grads injection (testing/chaos.py): the
+                # per-micro `_nan_grads` flag rides the batch dict only when
+                # the fault is armed — absent, the traced program is
+                # byte-identical to the uninjected step
+                flag = None
+                if isinstance(mb, dict) and "_nan_grads" in mb:
+                    mb = dict(mb)
+                    flag = mb.pop("_nan_grads")
+                loss, grads = self._loss_and_grads(state["master"], mb,
+                                                   scale)
+                if flag is not None:
+                    bad = jnp.where(flag > 0, jnp.nan, 1.0)
+                    grads = jax.tree.map(
+                        lambda g: g * bad.astype(g.dtype), grads)
+                return loss, grads
+
             grads_sum, mean_loss = self.accumulate_microbatches(
-                lambda mb: self._loss_and_grads(state["master"], mb, scale),
-                zeros, batch, gas, constrain=self._constrain_grads)
+                micro_fn, zeros, batch, gas, constrain=self._constrain_grads)
 
             grad_scale = jnp.float32(gas) * (scale if scale is not None else 1.0)
             lr_mult = None
@@ -1925,8 +2028,10 @@ class DeepSpeedTPUEngine:
 
     @property
     def skipped_steps(self) -> int:
-        """Exact count of overflow-skipped optimizer steps (device-side counter)."""
-        if not self.fp16_enabled:
+        """Exact count of skipped optimizer steps (device-side counter):
+        fp16 overflow skips, plus bf16/fp32 non-finite skips under
+        ``guardian.nonfinite_guard``."""
+        if "skips" not in self.state:
             return 0
         return int(jax.device_get(self.state["skips"]))
 
@@ -2017,9 +2122,35 @@ class DeepSpeedTPUEngine:
         stacked = self._inject_data_efficiency(stacked, gas)
         return self._dispatch_train_step(stacked, gas)
 
+    def _maybe_inject_nan_grads(self, stacked: PyTree, gas: int) -> PyTree:
+        """``train/nan_grads`` chaos injection point: when the armed fault
+        window covers this step, ride a per-micro poison flag into the
+        batch dict — the jitted step multiplies every gradient leaf by NaN
+        (``_train_step_fn``), which is exactly the shape of a real
+        non-finite backward. Unarmed cost: one global-is-None check."""
+        from deepspeed_tpu.testing.chaos import chaos_should_fire
+
+        if self._wire_format() != "exact" or self._host_runner is not None \
+                or not isinstance(stacked, dict):
+            # only the exact-wire fused builders strip the poison flag
+            # before the model's loss_fn — for wire-compressed / 1-bit /
+            # host-step builders the key would leak into the model batch
+            # (or silently never poison), and a NON-DICT batch can't
+            # carry the flag without changing the pytree the model sees.
+            # The point stays unarmed on those paths.
+            return stacked
+        if not chaos_should_fire("train/nan_grads"):
+            return stacked
+        stacked = dict(stacked)
+        stacked["_nan_grads"] = np.ones((gas,), np.float32)
+        logger.warning("chaos: train/nan_grads poisoning the gradients of "
+                       f"step {self.global_steps + 1}")
+        return stacked
+
     def _dispatch_train_step(self, stacked: PyTree, gas: int) -> jax.Array:
         """Run ONE fused step on an already-stacked [gas, ...] window."""
 
+        stacked = self._maybe_inject_nan_grads(stacked, gas)
         if self._host_runner is None:
             key = ("train_step", gas)
             if key not in self._compiled:
@@ -2193,6 +2324,11 @@ class DeepSpeedTPUEngine:
             self.lr_scheduler.step(self.global_steps)
         if self.global_steps % max(1, self.config.steps_per_print) == 0:
             host = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+            if self._guardian is not None:
+                # host-side numerics sentinel: the guardian's anomaly
+                # detector rides THIS device_get — the one the log cadence
+                # already pays — so detection adds zero hot-path syncs
+                self._guardian.observe(self.global_steps, host)
             if self._moe_drop_frac > 0:
                 logger.warning(
                     f"MoE expert-parallel dispatch dropped "
@@ -2449,7 +2585,7 @@ class DeepSpeedTPUEngine:
 
     def _on_preempt_signal(self, signum, frame) -> None:
         self._preempt_requested = True
-        busy = self._in_step or self._saving
+        busy = self._in_step or self._saving or self._guard_busy
         logger.warning(
             f"received signal {signum}: preemption imminent — will drain "
             "saves, write an emergency checkpoint, and exit cleanly"
@@ -2545,12 +2681,94 @@ class DeepSpeedTPUEngine:
                  f"{ftc.resume_dir}")
         return True
 
+    # ------------------------------------------------------------------ #
+    # training-run guardian hooks (runtime/guardian.py; config "guardian")
+    # ------------------------------------------------------------------ #
+    def attach_guardian(self, guardian) -> Optional[Dict]:
+        """Register a :class:`~deepspeed_tpu.runtime.guardian.
+        TrainingGuardian`: its loader/detector state rides every
+        checkpoint's client state, ``load_checkpoint`` restores it, and
+        the log-cadence metrics device_get feeds its anomaly detector.
+        Returns the client state of a checkpoint restored BEFORE the
+        guardian existed (``auto_resume`` at initialize), if any."""
+        self._guardian = guardian
+        return self._restored_client_state
+
+    def defer_preemption(self):
+        """Context manager deferring SIGTERM handling to scope exit while
+        the caller holds un-checkpointable in-flight state — the guardian
+        wraps each pull+step+containment cycle so an emergency checkpoint
+        can never capture a loader that advanced past a batch the step
+        hasn't trained (the offset/global_steps replay contract)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            # a separate flag, not _in_step: the wrapped engine.train_batch
+            # sets and CLEARS _in_step itself, which would re-open the
+            # window mid-scope
+            self._guard_busy = True
+            try:
+                yield
+            finally:
+                # boundary check INSIDE the finally: a body that raises
+                # (e.g. the guardian's RestartableFailure escalation) must
+                # still honor a deferred SIGTERM — preemption outranks the
+                # in-flight exception (emergency save + exit 0)
+                self._guard_busy = False
+                self._check_preemption_boundary()
+
+        return _scope()
+
+    def protect_checkpoint_tag(self, tag: Optional[str],
+                               root: Optional[str] = None) -> None:
+        """Pin ``tag`` (in checkpoint dir ``root``) against ``keep_n``
+        retention GC — the guardian's rollback anchor must survive until
+        a newer anchor commits. ``None`` clears the pins;
+        ``save_checkpoint`` clears them automatically once a newer tag
+        commits to the same dir (the walk-back then prefers that tag, so
+        the old anchor is obsolete)."""
+        if tag is None:
+            self._gc_protect_tags.clear()
+            self._gc_protect_root = None
+        else:
+            self._gc_protect_tags = {tag}
+            # normalized: supersession compares this to later save dirs —
+            # a different SPELLING of the same dir must still clear the pin
+            self._gc_protect_root = os.path.abspath(root) if root else None
+        self._gc_pin_stale = False
+
+    def probe_microbatch(self, micro: PyTree) -> Dict[str, float]:
+        """Replay ONE microbatch against the numerics sentinel WITHOUT
+        touching engine state — the guardian's bisect primitive. Runs a
+        jitted loss+grad pass (compiled once, cached; strictly off the
+        hot path) and returns host floats: ``loss``, ``grad_norm`` (fp16:
+        unscaled), ``finite``."""
+        if "probe" not in self._compiled:
+            def probe(state, b):
+                scale = state["scaler"].scale if self.fp16_enabled else None
+                loss, grads = self._loss_and_grads(state["master"], b, scale)
+                norm = global_grad_norm(grads)
+                if scale is not None:
+                    norm = norm / scale
+                return {"loss": loss, "grad_norm": norm}
+
+            self._compiled["probe"] = jax.jit(probe)
+        self._materialize_master()
+        batch = self._shard_batch(micro)
+        with self.mesh:
+            out = self._compiled["probe"](self.state, batch)
+        host = {k: float(jax.device_get(v)) for k, v in out.items()}
+        host["finite"] = float(np.isfinite(host["loss"])
+                               and np.isfinite(host["grad_norm"]))
+        return host
+
     def _check_preemption_boundary(self) -> None:
         """Step/save-boundary half of the deferred preemption handshake.
         Main thread only: SystemExit from a worker thread (e.g. a
         watchdog-thread save that finished while preemption was pending)
         would kill that thread, not the process."""
-        if self._preempt_requested and \
+        if self._preempt_requested and not self._guard_busy and \
                 threading.current_thread() is threading.main_thread():
             self._preemption_exit()
 
@@ -2568,6 +2786,10 @@ class DeepSpeedTPUEngine:
         if self._offload_param_nvme and self._param_swapper is not None:
             self._param_swapper.swap_in_params()
         tag = tag or f"global_step{self.global_steps}"
+        if self._gc_pin_stale:
+            # an async save superseded the anchor earlier; its commit has
+            # drained by now (save_state finalizes in-flight saves first)
+            self.protect_checkpoint_tag(None)
         client_state = dict(client_state or {})
         client_state.update({
             "global_steps": self.global_steps,
@@ -2580,6 +2802,11 @@ class DeepSpeedTPUEngine:
             # auto_resume must not replay or skip sampled randomness
             "np_rng": self._np_rng.bit_generator.state,
         })
+        if self._guardian is not None:
+            # loader position + quarantine list + detector bands ride every
+            # checkpoint — including the SIGTERM emergency tag — so resume
+            # replays the exact batch sequence (README "Training guardian")
+            client_state.update(self._guardian.client_state())
         ck = self.config.checkpoint
         self._saving = True   # a preemption signal mid-save defers here
         try:
@@ -2589,10 +2816,24 @@ class DeepSpeedTPUEngine:
                        keep_n=ck.keep_n, fsync=ck.fsync,
                        checksums=ck.verify_checksums, retries=ck.save_retries,
                        retry_backoff_s=ck.retry_backoff_s,
-                       retry_jitter_s=ck.retry_jitter_s)
+                       retry_jitter_s=ck.retry_jitter_s,
+                       protect=tuple(self._gc_protect_tags))
         finally:
             self._saving = False
         self._last_save_dir = save_dir
+        if (self._gc_protect_tags and tag not in self._gc_protect_tags
+                and self._gc_protect_root in (None,
+                                              os.path.abspath(save_dir))):
+            if async_save:
+                # the superseding tag's COMMIT is still in flight — mark
+                # the pin stale and clear it at the next save, whose
+                # finalize_async will have drained this commit first
+                self._gc_pin_stale = True
+            else:
+                # a NEWER tag just committed to the anchor's dir: the
+                # walk-back now prefers it, so the pinned rollback anchor
+                # is obsolete — let the next save's keep_n GC reclaim it
+                self.protect_checkpoint_tag(None)
         log_dist(f"saved checkpoint {save_dir}/{tag}"
                  + (" (async, commit in flight)" if async_save else ""))
         self._check_preemption_boundary()
@@ -2679,6 +2920,13 @@ class DeepSpeedTPUEngine:
             except (TypeError, ValueError) as e:
                 logger.warning(f"host RNG state in checkpoint not "
                                f"restorable ({e}) — fresh stream")
+        # guardian/loader state: restore through an attached guardian, and
+        # keep the raw client state so a guardian attached AFTER this load
+        # (auto_resume runs at initialize, before TrainingGuardian exists)
+        # can still pick it up (TrainingGuardian.__init__ does)
+        self._restored_client_state = client_state
+        if self._guardian is not None:
+            self._guardian.restore_client_state(client_state)
         log_dist(f"loaded checkpoint from {load_dir} (tag={tag or 'latest'})")
         return load_dir, client_state
 

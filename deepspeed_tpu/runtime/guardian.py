@@ -1,0 +1,535 @@
+"""Training-run guardian: numerics sentinel, automatic rollback, and
+bad-batch quarantine over the checkpointable data pipeline.
+
+The serving path survives chaos (circuit breaker, fleet failover); this
+module gives the *training* loop the same guarantee — a fault is
+detected, contained, and survived, automatically, with the blast radius
+named (README "Training guardian"; config section ``"guardian"``):
+
+1. **Numerics sentinel.** Device side, the engine extends the fp16
+   loss-scaler's ``isfinite`` + skip-update ``lax.cond`` to bf16/fp32
+   (``guardian.nonfinite_guard``; ``runtime/engine.py _apply_update``) —
+   a non-finite step never touches the weights and lands in the
+   device-side ``skips`` counter. Host side, :class:`AnomalyDetector`
+   keeps EMA mean/variance bands over loss and grad-norm and flags
+   ``z_threshold``-sigma spikes — fed by the metrics the engine already
+   ``device_get``\\ s each ``steps_per_print`` cadence, so the hot path
+   gains zero host syncs.
+2. **Rollback.** On a confirmed anomaly, dump a flight trace (reason
+   ``anomaly``), then roll engine + optimizer + scaler + loader back to
+   the last committed checkpoint tag — ``load_checkpoint``'s walk-back
+   reuses the commit-manifest verification, and the restored anchor is
+   pinned against ``keep_n`` retention GC until a newer anchor commits.
+3. **Quarantine.** Bisect the offending window by replaying its
+   microbatches against the sentinel (``engine.probe_microbatch`` —
+   loss/grad-norm/finiteness per micro, engine state untouched),
+   quarantine the culprit in the loader's state-carried quarantine list,
+   and continue past it.
+4. **Bounded escalation.** More than ``max_rollbacks`` rollbacks inside
+   ``rollback_window_steps`` raises a structured
+   :class:`~deepspeed_tpu.elasticity.elastic_agent.RestartableFailure`
+   (``reason="guardian"``) into the :class:`ElasticAgent` backoff path;
+   when the agent's restart budget is also exhausted the failure is
+   flight-dumped and re-raised — never a silent crash loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from deepspeed_tpu.elasticity.elastic_agent import RestartableFailure
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+PyTree = Any
+
+#: signals the detector bands, and the anomaly kind a spike maps to
+_BAND_SIGNALS = (("loss", "loss_spike"), ("grad_norm", "grad_norm_spike"))
+
+
+def _counter(name: str, description: str = ""):
+    from deepspeed_tpu import telemetry
+
+    return telemetry.counter(name, description)
+
+
+def _dump_flight(reason: str, note: Optional[str] = None) -> None:
+    """Flight-recorder dump that must never raise into the anomaly
+    handler it documents (one shared helper —
+    ``telemetry.tracing.safe_dump_flight``)."""
+    from deepspeed_tpu.telemetry.tracing import safe_dump_flight
+
+    safe_dump_flight(reason, note=note)
+
+
+@dataclasses.dataclass
+class Anomaly:
+    kind: str      # nonfinite | loss_spike | grad_norm_spike
+    step: int
+    value: float
+    detail: str
+
+
+class AnomalyDetector:
+    """EMA mean/variance bands with warmup over per-signal scalars.
+
+    Pure host math, JSON-serializable state (it rides the checkpoint's
+    client state so a restored run resumes with its learned bands, not a
+    cold warmup). An observed outlier is NOT folded into the band — a
+    spike must not raise the band it is judged against — and non-finite
+    observations short-circuit to a ``nonfinite`` anomaly.
+    """
+
+    #: per-signal variance floor, as a fraction of the band mean: a run
+    #: of near-identical observations (memorized batches) collapses the
+    #: EMA variance, and without a floor ordinary jitter becomes an
+    #: infinite z-score. Gradient norms swing ±50% step-to-step in
+    #: healthy training (measured on the tier-1 tiny lanes), so their
+    #: floor is wide — a REAL grad explosion is multiples of the mean,
+    #: not half a sigma of it.
+    REL_FLOORS = {"grad_norm": 0.25}
+    DEFAULT_REL_FLOOR = 0.05
+
+    def __init__(self, z_threshold: float = 6.0,
+                 warmup_observations: int = 8, ema_decay: float = 0.7):
+        self.z_threshold = float(z_threshold)
+        self.warmup = int(warmup_observations)
+        self.decay = float(ema_decay)
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    # ------------------------------------------------------------- bands
+    def _band(self, signal: str) -> Optional[Tuple[float, float]]:
+        st = self._stats.get(signal)
+        if st is None or st["n"] < self.warmup:
+            return None
+        std = math.sqrt(max(st["var"], 0.0))
+        rel = self.REL_FLOORS.get(signal, self.DEFAULT_REL_FLOOR)
+        floor = max(rel * abs(st["mean"]), 1e-8)
+        return st["mean"], max(std, floor)
+
+    def is_outlier(self, signal: str, value: float) -> bool:
+        """One-sided: only values ABOVE the band spike (a falling loss is
+        the goal, not an anomaly)."""
+        if not math.isfinite(value):
+            return True
+        band = self._band(signal)
+        if band is None:
+            return False
+        mean, std = band
+        return value > mean + self.z_threshold * std
+
+    def _fold(self, signal: str, value: float) -> None:
+        st = self._stats.setdefault(
+            signal, {"mean": value, "var": 0.0, "n": 0})
+        if 0 < st["n"] <= self.warmup:
+            # warmup: equal-weight Welford — an EMA variance seeded from
+            # 2-3 samples is pathologically tight and turns normal
+            # early-training drift into false spikes
+            delta = value - st["mean"]
+            st["mean"] += delta / (st["n"] + 1)
+            st["var"] += (delta * (value - st["mean"]) - st["var"]) \
+                / (st["n"] + 1)
+        elif st["n"] > self.warmup:
+            delta = value - st["mean"]
+            st["mean"] += (1.0 - self.decay) * delta
+            st["var"] = self.decay * (st["var"]
+                                      + (1.0 - self.decay) * delta * delta)
+        st["n"] += 1
+
+    # ---------------------------------------------------------- observe
+    def observe(self, step: int, metrics: Dict[str, float]
+                ) -> List[Anomaly]:
+        """Judge one log-cadence metrics sample; returns the anomalies it
+        triggers (empty = clean, and the sample is folded into the
+        bands)."""
+        out: List[Anomaly] = []
+        overflow = metrics.get("overflow") or 0.0
+        nonfinite = [k for k in ("loss", "grad_norm")
+                     if k in metrics and not math.isfinite(metrics[k])]
+        if overflow > 0 or nonfinite:
+            detail = ("device skip (overflow metric)" if overflow > 0
+                      else f"non-finite {','.join(nonfinite)}")
+            out.append(Anomaly("nonfinite", step,
+                               metrics.get("loss", float("nan")), detail))
+            return out   # a poisoned sample must not touch the bands
+        for signal, kind in _BAND_SIGNALS:
+            value = metrics.get(signal)
+            if value is None:
+                continue
+            if self.is_outlier(signal, value):
+                mean, std = self._band(signal)
+                out.append(Anomaly(
+                    kind, step, value,
+                    f"{signal}={value:.4g} vs band mean={mean:.4g} "
+                    f"std={std:.4g} (z>{self.z_threshold:g})"))
+            else:
+                self._fold(signal, value)
+        return out
+
+    # ------------------------------------------------------------ state
+    def state_dict(self) -> Dict[str, Any]:
+        return {"stats": {k: dict(v) for k, v in self._stats.items()}}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._stats = {
+            str(k): {"mean": float(v["mean"]), "var": float(v["var"]),
+                     "n": int(v["n"])}
+            for k, v in (sd.get("stats") or {}).items()}
+
+
+class _CountingStream:
+    """Adapter giving a plain iterable synthetic batch ids ``(0, n)`` —
+    used when the guardian's loader has no ``host_stream``/state; no
+    quarantine or fast-forward, but detection/rollback still work."""
+
+    def __init__(self, source):
+        self._it = iter(source)
+        self._n = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        bid = (0, self._n)
+        self._n += 1
+        return bid, batch
+
+
+class TrainingGuardian:
+    """Wraps an engine + checkpointable loader into a guarded train loop.
+
+    ::
+
+        engine, *_ = deepspeed_tpu.initialize(model=spec, config=cfg)
+        loader = DeepSpeedTPUDataLoader(source, sharding)   # stateful
+        guardian = TrainingGuardian(engine, loader,
+                                    checkpoint_dir="/ckpt")
+        guardian.run(num_steps=1000)        # or guardian.train_batch()
+
+    The guardian attaches to the engine: loader position, quarantine
+    list, and detector bands ride every checkpoint's client state
+    (including SIGTERM emergency tags), ``load_checkpoint`` restores
+    them, and the engine's log-cadence metrics feed :meth:`observe`. A
+    checkpoint restored by ``auto_resume`` BEFORE the guardian existed
+    is picked up at construction.
+    """
+
+    def __init__(self, engine, loader,
+                 checkpoint_dir: Optional[str] = None):
+        cfg = engine.config.guardian
+        if not cfg.enabled:
+            raise ValueError(
+                'TrainingGuardian needs `"guardian": {"enabled": true}` in '
+                "the engine config — the device-side non-finite skip is "
+                "compiled into the train step at initialize, so arming the "
+                "guardian after the fact would silently miss it")
+        self.engine = engine
+        self.loader = loader
+        self.cfg = cfg
+        self.checkpoint_dir = (checkpoint_dir
+                               or engine.config.fault_tolerance.resume_dir
+                               or engine._last_save_dir)
+        self.detector = AnomalyDetector(cfg.z_threshold,
+                                        cfg.warmup_observations,
+                                        cfg.ema_decay)
+        self._pending: List[Anomaly] = []
+        # rollback budget: global_steps at which rollbacks happened, kept
+        # within rollback_window_steps. Deliberately NOT checkpointed — an
+        # elastic-agent restart starts with a fresh budget (the agent's
+        # max_restarts bounds the outer loop).
+        self._rollback_steps: List[int] = []
+        self.quarantined_total = 0
+        self._skips_seen = int(engine.skipped_steps)
+        self._stream: Optional[Iterator] = None
+        self.last_window_ids: List[Tuple[int, int]] = []
+        restored = engine.attach_guardian(self)
+        if restored:
+            self.restore_client_state(restored)
+        log_dist(
+            f"training guardian armed: z={cfg.z_threshold} warmup="
+            f"{cfg.warmup_observations} max_rollbacks={cfg.max_rollbacks}"
+            f"/{cfg.rollback_window_steps} steps, nonfinite_guard="
+            f"{engine._nonfinite_guard or engine.fp16_enabled}, "
+            f"anchor dir={self.checkpoint_dir or '<none — escalate only>'}")
+
+    # ------------------------------------------------------------ state
+    def client_state(self) -> Dict[str, Any]:
+        cs: Dict[str, Any] = {"guardian": {
+            "detector": self.detector.state_dict(),
+            "quarantined_total": self.quarantined_total,
+        }}
+        sd = getattr(self.loader, "state_dict", None)
+        if callable(sd):
+            cs["loader"] = sd()
+        return cs
+
+    def restore_client_state(self, client_state: Dict[str, Any]) -> None:
+        g = client_state.get("guardian") or {}
+        if g.get("detector"):
+            self.detector.load_state_dict(g["detector"])
+        if "quarantined_total" in g:
+            self.quarantined_total = int(g["quarantined_total"])
+        loader_sd = client_state.get("loader")
+        restore = getattr(self.loader, "load_state_dict", None)
+        if loader_sd is not None and callable(restore):
+            restore(loader_sd)
+        # any live pull generator holds the pre-restore position
+        self._stream = None
+        self._skips_seen = int(self.engine.skipped_steps)
+
+    # ------------------------------------------------------- data pull
+    def _new_stream(self) -> Iterator:
+        host = getattr(self.loader, "host_stream", None)
+        if callable(host):
+            return host()
+        return _CountingStream(self.loader)
+
+    def _next_micro(self) -> Tuple[Tuple[int, int], PyTree]:
+        empty_passes = 0
+        while True:
+            if self._stream is None:
+                self._stream = self._new_stream()
+            try:
+                micro = next(self._stream)
+                return micro
+            except StopIteration:
+                self._stream = None   # epoch boundary — next pass
+                empty_passes += 1
+                if empty_passes >= 2:
+                    # two consecutive passes yielded NOTHING: empty
+                    # source, or every batch quarantined — spinning
+                    # through epochs forever would hang the run silently
+                    raise RuntimeError(
+                        "guardian: the data loader yielded no batches "
+                        "for two consecutive epochs (empty source, or "
+                        "the quarantine list covers everything)")
+
+    # -------------------------------------------------------- sentinel
+    def observe(self, step: int, host_metrics: Dict[str, float]) -> None:
+        """Engine hook (``_after_step``, log cadence): feed the anomaly
+        detector from the already-fetched host metrics, plus the delta of
+        the device-side skip counter (a skip EARLIER in the cadence
+        window would otherwise be invisible — the overflow metric only
+        reflects the last step)."""
+        host = dict(host_metrics)
+        fp16 = self.engine.fp16_enabled
+        if fp16:
+            # the dynamic loss scaler OWNS fp16 overflow recovery: warmup
+            # overflows are routine and self-healing (device skip + scale
+            # halving), not anomalies to roll a run back over — and the
+            # non-finite SCALED grad norm is the same event. A non-finite
+            # LOSS still escalates (the scaler never produces one).
+            host.pop("overflow", None)
+            gn = host.get("grad_norm")
+            if gn is not None and not math.isfinite(gn):
+                host.pop("grad_norm")
+        anomalies = self.detector.observe(step, host)
+        skips = int(self.engine.skipped_steps)
+        # fold into train_skipped_steps_total NOW: a rollback rewinds the
+        # device counter, so waiting for the next /metrics scrape could
+        # lose the skip from the accounting entirely
+        self.engine._fold_skipped_steps(skips)
+        if not fp16 and skips > self._skips_seen and not any(
+                a.kind == "nonfinite" for a in anomalies):
+            anomalies.append(Anomaly(
+                "nonfinite", step, float(skips - self._skips_seen),
+                f"device skip counter advanced {self._skips_seen} -> "
+                f"{skips} inside the cadence window"))
+        self._skips_seen = max(self._skips_seen, skips)
+        for a in anomalies:
+            _counter("guardian_anomalies_total",
+                     "training anomalies confirmed by the guardian "
+                     "sentinel").inc(kind=a.kind)
+            logger.warning(f"guardian: {a.kind} anomaly at step {a.step}: "
+                           f"{a.detail}")
+        self._pending.extend(anomalies)
+
+    def pending_anomalies(self) -> List[Anomaly]:
+        return list(self._pending)
+
+    # ------------------------------------------------------ train loop
+    def train_batch(self) -> float:
+        """One guarded optimizer step: pull the window from the
+        checkpointable loader, run the fused step, then contain any
+        anomaly the sentinel confirmed (rollback → bisect → quarantine →
+        continue, or a structured escalation)."""
+        with self.engine.defer_preemption():
+            # a SIGTERM inside this scope defers to scope exit: the
+            # emergency checkpoint must never capture a loader that
+            # advanced past a pulled-but-untrained window, or a
+            # containment mid-flight (the exact-replay contract)
+            gas = self.engine.gradient_accumulation_steps()
+            window = [self._next_micro() for _ in range(gas)]
+            self.last_window_ids = [bid for bid, _ in window]
+            loss = self.engine.train_batch(iter(m for _, m in window))
+            if self._pending:
+                self._contain(anomaly_step=self.engine.global_steps)
+        return float(loss)
+
+    def run(self, num_steps: int) -> Optional[float]:
+        """Run until ``num_steps`` MORE committed steps exist (rolled-back
+        steps are re-earned). ``guardian.checkpoint_every`` > 0 writes
+        rollback anchors at that cadence into ``checkpoint_dir``."""
+        target = self.engine.global_steps + int(num_steps)
+        every = self.cfg.checkpoint_every
+        loss = None
+        while self.engine.global_steps < target:
+            loss = self.train_batch()
+            if every and self.checkpoint_dir \
+                    and self.engine.global_steps % every == 0:
+                self.engine.save_checkpoint(self.checkpoint_dir)
+        return loss
+
+    # ----------------------------------------------------- containment
+    def _contain(self, anomaly_step: int) -> None:
+        anomalies, self._pending = list(self._pending), []
+        kinds = ",".join(sorted({a.kind for a in anomalies}))
+        _dump_flight("anomaly",
+                     note=f"step={anomaly_step} kinds={kinds}: "
+                          + "; ".join(a.detail for a in anomalies[:4]))
+        window = self.cfg.rollback_window_steps
+        self._rollback_steps = [
+            s for s in self._rollback_steps
+            if anomaly_step - s <= window]
+        if len(self._rollback_steps) >= self.cfg.max_rollbacks:
+            raise RestartableFailure(
+                f"guardian: anomaly ({kinds}) at step {anomaly_step} after "
+                f"{len(self._rollback_steps)} rollbacks within the last "
+                f"{window} steps — rollback budget exhausted, escalating "
+                "to the elastic agent", reason="guardian")
+        anchor_tag, anchor_step = self._rollback(anomaly_step, kinds)
+        self._rollback_steps.append(anomaly_step)
+        if self.cfg.bisect_microbatches:
+            culprits = self._bisect(anchor_step, anomaly_step)
+            for bid, probe in culprits:
+                log_dist(f"guardian: bisect culprit batch {bid}: "
+                         f"loss={probe['loss']:.4g} "
+                         f"grad_norm={probe['grad_norm']:.4g} "
+                         f"finite={bool(probe['finite'])}")
+                if self.cfg.quarantine \
+                        and callable(getattr(self.loader, "quarantine",
+                                             None)):
+                    self.loader.quarantine(bid)
+                    self.quarantined_total += 1
+                    _counter("guardian_quarantined_batches_total",
+                             "culprit batches quarantined after a bisect"
+                             ).inc()
+        log_dist(f"guardian: contained {kinds} anomaly — rolled back "
+                 f"step {anomaly_step} -> {anchor_step} "
+                 f"(anchor {anchor_tag!r}), resuming")
+
+    def _rollback(self, anomaly_step: int, kinds: str
+                  ) -> Tuple[str, int]:
+        """Restore engine + optimizer + scaler + loader to the newest
+        committed checkpoint tag (manifest-verified walk-back). Returns
+        ``(tag, restored_step)``; escalates when there is no anchor."""
+        if not self.checkpoint_dir:
+            raise RestartableFailure(
+                f"guardian: anomaly ({kinds}) at step {anomaly_step} and "
+                "no checkpoint dir to roll back to — escalating",
+                reason="guardian")
+        tag = self._pick_anchor_tag(anomaly_step)
+        try:
+            self.engine.load_checkpoint(self.checkpoint_dir, tag=tag)
+        except FileNotFoundError:
+            raise RestartableFailure(
+                f"guardian: anomaly ({kinds}) at step {anomaly_step} and "
+                f"no committed checkpoint in {self.checkpoint_dir!r} — "
+                "escalating", reason="guardian") from None
+        if tag is not None:
+            # the anchor must survive keep_n GC for as long as it IS the
+            # anchor (a re-rollback inside the window needs it intact);
+            # tag=None = a legacy latest-file checkpoint restored without
+            # a commit marker — nothing committed to pin
+            self.engine.protect_checkpoint_tag(tag,
+                                               root=self.checkpoint_dir)
+        else:
+            tag = "<legacy latest>"
+        self._stream = None   # loader position was restored
+        self._skips_seen = int(self.engine.skipped_steps)
+        # the device counter rewound with the restore — follow it down so
+        # post-rollback skips keep counting (the total stays monotone)
+        self.engine._fold_skipped_steps(self._skips_seen, resync=True)
+        _counter("guardian_rollbacks_total",
+                 "anomaly rollbacks to the last committed checkpoint"
+                 ).inc()
+        return tag, int(self.engine.global_steps)
+
+    def _pick_anchor_tag(self, anomaly_step: int) -> Optional[str]:
+        """Choose the rollback anchor: the NEWEST committed+intact tag
+        whose step pre-dates the whole detection window. Detection lags
+        up to one log cadence behind the fault, so a tag committed
+        inside ``(anomaly_step - cadence, anomaly_step]`` may already
+        hold poisoned weights — anchoring there would replay a window
+        that EXCLUDES the culprit and burn the rollback budget on
+        identical poisoned anchors. Falls back to the plain newest-intact
+        walk-back (with a warning) when no tag is old enough, and to
+        ``None`` (the loader-side legacy resolution) when nothing
+        carries a marker."""
+        from deepspeed_tpu.checkpoint.fault_tolerance import (
+            committed_tags,
+            find_restore_tag,
+            read_marker,
+            verify_tag,
+        )
+
+        checksums = self.engine.config.checkpoint.verify_checksums
+        cadence = max(1, self.engine.config.steps_per_print)
+        safe_step = anomaly_step - cadence
+        for tag in committed_tags(self.checkpoint_dir):
+            marker = read_marker(self.checkpoint_dir, tag) or {}
+            step = marker.get("step")
+            if not isinstance(step, int) or step > safe_step:
+                continue
+            ok, _why = verify_tag(self.checkpoint_dir, tag,
+                                  checksums=checksums)
+            if ok:
+                return tag
+        tag = find_restore_tag(self.checkpoint_dir, checksums=checksums)
+        if tag is not None:
+            logger.warning(
+                f"guardian: no committed anchor at step <= {safe_step} "
+                f"(anomaly at {anomaly_step}, detection cadence "
+                f"{cadence}) — rolling back to {tag!r}, which may "
+                "post-date the fault; the bisect window may miss the "
+                "culprit")
+        return tag
+
+    def _bisect(self, anchor_step: int, anomaly_step: int
+                ) -> List[Tuple[Tuple[int, int], Dict[str, float]]]:
+        """Replay the rolled-back window's microbatches against the
+        sentinel (probe only — engine state untouched) and name the
+        culprits; then rewind the loader to the anchor position so
+        training replays from exactly where the rollback left it."""
+        sd = getattr(self.loader, "state_dict", None)
+        snapshot = sd() if callable(sd) else None
+        if snapshot is None:
+            # a stateless loader cannot be rewound after the probe replay
+            # — bisecting would permanently consume the probed batches
+            # from the live stream (and there is no quarantine() to feed
+            # anyway); detection + rollback still ran
+            logger.warning(
+                "guardian: bisect skipped — the loader has no "
+                "state_dict() to rewind after the probe replay")
+            return []
+        gas = self.engine.gradient_accumulation_steps()
+        culprits = []
+        for _ in range(max(anomaly_step - anchor_step, 0)):
+            for _ in range(gas):
+                bid, micro = self._next_micro()
+                probe = self.engine.probe_microbatch(micro)
+                # culprit criteria: non-finite, or per-micro LOSS outside
+                # the band. Deliberately NOT the grad-norm band: its
+                # statistics are per-STEP (gas-averaged gradients — norm
+                # ~1/sqrt(gas) of a single micro's), so judging a single
+                # micro against it would quarantine healthy batches at
+                # large gas. Loss is a mean either way — scale-compatible.
+                if not probe["finite"] \
+                        or self.detector.is_outlier("loss", probe["loss"]):
+                    culprits.append((bid, probe))
+        restore = getattr(self.loader, "load_state_dict", None)
+        if snapshot is not None and callable(restore):
+            restore(snapshot)
+        self._stream = None
+        return culprits

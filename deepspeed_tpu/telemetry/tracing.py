@@ -488,6 +488,20 @@ class Tracer:
 _default_tracer = Tracer()
 
 
+def safe_dump_flight(reason: str, note: Optional[str] = None
+                     ) -> Optional[str]:
+    """Module-level convenience for failure handlers: dump the process
+    tracer's flight recorder, never raising. ``Tracer.dump_flight``
+    already swallows its own failures; this additionally guards the
+    tracer lookup itself, so callers (guardian anomaly containment,
+    elastic-agent give-up) need no boilerplate try/except."""
+    try:
+        return get_tracer().dump_flight(reason, note=note)
+    except Exception as e:   # the caller's failure must win
+        logger.warning(f"flight dump ({reason}) failed: {e}")
+        return None
+
+
 def get_tracer() -> Tracer:
     return _default_tracer
 

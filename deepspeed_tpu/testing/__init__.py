@@ -11,6 +11,7 @@ from deepspeed_tpu.testing.chaos import (  # noqa: F401
     OverloadGenerator,
     arm,
     chaos_point,
+    chaos_should_fire,
     disarm,
     failing_writes,
 )

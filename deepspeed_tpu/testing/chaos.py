@@ -26,14 +26,27 @@ checkpoint hot paths that must stay importable everywhere):
   queue capacity and asserting clean shedding / zero KV leaks.
 
 ``DSTPU_CHAOS`` grammar: ``point=action[;point=action...]``
-  * ``fail:n``  — the first ``n`` hits of the point raise :class:`ChaosError`
-    (default 1); later hits pass — the transient-I/O shape retry must absorb.
+  * ``fail:n[:skip]`` — after ``skip`` passing hits (default 0), the next
+    ``n`` hits of the point raise :class:`ChaosError` (default 1); later
+    hits pass — the transient-I/O shape retry must absorb. The ``skip``
+    offset arms a fault *at* hit ``skip+1`` (e.g. "poison step N": the
+    training fault points are hit once per step, so
+    ``train/nan_grads=fail:1:3`` corrupts exactly step 4).
   * ``kill:n``  — the ``n``-th hit of the point calls ``os._exit(137)``
     (default 1): an un-catchable crash, the preemption/OOM-killer shape.
   * ``hang:s:n`` — the first ``n`` hits (default 1) BLOCK for ``s`` seconds
     (default 0.05) before returning: the tick-stuck-in-a-device-call shape,
     distinct from a raise — nothing fails, the heartbeat just goes stale
     (``serving/hang`` is armed this way for hang-vs-crash detection tests).
+
+Injection points: some fault points model *corruption*, not failure — the
+caller asks :func:`chaos_should_fire` whether the armed ``fail`` window
+covers this hit and, when it does, corrupts its own value instead of
+raising (``train/nan_grads`` tree-poisons the step's gradients in
+``runtime/engine.py``; ``data/poison_batch`` corrupts one batch's tokens
+in ``runtime/dataloader.py``). The hit accounting is identical to
+:func:`chaos_point` — scoped rules, skip offsets and counts compose — so
+one grammar drives both raise-style and corrupt-style faults.
 
 Scoped points: a rule keyed ``point@scope`` fires only for hits that pass a
 matching ``scope=`` (the serving front-end passes its replica name), so a
@@ -74,7 +87,8 @@ class FaultPlan:
     writers hit points from worker threads."""
 
     def __init__(self, rules: Dict[str, Any]):
-        # rules: point[@scope] -> ("fail"|"kill", n) | ("hang", n, stall_s)
+        # rules: point[@scope] -> ("fail", n, skip) | ("kill", n)
+        #                         | ("hang", n, stall_s)
         self.rules = dict(rules)
         self._hits: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -93,7 +107,11 @@ class FaultPlan:
                 stall = float(args[1]) if len(args) > 1 and args[1] else 0.05
                 n = int(args[2]) if len(args) > 2 and args[2] else 1
                 rules[point.strip()] = ("hang", n, stall)
-            elif action in ("fail", "kill"):
+            elif action == "fail":
+                n = int(args[1]) if len(args) > 1 and args[1] else 1
+                skip = int(args[2]) if len(args) > 2 and args[2] else 0
+                rules[point.strip()] = ("fail", n, skip)
+            elif action == "kill":
                 n = int(args[1]) if len(args) > 1 and args[1] else 1
                 rules[point.strip()] = (action, n)
             else:
@@ -102,9 +120,10 @@ class FaultPlan:
                     f"(spec {spec!r})")
         return cls(rules)
 
-    def hit(self, point: str, scope: Optional[str] = None) -> None:
-        # a scoped rule (point@scope) outranks the unscoped one for hits
-        # that carry the matching scope; unscoped rules match every hit
+    def _account(self, point: str, scope: Optional[str]):
+        """One hit of ``point``: resolve the matching rule (scoped rules
+        outrank unscoped ones) and advance its counter. Returns
+        ``(key, rule, count)`` or ``(None, None, 0)`` when unarmed."""
         keys = [f"{point}@{scope}"] if scope else []
         keys.append(point)
         with self._lock:
@@ -114,23 +133,55 @@ class FaultPlan:
                     rule, key = self.rules[k], k
                     break
             if rule is None:
-                return
+                return None, None, 0
             self._hits[key] = count = self._hits.get(key, 0) + 1
-            action, n = rule[0], rule[1]
+        return key, rule, count
+
+    def _execute(self, rule, count: int) -> bool:
+        """Run a matched rule's side effect for hit ``count`` (ONE copy of
+        the action semantics for both raise-style and corrupt-style
+        points). Returns True iff a ``fail`` window covers the hit —
+        :meth:`hit` turns that into a :class:`ChaosError`,
+        :meth:`should_fire` into a corrupt-your-own-value answer."""
+        action, n = rule[0], rule[1]
         if action == "kill":
             if count == n:
                 # hard crash: no atexit, no finally blocks, no flushing —
                 # the honest model of preemption/OOM-kill
                 os._exit(KILL_EXIT_CODE)
-        elif action == "hang":
+            return False
+        if action == "hang":
             if count <= n:
                 # block (outside the lock) — the heartbeat goes stale but
                 # nothing raises; hang-vs-crash detection must tell these
                 # apart
                 time.sleep(rule[2])
-        elif count <= n:
+            return False
+        return self._fail_covers(rule, count)
+
+    def hit(self, point: str, scope: Optional[str] = None) -> None:
+        key, rule, count = self._account(point, scope)
+        if rule is not None and self._execute(rule, count):
             raise ChaosError(f"chaos: injected failure at {key!r} "
-                             f"(hit {count}/{n})")
+                             f"(hit {count}, window {rule[2] + 1}.."
+                             f"{rule[2] + rule[1]})")
+
+    @staticmethod
+    def _fail_covers(rule, count: int) -> bool:
+        """Whether a ``fail`` rule's (skip, n) window covers hit ``count``."""
+        n, skip = rule[1], rule[2]
+        return skip < count <= skip + n
+
+    def should_fire(self, point: str, scope: Optional[str] = None) -> bool:
+        """Injection-point query: advance the hit counter exactly like
+        :meth:`hit`, but a covering ``fail`` rule answers ``True`` instead
+        of raising — the caller corrupts its own value (NaN grads, poisoned
+        tokens). ``kill``/``hang`` rules keep their :meth:`hit` semantics
+        (a crash/hang at an injection point is still a crash/hang)."""
+        _key, rule, count = self._account(point, scope)
+        if rule is None:
+            return False
+        return self._execute(rule, count)
 
     def hits(self, point: str) -> int:
         with self._lock:
@@ -155,21 +206,40 @@ def disarm() -> None:
     _env_checked = True   # an explicit disarm also wins over the env
 
 
+def _resolve_plan() -> Optional[FaultPlan]:
+    """Lazy env-arm shared by both hook flavors: resolve the armed plan,
+    parsing ``DSTPU_CHAOS`` exactly once per process."""
+    global _armed, _env_checked
+    if _armed is None:
+        if _env_checked:
+            return None
+        _env_checked = True
+        spec = os.environ.get(CHAOS_ENV)
+        if not spec:
+            return None
+        _armed = FaultPlan.parse(spec)
+    return _armed
+
+
 def chaos_point(point: str, scope: Optional[str] = None) -> None:
     """Production-code hook: no-op unless a plan is armed (in-process or
     via ``DSTPU_CHAOS``). ``scope`` narrows which instance is hitting the
     point (e.g. a serving replica's name) so plans can target one replica
     of a fleet via ``point@scope`` rules."""
-    global _armed, _env_checked
-    if _armed is None:
-        if _env_checked:
-            return
-        _env_checked = True
-        spec = os.environ.get(CHAOS_ENV)
-        if not spec:
-            return
-        _armed = FaultPlan.parse(spec)
-    _armed.hit(point, scope=scope)
+    plan = _resolve_plan()
+    if plan is not None:
+        plan.hit(point, scope=scope)
+
+
+def chaos_should_fire(point: str, scope: Optional[str] = None) -> bool:
+    """Injection-point hook (``train/nan_grads``, ``data/poison_batch``):
+    ``True`` when an armed ``fail`` rule covers this hit — the caller then
+    corrupts its own value instead of raising. Unarmed cost is the same
+    one global-is-None check as :func:`chaos_point`."""
+    plan = _resolve_plan()
+    if plan is None:
+        return False
+    return plan.should_fire(point, scope=scope)
 
 
 class ChaosCheckpointEngine:

@@ -105,6 +105,14 @@ def pytest_configure(config):
         "C-level XLA call can't be interrupted this way — the outer "
         "tier-1 `timeout` still bounds those")
     config.addinivalue_line(
+        "markers", "guardian: training-run guardian tests (numerics "
+        "sentinel skip-update, EMA anomaly bands, checkpoint rollback + "
+        "microbatch bisect + bad-batch quarantine over the checkpointable "
+        "loader, bounded escalation into the elastic agent — CPU backend, "
+        "tier-1-eligible under JAX_PLATFORMS=cpu; the chaos acceptance "
+        "runs arm train/nan_grads and data/poison_batch against a bf16 "
+        "zero-3 engine and pin the curve against an uninjected twin)")
+    config.addinivalue_line(
         "markers", "fleet: multi-replica serving-fleet tests (FleetRouter "
         "failover/hedging/draining over chaos-killed and chaos-hung "
         "replicas — CPU backend, tier-1-eligible under JAX_PLATFORMS=cpu; "

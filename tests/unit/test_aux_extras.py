@@ -78,7 +78,8 @@ class TestElasticAgent:
     def test_exponential_backoff_with_cap_and_counters(self, monkeypatch):
         from deepspeed_tpu import telemetry
 
-        restarts0 = telemetry.counter("elastic_restarts_total").value()
+        restarts0 = telemetry.counter(
+            "elastic_restarts_total").value(reason="failure")
         exhausted0 = telemetry.counter(
             "elastic_restart_exhausted_total").value()
         sleeps = []
@@ -100,7 +101,7 @@ class TestElasticAgent:
         # 0.01 -> 0.02 -> 0.04 capped to 0.03; 4th failure gives up, no sleep
         assert sleeps == [0.01, 0.02, 0.03]
         assert telemetry.counter(
-            "elastic_restarts_total").value() == restarts0 + 3
+            "elastic_restarts_total").value(reason="failure") == restarts0 + 3
         assert telemetry.counter(
             "elastic_restart_exhausted_total").value() == exhausted0 + 1
 

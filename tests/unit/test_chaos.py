@@ -181,7 +181,7 @@ class TestSelfHealingLoad:
 class TestChaosHarness:
     def test_plan_parse_and_counts(self):
         plan = chaos.FaultPlan.parse("a/b=fail:2;c=kill:3")
-        assert plan.rules == {"a/b": ("fail", 2), "c": ("kill", 3)}
+        assert plan.rules == {"a/b": ("fail", 2, 0), "c": ("kill", 3)}
         with pytest.raises(ValueError):
             chaos.FaultPlan.parse("x=explode")
         chaos.arm("p=fail:1")
@@ -189,6 +189,37 @@ class TestChaosHarness:
             chaos.chaos_point("p")
         chaos.chaos_point("p")   # second hit passes
         chaos.chaos_point("unarmed-point")
+
+    def test_fail_skip_offset_arms_at_hit_n(self):
+        """``fail:n:skip`` — `skip` hits pass, the next `n` raise, later
+        hits pass: how a fault is armed *at step N* of a training run
+        whose fault point fires once per step."""
+        plan = chaos.FaultPlan.parse("train/nan_grads=fail:2:3")
+        assert plan.rules == {"train/nan_grads": ("fail", 2, 3)}
+        chaos.arm(plan)
+        for _ in range(3):
+            chaos.chaos_point("train/nan_grads")    # hits 1-3 pass
+        for _ in range(2):
+            with pytest.raises(chaos.ChaosError):
+                chaos.chaos_point("train/nan_grads")  # hits 4-5 raise
+        chaos.chaos_point("train/nan_grads")        # window spent
+
+    def test_should_fire_covers_fail_window_without_raising(self):
+        """Injection points (train/nan_grads, data/poison_batch) consume
+        the same hit accounting but corrupt instead of raising."""
+        chaos.arm("train/nan_grads=fail:1:2")
+        fired = [chaos.chaos_should_fire("train/nan_grads")
+                 for _ in range(4)]
+        assert fired == [False, False, True, False]
+        # unarmed point: permanently False, no accounting
+        assert not chaos.chaos_should_fire("data/poison_batch")
+
+    def test_should_fire_scoped_rules(self):
+        plan = chaos.arm("data/poison_batch@ldr1=fail:1")
+        assert not chaos.chaos_should_fire("data/poison_batch",
+                                           scope="ldr0")
+        assert chaos.chaos_should_fire("data/poison_batch", scope="ldr1")
+        assert plan.hits("data/poison_batch@ldr1") == 1
 
     def test_hang_action_blocks_without_raising(self):
         plan = chaos.FaultPlan.parse("serving/hang=hang:0.05:2")

@@ -230,6 +230,14 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
                                 loco_error_feedback=True)
     elif wire:
         raise ValueError(f"BENCH_WIRE must be exact|qgz, got {wire!r}")
+    if os.environ.get("BENCH_STEP_OVERLAP", "1") == "0":
+        # A/B switch for the step-phase overlap (bucketed update +
+        # double-buffered params; README "Overlap scheduler"): the
+        # transform is numerics-identical, so two runs differing only in
+        # this knob isolate its wall-clock effect for bench-diff.
+        # Applied AFTER config_extra, like BENCH_OVERLAP/BENCH_WIRE — a
+        # row whose extra replaces the zero section still honors the A/B
+        config["zero_optimization"]["overlap_step"] = False
     engine, *_ = dst.initialize(model=spec, config=config)
     cfg = PRESETS[model]
     data = synthetic_lm_data(batch * n_chips, seq_len, cfg.vocab_size, seed=0)

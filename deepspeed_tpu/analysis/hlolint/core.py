@@ -165,10 +165,14 @@ def contract_observations(ledger) -> Dict[str, Any]:
     by_sub: Dict[str, Dict[str, Any]] = {}
     for op in ledger.ops:
         sub = op.subsystem or "other"
-        row = by_sub.setdefault(sub, {"bytes": 0, "count": 0,
+        row = by_sub.setdefault(sub, {"bytes": 0, "count": 0, "async": 0,
                                       "dtypes": set()})
         row["bytes"] += op.size_bytes
         row["count"] += 1
+        # the parser counts each async pair ONCE, at its -start line —
+        # a *-start opcode here IS one matched pair of this subsystem
+        if str(op.hlo_opcode or "").endswith("-start"):
+            row["async"] += 1
         if op.dtype:
             row["dtypes"].add(op.dtype)
     return {
@@ -180,7 +184,7 @@ def contract_observations(ledger) -> Dict[str, Any]:
                                if op.dtype in INT8_DTYPES),
         "subsystems": {
             sub: {"bytes": row["bytes"], "count": row["count"],
-                  "dtypes": sorted(row["dtypes"])}
+                  "async": row["async"], "dtypes": sorted(row["dtypes"])}
             for sub, row in sorted(by_sub.items())},
     }
 
@@ -231,7 +235,7 @@ def check_contract(ledger, contract: Dict[str, Any],
                 limit=bound, observed=got))
     for sub, bounds in (contract.get("subsystems") or {}).items():
         got_row = obs["subsystems"].get(sub, {"bytes": 0, "count": 0,
-                                              "dtypes": []})
+                                              "async": 0, "dtypes": []})
         bmax = bounds.get("bytes_max")
         if bmax is not None and got_row["bytes"] > bmax:
             findings.append(HloFinding(
@@ -246,6 +250,30 @@ def check_contract(ledger, contract: Dict[str, Any],
                 "floor — the collectives moved elsewhere (reattributed?)"
                 " or vanished from the program",
                 limit=bmin, observed=got_row["bytes"]))
+        cmax = bounds.get("count_max")
+        if cmax is not None and got_row["count"] > cmax:
+            findings.append(HloFinding(
+                "contract", program,
+                f"subsystem {sub!r} collective count violates the "
+                "committed ceiling — the phase grew ops the contract "
+                "never priced",
+                limit=cmax, observed=got_row["count"]))
+        cmin = bounds.get("count_min")
+        if cmin is not None and got_row["count"] < cmin:
+            findings.append(HloFinding(
+                "contract", program,
+                f"subsystem {sub!r} collective count fell below the "
+                "committed floor — the fence chain's size-bounded "
+                "groups re-fused (or the ops vanished/reattributed)",
+                limit=cmin, observed=got_row["count"]))
+        amin = bounds.get("async_min")
+        if amin is not None and got_row["async"] < amin:
+            findings.append(HloFinding(
+                "contract", program,
+                f"subsystem {sub!r} async start/done pairs fell below "
+                "the committed floor — the phase's collectives lowered "
+                "synchronous and cannot hide under compute",
+                limit=amin, observed=got_row["async"]))
         allowed = bounds.get("allowed_dtypes")
         if allowed is not None:
             stray = sorted(set(got_row["dtypes"]) - set(allowed))
@@ -256,7 +284,8 @@ def check_contract(ledger, contract: Dict[str, Any],
                     f"the committed allowed_dtypes {sorted(allowed)}",
                     limit=len(allowed), observed=len(got_row["dtypes"])))
         unknown_sub = set(bounds) - {"bytes_max", "bytes_min",
-                                     "allowed_dtypes"}
+                                     "count_max", "count_min",
+                                     "async_min", "allowed_dtypes"}
         if unknown_sub:
             raise ContractError(
                 f"contract subsystem {sub!r} has unknown bound key(s) "
@@ -323,6 +352,15 @@ def _loosenings(old: Dict[str, Any],
         if o is not None and (n is None or n < o):
             out.append(f"subsystems.{sub}.bytes_min "
                        f"{_fmt_num(o)} -> {_fmt_num(n)}")
+        o, n = bounds.get("count_max"), nb.get("count_max")
+        if o is not None and (n is None or n > o):
+            out.append(f"subsystems.{sub}.count_max "
+                       f"{_fmt_num(o)} -> {_fmt_num(n)}")
+        for floor_key in ("count_min", "async_min"):
+            o, n = bounds.get(floor_key), nb.get(floor_key)
+            if o is not None and (n is None or n < o):
+                out.append(f"subsystems.{sub}.{floor_key} "
+                           f"{_fmt_num(o)} -> {_fmt_num(n)}")
         oa, na = bounds.get("allowed_dtypes"), nb.get("allowed_dtypes")
         if oa is not None and (na is None or not set(na) <= set(oa)):
             out.append(f"subsystems.{sub}.allowed_dtypes "
@@ -351,6 +389,11 @@ def bootstrap_contract(ledger, cfg: LintConfig,
     body["subsystems"] = {
         sub: {"bytes_max": row["bytes"],
               "bytes_min": row["bytes"],
+              "count_max": row["count"],
+              "count_min": row["count"],
+              # the per-subsystem async floor only exists where the
+              # program shows pairs (sync-only fixtures pin none)
+              **({"async_min": row["async"]} if row["async"] else {}),
               "allowed_dtypes": row["dtypes"]}
         for sub, row in obs["subsystems"].items()}
     section = {

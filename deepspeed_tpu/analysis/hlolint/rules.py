@@ -53,8 +53,17 @@ from deepspeed_tpu.analysis.hlolint.core import (
     check_contract,
 )
 
-_QUANTIZED_SUBSYSTEM = {"quant_grads": "zero_grad_sync",
-                        "quant_weights": "zero_param_gather"}
+#: quantized-wire flag -> the subsystems its bytes may travel in,
+#: checked as ONE pool. The deferred post-update publish (overlap_step)
+#: re-attributes the qwZ gather to zero_param_update — the wire-dtype
+#: check must follow the bytes there or a bypassed quantizer in the
+#: deferred gather would lint clean; pooling (rather than per-sub
+#: checks) keeps the residual f32 dross the stage-3 heuristic leaves in
+#: zero_param_gather from dominating a now-nearly-empty subsystem.
+_QUANTIZED_SUBSYSTEMS = {
+    "quant_grads": ("zero_grad_sync",),
+    "quant_weights": ("zero_param_gather", "zero_param_update"),
+}
 
 
 class _SyncCollective:
@@ -111,11 +120,11 @@ class _WireDtype:
 
     @staticmethod
     def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
-        for flag, sub in _QUANTIZED_SUBSYSTEM.items():
+        for flag, subs in _QUANTIZED_SUBSYSTEMS.items():
             if not getattr(cfg, flag):
                 continue
             ops = [op for op in ledger.ops
-                   if (op.subsystem or "") == sub]
+                   if (op.subsystem or "") in subs]
             total = sum(op.size_bytes for op in ops)
             if not total:
                 continue
@@ -125,15 +134,16 @@ class _WireDtype:
             if wide > ceiling:
                 narrow = sum(op.size_bytes for op in ops
                              if op.dtype in INT8_DTYPES)
+                label = "/".join(subs)
                 yield HloFinding(
                     _WireDtype.RULE_ID, ledger.program,
-                    f"{flag} is on but subsystem {sub!r} moves "
+                    f"{flag} is on but subsystem(s) {label} move "
                     f"{wide} of {total} bytes in wide dtypes "
                     f"({narrow} int8) — the quantized wire was "
                     "silently bypassed (config-plumbing regression?); "
                     "legit f32 scale companions stay under "
                     f"{cfg.wire_wide_dtype_max_frac:.0%} of the "
-                    "subsystem",
+                    "subsystem pool",
                     limit=round(ceiling), observed=wide)
 
 
@@ -146,8 +156,12 @@ class _AccidentalReplication:
     @staticmethod
     def check(ledger, cfg: LintConfig) -> Iterable[HloFinding]:
         if cfg.param_bytes and cfg.max_full_gathers:
+            # the deferred post-update publish (zero_param_update) still
+            # moves the tree across the wire — it spends the same gather
+            # budget the in-step gather did, just later in the program
             gathered = sum(op.size_bytes for op in ledger.ops
-                           if (op.subsystem or "") == "zero_param_gather")
+                           if (op.subsystem or "") in
+                           ("zero_param_gather", "zero_param_update"))
             budget = cfg.param_bytes * cfg.max_full_gathers
             if gathered > budget:
                 yield HloFinding(

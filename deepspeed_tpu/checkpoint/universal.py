@@ -182,6 +182,10 @@ def load_universal_into_engine(engine, universal_dir: str,
     new_master = _unflatten_like(engine.state["master"], master_np)
 
     new_state = dict(engine.state)
+    # the derived double buffer is never restored — dropping it here
+    # (and from the shardings) skips a full-model device_put that
+    # _refresh_param_buffer would immediately overwrite anyway
+    new_state.pop("gathered", None)
     new_state["master"] = new_master
     if load_optimizer_states:
         for moment in manifest["optimizer_moments"]:
@@ -198,10 +202,12 @@ def load_universal_into_engine(engine, universal_dir: str,
                 manifest["optimizer_scalars"]["step"])
     new_state["step"] = np.int32(manifest.get("step", 0))
 
-    shardings = engine._state_shardings()
+    shardings = dict(engine._state_shardings())
+    shardings.pop("gathered", None)
     engine.state = jax.tree.map(
         lambda x, sh: jax.device_put(jax.numpy.asarray(x), sh),
         new_state, shardings)
+    engine._refresh_param_buffer()   # buffer follows the loaded master
     engine.global_steps = int(manifest.get("step", 0))
 
     cs_path = os.path.join(universal_dir, "client_state.json")

@@ -465,6 +465,39 @@ def gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
     return gather_tree
 
 
+def publish_gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT,
+                           world: int, out_dtype, quant_weights: bool,
+                           chunk_bounds: Optional[Sequence[Tuple[int, int]]]
+                           = None,
+                           block: int = DEFAULT_BLOCK,
+                           axis_sizes: Optional[dict] = None):
+    """The DEFERRED post-update parameter publish (2004.13336): the same
+    (chunk-fenced when ``chunk_bounds``) qwZ/hpZ gather the forward used
+    to issue at step start, re-issued at step END on the freshly-updated
+    master shards and traced under the ``zero_param_update`` name scope
+    — the observatory ledger prices its collectives as the update
+    phase, not the forward's. The wire is UNCHANGED: quantizer blocking,
+    hpZ subgroup routing and chunk fencing all come from the one
+    :func:`chunked_gather_tree_fn` / :func:`gather_tree_fn` builder, so
+    the double-buffered params the next forward consumes are bit-equal
+    to what an in-step gather of the same master would have produced.
+    """
+    bounds = [tuple(b) for b in (chunk_bounds or [])]
+    if len(bounds) > 1:
+        inner = chunked_gather_tree_fn(spec_tree, manual_axes, world,
+                                       out_dtype, quant_weights, bounds,
+                                       block, axis_sizes)
+    else:
+        inner = gather_tree_fn(spec_tree, manual_axes, world, out_dtype,
+                               quant_weights, False, block, axis_sizes)
+
+    def publish(master_local):
+        with jax.named_scope("zero_param_update"):
+            return inner(master_local)
+
+    return publish
+
+
 def chunked_gather_tree_fn(spec_tree: PyTree, manual_axes: AxesT, world: int,
                            out_dtype, quant_weights: bool,
                            chunk_bounds: Sequence[Tuple[int, int]],

@@ -28,6 +28,13 @@ device:
   buckets, so XLA cannot re-combine them into one step-end collective
   and its async-collective pass (``runtime/domino.py`` flags) can hoist
   each bucket's start under the remaining backward;
+* :func:`fenced_update_chain` — the step-phase half (Automatic
+  Cross-Replica Sharding of Weight Update, arXiv:2004.13336): an
+  already-computed tree-wide optimizer update restructured into
+  per-bucket fenced groups in backward-completion order; the deferred
+  parameter publish (the all-gather feeding the NEXT step's forward)
+  rides a separate :func:`fenced_bucket_apply` chain over the same
+  bucket plan, one data-dependence edge behind each update bucket;
 * :func:`make_grad_sync` — a ``custom_vjp`` identity that applies the
   gradient sharding constraint to the COTANGENT at the point it
   materializes. Wrapped around each layer-chunk's parameters inside the
@@ -221,6 +228,54 @@ def fenced_bucket_apply(leaves: Sequence[Any],
                     fenced_flat[pos * n_outputs:(pos + 1) * n_outputs])
         token = fenced_flat[0]
     return out
+
+
+def fenced_update_chain(master_leaves: Sequence[Any],
+                        aux_leaf_lists: Sequence[Sequence[Any]],
+                        buckets: Sequence[Sequence[int]]):
+    """The step-phase fence chain (weight-update sharding, 2004.13336):
+    split an already-computed tree-wide optimizer update into per-bucket
+    fenced groups in ``buckets`` order.
+
+    ``master_leaves`` — the updated master leaves (flatten order);
+    ``aux_leaf_lists`` — parallel leaf lists riding the same fences
+    (optimizer moment trees that mirror the master tree: a bucket's
+    moments must materialize WITH its params, or XLA could sink their
+    math past the bucket boundary).
+
+    Per bucket k: ``barrier(update outputs + token)`` — bucket k's
+    apply is free to launch the moment its gradients land, under bucket
+    k+1's update math. The deferred parameter publish is fenced
+    SEPARATELY (:func:`fenced_bucket_apply` over the same bucket plan —
+    engine ``_publish_fenced``): it must run outside the engine's
+    skip-update ``lax.cond``, and data dependence on these fenced
+    leaves already chains publish bucket k behind update bucket k.
+    Values are bit-identical to the unfenced program (barriers are
+    identities); returns ``(master_out, aux_out_lists, token)`` in
+    original leaf order.
+    """
+    import jax
+
+    out_m: List[Any] = list(master_leaves)
+    out_aux: List[List[Any]] = [list(leaves) for leaves in aux_leaf_lists]
+    token = None
+    for bucket in buckets:
+        group: List[Any] = []
+        for i in bucket:
+            group.append(out_m[i])
+            for aux in out_aux:
+                group.append(aux[i])
+        fenced = jax.lax.optimization_barrier(
+            tuple(group) + ((token,) if token is not None else ()))
+        k = 0
+        for i in bucket:
+            out_m[i] = fenced[k]
+            k += 1
+            for aux in out_aux:
+                aux[i] = fenced[k]
+                k += 1
+        token = fenced[0]
+    return out_m, out_aux, token
 
 
 def make_grad_sync(constrain_fn: Callable[[PyTree], PyTree]

@@ -77,6 +77,18 @@ def _text_summary(report: Dict[str, Any]) -> str:
             f"comm~{row['predicted_comm_s'] * 1e3:7.2f} ms  "
             f"overlap {row['overlap_fraction']:.2f}  -> {row['verdict']}"
             f"{dom}")
+    upd = (led.get("by_subsystem") or {}).get("zero_param_update")
+    if upd:
+        # the step phase got the PR 8/10 treatment too: the bucketed
+        # update's deferred publish collectives, fence-chained behind
+        # the weight update (zero_param_update attribution)
+        step_row = (report.get("phases") or {}).get("step") or {}
+        frac = step_row.get("overlap_fraction")
+        lines.append(
+            f"  step-phase overlap: {upd['count']} fenced update-phase "
+            f"collective(s), {upd['bytes'] / 1e6:.3f} MB deferred "
+            "publish (zero_param_update)"
+            + (f", overlap {frac:.2f}" if frac is not None else ""))
     if "verdict" in report:
         lines.append(f"  overlap_fraction={report['overlap_fraction']} "
                      f"verdict={report['verdict']}")

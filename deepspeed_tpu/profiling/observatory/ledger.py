@@ -12,7 +12,14 @@ The subsystem rules are substring heuristics over that path plus the
 engine's ZeRO stage — documented, testable, and honest about being
 heuristics (anything unmatched lands in ``"other"``, never dropped):
 
-* quantized wire (checked FIRST — most specific): the ZeRO++ wire
+* ``zero_param_update`` (checked FIRST — outermost scope): collectives
+  traced under the ``zero_param_update`` name scope — the step-phase
+  overlap's bucketed weight update and its DEFERRED post-update param
+  publish (engine ``_apply_update`` /
+  ``compressed.publish_gather_tree_fn``); the deferred qwZ gather nests
+  its ``qwz_wire`` mark inside this scope and bills to the update
+  phase, not the forward;
+* quantized wire (next — most specific of the rest): the ZeRO++ wire
   kernels trace under ``qgz_wire`` / ``qwz_wire`` name scopes
   (``parallel/compressed.py``; the wire step's exact-branch parameter
   gather marks ``zpp_gather``), so the int8 blocks AND their fp32
@@ -48,8 +55,8 @@ from deepspeed_tpu.profiling.observatory.hlo import (
     parse_hlo_collectives,
 )
 
-SUBSYSTEMS = ("zero_grad_sync", "zero_param_gather", "moe_dispatch",
-              "pipeline_handoff", "other")
+SUBSYSTEMS = ("zero_grad_sync", "zero_param_gather", "zero_param_update",
+              "moe_dispatch", "pipeline_handoff", "other")
 
 _MOE_MARKS = ("moe", "expert", "router", "dispatch", "combine")
 _PIPE_MARKS = ("ppermute", "pipeline", "pipe_stage")
@@ -61,6 +68,13 @@ _WIRE_GRAD_MARK = "qgz_wire"
 #: qwz_wire = quantized parameter gather; zpp_gather = the wire step's
 #: exact-branch parameter gather (same collective, uncompressed wire)
 _WIRE_PARAM_MARKS = ("qwz_wire", "zpp_gather")
+#: the step-phase overlap scope (engine ``_apply_update`` /
+#: ``compressed.publish_gather_tree_fn``): the bucketed weight update's
+#: fenced applies and the DEFERRED post-update param publish. Checked
+#: before the wire marks — the deferred qwZ gather nests qwz_wire
+#: INSIDE this scope, and it must price as the update phase, not the
+#: forward's.
+_UPDATE_MARK = "zero_param_update"
 _INT8_DTYPES = ("s8", "u8")
 
 
@@ -69,9 +83,15 @@ def attribute_subsystem(op: CollectiveOp, zero_stage: int = 0) -> str:
     rule table). Pure function of the op + ZeRO stage so fixtures test it
     without an engine."""
     path = f"{op.op_name or ''} {op.source_file or ''}".lower()
-    # quantized wire first — most specific. The qgZ mark outranks qwZ
-    # (the hpZ replica hop reuses the quantized gather for GRADIENTS,
-    # under an outer qgz_wire scope).
+    # update phase first — outermost scope: the deferred publish nests
+    # the qwZ/zpp gather kernels inside zero_param_update, and those
+    # collectives bill to the step phase (the fence-chained post-update
+    # publish), not the forward
+    if _UPDATE_MARK in path:
+        return "zero_param_update"
+    # quantized wire next — most specific of the rest. The qgZ mark
+    # outranks qwZ (the hpZ replica hop reuses the quantized gather for
+    # GRADIENTS, under an outer qgz_wire scope).
     if _WIRE_GRAD_MARK in path:
         return "zero_grad_sync"
     if any(m in path for m in _WIRE_PARAM_MARKS):

@@ -35,6 +35,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.comm import bandwidth as BW
+from deepspeed_tpu.utils.chip_specs import chip_hbm_gbps
 from deepspeed_tpu.profiling.observatory.ledger import (
     CollectiveLedger,
     ledger_for_engine,
@@ -53,8 +54,39 @@ SUBSYSTEM_PHASE = {
     "moe_dispatch": "fwd",
     "pipeline_handoff": "fwd",
     "zero_grad_sync": "bwd",
+    "zero_param_update": "step",   # the deferred post-update publish
     "other": "step",
 }
+
+#: bytes one optimizer update streams per parameter element — the
+#: update is MEMORY-bound (elementwise; pricing it at the matmul peak
+#: would understate it by orders of magnitude on any real chip): Adam
+#: reads+writes fp32 master and two fp32 moments and reads the fp32
+#: grad ≈ 7 × 4B streams. The step phase's compute leg, priced only
+#: when the engine's bucketed update is active (the serial step bills
+#: its update to wall, not to an overlap estimate). The documented
+#: Adam default; ``_update_bytes_per_elem`` derives the real figure
+#: from the engine's optimizer moment count.
+UPDATE_BYTES_PER_ELEM = 28.0
+
+
+def _update_bytes_per_elem(engine) -> float:
+    """Streamed fp32 bytes per master element for ONE update: the grad
+    read + master read/write + a read/write per optimizer moment tree
+    ((3 + 2·moments) × 4B — Adam's two moments give the documented
+    ``UPDATE_BYTES_PER_ELEM``; SGD's single moment ~20B)."""
+    names = getattr(getattr(engine, "optimizer", None),
+                    "moment_names", None)
+    if names is None:
+        return UPDATE_BYTES_PER_ELEM
+    return (3 + 2 * len(names)) * 4.0
+
+#: host memory bandwidth used when the backend has no datasheet HBM
+#: rate (the CPU tier) — the compute-side twin of
+#: ``comm.bandwidth.DEFAULT_LINK_GBPS``: a documented nominal rate so
+#: the estimator path still produces a step-phase estimate instead of a
+#: structural zero (one host core streams ~10 GB/s)
+DEFAULT_UPDATE_GBPS = 10.0
 
 #: fwd/bwd compute split when only whole-step FLOPs are known (the
 #: standard 1:2 fwd:bwd ratio; optimizer flops are noise at LM scale)
@@ -97,9 +129,18 @@ def _verdict(wall_s: float, compute_s: float, overlap: OverlapResult) -> str:
 def phase_verdicts(ledger: CollectiveLedger,
                    phase_walls: Dict[str, float],
                    total_compute_s: Optional[float],
-                   link_gbps: float) -> Dict[str, Dict[str, Any]]:
+                   link_gbps: float,
+                   compute_overrides: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, Dict[str, Any]]:
     """Per-phase roofline rows: wall, predicted comm, compute estimate,
-    overlap estimate, bound verdict, dominant collective."""
+    overlap estimate, bound verdict, dominant collective.
+
+    ``compute_overrides``: absolute per-phase compute-seconds estimates
+    that take precedence over the ``_COMPUTE_SHARE`` split — the step
+    phase's streamed update bytes (``UPDATE_BYTES_PER_ELEM`` at the
+    chip's HBM rate) ride in here when the bucketed update is active,
+    so the estimator can price update compute hiding update comm
+    instead of assuming the step phase is pure serial wall."""
     comm = _phase_comm_seconds(ledger, link_gbps)
     dominant = _phase_dominant_kind(ledger)
     out: Dict[str, Dict[str, Any]] = {}
@@ -109,6 +150,8 @@ def phase_verdicts(ledger: CollectiveLedger,
             continue
         compute_est = (total_compute_s * _COMPUTE_SHARE[phase]
                        if total_compute_s else None)
+        if compute_overrides and phase in compute_overrides:
+            compute_est = float(compute_overrides[phase])
         ov = estimate_overlap(wall, comm[phase], compute_est)
         row: Dict[str, Any] = {
             "wall_s": round(wall, 6),
@@ -223,7 +266,34 @@ def step_report(engine,
     total_compute_s = (ledger.cost_flops / peak
                        if cost_available and peak else None)
 
-    phases = phase_verdicts(ledger, walls, total_compute_s, link)
+    # step-phase compute leg: with the bucketed update active, the
+    # elementwise update's streamed state bytes are the compute the
+    # fence chain hides its publish collectives under — memory-bound,
+    # so priced at the chip's HBM rate (documented host rate on the
+    # CPU tier), never the matmul peak; the estimator can then
+    # attribute a nonzero step-phase overlap (the serial step keeps
+    # the pure-wall assumption)
+    compute_overrides = None
+    try:
+        plan = engine.overlap_plan()
+    except (AttributeError, TypeError):
+        plan = {}
+    if plan.get("step_overlap"):
+        import numpy as _np
+
+        elems = sum(
+            int(_np.prod(getattr(s, "shape", ())))
+            for s in jax.tree.leaves(engine._shapes))
+        # per-CHIP: the ZeRO-sharded update only streams this rank's
+        # 1/dp_world slice of the master + moments
+        shard = max(int(getattr(engine, "dp_world_size", 1) or 1), 1)
+        hbm = chip_hbm_gbps(device_kind, default=DEFAULT_UPDATE_GBPS)
+        compute_overrides = {
+            "step": (elems / shard * _update_bytes_per_elem(engine)
+                     / (hbm * 1e9))}
+
+    phases = phase_verdicts(ledger, walls, total_compute_s, link,
+                            compute_overrides=compute_overrides)
 
     # whole-step overlap: the profiler-measured number when a step runner
     # was provided and the capture yielded device lanes; else the comm-

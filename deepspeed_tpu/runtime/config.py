@@ -106,6 +106,19 @@ class ZeroConfig:
     (numel), not bytes, exactly as in ``stage_1_and_2.py`` IPG buckets
     and ``partitioned_param_coordinator`` prefetch — so a ported
     reference config buckets at the same granularity here.
+
+    Step-phase overlap (the optimizer update — Automatic Cross-Replica
+    Sharding of Weight Update, arXiv:2004.13336): ``overlap_step``
+    splits the sharded weight update into ``update_bucket_size``-bounded
+    fenced buckets in backward-completion order and defers the
+    post-update parameter publish (cast/all-gather) behind the same
+    fence chain, double-buffering the gathered compute params through
+    train-step state into the NEXT step's forward. Rides the overlap
+    scheduler (inactive when ``overlap_comm`` is off or stage < 1).
+    ``update_bucket_size`` follows the PR-8 bucket-key contract
+    (ELEMENT counts, float/"auto" coercion); ``"auto"`` = follow
+    ``reduce_bucket_size`` so update buckets chain one-for-one onto the
+    grad-sync buckets.
     """
     stage: int = 0
     contiguous_gradients: bool = True
@@ -114,6 +127,13 @@ class ZeroConfig:
     allgather_partitions: bool = True
     allgather_bucket_size: int = 500_000_000
     overlap_comm: bool = True
+    # step-phase overlap (2004.13336): bucketed weight update under the
+    # fence chain + deferred param publish double-buffered into the next
+    # forward. Gated by overlap_comm like the rest of the scheduler.
+    overlap_step: bool = True
+    # "auto" = follow reduce_bucket_size (update buckets chain onto the
+    # grad-sync buckets one-for-one); element counts otherwise
+    update_bucket_size: Any = "auto"
     offload_optimizer: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
     offload_param: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
     sub_group_size: int = 1_000_000_000
@@ -172,6 +192,24 @@ class ZeroConfig:
                 raise DeepSpeedConfigError(
                     f"zero_optimization.{key} must be a positive int "
                     f"(elements), got {val!r}")
+        # update_bucket_size follows the same normalization contract but
+        # keeps "auto" as its resolved spelling: auto = follow
+        # reduce_bucket_size (the engine resolves it, which knows the
+        # final reduce bucket after ITS coercion)
+        ub = self.update_bucket_size
+        if ub != "auto":
+            if isinstance(ub, float) and not isinstance(ub, bool) \
+                    and float(ub).is_integer():
+                ub = int(ub)
+                self.update_bucket_size = ub
+            if not isinstance(ub, int) or isinstance(ub, bool) or ub <= 0:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.update_bucket_size must be a "
+                    f"positive int (elements) or \"auto\", got {ub!r}")
+        if not isinstance(self.overlap_step, bool):
+            raise DeepSpeedConfigError(
+                "zero_optimization.overlap_step must be a bool, got "
+                f"{self.overlap_step!r}")
         # the subgroup keys follow the same normalization contract but
         # both have an OFF spelling the reference schema allows (hpZ:
         # ge=0 — 0 and 1 both mean no secondary partition; MiCS: 0) —

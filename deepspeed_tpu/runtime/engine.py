@@ -344,6 +344,9 @@ class DeepSpeedTPUEngine:
 
         self.state = self._init_state()
         self._compiled: Dict[Any, Any] = {}
+        # step-phase overlap: seed the double-buffered param publish so
+        # the FIRST step's forward has a buffer to consume
+        self._refresh_param_buffer()
         if self._offload_opt:
             self._opt_swap("out")
         self._host_runner = None
@@ -569,9 +572,40 @@ class DeepSpeedTPUEngine:
         )
 
         self._overlap = OverlapConfig.from_zero_config(zcfg, self.zero_stage)
+        # step-phase overlap (ROADMAP item 2; Automatic Cross-Replica
+        # Sharding of Weight Update, 2004.13336): bucketed weight update
+        # under the fence chain + the post-update param publish deferred
+        # into a double buffer the NEXT step's forward consumes. Rides
+        # the scheduler gate; the param buffer additionally needs a
+        # fused device step that owns both the forward and the update
+        # (no pipeline loss_and_grads_fn, no host-resident master, no
+        # host-executed update; the 1-bit transport is stage 0 and never
+        # reaches here with the scheduler on).
+        ub = zcfg.update_bucket_size
+        self._update_bucket_elems = (self._overlap.reduce_bucket_elems
+                                     if ub == "auto" else int(ub))
+        # dp world 1 has NO update-phase collectives to hide (GSPMD
+        # elides them — the same reason hlolint's fence-defeat floor
+        # only arms at dp > 1): the fences would only perturb fusion on
+        # a program with nothing to overlap, so the serial step is kept
+        # bit-identical there (incl. the single-chip CPU bench tier)
+        self._step_overlap = bool(zcfg.overlap_step) \
+            and self._overlap.enabled and self._dp_manual_world > 1
+        # a pipe mesh activates the spec's explicit-backward
+        # loss_and_grads_fn path, which bypasses the buffered forward
+        pipelined = self.mesh_manager.axis_size("pipe") > 1
+        self._param_buffer = (self._step_overlap and not pipelined
+                              and not self._offload_param
+                              and not self._host_step
+                              and not self._onebit_wire)
+        self._publish_fn = None     # lazy _publish_tree_fn cache
+        self._consume_fn = None     # lazy _consume_param_buffer cache
         self._overlap_plan: Dict[str, Any] = {
             "enabled": self._overlap.enabled, "scan_chunks": 1,
             "chunk_bounds": [], "grad_sync_points": False,
+            "step_overlap": self._step_overlap,
+            "param_buffer": self._param_buffer,
+            "update_bucket_elems": self._update_bucket_elems,
             "wire_format": self._wire_format()}
         if not self._overlap.enabled:
             return
@@ -668,12 +702,235 @@ class DeepSpeedTPUEngine:
 
     def overlap_plan(self) -> Dict[str, Any]:
         """The resolved overlap-scheduler plan (chunk bounds, bucket
-        sizes, sync-point installation) — step-report / test hook."""
+        sizes, sync-point installation, step-phase overlap + param
+        double buffer) — step-report / test hook."""
         plan = dict(self._overlap_plan)
         plan.update(reduce_bucket_elems=self._overlap.reduce_bucket_elems,
                     allgather_bucket_elems=self._overlap.allgather_bucket_elems,
                     prefetch_bucket_elems=self._overlap.prefetch_bucket_elems)
         return plan
+
+    # ------------------------------------------------------------------ #
+    # step-phase overlap: bucketed update + double-buffered params
+    # (ROADMAP item 2; 2004.13336 — README "Overlap scheduler")
+    # ------------------------------------------------------------------ #
+    def _buffer_shardings(self) -> Any:
+        """Shardings of the double-buffered gathered-params state leaf:
+        the wire step's buffer is the per-rank FULL param tree
+        (replicated — the persistent form of the stage-2-like transient
+        the reduce-outside-vjp formulation already materialized); the
+        exact step's buffer is the compute-param layout
+        (``param_spec`` — stages 1-2 replicated, stage 3 sharded with
+        per-use gathers staying in the forward)."""
+        if self._compressed is not None:
+            rep = NamedSharding(self.mesh, P())
+            return jax.tree.map(lambda _: rep, self.master_spec,
+                                is_leaf=lambda x: isinstance(x, P))
+        return self.policy.to_shardings(self.param_spec)
+
+    def _publish_tree_fn(self):
+        """The tree-level deferred publish: new master → the gathered
+        compute-param buffer the NEXT forward consumes. Wire steps run
+        the SAME (chunk-fenced) qwZ/hpZ gather the forward used to
+        issue at step start (``compressed.publish_gather_tree_fn`` —
+        the wire rides the deferral unchanged); exact steps run the
+        ``_compute_params`` cast/constrain, which at stages 1-2 IS the
+        post-update all-gather. Traced under the ``zero_param_update``
+        name scope so the observatory prices it as the update phase.
+        Also the ``_refresh_param_buffer`` recompute — publish values
+        are deterministic in the master, so a recomputed buffer is
+        bit-equal to the in-step one."""
+        if self._publish_fn is not None:
+            return self._publish_fn
+        if self._compressed is not None:
+            from jax import shard_map
+
+            from deepspeed_tpu.parallel import compressed as C
+
+            axes = self._dp_manual_axes
+            world = self._dp_manual_world
+            dtype = jnp.dtype(self.precision)
+            bounds = (self._overlap_plan.get("chunk_bounds") or [])
+            gather = C.publish_gather_tree_fn(
+                self.master_spec, axes, world, dtype,
+                quant_weights=self._compressed["quant_weights"],
+                chunk_bounds=bounds, axis_sizes=dict(self.mesh.shape))
+            master_manual = jax.tree.map(
+                lambda s: C.manual_spec(s, axes), self.master_spec,
+                is_leaf=lambda x: isinstance(x, P))
+            rep_specs = jax.tree.map(
+                lambda _: P(), self.master_spec,
+                is_leaf=lambda x: isinstance(x, P))
+            mesh = self.mesh
+
+            def publish(master):
+                fn = shard_map(gather, mesh=mesh,
+                               in_specs=(master_manual,),
+                               out_specs=rep_specs,
+                               axis_names=set(axes), check_vma=False)
+                return fn(master)
+        else:
+            def publish(master):
+                with jax.named_scope("zero_param_update"):
+                    return self._compute_params(master)
+        self._publish_fn = publish
+        return publish
+
+    def _publish_leaf_fns(self):
+        """Per-leaf exact-path publish (master flatten order) — the
+        ``_compute_params`` cast/constrain leaf-by-leaf
+        (``_compute_param_leaf`` — the shared implementation), so each
+        update bucket's publish can chain ONE fence behind its update
+        in ``fenced_update_chain`` instead of waiting for the whole
+        tree."""
+        param_sh = jax.tree.leaves(
+            self.policy.to_shardings(self.param_spec),
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        return [lambda m, sh=sh: self._compute_param_leaf(m, sh)
+                for sh in param_sh]
+
+    def _fence_update_buckets(self, new_master: PyTree, new_opt: Dict
+                              ) -> Tuple[PyTree, Dict]:
+        """Restructure the tree-wide optimizer update into per-bucket
+        fenced groups (``update_bucket_size`` elements, reversed-flatten
+        backward-completion order — the SAME plan the grad-sync buckets
+        use, so update bucket k consumes grad bucket k). Optimizer
+        moment trees that mirror the master tree ride the same fences;
+        auxiliary state of other structures (factored Adafactor
+        moments, per-layer scalars) is left to data dependence. Values
+        are bit-identical to the unfenced update. The deferred publish
+        consumes these FENCED leaves per bucket (``_publish_fenced``),
+        so publish bucket k still launches the moment update bucket k
+        lands — it runs outside this call (and outside the skip cond,
+        see ``_apply_update``)."""
+        from deepspeed_tpu.parallel.overlap import (
+            fenced_update_chain,
+            leaf_count,
+            plan_buckets,
+        )
+
+        m_leaves, m_def = jax.tree.flatten(new_master)
+        if not m_leaves:
+            return new_master, new_opt
+        sizes = [leaf_count(x.shape) for x in m_leaves]
+        buckets = plan_buckets(sizes, self._update_bucket_elems)
+        aux_names, aux_lists = [], []
+        if isinstance(new_opt, dict):
+            for name in getattr(self.optimizer, "moment_names", ()):
+                sub = new_opt.get(name)
+                if sub is None:
+                    continue
+                leaves, sdef = jax.tree.flatten(sub)
+                if sdef == m_def:
+                    aux_names.append(name)
+                    aux_lists.append(leaves)
+        out_m, out_aux, _ = fenced_update_chain(
+            m_leaves, aux_lists, buckets)
+        new_master = m_def.unflatten(out_m)
+        if aux_names:
+            new_opt = dict(new_opt)
+            for name, leaves in zip(aux_names, out_aux):
+                new_opt[name] = m_def.unflatten(leaves)
+        return new_master, new_opt
+
+    def _publish_fenced(self, master: PyTree) -> PyTree:
+        """The deferred publish on the (fenced) post-update master:
+        exact path — per-leaf cast/constrain grouped into the SAME
+        bucket plan as the update fences and chained behind
+        ``optimization_barrier`` tokens (``fenced_bucket_apply``), so
+        each bucket's publish all-gather launches as its update lands;
+        wire path — the tree-level chunk-fenced qwZ/hpZ gather
+        (``_publish_tree_fn``)."""
+        if self._compressed is not None or not self._step_overlap:
+            return self._publish_tree_fn()(master)
+        from deepspeed_tpu.parallel.overlap import (
+            fenced_bucket_apply,
+            leaf_count,
+            plan_buckets,
+        )
+
+        leaves, tdef = jax.tree.flatten(master)
+        pubs = self._publish_leaf_fns()
+        if not leaves or len(pubs) != len(leaves):   # defensive drift
+            return self._publish_tree_fn()(master)
+        buckets = plan_buckets([leaf_count(x.shape) for x in leaves],
+                               self._update_bucket_elems)
+        return tdef.unflatten(fenced_bucket_apply(leaves, buckets, pubs))
+
+    def _consume_param_buffer(self):
+        """Straight-through consumption of the double-buffered params:
+        the forward VALUE is the buffer (published by the PREVIOUS
+        step's update phase — bit-equal to ``_compute_params(master)``
+        by construction, both are deterministic in the master), while
+        gradients flow exactly as if the forward had computed
+        ``_compute_params(master)`` in-step — so the buffered step's
+        backward (and its mid-backward sync points) is identical to the
+        serial step's."""
+        if self._consume_fn is not None:
+            return self._consume_fn
+
+        @jax.custom_vjp
+        def use_buf(master, buf):
+            return buf
+
+        def fwd(master, buf):
+            return buf, master
+
+        def bwd(master, g):
+            _, vjp = jax.vjp(self._compute_params, master)
+            (gm,) = vjp(g)
+            return gm, jax.tree.map(jnp.zeros_like, g)
+
+        use_buf.defvjp(fwd, bwd)
+        self._consume_fn = use_buf
+        return use_buf
+
+    def _refresh_param_buffer(self) -> None:
+        """(Re)compute ``state['gathered']`` from the CURRENT master —
+        at initialize and after any restore that replaces the master
+        out-of-band (checkpoint load, universal load). The buffer is
+        deliberately NEVER checkpointed: a recompute from the committed
+        master is always consistent, so no checkpoint can capture a
+        buffer one step stale relative to the weights it rode with."""
+        if not self._param_buffer:
+            return
+        if self._compressed is None:
+            # exact path: eager per-leaf cast + reshard — bit-equal to
+            # the in-step publish (same cast, same layout) without a
+            # per-engine XLA compile of a fused publish program at init.
+            # A no-op cast (fp32 model, bf16 no-master) would ALIAS the
+            # master leaf — the train step donates state, and a buffer
+            # appearing under two donated leaves aborts Execute() —
+            # so the same-dtype branch forces a real copy.
+            dtype = jnp.dtype(self.precision)
+            param_sh = self.policy.to_shardings(self.param_spec)
+
+            def one(p, sh):
+                x = p.astype(dtype) if p.dtype != dtype \
+                    else jnp.array(p, copy=True)
+                return jax.device_put(x, sh)
+
+            with self.mesh:
+                self.state["gathered"] = jax.tree.map(
+                    one, self.state["master"], param_sh)
+            return
+        # wire path: the publish is a shard_map'd (possibly chunk-fenced
+        # quantized) gather — jit it once per engine
+        if "publish" not in self._compiled:
+            self._compiled["publish"] = jax.jit(
+                self._publish_tree_fn(),
+                out_shardings=self._buffer_shardings())
+        with self.mesh:
+            self.state["gathered"] = self._compiled["publish"](
+                self.state["master"])
+
+    def _checkpoint_state(self) -> Dict[str, Any]:
+        """The persisted view of train-step state: everything except the
+        derived ``gathered`` double buffer (see
+        ``_refresh_param_buffer`` — recomputed on every restore)."""
+        if self._param_buffer and "gathered" in self.state:
+            return {k: v for k, v in self.state.items() if k != "gathered"}
+        return self.state
 
     # ------------------------------------------------------------------ #
     # data efficiency (curriculum / random-LTD / PLD / variable batch)
@@ -1171,6 +1428,10 @@ class DeepSpeedTPUEngine:
                 lambda s: NamedSharding(
                     self.mesh, P(row, *([None] * len(s.shape)))),
                 self._shapes)
+        if self._param_buffer:
+            # double-buffered gathered params (step-phase overlap):
+            # published at step END, consumed by the NEXT forward
+            sh["gathered"] = self._buffer_shardings()
         return sh
 
     @staticmethod
@@ -1274,6 +1535,9 @@ class DeepSpeedTPUEngine:
 
     def _init_state(self) -> Dict[str, Any]:
         shardings = self._state_shardings()
+        # the gathered double buffer is DERIVED state — built by
+        # _refresh_param_buffer right after init, never by _make_state
+        shardings.pop("gathered", None)
         init = jax.jit(self._make_state, out_shardings=shardings)
         with self.mesh:
             state = init(self._init_rng)
@@ -1285,6 +1549,17 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ #
     # jitted step builders
     # ------------------------------------------------------------------ #
+    def _compute_param_leaf(self, p, sh):
+        """THE per-leaf master → compute-param math (cast + constrain).
+        ``_compute_params`` and the per-bucket fenced publish
+        (``_publish_leaf_fns``) must stay ONE implementation: the
+        double-buffered forward consumes the publish VALUE while
+        gradients flow through ``_compute_params``, so any drift
+        between them silently breaks the buffer's bit-equality
+        contract."""
+        return jax.lax.with_sharding_constraint(
+            p.astype(jnp.dtype(self.precision)), sh)
+
     def _compute_params(self, master: PyTree) -> PyTree:
         """Cast fp32 master → compute dtype, constrained to the param sharding
         (stage 3: sharded → XLA gathers per use; else replicated over data).
@@ -1292,13 +1567,8 @@ class DeepSpeedTPUEngine:
         offload_param: by the time this runs, the engine has already
         streamed the host master onto device in the sharded layout
         (``_loss_and_grads``), so the normal cast/constrain applies."""
-        dtype = jnp.dtype(self.precision)
         param_sh = self.policy.to_shardings(self.param_spec)
-
-        def one(p, sh):
-            return jax.lax.with_sharding_constraint(p.astype(dtype), sh)
-
-        return jax.tree.map(one, master, param_sh)
+        return jax.tree.map(self._compute_param_leaf, master, param_sh)
 
     def _constrain_grads(self, grads: PyTree) -> PyTree:
         grad_sh = self.policy.to_shardings(self.grad_spec)
@@ -1337,7 +1607,9 @@ class DeepSpeedTPUEngine:
         return jax.tree.unflatten(
             treedef, fenced_bucket_apply(leaves, buckets, fns))
 
-    def _loss_and_grads(self, master: PyTree, batch: PyTree, scale) -> Tuple[jax.Array, PyTree]:
+    def _loss_and_grads(self, master: PyTree, batch: PyTree, scale,
+                        params_buf: Optional[PyTree] = None
+                        ) -> Tuple[jax.Array, PyTree]:
         if self._offload_param:
             # H2D stream OUTSIDE the autodiff: differentiating w.r.t. the
             # host-resident master would put every cotangent in host space
@@ -1363,7 +1635,14 @@ class DeepSpeedTPUEngine:
                 return loss, self._constrain_grads(grads)
 
         def scaled_loss(m):
-            params = self._compute_params(m)
+            if params_buf is not None:
+                # double-buffered forward (step-phase overlap): consume
+                # the buffer published by the PREVIOUS step's update
+                # phase; gradients still flow through _compute_params
+                # (straight-through — see _consume_param_buffer)
+                params = self._consume_param_buffer()(m, params_buf)
+            else:
+                params = self._compute_params(m)
             loss = self.model_spec.loss_fn(params, batch)
             return loss * scale if scale is not None else loss
 
@@ -1380,7 +1659,18 @@ class DeepSpeedTPUEngine:
     def _apply_update(self, state: Dict[str, Any], grads: PyTree,
                       grad_scale, lr_mult=None
                       ) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
-        """Unscale, clip, (maybe skip on overflow), optimizer update."""
+        """Unscale, clip, (maybe skip on overflow), optimizer update.
+
+        Step-phase overlap (``overlap_step``; 2004.13336): the update's
+        outputs are restructured into per-bucket fenced groups in
+        backward-completion order (``_fence_update_buckets``) so each
+        bucket's apply — and, double-buffered, its param publish —
+        leaves the critical path the moment its gradients land instead
+        of waiting for the whole tree; the publish lands in
+        ``state['gathered']`` for the NEXT step's forward. The skip
+        branch (fp16 overflow / guardian non-finite) skips every
+        bucket's update coherently (ONE ``lax.cond`` around the whole
+        phase) and republishes the UNCHANGED buffer."""
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) / grad_scale, grads)
         lr = self._lr_at(state["step"])
         if lr_mult is not None:
@@ -1404,10 +1694,18 @@ class DeepSpeedTPUEngine:
             return stream_to_shardings(
                 master, self.policy.to_shardings(self.master_spec))
 
+        buffered = self._param_buffer and "gathered" in state
+        step_fenced = self._step_overlap
+
         def do_update(operand):
             master, opt, g = operand
-            return self.optimizer.update(g, opt, _stream_master(master),
-                                         lr=lr)
+            new_master, new_opt = self.optimizer.update(
+                g, opt, _stream_master(master), lr=lr)
+            if step_fenced:
+                with jax.named_scope("zero_param_update"):
+                    new_master, new_opt = self._fence_update_buckets(
+                        new_master, new_opt)
+            return new_master, new_opt
 
         def skip_update(operand):
             master, opt, _ = operand
@@ -1437,8 +1735,21 @@ class DeepSpeedTPUEngine:
             overflow = jnp.asarray(False)
             new_master, new_opt = do_update((state["master"], state["opt"], grads))
             new_scaler = None
+        new_gathered = None
+        if buffered:
+            # the deferred publish runs OUTSIDE the skip cond: the
+            # publish is deterministic in the master, so a skipped step
+            # republishes the UNCHANGED buffer bit-equal (master didn't
+            # move) — and the guarded program keeps the unguarded one's
+            # collective shape (a publish inside a cond branch forces
+            # GSPMD resharding around the branch; the guardian's
+            # zero-added-collectives pin forbids that)
+            with jax.named_scope("zero_param_update"):
+                new_gathered = self._publish_fenced(new_master)
 
         new_state = {"step": state["step"] + 1, "master": new_master, "opt": new_opt}
+        if new_gathered is not None:
+            new_state["gathered"] = new_gathered
         if new_scaler is not None:
             new_state["scaler"] = new_scaler
         if "skips" in state:
@@ -1518,8 +1829,10 @@ class DeepSpeedTPUEngine:
                 if isinstance(mb, dict) and "_nan_grads" in mb:
                     mb = dict(mb)
                     flag = mb.pop("_nan_grads")
-                loss, grads = self._loss_and_grads(state["master"], mb,
-                                                   scale)
+                loss, grads = self._loss_and_grads(
+                    state["master"], mb, scale,
+                    params_buf=(state.get("gathered")
+                                if self._param_buffer else None))
                 if flag is not None:
                     bad = jnp.where(flag > 0, jnp.nan, 1.0)
                     grads = jax.tree.map(
@@ -1691,6 +2004,7 @@ class DeepSpeedTPUEngine:
             else None
         bounds = (self._overlap_plan.get("chunk_bounds") or []) \
             if overlap_on else []
+        buffered = self._param_buffer
         if len(bounds) > 1:
             gather_tree = C.chunked_gather_tree_fn(
                 self.master_spec, axes, world, dtype,
@@ -1704,16 +2018,25 @@ class DeepSpeedTPUEngine:
         master_manual = jax.tree.map(
             lambda s: C.manual_spec(s, axes), self.master_spec,
             is_leaf=lambda x: isinstance(x, P))
+        rep_specs = jax.tree.map(lambda s: P(), self.master_spec,
+                                 is_leaf=lambda x: isinstance(x, P))
         row = axes if len(axes) > 1 else axes[0]
 
         acc_dt = self._grad_accum_dtype()
 
-        def core(master_local, err0, batch_local, scale):
+        def core(master_local, err0, batch_local, scale,
+                 params_full=None):
             zeros = jax.tree.map(
                 lambda x: jnp.zeros(x.shape, acc_dt), master_local)
             # loop-invariant: ONE (possibly quantized, possibly chunk-
-            # fenced) param gather per step, not per micro
-            params = gather_tree(master_local)
+            # fenced) param gather per step, not per micro — and with
+            # the double buffer (overlap_step) ZERO: the forward
+            # consumes the params the PREVIOUS step's update phase
+            # published (bit-equal: the publish runs the same wire on
+            # the same master), moving the gather off this step's
+            # critical path entirely
+            params = params_full if params_full is not None \
+                else gather_tree(master_local)
 
             def full_loss(pf, b):
                 return self.model_spec.loss_fn(pf, b) * scale
@@ -1746,16 +2069,19 @@ class DeepSpeedTPUEngine:
             mean_loss = jax.lax.pmean(losses_mean, axes) / scale
             return grads_sum, err, mean_loss
 
-        def local_loco(master_local, err_local, batch_local, scale):
+        def local_loco(master_local, err_local, batch_local, scale,
+                       *buf):
             err0 = jax.tree.map(lambda e: e[0], err_local)   # drop world row
             grads_sum, err, mean_loss = core(master_local, err0,
-                                             batch_local, scale)
+                                             batch_local, scale,
+                                             buf[0] if buf else None)
             err_out = jax.tree.map(lambda e: e[None], err)
             return grads_sum, err_out, mean_loss
 
-        def local_plain(master_local, batch_local, scale):
+        def local_plain(master_local, batch_local, scale, *buf):
             grads_sum, _, mean_loss = core(master_local, None,
-                                           batch_local, scale)
+                                           batch_local, scale,
+                                           buf[0] if buf else None)
             return grads_sum, mean_loss
 
         def train_step(state, batch):
@@ -1763,23 +2089,28 @@ class DeepSpeedTPUEngine:
                 else jnp.float32(1.0)
             b_specs = jax.tree.map(
                 lambda x: self._manual_batch_spec(x.ndim), batch)
+            buf_in = (rep_specs,) if buffered else ()
+            buf_arg = (state["gathered"],) if buffered else ()
             if loco:
                 err_specs = jax.tree.map(
                     lambda s: P(row, *([None] * len(s.shape))), self._shapes)
                 fn = shard_map(
                     local_loco, mesh=self.mesh,
-                    in_specs=(master_manual, err_specs, b_specs, P()),
+                    in_specs=(master_manual, err_specs, b_specs, P())
+                    + buf_in,
                     out_specs=(master_manual, err_specs, P()),
                     axis_names=set(axes), check_vma=False)
                 grads_sum, new_err, mean_loss = fn(
-                    state["master"], state["loco_err"], batch, scale)
+                    state["master"], state["loco_err"], batch, scale,
+                    *buf_arg)
             else:
                 fn = shard_map(
                     local_plain, mesh=self.mesh,
-                    in_specs=(master_manual, b_specs, P()),
+                    in_specs=(master_manual, b_specs, P()) + buf_in,
                     out_specs=(master_manual, P()),
                     axis_names=set(axes), check_vma=False)
-                grads_sum, mean_loss = fn(state["master"], batch, scale)
+                grads_sum, mean_loss = fn(state["master"], batch, scale,
+                                          *buf_arg)
                 new_err = None
             grad_scale = jnp.float32(gas) * scale
             new_state, metrics = self._apply_update(state, grads_sum,
@@ -2380,7 +2711,13 @@ class DeepSpeedTPUEngine:
         if "fwd_bwd" not in self._compiled:
             def fwd_bwd(state, b):
                 scale = state["scaler"].scale if self.fp16_enabled else None
-                return self._loss_and_grads(state["master"], b, scale)
+                # the eager path consumes the double buffer too — its
+                # step() republishes after every update, so the publish
+                # is never wasted work on this path either
+                return self._loss_and_grads(
+                    state["master"], b, scale,
+                    params_buf=(state.get("gathered")
+                                if self._param_buffer else None))
 
             self._compiled["fwd_bwd"] = jax.jit(fwd_bwd)
         batch = self._shard_batch(batch)
@@ -2810,7 +3147,11 @@ class DeepSpeedTPUEngine:
         ck = self.config.checkpoint
         self._saving = True   # a preemption signal mid-save defers here
         try:
-            save_state(save_dir, tag, self.state, client_state,
+            # _checkpoint_state: the gathered double buffer is derived
+            # state, excluded from every checkpoint (incl. SIGTERM
+            # emergency tags) and recomputed on restore — a checkpoint
+            # can never capture a buffer stale relative to its master
+            save_state(save_dir, tag, self._checkpoint_state(), client_state,
                        save_latest=save_latest, async_save=async_save,
                        writer=self.config.effective_checkpoint_writer,
                        keep_n=ck.keep_n, fsync=ck.fsync,
@@ -2883,12 +3224,17 @@ class DeepSpeedTPUEngine:
             # placeholders suffice as the orbax target template — swapping in
             # there would transiently double optimizer-state HBM
             self._opt_swapper.swap_in_optimizer()
+        load_sh = self._state_shardings()
+        load_sh.pop("gathered", None)   # derived buffer: never persisted
         state, client_state = load_state(
-            load_dir, tag, self.state, self._state_shardings(),
+            load_dir, tag, self._checkpoint_state(), load_sh,
             verify_checksums=self.config.checkpoint.verify_checksums)
         if not load_optimizer_states:
             state["opt"] = self.state["opt"]
         self.state = state
+        # republish the double buffer from the RESTORED master — the
+        # next forward must consume exactly the restored weights
+        self._refresh_param_buffer()
         if self._offload_opt:
             self._opt_swap("out")
         if (self._offload_nvme and self._opt_swapper is not None

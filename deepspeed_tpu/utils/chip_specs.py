@@ -12,6 +12,12 @@ from typing import Optional
 PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0,
                     "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
 
+# HBM GB/s per chip, by TPU generation — the referent for MEMORY-bound
+# phases (the elementwise optimizer update streams state; pricing it at
+# the matmul peak would understate it by orders of magnitude)
+HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5 lite": 819.0,
+            "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
+
 
 def chip_peak_tflops(device_kind: str,
                      default: Optional[float] = None) -> Optional[float]:
@@ -21,4 +27,15 @@ def chip_peak_tflops(device_kind: str,
     for key, peak in PEAK_BF16_TFLOPS.items():
         if key in kind:
             return peak
+    return default
+
+
+def chip_hbm_gbps(device_kind: str,
+                  default: Optional[float] = None) -> Optional[float]:
+    """Datasheet HBM GB/s for a PJRT ``device_kind``; ``default`` when
+    unrecognized (CPU hosts: caller picks a documented host rate)."""
+    kind = (device_kind or "").lower()
+    for key, bw in HBM_GBPS.items():
+        if key in kind:
+            return bw
     return default

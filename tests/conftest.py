@@ -93,7 +93,7 @@ def pytest_configure(config):
         "(rule passes + committed contracts over the committed HLO "
         "fixtures, CLI exit-code matrix, shrink-only contract rewrites, "
         "live engine.lint_step + bench refuse-to-record — tier-1-"
-        "eligible under JAX_PLATFORMS=cpu; the six committed "
+        "eligible under JAX_PLATFORMS=cpu; the seven committed "
         "observatory_fixtures/*.hlo.txt are enforced against "
         "analysis/hlolint/contracts/ here)")
     config.addinivalue_line(

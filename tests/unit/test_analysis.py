@@ -151,13 +151,17 @@ class TestConfigKeys:
         )
 
         # zero_hpz_partition_size joined the validated-and-consumed set in
-        # ISSUE 10 (hpZ subgroup resolution + the quantized-wire pipeline)
+        # ISSUE 10 (hpZ subgroup resolution + the quantized-wire
+        # pipeline); overlap_step/update_bucket_size in ISSUE 14 (the
+        # step-phase overlap: bucketed update + double-buffered params)
         bucket_keys = {"reduce_bucket_size", "allgather_bucket_size",
                        "stage3_prefetch_bucket_size",
-                       "zero_hpz_partition_size"}
+                       "zero_hpz_partition_size",
+                       "overlap_step", "update_bucket_size"}
         assert not bucket_keys & set(DEAD_KEYS), (
-            "overlap/hpZ keys re-declared dead — the scheduler/engine "
-            "consume them (parallel/overlap.py, runtime/engine.py)")
+            "overlap/hpZ/step-overlap keys re-declared dead — the "
+            "scheduler/engine consume them (parallel/overlap.py, "
+            "runtime/engine.py _setup_overlap_scheduler)")
         proj, _ = dsl_core.load_project([PKG])
         consumed = consumed_attr_keys(proj, bucket_keys)
         assert consumed == bucket_keys, (

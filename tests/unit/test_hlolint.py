@@ -465,7 +465,7 @@ class TestCommittedContracts:
         )
 
         pairs = fixture_pairs(FIXTURES)
-        assert len(pairs) == 6
+        assert len(pairs) == 7   # + zero3_qwz_update_defer (ISSUE 14)
         for hlo_path, contract_path in pairs:
             found = lint_fixture(hlo_path, contract_path)
             assert found == [], (os.path.basename(hlo_path),
@@ -519,11 +519,11 @@ class TestCommittedContracts:
 # --------------------------------------------------------------------- #
 class TestCli:
     def test_fixtures_mode_clean_exit_0(self):
-        # the acceptance invocation: all six committed fixtures against
-        # their committed contracts
+        # the acceptance invocation: all seven committed fixtures
+        # against their committed contracts
         proc = run_cli("--fixtures")
         assert proc.returncode == 0, proc.stderr
-        assert "clean (6 program(s))" in proc.stdout
+        assert "clean (7 program(s))" in proc.stdout
 
     def test_single_fixture_with_contract_exit_0(self):
         proc = run_cli(fixture_path(QGZ), "--contract",

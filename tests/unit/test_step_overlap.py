@@ -1,0 +1,724 @@
+"""Step-phase overlap: bucketed sharded weight update under the fence
+chain + double-buffered params (ISSUE 14; Automatic Cross-Replica
+Sharding of Weight Update, arXiv:2004.13336).
+
+1. Pure transform — ``fenced_update_chain`` is a numeric identity that
+   really fences each update bucket (the publish rides a separate
+   ``fenced_bucket_apply`` chain — engine ``_publish_fenced``).
+2. Config — ``overlap_step`` / ``update_bucket_size`` follow the PR-8
+   bucket-key contract (bool / positive-int-or-"auto", float coercion,
+   loud errors), and the engine's resolved plan exposes the step leg.
+3. Numerics — the bucketed+double-buffered step is allclose-identical
+   to the serial step per ZeRO stage 1/2/3 (exact wire), identical on
+   the unchunked qwZ wire, LoCo residual state equal on the qgZ wire,
+   and the published buffer is bit-equal to ``_compute_params(master)``.
+4. Skip coherence — an fp16 overflow step and a guardian non-finite
+   step leave the weights bit-equal AND the deferred publish republishes
+   the UNCHANGED buffer (no bucket updates, coherently).
+5. Restore — checkpoints never persist the ``gathered`` buffer; restore
+   recomputes it from the committed master, and a SIGTERM-interrupted
+   run resumes bit-compared against an uninterrupted twin (chaos leg).
+6. HLO evidence — the committed
+   ``zero3_qwz_update_defer_async_step`` fixture holds its committed
+   contract: update-phase (``zero_param_update``) async pairs >= 1 and
+   a fence-count floor (``count_min``), enforced through hlolint.
+7. Observatory — ``zero_param_update`` attribution (outranks the wire
+   marks), step-phase pricing in the roofline report, and a nonzero
+   step-phase ``overlap_fraction`` on the CPU-tier estimator path.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.parallel.overlap import (
+    fenced_update_chain,
+    plan_buckets,
+)
+from deepspeed_tpu.runtime.config import DeepSpeedConfigError, ZeroConfig
+from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+from deepspeed_tpu.testing import chaos
+
+pytestmark = pytest.mark.overlap
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "observatory_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+UPDATE_FIXTURE = "zero3_qwz_update_defer_async_step.hlo.txt"
+
+#: tiny buckets force REAL structure on the tiny model: >1 grad bucket,
+#: 2 layer chunks, >1 update bucket
+FORCING = {"reduce_bucket_size": 4096, "allgather_bucket_size": 8192,
+           "stage3_prefetch_bucket_size": 8192, "update_bucket_size": 4096}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _engine(stage, overlap, dtype="float32", extra=None, **zero):
+    from deepspeed_tpu.comm.mesh import reset_mesh
+
+    reset_mesh()
+    # small tiny variant (test_wire_overlap's shape): same structure,
+    # ~4x faster compiles — this suite builds many engine pairs
+    spec = dst.causal_lm_spec("tiny", dtype=dtype, hidden_size=64,
+                              num_layers=2, num_heads=4, max_seq_len=64,
+                              vocab_size=512)
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 10 ** 9,
+           "zero_optimization": {"stage": stage, "overlap_comm": overlap,
+                                 **zero}}
+    cfg.update(extra or {})
+    engine, *_ = dst.initialize(model=spec, config=cfg)
+    return engine
+
+
+def _data(seed=11):
+    return synthetic_lm_data(batch_size=8, seq_len=32, vocab_size=512,
+                             seed=seed)
+
+
+def fixture_text(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+# --------------------------------------------------------------------- #
+# pure transform
+# --------------------------------------------------------------------- #
+class TestFencedUpdateChain:
+    def test_values_identity_with_aux(self):
+        leaves = [jnp.full((4,), float(i + 1)) for i in range(5)]
+        aux = [jnp.full((4,), float(i) * 0.5) for i in range(5)]
+        buckets = plan_buckets([4] * 5, 8)
+        assert len(buckets) >= 2
+
+        def run(ls, ax):
+            m, (a,), tok = fenced_update_chain(ls, [ax], buckets)
+            return m, a
+
+        m, a = jax.jit(run)(leaves, aux)
+        for i in range(5):
+            np.testing.assert_array_equal(np.asarray(m[i]),
+                                          np.asarray(leaves[i]))
+            np.testing.assert_array_equal(np.asarray(a[i]),
+                                          np.asarray(aux[i]))
+
+    def test_every_bucket_is_fenced(self):
+        leaves = [jnp.ones((4,)) for _ in range(4)]
+        buckets = [[3, 2], [1, 0]]
+
+        def run(ls):
+            m, _, _ = fenced_update_chain(ls, [], buckets)
+            return m
+
+        text = jax.jit(run).lower(leaves).as_text()
+        assert text.count("optimization_barrier") >= len(buckets)
+
+    def test_returns_token_for_downstream_chaining(self):
+        leaves = [jnp.ones((2,))] * 3
+        m, _, tok = fenced_update_chain(leaves, [], [[2, 1, 0]])
+        assert tok is not None and len(m) == 3
+
+
+# --------------------------------------------------------------------- #
+# config keys (PR-8 bucket-key contract)
+# --------------------------------------------------------------------- #
+class TestConfigKeys:
+    def test_defaults(self):
+        z = ZeroConfig()
+        z.validate()
+        assert z.overlap_step is True
+        assert z.update_bucket_size == "auto"
+
+    def test_update_bucket_float_coerces(self):
+        z = ZeroConfig(update_bucket_size=5e3)
+        z.validate()
+        assert z.update_bucket_size == 5000
+
+    @pytest.mark.parametrize("bad", [0, -1, 2.5, "big", False])
+    def test_update_bucket_rejects(self, bad):
+        z = ZeroConfig(update_bucket_size=bad)
+        with pytest.raises(DeepSpeedConfigError, match="update_bucket_size"):
+            z.validate()
+
+    @pytest.mark.parametrize("bad", ["yes", 1, 0.0])
+    def test_overlap_step_must_be_bool(self, bad):
+        z = ZeroConfig(overlap_step=bad)
+        with pytest.raises(DeepSpeedConfigError, match="overlap_step"):
+            z.validate()
+
+    def test_engine_resolves_auto_to_reduce_bucket(self):
+        e = _engine(2, True, **FORCING)
+        assert e.overlap_plan()["update_bucket_elems"] == 4096
+        e2 = _engine(2, True, **dict(FORCING, update_bucket_size="auto",
+                                     reduce_bucket_size=8192))
+        assert e2.overlap_plan()["update_bucket_elems"] == 8192
+
+
+# --------------------------------------------------------------------- #
+# plan gating
+# --------------------------------------------------------------------- #
+class TestPlanGating:
+    def test_active_by_default_with_scheduler(self):
+        e = _engine(2, True, **FORCING)
+        plan = e.overlap_plan()
+        assert plan["step_overlap"] and plan["param_buffer"]
+        assert "gathered" in e.state
+
+    def test_off_when_overlap_comm_off(self):
+        e = _engine(2, False)
+        plan = e.overlap_plan()
+        assert not plan["step_overlap"] and not plan["param_buffer"]
+        assert "gathered" not in e.state
+
+    def test_off_when_overlap_step_off(self):
+        e = _engine(2, True, **dict(FORCING, overlap_step=False))
+        plan = e.overlap_plan()
+        assert not plan["step_overlap"] and not plan["param_buffer"]
+        assert "gathered" not in e.state
+        # (the off-knob program also measures in the BENCH_STEP_OVERLAP
+        # A/B — training it again here would only re-pay the compile)
+
+    def test_off_at_stage_0(self):
+        e = _engine(0, True)
+        assert not e.overlap_plan()["step_overlap"]
+
+
+# --------------------------------------------------------------------- #
+# numerics: bucketed + double-buffered == serial, per stage
+# --------------------------------------------------------------------- #
+class TestParity:
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_exact_step_allclose_serial(self, stage):
+        e_on = _engine(stage, True, **FORCING)
+        assert e_on.overlap_plan()["param_buffer"]
+        e_off = _engine(stage, False)
+        d_on, d_off = _data(), _data()
+        for _ in range(3):
+            loss_on = float(jax.device_get(e_on.train_batch(d_on)))
+            loss_off = float(jax.device_get(e_off.train_batch(d_off)))
+        np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+        # same atol rationale as TestEngineParity (test_overlap.py):
+        # adam amplifies float reassociation on near-zero-grad leaves
+        for a, b in zip(
+                jax.device_get(jax.tree.leaves(e_on.state["master"])),
+                jax.device_get(jax.tree.leaves(e_off.state["master"]))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-5)
+
+    def test_buffer_bit_equals_compute_params(self):
+        # the published buffer IS _compute_params(master) — a stale or
+        # wrong-leaf publish would desync the next forward from the
+        # weights
+        e = _engine(2, True, **FORCING)
+        for _ in range(2):
+            e.train_batch(_data())
+        with e.mesh:
+            want = jax.jit(e._compute_params)(e.state["master"])
+        for a, b in zip(jax.device_get(jax.tree.leaves(e.state["gathered"])),
+                        jax.device_get(jax.tree.leaves(want))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_qwz_unchunked_publish_identical_to_serial(self):
+        # quantized weights with ONE chunk (huge allgather bucket): the
+        # deferred publish runs the same quantizer on the same master as
+        # the in-step gather — losses identical to the overlap-off step
+        base = dict(FORCING, zero_quantized_weights=True,
+                    allgather_bucket_size=10 ** 9)
+        e_on = _engine(2, True, **base)
+        assert e_on.overlap_plan()["param_buffer"]
+        assert e_on._wire_format() == "qz"
+        e_off = _engine(2, False, **{k: v for k, v in base.items()
+                                     if k != "overlap_comm"})
+        d_on, d_off = _data(), _data()
+        for _ in range(3):
+            loss_on = float(jax.device_get(e_on.train_batch(d_on)))
+            loss_off = float(jax.device_get(e_off.train_batch(d_off)))
+        np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_qgz_loco_residuals_equal_across_step_overlap(self):
+        # (tier-1 still pins LoCo-on-the-buffered-step every run:
+        # test_wire_overlap's composed-parity test compares overlap ON —
+        # which now includes the double buffer — against OFF with
+        # residual equality; this test isolates the overlap_step axis)
+        # the double buffer must not perturb the LoCo error-feedback
+        # state: overlap_step on/off differ only in WHERE the (exact
+        # numerics) publish runs
+        base = dict(FORCING, zero_quantized_gradients=True,
+                    loco_error_feedback=True)
+        e_on = _engine(2, True, **base)
+        assert e_on.overlap_plan()["param_buffer"]
+        e_off = _engine(2, True, **dict(base, overlap_step=False))
+        d_on, d_off = _data(), _data()
+        for _ in range(3):
+            loss_on = float(jax.device_get(e_on.train_batch(d_on)))
+            loss_off = float(jax.device_get(e_off.train_batch(d_off)))
+        np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+        for a, b in zip(
+                jax.device_get(jax.tree.leaves(e_on.state["loco_err"])),
+                jax.device_get(jax.tree.leaves(e_off.state["loco_err"]))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_multi_step_window_carries_buffer(self):
+        # the fused lax.scan window threads the buffer through its carry
+        # — the deferred publish of scan iteration k feeds iteration
+        # k+1's forward inside ONE dispatch
+        e_on = _engine(2, True, **FORCING)
+        e_off = _engine(2, False)
+        d_on, d_off = _data(), _data()
+        loss_on = float(jax.device_get(e_on.train_batches(d_on, 3)))
+        loss_off = float(jax.device_get(e_off.train_batches(d_off, 3)))
+        np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# skip coherence: overflow / non-finite steps skip EVERY bucket and
+# republish the unchanged buffer
+# --------------------------------------------------------------------- #
+class TestSkipCoherence:
+    def test_fp16_overflow_skips_and_republishes(self):
+        # static loss scale far beyond fp16 range: the scaled backward
+        # overflows, the whole bucketed update must skip coherently
+        e = _engine(2, True, dtype="float16",
+                    extra={"fp16": {"enabled": True,
+                                    "loss_scale": float(2 ** 32)}},
+                    **FORCING)
+        assert e.overlap_plan()["param_buffer"]
+        before_m = jax.device_get(jax.tree.leaves(e.state["master"]))
+        before_g = jax.device_get(jax.tree.leaves(e.state["gathered"]))
+        e.train_batch(_data())
+        assert int(jax.device_get(e.state["skips"])) == 1
+        for a, b in zip(before_m,
+                        jax.device_get(jax.tree.leaves(e.state["master"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(before_g,
+                        jax.device_get(jax.tree.leaves(e.state["gathered"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_guardian_nonfinite_skips_and_republishes(self):
+        e = _engine(2, True, extra={"guardian": {"enabled": True}},
+                    **FORCING)
+        assert e._nonfinite_guard and e.overlap_plan()["param_buffer"]
+        e.train_batch(_data())        # one clean step first
+        before_m = jax.device_get(jax.tree.leaves(e.state["master"]))
+        before_g = jax.device_get(jax.tree.leaves(e.state["gathered"]))
+        chaos.arm("train/nan_grads=fail:1")
+        e.train_batch(_data())
+        assert int(jax.device_get(e.state["skips"])) == 1
+        for a, b in zip(before_m,
+                        jax.device_get(jax.tree.leaves(e.state["master"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(before_g,
+                        jax.device_get(jax.tree.leaves(e.state["gathered"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the run continues finite past the skipped step
+        loss = float(jax.device_get(e.train_batch(_data())))
+        assert np.isfinite(loss)
+
+
+# --------------------------------------------------------------------- #
+# restore: the buffer is never persisted, always recomputed
+# --------------------------------------------------------------------- #
+class TestRestore:
+    def test_checkpoint_excludes_buffer_and_restore_recomputes(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        e = _engine(2, True, **FORCING)
+        d = _data()
+        for _ in range(2):
+            e.train_batch(d)
+        e.save_checkpoint(root)
+        # no leaf of the checkpoint names the gathered buffer
+        names = []
+        for dirpath, _, files in os.walk(root):
+            names.extend(os.path.join(dirpath, f) for f in files)
+        assert names
+        assert not any("gathered" in n for n in names), names
+
+        resumed = _engine(
+            2, True,
+            extra={"fault_tolerance": {"resume_dir": root,
+                                       "auto_resume": True,
+                                       "graceful_preemption": False}},
+            **FORCING)
+        assert resumed.global_steps == e.global_steps
+        # restored buffer == publish of the restored master (bit-equal
+        # to the live engine's buffer: same master, same publish)
+        for a, b in zip(
+                jax.device_get(jax.tree.leaves(e.state["gathered"])),
+                jax.device_get(jax.tree.leaves(resumed.state["gathered"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the curves stay bit-equal across the restore boundary
+        d_live, d_res = _data(seed=5), _data(seed=5)
+        for _ in range(2):
+            loss_live = float(jax.device_get(e.train_batch(d_live)))
+            loss_res = float(jax.device_get(resumed.train_batch(d_res)))
+            assert loss_live == loss_res
+
+
+# --------------------------------------------------------------------- #
+# chaos: SIGTERM mid-step on the double-buffered config → emergency
+# checkpoint → auto_resume bit-compared against an uninterrupted twin
+# --------------------------------------------------------------------- #
+_DB_ZERO = dict(FORCING, stage=2, overlap_comm=True)
+
+_DB_TRAIN_SCRIPT = f"""
+import sys, time
+import numpy as np
+import deepspeed_tpu as dst
+
+root, progress = sys.argv[1], sys.argv[2]
+spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                          num_layers=2, num_heads=2, max_seq_len=16,
+                          vocab_size=64)
+config = {{
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {{"type": "adam", "params": {{"lr": 1e-2}}}},
+    "steps_per_print": 10 ** 9,
+    "zero_optimization": {_DB_ZERO!r},
+    "fault_tolerance": {{"resume_dir": root, "auto_resume": True}},
+}}
+engine, *_ = dst.initialize(model=spec, config=config)
+assert engine.overlap_plan()["param_buffer"], engine.overlap_plan()
+batch = {{"tokens": np.random.RandomState(0).randint(
+    0, 64, size=(8, 16)).astype(np.int32)}}
+it = iter(lambda: batch, None)
+for _ in range(10 ** 6):
+    engine.train_batch(it)
+    with open(progress, "w") as f:
+        f.write(str(engine.global_steps))
+    time.sleep(0.05)
+"""
+
+
+def _db_engine(root):
+    from deepspeed_tpu.comm.mesh import reset_mesh
+
+    reset_mesh()
+    spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                              num_layers=2, num_heads=2, max_seq_len=16,
+                              vocab_size=64)
+    config = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 10 ** 9,
+        "zero_optimization": dict(_DB_ZERO),
+        "fault_tolerance": {"resume_dir": root, "auto_resume": True,
+                            "graceful_preemption": False},
+    }
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return engine
+
+
+@pytest.mark.chaos
+class TestSigtermDoubleBuffer:
+    # slow lane: test_wire_overlap's SIGTERM chaos test already runs the
+    # double-buffered composed config through emergency-checkpoint +
+    # auto_resume in tier-1 (overlap_step defaults on there); this test
+    # adds the bit-exact curve/buffer comparison on the exact wire
+    @pytest.mark.slow
+    def test_sigterm_resume_bit_matches_uninterrupted_twin(self, tmp_path):
+        from deepspeed_tpu.checkpoint import fault_tolerance as ftmod
+
+        root = str(tmp_path / "ckpt")
+        progress = str(tmp_path / "progress")
+        script = str(tmp_path / "train_script.py")
+        with open(script, "w") as f:
+            f.write(_DB_TRAIN_SCRIPT)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_THREEFRY_PARTITIONABLE"] = "true"
+        proc = subprocess.Popen(
+            [sys.executable, script, root, progress], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 240
+        step = 0
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                raise AssertionError(f"trainer died early:\n{out}")
+            try:
+                with open(progress) as f:
+                    step = int(f.read().strip() or 0)
+                if step >= 2:
+                    break
+            except (FileNotFoundError, ValueError):
+                pass
+            time.sleep(0.1)
+        assert step >= 2, "trainer never reached step 2"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+        assert proc.returncode == 0, out
+        tag = ftmod.find_restore_tag(root)
+        assert tag is not None and tag.startswith("emergency_step"), out
+        saved_step = ftmod.read_marker(root, tag)["step"]
+        assert saved_step >= 2
+
+        batch = {"tokens": np.random.RandomState(0).randint(
+            0, 64, size=(8, 16)).astype(np.int32)}
+        ref = _db_engine(str(tmp_path / "no_ckpt"))
+        assert ref.global_steps == 0
+        for _ in range(saved_step):
+            ref.train_batch(iter(lambda: batch, None))
+
+        resumed = _db_engine(root)
+        assert resumed.global_steps == saved_step
+        # the restored buffer is recomputed from the committed master —
+        # it can NOT be one step stale, so the resumed curve is
+        # bit-identical to the uninterrupted twin's (CPU deterministic)
+        for a, b in zip(
+                jax.device_get(jax.tree.leaves(ref.state["gathered"])),
+                jax.device_get(jax.tree.leaves(resumed.state["gathered"]))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for _ in range(3):
+            loss_ref = float(ref.train_batch(iter(lambda: batch, None)))
+            loss_res = float(resumed.train_batch(iter(lambda: batch, None)))
+            assert loss_ref == loss_res, (loss_ref, loss_res)
+
+
+# --------------------------------------------------------------------- #
+# HLO evidence: committed fixture + contract (hlolint is THE path)
+# --------------------------------------------------------------------- #
+class TestUpdateFixtureContract:
+    def test_fixture_enforced_by_committed_contract(self):
+        from deepspeed_tpu.analysis.hlolint import (
+            contracts_dir,
+            lint_fixture,
+            load_contract,
+        )
+
+        contract_path = os.path.join(
+            contracts_dir(), "zero3_qwz_update_defer_async_step.json")
+        found = lint_fixture(os.path.join(FIXTURES, UPDATE_FIXTURE),
+                             contract_path)
+        assert found == [], [f.render() for f in found]
+        body = load_contract(contract_path)["contract"]
+        upd = body["subsystems"]["zero_param_update"]
+        # the acceptance pins: update-phase async pairs >= 1
+        # (asyncified) and a fence-count floor (count_min — the fence
+        # chain's size-bounded gather groups survived into the HLO)
+        assert upd["async_min"] >= 1
+        assert upd["count_min"] >= 1
+        assert upd["bytes_min"] > 0
+        # the deferred publish rides the QUANTIZED wire: int8 blocks
+        # (plus their f32 scale companions) — qwZ unchanged by deferral
+        assert "s8" in upd["allowed_dtypes"]
+        assert body["async_pairs_min"] >= 1
+
+    def test_update_subsystem_floors_are_shrink_only(self, tmp_path):
+        from deepspeed_tpu.analysis.hlolint import (
+            ContractError,
+            contracts_dir,
+            load_contract,
+            write_contract,
+        )
+
+        committed = load_contract(os.path.join(
+            contracts_dir(), "zero3_qwz_update_defer_async_step.json"))
+        path = str(tmp_path / "c.json")
+        write_contract(path, committed)
+        # lowering the update-phase async floor is a refused loosening
+        looser = json.loads(json.dumps(committed))
+        looser["contract"]["subsystems"]["zero_param_update"][
+            "async_min"] -= 1
+        with pytest.raises(ContractError, match="async_min"):
+            write_contract(path, looser)
+        # so is lowering the fence-count floor
+        fewer = json.loads(json.dumps(committed))
+        fewer["contract"]["subsystems"]["zero_param_update"][
+            "count_min"] -= 1
+        with pytest.raises(ContractError, match="count_min"):
+            write_contract(path, fewer)
+        # and raising the count ceiling
+        wider = json.loads(json.dumps(committed))
+        wider["contract"]["subsystems"]["zero_param_update"][
+            "count_max"] += 1
+        with pytest.raises(ContractError, match="count_max"):
+            write_contract(path, wider)
+
+    def test_seeded_update_async_violation_is_caught(self):
+        # strip the -start/-done pairs from the fixture's update phase:
+        # the committed async floor must flag the de-asyncified program
+        from deepspeed_tpu.analysis.hlolint import (
+            LintConfig,
+            contracts_dir,
+            lint_ledger,
+            load_contract,
+        )
+        from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+        sync_text = "\n".join(
+            line for line in fixture_text(UPDATE_FIXTURE).splitlines()
+            if "-done" not in line).replace("-start", "")
+        data = load_contract(os.path.join(
+            contracts_dir(), "zero3_qwz_update_defer_async_step.json"))
+        cfg = LintConfig.from_contract(
+            data, program="zero3_qwz_update_defer_async_step")
+        led = build_ledger(sync_text,
+                           program=cfg.program, world=8, zero_stage=3)
+        found = lint_ledger(led, cfg)
+        assert any(f.rule == "contract" and "async" in f.message
+                   for f in found), [f.render() for f in found]
+
+
+# --------------------------------------------------------------------- #
+# observatory: attribution + step-phase pricing + estimator overlap
+# --------------------------------------------------------------------- #
+class TestObservatory:
+    def test_update_scope_outranks_wire_marks(self):
+        from deepspeed_tpu.profiling.observatory.hlo import CollectiveOp
+        from deepspeed_tpu.profiling.observatory.ledger import (
+            attribute_subsystem,
+        )
+
+        op = CollectiveOp(
+            kind="all_gather", hlo_opcode="all-gather", result="ag.1",
+            dtype="s8", shape=(8, 64), size_bytes=512, group_size=8,
+            n_groups=1, channel_id=None,
+            op_name="jit(train_step)/zero_param_update/qwz_wire/all_gather")
+        assert attribute_subsystem(op, zero_stage=3) == "zero_param_update"
+        # without the update scope the wire mark still wins
+        op2 = CollectiveOp(
+            kind="all_gather", hlo_opcode="all-gather", result="ag.2",
+            dtype="s8", shape=(8, 64), size_bytes=512, group_size=8,
+            n_groups=1, channel_id=None,
+            op_name="jit(train_step)/qwz_wire/all_gather")
+        assert attribute_subsystem(op2, zero_stage=3) == "zero_param_gather"
+
+    def test_fixture_ledger_prices_update_phase(self):
+        from deepspeed_tpu.comm import bandwidth as BW
+        from deepspeed_tpu.profiling.observatory.ledger import build_ledger
+
+        led = build_ledger(fixture_text(UPDATE_FIXTURE), world=8,
+                           zero_stage=3)
+        subs = led.totals_by_subsystem()
+        assert subs["zero_param_update"]["bytes"] > 0
+        # the update-phase collectives are priced into the serialized
+        # comm prediction: removing them must shrink it
+        full = led.predicted_comm_seconds(BW.DEFAULT_LINK_GBPS)
+        led.ops = [op for op in led.ops
+                   if op.subsystem != "zero_param_update"]
+        assert led.predicted_comm_seconds(BW.DEFAULT_LINK_GBPS) < full
+
+    def test_subsystem_phase_maps_update_to_step(self):
+        from deepspeed_tpu.profiling.observatory.report import (
+            SUBSYSTEM_PHASE,
+        )
+
+        assert SUBSYSTEM_PHASE["zero_param_update"] == "step"
+
+    def test_step_phase_overlap_nonzero_on_estimator_path(self):
+        # the acceptance leg: a live double-buffered engine's roofline
+        # report shows a NONZERO step-phase overlap_fraction on the CPU
+        # tier — the update's compute leg (UPDATE_BYTES_PER_ELEM at the
+        # documented host rate) hides part of the fenced publish comm
+        from deepspeed_tpu.profiling.observatory.report import (
+            validate_report,
+        )
+
+        e = _engine(3, True, zero_quantized_weights=True, **FORCING)
+        assert e.overlap_plan()["param_buffer"]
+        # the acceptance's live-lint leg: the composed double-buffered
+        # program passes every structural hlolint rule (sync-collective
+        # honest on CPU, fence-defeat, wire-dtype over the pooled
+        # gather+update subsystems, replication incl. the deferred
+        # publish bytes)
+        assert e.lint_step() == [], [f.render() for f in e.lint_step()]
+        led = e.collective_ledger(fold=False, seq_len=32)
+        step_comm = sum(
+            op.size_bytes for op in led.ops
+            if op.subsystem == "zero_param_update")
+        assert step_comm > 0
+        # a step wall shorter than compute+comm = the estimator's
+        # evidence of hiding
+        report = e.step_report(
+            phase_walls={"fwd": 5e-3, "bwd": 1e-2, "step": 2e-5},
+            seq_len=32, fold=False)
+        assert validate_report(report) == []
+        step_row = report["phases"]["step"]
+        assert step_row["overlap_fraction"] > 0.0
+        assert report["ledger"]["by_subsystem"][
+            "zero_param_update"]["count"] > 0
+
+    def test_serial_engine_report_keeps_step_share(self):
+        # overlap_step off: no override — the step phase keeps the
+        # serial assumption (overlap 0 with comm, vacuous 1 without)
+        e = _engine(2, False)
+        report = e.step_report(
+            phase_walls={"fwd": 5e-3, "bwd": 1e-2, "step": 2e-5},
+            seq_len=32, fold=False)
+        sub = report["ledger"]["by_subsystem"]
+        assert "zero_param_update" not in sub
+
+    def test_cli_renders_step_phase_overlap_line(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "step-report"),
+             "--hlo-file", os.path.join(FIXTURES, UPDATE_FIXTURE),
+             "--world", "8", "--zero-stage", "3", "--format", "text"],
+            capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        assert "step-phase overlap:" in proc.stdout
+        assert "zero_param_update" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# bench knob: BENCH_STEP_OVERLAP=0 mirrors BENCH_OVERLAP/BENCH_WIRE
+# --------------------------------------------------------------------- #
+class TestBenchKnob:
+    def test_knob_applies_after_config_extra(self, monkeypatch):
+        # the PR 10 fix class: a row whose config_extra REPLACES the
+        # zero section must still honor the A/B knob
+        import bench as bench_mod
+
+        captured = {}
+        real_init = dst.initialize
+
+        def spy_init(*args, **kwargs):
+            captured["config"] = kwargs.get("config") or args[1]
+            raise RuntimeError("stop-after-config")
+
+        monkeypatch.setattr(dst, "initialize", spy_init)
+        monkeypatch.setenv("BENCH_STEP_OVERLAP", "0")
+        with pytest.raises(RuntimeError, match="stop-after-config"):
+            bench_mod.train_bench(
+                "tiny", zero_stage=2, batch=1, seq_len=32, gas=1,
+                steps=1, config_extra={"zero_optimization": {"stage": 2}})
+        assert captured["config"]["zero_optimization"][
+            "overlap_step"] is False
+        monkeypatch.setattr(dst, "initialize", real_init)
+
+    def test_knob_default_leaves_config_untouched(self, monkeypatch):
+        import bench as bench_mod
+
+        captured = {}
+
+        def spy_init(*args, **kwargs):
+            captured["config"] = kwargs.get("config") or args[1]
+            raise RuntimeError("stop-after-config")
+
+        monkeypatch.setattr(dst, "initialize", spy_init)
+        monkeypatch.delenv("BENCH_STEP_OVERLAP", raising=False)
+        with pytest.raises(RuntimeError, match="stop-after-config"):
+            bench_mod.train_bench(
+                "tiny", zero_stage=2, batch=1, seq_len=32, gas=1, steps=1)
+        assert "overlap_step" not in captured["config"]["zero_optimization"]

@@ -28,7 +28,7 @@ pinned env) via this file's ``--_generate`` child mode:
 Usage::
 
     tools/regen_hlo_fixtures.py --list                 # what would run
-    tools/regen_hlo_fixtures.py --out /tmp/fx          # all six, elsewhere
+    tools/regen_hlo_fixtures.py --out /tmp/fx          # all fixtures, elsewhere
     tools/regen_hlo_fixtures.py --only zero2_tiny_step # one fixture
     tools/regen_hlo_fixtures.py --write-contracts      # + retighten contracts
     tools/regen_hlo_fixtures.py --write-contracts --allow-loosen  # regeneration
@@ -102,6 +102,16 @@ FIXTURE_SPECS = {
                   "SAME config as zero2_qgz_bucketed_async_step minus "
                   "the quantized-wire flags; the unquantized baseline "
                   "the wire-byte-reduction contract divides against",
+    },
+    "zero3_qwz_update_defer_async_step": {
+        "spec": dict(model="tiny", num_layers=2, max_seq_len=64),
+        "zero": dict(_FORCING, stage=3, zero_quantized_weights=True,
+                     update_bucket_size=4096),
+        "asyncify": True,
+        "banner": "the BUCKETED-UPDATE double-buffered zero3 qwZ train "
+                  "step (overlap_step: per-bucket fenced weight update, "
+                  "deferred zero_param_update publish gather feeding "
+                  "the next forward's double buffer), asyncified",
     },
     "zero2_qgz_bucketed_async_step": {
         "spec": dict(model="tiny", hidden_size=64, num_layers=2,
@@ -191,8 +201,17 @@ def _regen_contract(stem: str, hlo_path: str, contracts_out: str,
                                        program=stem)
     else:
         fx = FIXTURE_SPECS[stem]
+        z = fx["zero"]
+        quant_w = bool(z.get("zero_quantized_weights"))
+        quant_g = bool(z.get("zero_quantized_gradients"))
+        wire = "exact"
+        if quant_w or quant_g:
+            wire = "qz+loco" if (quant_g and z.get("loco_error_feedback")) \
+                else "qz"
         cfg = LintConfig(program=stem, world=8,
-                         zero_stage=fx["zero"]["stage"],
+                         zero_stage=z["stage"],
+                         wire_format=wire, quant_weights=quant_w,
+                         quant_grads=quant_g,
                          expect_async=bool(fx.get("asyncify")))
     with open(hlo_path) as f:
         text = f.read()

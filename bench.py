@@ -285,12 +285,41 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     except Exception as e:
         print(f"bench: collective ledger unavailable for this entry "
               f"({type(e).__name__}: {e})", file=sys.stderr)
+    # schema: per-entry compiled-program memory legs next to the host
+    # RSS + PJRT allocator stats the --entry wrapper adds — bench-diff
+    # treats memory.* lower-is-better, so a temp-bytes blowup in the
+    # lowered step diffs like a speed regression. Reads the SAME cached
+    # lowering as the comms block above (no extra compile); a failure
+    # costs a stderr note, never the measured row.
+    mem_analysis_block = {}
+    try:
+        from deepspeed_tpu.autotuning.memory_model import (
+            peak_bytes_from_stats,
+        )
+        from deepspeed_tpu.profiling.observatory import ledger_for_engine
+
+        _, mem_stats = ledger_for_engine(engine, fold=False,
+                                         seq_len=seq_len)
+        if mem_stats:
+            peak = peak_bytes_from_stats(mem_stats)
+            if peak is not None:
+                mem_analysis_block["device_peak_bytes"] = int(peak)
+            temp = mem_stats.get("temp_size_in_bytes")
+            if temp is not None:
+                mem_analysis_block["temp_bytes"] = int(temp)
+    except Exception as e:
+        print(f"bench: memory_analysis unavailable for this entry "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
     # hlolint gate (mirrors BENCH_DSLINT, compiled-program edition): a
     # round whose LOWERED step violates its contract is refused, not
     # recorded — the lint reuses the ledger lowering cached just above,
     # so a clean step costs nothing extra. Raising here turns the row
     # into an explicit error row (the --entry wrapper's contract).
     _hlolint_entry_gate(engine, seq_len)
+    # memlint gate (the memory-side sibling): donation/aliasing,
+    # residency, and the committed memory contract over the same cached
+    # lowering. BENCH_MEMLINT=0 opts out; BENCH_MEMLINT_CONTRACT pins.
+    _memlint_entry_gate(engine, seq_len)
     # price the scrape-time gauges (tokens/s from the fenced window, measured
     # MFU via XLA cost analysis) while the engine is still alive — the
     # --entry wrapper then embeds the full snapshot in this row's JSON
@@ -314,6 +343,10 @@ def train_bench(model, *, zero_stage, precision="bf16", optimizer="adam",
     if report_moe_drops:
         out["moe_dropped_frac"] = round(float(moe_drop_frac), 5)
     out.update(comms_block)
+    if mem_analysis_block:
+        # the --entry wrapper MERGES its host-RSS/PJRT stats into this
+        # block (the engine is gone by the time the wrapper runs)
+        out["memory"] = mem_analysis_block
     if note:
         out["note"] = note
     return out
@@ -1403,6 +1436,39 @@ def _hlolint_entry_gate(engine, seq_len):
             "overrides locally)")
 
 
+def _memlint_entry_gate(engine, seq_len):
+    """Refuse to record a train row whose LOWERED step violates its
+    MEMORY contract (``deepspeed_tpu/analysis/memlint`` — hlolint's
+    memory-side sibling): donation/aliasing verification, residency vs
+    the ZeRO prediction, and ``BENCH_MEMLINT_CONTRACT`` naming a
+    committed memory contract to hold the step to. ``BENCH_MEMLINT=0``
+    opts out for local what-if runs; an EXPLICITLY-set-but-unreadable
+    contract fails the row (the gate the operator believes is armed
+    must not silently disarm), while internal linter breakage degrades
+    to ungated."""
+    if os.environ.get("BENCH_MEMLINT", "1") == "0":
+        return
+    contract = os.environ.get("BENCH_MEMLINT_CONTRACT") or None
+    try:
+        findings = engine.lint_memory(contract=contract, seq_len=seq_len)
+    except Exception as e:
+        if contract and type(e).__name__ == "ContractError":
+            raise RuntimeError(
+                f"memlint: cannot enforce BENCH_MEMLINT_CONTRACT="
+                f"{contract}: {e}") from e
+        print(f"bench: memlint gate unavailable ({type(e).__name__}: {e});"
+              " proceeding ungated", file=sys.stderr)
+        return
+    if findings:
+        for f in findings[:20]:
+            print(f"bench: memlint: {f.render()}", file=sys.stderr)
+        raise RuntimeError(
+            f"memlint: {len(findings)} memory contract violation(s) in "
+            f"the lowered step — refusing to record "
+            f"(first: {findings[0].render()[:160]}; BENCH_MEMLINT=0 "
+            "overrides locally)")
+
+
 def _dslint_gate():
     """Refuse to record benchmarks from a tree carrying new (non-baselined)
     dslint findings: a host-sync or lock hazard that slipped in makes the
@@ -1460,7 +1526,11 @@ def main():
                     pass
                 mem = _entry_memory_stats()
                 if mem:
-                    row["memory"] = mem
+                    # merge, don't replace: the entry body may already
+                    # carry compiled-program memory_analysis legs
+                    merged = dict(row.get("memory") or {})
+                    merged.update(mem)
+                    row["memory"] = merged
                 guardian = _entry_guardian_stats()
                 if guardian:
                     row["guardian"] = guardian

@@ -41,6 +41,7 @@ KNOWN_RULES = (
     "silent-except",
     "config-key",
     "metric-name",
+    "donation",
     "all",
     "parse-error",
     "unknown-suppression",

@@ -1,0 +1,319 @@
+"""memlint — memory contract checking for compiled XLA programs.
+
+hlolint's memory-side sibling (same core/contract/CLI architecture):
+where hlolint checks the lowered program's collective/wire story, this
+package checks its MEMORY story — the side where this repo's worst
+live-repro'd failures actually happen (the PR 14 "donate the same
+buffer twice" ``Execute()`` abort; HBM OOM, the canonical TPU training
+failure). Three legs:
+
+* a **donation/aliasing pass** over the entry computation's
+  ``input_output_alias`` directives (the same HLO text the observatory
+  ledger already carries) verifying the engine's donation intent:
+  every donated state leaf actually aliased, no buffer reachable under
+  two donated leaves, derived buffers (``state["gathered"]``) never
+  breaking master-leaf donation;
+* a **residency pass** cross-checking ``memory_analysis()``
+  args/temp/output bytes against the ZeRO partitioning-math predicted
+  resident state and the analytic ``autotuning/memory_model`` estimate
+  (ONE copy of that math — ``memory_model.predicted_state_bytes_per_
+  device`` / ``peak_bytes_from_stats``);
+* committed per-(program, config) **memory contracts**
+  (``memlint/contracts/*.json`` — sidecars to the hlolint contracts,
+  same fixture stems) with shrink-only ceilings and rise-only floors,
+  plus an **OOM pre-flight gate** at ``deepspeed_initialize`` (the
+  ``"memlint"`` config section) refusing a job whose predicted peak
+  exceeds the chip's HBM budget before any chip time is spent.
+
+Front ends: ``python -m deepspeed_tpu.analysis.memlint`` /
+``tools/memlint`` / the ``memlint`` console entry (``--fixtures`` /
+``--live`` / ``--write-contract``; exit 0/1/2); ``engine.lint_memory()``
+(reuses the cached observatory lowering — no second compile); bench's
+per-entry gate (``BENCH_MEMLINT=0`` / ``BENCH_MEMLINT_CONTRACT``);
+``tools/step-report``'s memory verdict line. Rule catalog: README
+"Memory contracts"; worked example: ``docs/tutorials/memlint.md``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.analysis.hlolint import default_fixtures_dir
+from deepspeed_tpu.analysis.hlolint.core import (
+    ContractError,
+    program_stem,
+)
+from deepspeed_tpu.analysis.memlint.core import (
+    CONTRACT_BOUNDS,
+    LIVE_TIER_BOUNDS,
+    MemFinding,
+    MemLintConfig,
+    MemLintViolation,
+    MemObservations,
+    bootstrap_contract,
+    check_contract,
+    contract_observations,
+    contracts_dir,
+    iter_rule_findings,
+    load_contract,
+    observe_hlo,
+    parse_entry_layout,
+    parse_input_output_alias,
+    write_contract,
+)
+from deepspeed_tpu.analysis.memlint.rules import (
+    ALL_RULES,
+    RULE_IDS,
+    select_rules,
+)
+
+__all__ = [
+    "ALL_RULES", "RULE_IDS", "CONTRACT_BOUNDS", "LIVE_TIER_BOUNDS",
+    "ContractError", "MemFinding", "MemLintConfig", "MemLintViolation",
+    "MemObservations", "bootstrap_contract", "check_contract",
+    "contract_observations", "contracts_dir", "default_fixtures_dir",
+    "fixture_pairs", "iter_rule_findings", "lint_engine", "lint_fixture",
+    "lint_fixture_deferred", "lint_hlo_memory", "lint_hlo_memory_deferred",
+    "load_contract", "observe_hlo",
+    "parse_entry_layout", "parse_input_output_alias", "program_stem",
+    "select_rules", "write_contract", "engine_observations",
+    "observe_for_config",
+]
+
+
+def observe_for_config(hlo_text: str,
+                       cfg: MemLintConfig) -> MemObservations:
+    """Text-tier observations with the config's PINNED prediction
+    injected: fixture contracts carry the generation-time
+    ``predicted_state_bytes`` precisely so ``--fixtures`` can enforce
+    the ``args_vs_predicted_max`` ceiling with no engine."""
+    obs = observe_hlo(hlo_text)
+    if obs.predicted_state_bytes is None and cfg.predicted_state_bytes:
+        obs.predicted_state_bytes = float(cfg.predicted_state_bytes)
+    return obs
+
+
+def lint_hlo_memory(hlo_text: str, cfg: MemLintConfig,
+                    rules=None) -> List[MemFinding]:
+    """Lint one compiled module's memory story from its text alone —
+    the pure-text entry point (no device, no jax import)."""
+    return lint_hlo_memory_deferred(hlo_text, cfg, rules=rules)[0]
+
+
+def lint_hlo_memory_deferred(hlo_text: str, cfg: MemLintConfig,
+                             rules=None):
+    """:func:`lint_hlo_memory` plus the contract bound keys whose
+    observation is unavailable at this lint tier — ``(findings,
+    deferred)``; callers surface ``deferred`` rather than reading an
+    unchecked bound as clean. One read/parse of the text, one place
+    deferral is computed (the CLI reads it from here)."""
+    obs = observe_for_config(hlo_text, cfg)
+    findings = iter_rule_findings(obs, cfg, rules=rules)
+    deferred: List[str] = []
+    if cfg.contract:
+        _, deferred = check_contract(obs, cfg.contract, cfg.program)
+    return findings, deferred
+
+
+def lint_fixture(hlo_path: str, contract_path: str,
+                 rules=None) -> List[MemFinding]:
+    """Lint one committed ``.hlo.txt`` against its committed memory
+    contract (the lint config comes from the contract's ``config``
+    block). Live-tier bounds defer here by construction — they are
+    enforced wherever a live lowering exists."""
+    return lint_fixture_deferred(hlo_path, contract_path,
+                                 rules=rules)[0]
+
+
+def lint_fixture_deferred(hlo_path: str, contract_path: str,
+                          rules=None):
+    """:func:`lint_fixture` plus the deferred bound keys —
+    ``(findings, deferred)``."""
+    data = load_contract(contract_path)
+    cfg = MemLintConfig.from_contract(data,
+                                      program=program_stem(hlo_path))
+    try:
+        with open(hlo_path) as f:
+            text = f.read()
+    except OSError as e:
+        raise ContractError(f"cannot read HLO {hlo_path}: {e}")
+    return lint_hlo_memory_deferred(text, cfg, rules=rules)
+
+
+def fixture_pairs(fixtures_dir: str,
+                  contracts: Optional[str] = None):
+    """(hlo_path, memory_contract_path) for every committed fixture —
+    hlolint's pairing walk pointed at THIS package's contracts dir
+    (orphans on either side stay loud errors)."""
+    from deepspeed_tpu.analysis.hlolint.core import (
+        fixture_pairs as _pairs,
+    )
+
+    return _pairs(fixtures_dir, contracts or contracts_dir())
+
+
+# ------------------------------------------------------------------ #
+# live engines
+# ------------------------------------------------------------------ #
+def _leaf_buffer_ids(leaf) -> frozenset:
+    """Device-buffer identity of one live array: (device, pointer)
+    per shard — each chip has its own address space, so a raw pointer
+    alone would false-positive on two different leaves whose shards on
+    DIFFERENT chips happen to share an address value. Empty set when
+    the backend can't report — identity then never matches, so absence
+    degrades to 'no duplicate found', never a false positive."""
+    ptrs = []
+    try:
+        if getattr(leaf, "size", 1) == 0:
+            return frozenset()   # zero-size buffers may legally share
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                ptrs.append((repr(s.device),
+                             s.data.unsafe_buffer_pointer()))
+        else:
+            ptrs.append((repr(getattr(leaf, "device", None)),
+                         leaf.unsafe_buffer_pointer()))
+    except Exception as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"memlint buffer-identity probe unavailable "
+                     f"({type(e).__name__}: {e})")
+        return frozenset()
+    return frozenset(ptrs)
+
+
+def duplicate_buffer_leaves(state) -> List[tuple]:
+    """Pairs of state-tree leaf paths sharing at least one device
+    buffer — donating this tree would abort ``Execute()`` with
+    'donate the same buffer twice'. Paths are jax keystrs, so the
+    finding names the exact leaves (``['gathered']['w']`` vs
+    ``['master']['w']``)."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    seen: Dict[int, str] = {}
+    pairs: List[tuple] = []
+    for path, leaf in leaves:
+        name = jax.tree_util.keystr(path)
+        for ptr in _leaf_buffer_ids(leaf):
+            other = seen.get(ptr)
+            if other is not None and other != name:
+                if (other, name) not in pairs:
+                    pairs.append((other, name))
+            else:
+                seen[ptr] = name
+    return pairs
+
+
+def _model_estimate_bytes(engine, seq_len: Optional[int]
+                          ) -> Optional[float]:
+    """Analytic per-chip estimate for THIS engine's resolved config
+    (the autotuner's pruning model, reused as the residency
+    cross-check)."""
+    try:
+        from deepspeed_tpu.autotuning import memory_model as mm
+
+        info = mm.ModelInfo.from_spec(engine.model_spec,
+                                      seq_len=seq_len)
+        opt = (engine.config.optimizer.type
+               if engine.config.optimizer else "adam")
+        return float(mm.estimate(
+            info, zero_stage=engine.zero_stage,
+            dp_shards=max(engine.dp_world_size, 1),
+            micro_batch=engine.train_micro_batch_size(),
+            seq_len=seq_len,
+            remat=engine.config.activation_checkpointing.policy,
+            precision=engine.precision, optimizer=opt,
+            offload_optimizer=bool(getattr(engine, "_offload_opt", False)
+                                   or getattr(engine, "_host_step", False)),
+            offload_param=bool(getattr(engine, "_offload_param", False)),
+        ).total)
+    except (ImportError, TypeError, ValueError, AttributeError) as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"memlint analytic estimate unavailable "
+                     f"({type(e).__name__}: {e})")
+        return None
+
+
+def engine_observations(engine,
+                        seq_len: Optional[int] = None) -> MemObservations:
+    """Full (text + live tier) observations of the engine's REAL
+    lowered train step — the same cached ``ledger_for_engine`` lowering
+    the hot path, ledger, step reports, and hlolint all share (a memory
+    lint never pays a second compile)."""
+    from deepspeed_tpu.autotuning.memory_model import (
+        peak_bytes_from_stats,
+        predicted_state_bytes_per_device,
+    )
+    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
+
+    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=seq_len)
+    obs = observe_hlo(ledger.hlo_text)
+    if mem:
+        obs.temp_bytes = mem.get("temp_size_in_bytes")
+        obs.alias_size_bytes = mem.get("alias_size_in_bytes")
+        obs.peak_bytes = peak_bytes_from_stats(mem)
+    obs.predicted_state_bytes = predicted_state_bytes_per_device(engine)
+    obs.model_estimate_bytes = _model_estimate_bytes(engine, seq_len)
+    if not getattr(engine, "_offload_param_stream", False):
+        obs.duplicate_buffer_leaves = duplicate_buffer_leaves(engine.state)
+    return obs
+
+
+def lint_engine(engine, contract: Optional[str] = None,
+                seq_len: Optional[int] = None,
+                hbm_budget_bytes: Optional[float] = None,
+                rules=None) -> List[MemFinding]:
+    """memlint over a live engine's lowered fused train step.
+
+    Donation intent comes from the engine's REAL dispatch: the step
+    donates state (``donate_argnums=(0,)``) everywhere except the
+    deliberately double-buffered ``_offload_param_stream`` path. The
+    expected donated-leaf count is the live state tree's leaf count;
+    the ZeRO-predicted resident state comes from the live shardings
+    (``memory_model.predicted_state_bytes_per_device`` — the ONE copy
+    of that math); ``contract`` (a path) additionally applies the
+    committed memory contract; ``hbm_budget_bytes`` arms the OOM
+    pre-flight rule.
+    """
+    import jax
+
+    obs = engine_observations(engine, seq_len=seq_len)
+    expect_donation = not getattr(engine, "_offload_param_stream", False)
+    donated = len(jax.tree.leaves(engine.state)) if expect_donation \
+        else None
+    cdata = load_contract(contract) if contract else None
+    cfg = MemLintConfig(
+        program="train_step",
+        world=engine.dp_world_size,
+        zero_stage=engine.zero_stage,
+        expect_donation=expect_donation,
+        donated_params=donated,
+        hbm_budget_bytes=hbm_budget_bytes,
+        contract=(cdata or {}).get("contract"))
+    if cdata:
+        # live lints derive the structural expectations from the engine
+        # itself; the residency ceiling is the one config-block knob a
+        # contract adds on top (engine state can't declare it)
+        ceiling = (cdata.get("config") or {}).get("args_vs_predicted_max")
+        if ceiling:
+            cfg.args_vs_predicted_max = float(ceiling)
+    findings = iter_rule_findings(obs, cfg, rules=rules)
+    if cfg.contract and (rules is None
+                         or any(r.RULE_ID == "contract" for r in rules)):
+        # the live tier IS the enforcement point text lints defer to —
+        # a bound unobservable HERE (backend reports no memory_analysis
+        # number) has nowhere left to defer, and the ceiling the caller
+        # believes is armed must not silently disarm
+        _, deferred = check_contract(obs, cfg.contract, cfg.program)
+        for key in deferred:
+            findings.append(MemFinding(
+                "contract", cfg.program,
+                f"committed bound {key} is unobservable on this backend "
+                "(no live memory_analysis number to hold it to) — the "
+                "live tier cannot defer it further; drop the bound or "
+                "fix the backend's memory reporting",
+                limit=cfg.contract.get(key), observed=None))
+    return findings

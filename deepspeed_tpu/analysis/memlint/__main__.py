@@ -1,0 +1,277 @@
+"""``python -m deepspeed_tpu.analysis.memlint`` — the memlint CLI.
+
+Exit codes (the dslint/hlolint contract): 0 = clean, 1 = violation(s)
+— each printed to stderr as ``memlint: [rule] program: message
+(contract=X, observed=Y)`` — 2 = unreadable HLO/contract, usage error,
+or a failed live lowering.
+
+Modes::
+
+    # lint a committed/captured HLO dump against its committed contract
+    memlint tests/unit/observatory_fixtures/zero3_tiny_step.hlo.txt \\
+        --contract deepspeed_tpu/analysis/memlint/contracts/zero3_tiny_step.json
+
+    # lint a dump with structural rules only (config from flags)
+    memlint step.hlo.txt --world 8 --zero-stage 3 --donated-params 62
+
+    # every committed fixture against every committed memory contract
+    memlint --fixtures
+
+    # live: lower the engine's real fused step and lint its memory
+    memlint --live --model tiny --zero-stage 2
+    memlint --live --model tiny --hbm-budget-bytes 1000000   # pre-flight
+
+    # bootstrap/retighten a memory contract from a dump (shrink-only)
+    memlint step.hlo.txt --world 8 --zero-stage 3 --write-contract out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from deepspeed_tpu.analysis.memlint import (
+    ALL_RULES,
+    ContractError,
+    LIVE_TIER_BOUNDS,
+    MemFinding,
+    MemLintConfig,
+    bootstrap_contract,
+    contracts_dir,
+    default_fixtures_dir,
+    fixture_pairs,
+    lint_fixture_deferred,
+    lint_hlo_memory_deferred,
+    load_contract,
+    observe_for_config,
+    program_stem,
+    select_rules,
+    write_contract,
+)
+
+JSON_SCHEMA_VERSION = 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="memlint",
+        description="compiled-program memory contract checker: "
+                    "donation/aliasing verification over the entry "
+                    "header, residency vs the ZeRO prediction, "
+                    "committed shrink-only peak-HBM contracts, and the "
+                    "OOM pre-flight budget gate")
+    p.add_argument("hlo_file", nargs="?", default=None,
+                   help="compiled HLO text dump to lint")
+    p.add_argument("--contract", default=None, metavar="FILE",
+                   help="committed memory contract JSON (its config "
+                        "block supplies the lint config; flags override)")
+    p.add_argument("--fixtures", action="store_true",
+                   help="lint every committed observatory fixture "
+                        "against its committed memory contract")
+    p.add_argument("--fixtures-dir", default=None,
+                   help="fixture directory for --fixtures (default: "
+                        "the checkout's tests/unit/observatory_fixtures)")
+    p.add_argument("--contracts-dir", default=None,
+                   help="contract directory for --fixtures (default: "
+                        "the packaged analysis/memlint/contracts)")
+    p.add_argument("--live", action="store_true",
+                   help="build a tiny engine, lower its REAL fused "
+                        "train step, and lint that program's memory")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch", type=int, default=1)
+    # structural-config flags (fill/override the contract's config block)
+    p.add_argument("--world", type=int, default=None)
+    p.add_argument("--zero-stage", type=int, default=None)
+    p.add_argument("--donated-params", type=int, default=None,
+                   metavar="N", help="entry parameters that are donated "
+                   "state leaves (every one must be aliased)")
+    p.add_argument("--no-donation", action="store_true",
+                   help="the program deliberately does NOT donate state "
+                        "(disarms the donation rules)")
+    p.add_argument("--predicted-state-bytes", type=float, default=None,
+                   help="ZeRO partitioning-math predicted resident "
+                        "state (per device) for text-mode residency")
+    p.add_argument("--args-vs-predicted-max", type=float, default=None,
+                   help="resident-args ceiling vs the predicted state")
+    p.add_argument("--hbm-budget-bytes", type=float, default=None,
+                   help="arm the OOM pre-flight rule at this budget")
+    p.add_argument("--program", default=None,
+                   help="program label (default: the HLO file stem)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--write-contract", metavar="FILE", default=None,
+                   help="write the linted program's numbers as a memory "
+                        "contract (refuses to LOOSEN an existing one)")
+    p.add_argument("--allow-loosen", action="store_true",
+                   help="permit --write-contract to loosen committed "
+                        "bounds (deliberate regeneration only)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _config_from_args(args, program: str) -> MemLintConfig:
+    if args.contract:
+        cfg = MemLintConfig.from_contract(load_contract(args.contract),
+                                          program=program)
+    else:
+        cfg = MemLintConfig(program=program)
+    overrides = {
+        "world": args.world, "zero_stage": args.zero_stage,
+        "donated_params": args.donated_params,
+        "predicted_state_bytes": args.predicted_state_bytes,
+        "args_vs_predicted_max": args.args_vs_predicted_max,
+        "hbm_budget_bytes": args.hbm_budget_bytes,
+    }
+    for key, val in overrides.items():
+        if val is not None:
+            setattr(cfg, key, val)
+    if args.no_donation:
+        cfg.expect_donation = False
+    return cfg
+
+
+def _lint_one_file(args, rules) -> Tuple[List[MemFinding], List[str]]:
+    program = args.program or program_stem(args.hlo_file)
+    cfg = _config_from_args(args, program)
+    try:
+        with open(args.hlo_file) as f:
+            text = f.read()
+    except OSError as e:
+        raise ContractError(f"cannot read HLO {args.hlo_file}: {e}")
+    return lint_hlo_memory_deferred(text, cfg, rules=rules)
+
+
+def _lint_fixtures(args, rules):
+    fdir = args.fixtures_dir or default_fixtures_dir()
+    if not fdir:
+        raise ContractError(
+            "--fixtures: no tests/unit/observatory_fixtures found from "
+            "here (pass --fixtures-dir)")
+    cdir = args.contracts_dir or contracts_dir()
+    findings: List[MemFinding] = []
+    deferred: List[str] = []
+    pairs = fixture_pairs(fdir, cdir)
+    for hlo_path, contract_path in pairs:
+        fs, d = lint_fixture_deferred(hlo_path, contract_path,
+                                      rules=rules)
+        findings.extend(fs)
+        deferred.extend(f"{program_stem(hlo_path)}:{k}" for k in d)
+    return findings, len(pairs), deferred
+
+
+def _lint_live(args, rules) -> List[MemFinding]:
+    import jax
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.analysis.memlint import lint_engine
+
+    config = {
+        "train_batch_size": args.batch * jax.device_count(),
+        "train_micro_batch_size_per_gpu": args.batch,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": args.zero_stage
+                              if args.zero_stage is not None else 3},
+        "steps_per_print": 10 ** 9,
+    }
+    spec = dst.causal_lm_spec(args.model, dtype="float32")
+    engine, *_ = dst.initialize(model=spec, config=config)
+    return lint_engine(engine, contract=args.contract,
+                       seq_len=args.seq_len,
+                       hbm_budget_bytes=args.hbm_budget_bytes,
+                       rules=rules)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.RULE_ID:24s} {rule.RULE_DOC}")
+        return 0
+    rules = None
+    programs = 1
+    deferred: List[str] = []
+    try:
+        if args.rules:
+            rules = select_rules([r.strip()
+                                  for r in args.rules.split(",")])
+        if args.fixtures:
+            findings, programs, deferred = _lint_fixtures(args, rules)
+        elif args.live:
+            findings = _lint_live(args, rules)
+        elif args.hlo_file:
+            if args.write_contract:
+                return _write_contract_mode(args)
+            findings, deferred = _lint_one_file(args, rules)
+        else:
+            print("memlint: nothing to lint — pass an HLO file, "
+                  "--fixtures, or --live (see --help)", file=sys.stderr)
+            return 2
+    except (ContractError, KeyError) as e:
+        print(f"memlint: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        # the --live leg can die inside jax/XLA; the documented contract
+        # is exit 2, never an undefined traceback code
+        print(f"memlint: error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "programs": programs,
+            "findings": [f.to_json() for f in findings],
+            "counts": _counts(findings),
+            "deferred_bounds": deferred,
+            "ok": not findings,
+        }, indent=2))
+    else:
+        print(f"memlint: {len(findings)} violation(s) across "
+              f"{programs} program(s)" if findings else
+              f"memlint: clean ({programs} program(s))")
+        if deferred:
+            # a live-tier bound a text lint can't observe is DEFERRED
+            # (enforced at initialize / bench / --live), never silently
+            # counted as clean — say so
+            known_live = [d for d in deferred
+                          if d.split(":")[-1] in LIVE_TIER_BOUNDS]
+            print(f"memlint: {len(deferred)} live-tier bound(s) "
+                  f"deferred to live enforcement"
+                  + ("" if len(known_live) == len(deferred) else
+                     f" (UNEXPECTED deferrals: "
+                     f"{sorted(set(deferred) - set(known_live))})"))
+    for f in findings:
+        print(f"memlint: {f.render()}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+def _write_contract_mode(args) -> int:
+    program = args.program or program_stem(args.hlo_file)
+    cfg = _config_from_args(args, program)
+    # observe_for_config, not observe_hlo: a --predicted-state-bytes
+    # flag must arm the args_vs_predicted_max ceiling in the written
+    # contract, not just pin the prediction in its config block
+    with open(args.hlo_file) as f:
+        obs = observe_for_config(f.read(), cfg)
+    doc = bootstrap_contract(obs, cfg,
+                             hlo_name=os.path.basename(args.hlo_file))
+    write_contract(args.write_contract, doc,
+                   allow_loosen=args.allow_loosen)
+    print(f"memlint: wrote {len(doc['contract'])} bound(s) for "
+          f"{program!r} to {args.write_contract}")
+    return 0
+
+
+def _counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

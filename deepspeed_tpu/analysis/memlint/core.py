@@ -1,0 +1,512 @@
+"""memlint core: memory observations, lint configs, and memory contracts.
+
+hlolint's memory-side sibling (``analysis/hlolint/core.py`` is the
+collective/wire edition; this package lints the MEMORY story of the same
+lowered artifact). Vocabulary:
+
+* an **observation** (:class:`MemObservations`) is everything the memory
+  passes can measure about one compiled program: the entry header's
+  ``input_output_alias`` directives and per-parameter/output byte sizes
+  (text tier — committed fixtures lint in tier-1 with no device and no
+  jax import), plus ``compiled.memory_analysis()`` args/temp/output/alias
+  bytes, the ZeRO partitioning-math predicted resident state, the
+  analytic ``autotuning/memory_model`` estimate, and live state-tree
+  buffer identity (live tier — engines only);
+* a **rule** is ``check(obs, cfg) -> Iterable[MemFinding]``
+  (``memlint/rules.py``);
+* a **lint config** (:class:`MemLintConfig`) declares the donation
+  intent (the engine donates its state tree), the residency ceilings,
+  and the HBM budget the pre-flight gate enforces;
+* a **contract** is a committed ``contracts/*.json`` sidecar per
+  (program, config) — one per observatory fixture, same stems as the
+  hlolint contracts — whose ceilings (``peak_bytes_max``,
+  ``temp_bytes_max``, ``args_bytes_max``, ``args_vs_predicted_max``)
+  only shrink and floors (``aliased_pairs_min`` — a silent donation
+  regression that un-aliases everything must not read as clean) only
+  rise. ``write_contract`` refuses a loosening rewrite without
+  ``--allow-loosen``, hlolint's posture exactly.
+
+Byte tier honesty: the text tier observes what the committed fixture
+header carries (parameter/output/alias bytes); ``temp``/``peak`` need a
+live ``memory_analysis()`` and are bootstrapped into contracts by the
+regen tool's live engines, then enforced wherever a live lowering
+exists (``engine.lint_memory()``, the ``"memlint"`` initialize gate,
+bench's ``BENCH_MEMLINT`` gate). ``check_contract`` reports bounds it
+could not observe as *deferred* instead of silently passing them.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# the finding/contract-error vocabulary is shared with hlolint — one
+# render format ("[rule] program: message (contract=X, observed=Y)"),
+# one exit-code contract, one refuse-on-loosen error class
+from deepspeed_tpu.analysis.hlolint.core import (
+    ContractError,
+    HloFinding as MemFinding,
+    _fmt_num,
+    program_stem,
+)
+
+CONTRACT_VERSION = 1
+
+
+class MemLintViolation(RuntimeError):
+    """A compiled program violated its memory contract (or the OOM
+    pre-flight budget) where the caller asked for enforcement — the
+    engine's ``memlint.fail_on_violation``, bench's refuse-to-record
+    gate."""
+
+
+# ------------------------------------------------------------------ #
+# entry-header parsing (the text tier)
+# ------------------------------------------------------------------ #
+#: one alias directive inside the input_output_alias block:
+#: ``{out_idx}: (param, {param_path}, may-alias|must-alias)``
+_ALIAS_ENTRY = re.compile(
+    r"\{(?P<out>[0-9, ]*)\}\s*:\s*\(\s*(?P<param>\d+)\s*,\s*"
+    r"\{(?P<ppath>[0-9, ]*)\}\s*,\s*(?P<kind>[a-z-]+)\s*\)")
+
+#: one typed array in the entry layout: f32[2,8]{1,0} (TPU tiled
+#: layouts — {1,0:T(8,128)} — contain no '}' before the close)
+_TYPED_ARRAY = re.compile(
+    r"^\s*([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})?\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasEntry:
+    """One ``input_output_alias`` directive: output index -> parameter."""
+
+    output_index: Tuple[int, ...]
+    param: int
+    param_index: Tuple[int, ...]
+    kind: str                     # "may-alias" | "must-alias"
+
+
+def _balanced_brace_span(text: str, open_idx: int) -> int:
+    """Index just past the ``}`` closing the ``{`` at ``open_idx``."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return -1
+
+
+def parse_input_output_alias(hlo_text: str) -> List[AliasEntry]:
+    """The entry computation's donation directives, straight from the
+    module header (``input_output_alias={ {0}: (0, {}, may-alias), ...}``).
+    Empty when the header carries none (nothing donated — itself a
+    donation finding when the config says the step donates state)."""
+    marker = "input_output_alias={"
+    idx = hlo_text.find(marker)
+    if idx < 0:
+        return []
+    open_idx = idx + len(marker) - 1
+    end = _balanced_brace_span(hlo_text, open_idx)
+    body = hlo_text[open_idx + 1:end - 1] if end > 0 else \
+        hlo_text[open_idx + 1:hlo_text.find("\n", open_idx)]
+    out: List[AliasEntry] = []
+    for m in _ALIAS_ENTRY.finditer(body):
+        out.append(AliasEntry(
+            output_index=tuple(int(t) for t in m.group("out").split(",")
+                               if t.strip()),
+            param=int(m.group("param")),
+            param_index=tuple(int(t) for t in m.group("ppath").split(",")
+                              if t.strip()),
+            kind=m.group("kind")))
+    return out
+
+
+def _split_top_level(s: str) -> List[str]:
+    """Split a type list on commas at bracket depth 0 — array types
+    carry commas inside ``[...]``/``{...}``/``(...)``."""
+    parts: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    for ch in s:
+        if ch in "[{(":
+            depth += 1
+        elif ch in "]})":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
+def _type_bytes(type_text: str) -> int:
+    """Bytes of one entry-layout array type (0 for token/opaque types —
+    they occupy no data payload but still hold an index slot)."""
+    from deepspeed_tpu.profiling.observatory.hlo import DTYPE_BYTES
+
+    t = re.sub(r"/\*.*?\*/", "", type_text).strip()
+    m = _TYPED_ARRAY.match(t)
+    if not m:
+        return 0
+    dtype, dims = m.group(1), m.group(2)
+    if dtype not in DTYPE_BYTES:
+        return 0
+    n = 1
+    for d in (int(x) for x in dims.split(",") if x):
+        n *= d
+    return n * DTYPE_BYTES[dtype]
+
+
+def parse_entry_layout(hlo_text: str
+                       ) -> Tuple[List[int], List[int]]:
+    """``entry_computation_layout={(P0, ...)->(R0, ...)}`` → per-index
+    byte sizes ``(param_bytes, output_bytes)``. Index order is the
+    layout's — alias directives index into exactly these lists."""
+    marker = "entry_computation_layout={"
+    idx = hlo_text.find(marker)
+    if idx < 0:
+        return [], []
+    open_idx = idx + len(marker) - 1
+    end = _balanced_brace_span(hlo_text, open_idx)
+    body = hlo_text[open_idx + 1:end - 1] if end > 0 else ""
+    arrow = body.find(")->(")
+    if arrow < 0:
+        return [], []
+    params_text = body[1:arrow]
+    outputs_text = body[arrow + len(")->("):]
+    if outputs_text.endswith(")"):
+        outputs_text = outputs_text[:-1]
+    params = [_type_bytes(t) for t in _split_top_level(params_text)]
+    outputs = [_type_bytes(t) for t in _split_top_level(outputs_text)]
+    return params, outputs
+
+
+# ------------------------------------------------------------------ #
+# observations
+# ------------------------------------------------------------------ #
+@dataclasses.dataclass
+class MemObservations:
+    """Everything the memory rules can judge about one compiled program.
+
+    The text-tier fields come from the module header alone (committed
+    fixtures, tier-1, no jax); the Optional live-tier fields are filled
+    by ``lint_engine`` from ``memory_analysis()`` + the engine's state
+    tree and stay ``None`` on text-only lints.
+    """
+
+    n_params: int = 0
+    n_outputs: int = 0
+    args_bytes: int = 0            # Σ entry parameter bytes (header)
+    output_bytes: int = 0          # Σ entry output bytes (header)
+    aliased_pairs: int = 0         # alias directives in the header
+    aliased_params: int = 0        # DISTINCT parameters aliased
+    aliased_bytes: int = 0         # Σ bytes of aliased outputs
+    double_aliased: List[int] = dataclasses.field(default_factory=list)
+    #: text-tier steady state: args + outputs − aliased reuse (an
+    #: aliased output writes into its donated argument's buffer)
+    resident_bytes: int = 0
+    # ------- live tier (memory_analysis + engine state) -------- #
+    temp_bytes: Optional[float] = None
+    alias_size_bytes: Optional[float] = None
+    #: args + temp + output − alias (memory_model.peak_bytes_from_stats
+    #: — the ONE copy of the formula)
+    peak_bytes: Optional[float] = None
+    #: ZeRO partitioning-math predicted per-device resident state
+    predicted_state_bytes: Optional[float] = None
+    #: analytic autotuning/memory_model estimate for this config
+    model_estimate_bytes: Optional[float] = None
+    #: state-tree leaf paths sharing one device buffer (the PR 14
+    #: "donate the same buffer twice" Execute abort, caught statically)
+    duplicate_buffer_leaves: List[Tuple[str, str]] = \
+        dataclasses.field(default_factory=list)
+
+    @property
+    def args_vs_predicted(self) -> Optional[float]:
+        if self.args_bytes and self.predicted_state_bytes:
+            return self.args_bytes / self.predicted_state_bytes
+        return None
+
+
+def observe_hlo(hlo_text: str) -> MemObservations:
+    """Text-tier observations from one compiled module's entry header
+    (works on full dumps, the observatory's trimmed cache, and the
+    committed fixtures alike — the header line survives all three)."""
+    aliases = parse_input_output_alias(hlo_text)
+    params, outputs = parse_entry_layout(hlo_text)
+    by_param: Dict[int, int] = {}
+    aliased_bytes = 0
+    for a in aliases:
+        by_param[a.param] = by_param.get(a.param, 0) + 1
+        if len(a.output_index) == 1 and a.output_index[0] < len(outputs):
+            aliased_bytes += outputs[a.output_index[0]]
+        elif not a.output_index and len(outputs) == 1:
+            aliased_bytes += outputs[0]
+    args_bytes = sum(params)
+    output_bytes = sum(outputs)
+    return MemObservations(
+        n_params=len(params),
+        n_outputs=len(outputs),
+        args_bytes=args_bytes,
+        output_bytes=output_bytes,
+        aliased_pairs=len(aliases),
+        aliased_params=len(by_param),
+        aliased_bytes=aliased_bytes,
+        double_aliased=sorted(p for p, n in by_param.items() if n > 1),
+        resident_bytes=max(args_bytes + output_bytes - aliased_bytes, 0),
+    )
+
+
+# ------------------------------------------------------------------ #
+# lint config
+# ------------------------------------------------------------------ #
+@dataclasses.dataclass
+class MemLintConfig:
+    """What the compiled program's memory story is SUPPOSED to be.
+
+    Built from a contract's ``config`` block (fixture lints), CLI flags
+    (ad-hoc dumps), or the live engine's resolved state
+    (``engine.lint_memory``: donation intent from the step builder's
+    ``donate_argnums``, the predicted state from the live shardings,
+    the HBM budget from the ``"memlint"`` config section / datasheet).
+    """
+
+    program: str = "program"
+    world: int = 1
+    zero_stage: int = 0
+    #: the step donates its state tree (engine ``donate_argnums=(0,)``;
+    #: False on the deliberately double-buffered offload_param_stream
+    #: path)
+    expect_donation: bool = True
+    #: entry parameters that ARE donated state leaves — every one of
+    #: them must be aliased (None = unknown: only the zero-alias
+    #: regression arms)
+    donated_params: Optional[int] = None
+    #: resident-args ceiling vs the ZeRO-predicted state (None = the
+    #: residency rule only arms through a contract)
+    args_vs_predicted_max: Optional[float] = None
+    #: measured peak vs the analytic memory-model estimate — catches
+    #: temp-bytes blowups from fence/bucket interactions without a
+    #: committed contract. The analytic model is deliberately coarse
+    #: (a healthy tiny zero3 step measures ~3.8x: XLA temp workspace
+    #: dwarfs tiny-model state), hence the wide default — committed
+    #: contracts pin the tight per-program ceiling instead
+    estimate_max_ratio: float = 8.0
+    #: the OOM pre-flight budget (bytes); None disarms the gate
+    hbm_budget_bytes: Optional[float] = None
+    #: pinned per-device predicted state for TEXT lints (fixture
+    #: contracts carry the generation-time number so --fixtures can
+    #: enforce args_vs_predicted_max without an engine)
+    predicted_state_bytes: Optional[float] = None
+    #: the committed contract body (the ``"contract"`` block), if any
+    contract: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_contract(cls, data: Dict[str, Any],
+                      program: str = "") -> "MemLintConfig":
+        section = dict(data.get("config") or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(section) - known
+        if unknown:
+            raise ContractError(
+                f"memlint contract config block has unknown key(s) "
+                f"{sorted(unknown)} (known: {sorted(known)})")
+        out = cls(**section)
+        out.program = program or data.get("program") or out.program
+        out.contract = data.get("contract") or None
+        return out
+
+
+# ------------------------------------------------------------------ #
+# contracts
+# ------------------------------------------------------------------ #
+#: bound key -> (observation key, direction). ``min`` floors only rise,
+#: ``max`` ceilings only fall. args/aliased floors pin that the program
+#: (and the header parser reading it) is still there — an empty dump
+#: satisfies every ceiling and no floor.
+CONTRACT_BOUNDS = {
+    "args_bytes_max": ("args_bytes", "max"),
+    "args_bytes_min": ("args_bytes", "min"),
+    "output_bytes_max": ("output_bytes", "max"),
+    "resident_bytes_max": ("resident_bytes", "max"),
+    "aliased_pairs_min": ("aliased_pairs", "min"),
+    # live tier: observable only where a memory_analysis exists;
+    # unobservable bounds come back as DEFERRED, never silently pass
+    "peak_bytes_max": ("peak_bytes", "max"),
+    "temp_bytes_max": ("temp_bytes", "max"),
+    "args_vs_predicted_max": ("args_vs_predicted", "max"),
+}
+
+#: the live-tier bound keys (documented deferral set for --fixtures)
+LIVE_TIER_BOUNDS = ("peak_bytes_max", "temp_bytes_max")
+
+
+def contract_observations(obs: MemObservations) -> Dict[str, Any]:
+    """Observation dict in the contract vocabulary (None = this lint
+    tier cannot observe the number — its bounds defer)."""
+    ratio = obs.args_vs_predicted
+    return {
+        "args_bytes": obs.args_bytes,
+        "output_bytes": obs.output_bytes,
+        "resident_bytes": obs.resident_bytes,
+        "aliased_pairs": obs.aliased_pairs,
+        "peak_bytes": obs.peak_bytes,
+        "temp_bytes": obs.temp_bytes,
+        "args_vs_predicted": (round(ratio, 4)
+                              if ratio is not None else None),
+    }
+
+
+def check_contract(obs: MemObservations, contract: Dict[str, Any],
+                   program: str
+                   ) -> Tuple[List[MemFinding], List[str]]:
+    """Every committed bound against the observations. Returns
+    ``(findings, deferred)`` — ``deferred`` lists bound keys whose
+    observation is unavailable at this lint tier (text-mode fixture
+    checks of live-tier bounds); callers surface it rather than reading
+    an unchecked bound as clean. Unknown bound keys are a loud
+    :class:`ContractError`."""
+    findings: List[MemFinding] = []
+    deferred: List[str] = []
+    numbers = contract_observations(obs)
+    unknown = set(contract) - set(CONTRACT_BOUNDS)
+    if unknown:
+        raise ContractError(
+            f"memlint contract has unknown bound key(s) {sorted(unknown)} "
+            f"(known: {sorted(CONTRACT_BOUNDS)})")
+    for key, (obs_key, direction) in CONTRACT_BOUNDS.items():
+        bound = contract.get(key)
+        if bound is None:
+            continue
+        got = numbers[obs_key]
+        if got is None:
+            deferred.append(key)
+            continue
+        bad = got < bound if direction == "min" else got > bound
+        if bad:
+            word = "floor" if direction == "min" else "ceiling"
+            findings.append(MemFinding(
+                "contract", program,
+                f"{obs_key} violates the committed memory {word} {key}",
+                limit=bound, observed=got))
+    return findings, deferred
+
+
+def _loosenings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
+    out: List[str] = []
+    for key, (_, direction) in CONTRACT_BOUNDS.items():
+        o, n = old.get(key), new.get(key)
+        if o is None:
+            continue
+        if n is None:
+            out.append(f"{key} dropped (was {_fmt_num(o)})")
+            continue
+        if (direction == "min" and n < o) or \
+                (direction == "max" and n > o):
+            out.append(f"{key} {_fmt_num(o)} -> {_fmt_num(n)}")
+    return out
+
+
+def bootstrap_contract(obs: MemObservations, cfg: MemLintConfig,
+                       hlo_name: str = "") -> Dict[str, Any]:
+    """A fresh memory contract pinning the CURRENT numbers exactly
+    (zero slack — committed fixtures are static artifacts; drift is a
+    regeneration event). Live-tier bounds are written only when the
+    bootstrap actually observed them."""
+    numbers = contract_observations(obs)
+    body: Dict[str, Any] = {
+        "args_bytes_max": numbers["args_bytes"],
+        "args_bytes_min": numbers["args_bytes"],
+        "output_bytes_max": numbers["output_bytes"],
+        "resident_bytes_max": numbers["resident_bytes"],
+        "aliased_pairs_min": numbers["aliased_pairs"],
+    }
+    if numbers["peak_bytes"] is not None:
+        body["peak_bytes_max"] = int(numbers["peak_bytes"])
+    if numbers["temp_bytes"] is not None:
+        body["temp_bytes_max"] = int(numbers["temp_bytes"])
+    if numbers["args_vs_predicted"] is not None:
+        # headroom, not zero slack: the ratio's denominator is a
+        # prediction (shardings × dtype), and a layout-padding change
+        # should not churn the committed ceiling
+        body["args_vs_predicted_max"] = round(
+            numbers["args_vs_predicted"] * 1.05, 4)
+    section: Dict[str, Any] = {
+        "world": cfg.world, "zero_stage": cfg.zero_stage,
+        "expect_donation": cfg.expect_donation,
+    }
+    if cfg.donated_params is not None:
+        section["donated_params"] = cfg.donated_params
+    pinned = cfg.predicted_state_bytes or obs.predicted_state_bytes
+    if pinned:
+        # pin the generation-time prediction so TEXT lints can enforce
+        # args_vs_predicted_max without an engine
+        section["predicted_state_bytes"] = float(pinned)
+    doc = {"version": CONTRACT_VERSION, "program": cfg.program,
+           "config": section, "contract": body}
+    if hlo_name:
+        doc["hlo"] = hlo_name
+    return doc
+
+
+def contracts_dir() -> str:
+    """The committed per-fixture memory contracts shipping with the
+    package (sidecars to the hlolint contracts — same stems)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "contracts")
+
+
+def load_contract(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise ContractError(f"cannot read memory contract {path}: {e}")
+    except json.JSONDecodeError as e:
+        raise ContractError(f"malformed memory contract JSON {path}: {e}")
+    if not isinstance(data, dict) or \
+            data.get("version") != CONTRACT_VERSION or \
+            not isinstance(data.get("contract"), dict):
+        raise ContractError(
+            f"malformed memory contract {path}: expected "
+            '{"version": 1, "program": ..., "config": {...}, '
+            '"contract": {...}}')
+    return data
+
+
+def write_contract(path: str, doc: Dict[str, Any],
+                   allow_loosen: bool = False) -> None:
+    """Shrink-only write: ceilings only fall, floors only rise;
+    ``allow_loosen=True`` is the deliberate-regeneration hatch
+    (fixture and contract rewritten together, reviewed together)."""
+    if os.path.exists(path) and not allow_loosen:
+        old = load_contract(path)
+        loosened = _loosenings(old["contract"],
+                               doc.get("contract") or {})
+        if loosened:
+            raise ContractError(
+                f"refusing to loosen committed memory contract {path}: "
+                + "; ".join(loosened)
+                + " (pass --allow-loosen to regenerate deliberately)")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def iter_rule_findings(obs: MemObservations, cfg: MemLintConfig,
+                       rules: Optional[Iterable] = None
+                       ) -> List[MemFinding]:
+    """Run every memory rule pass over one program's observations."""
+    from deepspeed_tpu.analysis.memlint.rules import ALL_RULES
+
+    findings: List[MemFinding] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        findings.extend(rule.check(obs, cfg))
+    findings.sort(key=lambda f: (f.rule, f.message))
+    return findings

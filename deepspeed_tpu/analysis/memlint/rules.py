@@ -1,0 +1,207 @@
+"""memlint rule passes: the compiled program's memory invariants.
+
+Each rule is ``check(obs, cfg) -> Iterable[MemFinding]`` over one
+program's :class:`~deepspeed_tpu.analysis.memlint.core.MemObservations`
+plus the :class:`~deepspeed_tpu.analysis.memlint.core.MemLintConfig`
+declaring the memory story the engine intends. Rationale: HBM OOM is
+the canonical TPU training failure and donation bugs abort at
+``Execute()`` — both are properties of the LOWERED artifact (the entry
+header's ``input_output_alias`` directives, ``memory_analysis()``'s
+args/temp bytes), so the lowered artifact is where they are checked,
+before any chip time is spent.
+
+Rule catalog (README "Memory contracts"):
+
+* **donation** — the engine donates its state tree
+  (``donate_argnums=(0,)``) but the compiled entry aliases fewer
+  parameters than the donated leaf count (or none at all): un-aliased
+  donated leaves are silent double-residency — the step holds old and
+  new state simultaneously, exactly what donation exists to prevent.
+* **double-donation** — one buffer reachable under two donated leaves:
+  a parameter aliased by multiple outputs in the header, or (live) two
+  state-tree leaves sharing one device buffer — the PR 14
+  "donate the same buffer twice" ``Execute()`` abort, caught statically
+  with the leaf paths named.
+* **residency** — compiled-program resident args exceed the
+  ``args_vs_predicted`` ceiling against the ZeRO partitioning-math
+  prediction (state resident that stage N promised to shard away), or
+  the measured peak exceeds ``estimate_max_ratio`` × the analytic
+  ``autotuning/memory_model`` estimate (temp-bytes blowup from
+  fence/bucket interactions).
+* **oom-preflight** — predicted peak HBM exceeds the chip's budget
+  (``utils/chip_specs`` datasheet capacity, or the explicit
+  ``memlint.hbm_budget_bytes``): the job WILL OOM — refuse it before
+  dispatch instead of after minutes of compilation and warmup.
+* **contract** — the committed per-(program, config) bounds
+  (``contracts/*.json``): see ``core.check_contract``.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from deepspeed_tpu.analysis.memlint.core import (
+    MemFinding,
+    MemLintConfig,
+    MemObservations,
+    check_contract,
+)
+
+
+class _Donation:
+    RULE_ID = "donation"
+    RULE_DOC = ("donated state leaves the compiled entry never aliased "
+                "(donation intent not honored: silent double-residency)")
+
+    @staticmethod
+    def check(obs: MemObservations,
+              cfg: MemLintConfig) -> Iterable[MemFinding]:
+        if not cfg.expect_donation:
+            return
+        if cfg.donated_params:
+            if obs.aliased_params < cfg.donated_params:
+                missing = cfg.donated_params - obs.aliased_params
+                yield MemFinding(
+                    _Donation.RULE_ID, cfg.program,
+                    f"{missing} donated state leaf/leaves never aliased "
+                    "in the compiled entry (input_output_alias) — the "
+                    "step keeps old AND new state resident for those "
+                    "buffers, the double-residency donation exists to "
+                    "prevent",
+                    limit=cfg.donated_params, observed=obs.aliased_params)
+        elif obs.n_params and obs.aliased_pairs == 0:
+            yield MemFinding(
+                _Donation.RULE_ID, cfg.program,
+                "the config declares state donation but the compiled "
+                "entry aliases NOTHING — a donation regression "
+                "(dropped donate_argnums?) doubles steady-state "
+                "residency across the whole tree",
+                limit=1, observed=0)
+
+
+class _DoubleDonation:
+    RULE_ID = "double-donation"
+    RULE_DOC = ("one buffer reachable under two donated leaves — the "
+                "'donate the same buffer twice' Execute abort, caught "
+                "statically")
+
+    @staticmethod
+    def check(obs: MemObservations,
+              cfg: MemLintConfig) -> Iterable[MemFinding]:
+        for param in obs.double_aliased:
+            yield MemFinding(
+                _DoubleDonation.RULE_ID, cfg.program,
+                f"entry parameter {param} is aliased by more than one "
+                "output — two outputs claim the same donated buffer",
+                limit=1, observed=2)
+        for left, right in obs.duplicate_buffer_leaves:
+            yield MemFinding(
+                _DoubleDonation.RULE_ID, cfg.program,
+                f"state leaves {left} and {right} share ONE device "
+                "buffer under a donated argument — Execute() would "
+                "abort with 'donate the same buffer twice'; a derived "
+                "buffer (e.g. a no-op same-dtype cast of a master leaf) "
+                "must copy, not alias",
+                limit=1, observed=2)
+
+
+class _Residency:
+    RULE_ID = "residency"
+    RULE_DOC = ("resident args over the ZeRO-predicted-state ceiling, or "
+                "measured peak blowing past the analytic memory-model "
+                "estimate (temp-bytes blowup)")
+
+    @staticmethod
+    def check(obs: MemObservations,
+              cfg: MemLintConfig) -> Iterable[MemFinding]:
+        predicted = obs.predicted_state_bytes or cfg.predicted_state_bytes
+        ceiling = cfg.args_vs_predicted_max
+        if obs.args_bytes and predicted and ceiling:
+            ratio = obs.args_bytes / predicted
+            if ratio > ceiling:
+                yield MemFinding(
+                    _Residency.RULE_ID, cfg.program,
+                    "compiled-program resident args exceed the "
+                    "args_vs_predicted ceiling against the ZeRO "
+                    "partitioning-math prediction — state is resident "
+                    f"that stage {cfg.zero_stage} promised to shard "
+                    "away (accidental full-replica residency)",
+                    limit=ceiling, observed=round(ratio, 3))
+        est = obs.model_estimate_bytes
+        measured = obs.peak_bytes if obs.peak_bytes is not None \
+            else (obs.resident_bytes or None)
+        if est and measured and cfg.estimate_max_ratio \
+                and measured > cfg.estimate_max_ratio * est:
+            yield MemFinding(
+                _Residency.RULE_ID, cfg.program,
+                "measured peak HBM blows past the analytic memory-model "
+                f"estimate by more than {cfg.estimate_max_ratio}x — a "
+                "temp-bytes blowup (fence/bucket interaction keeping "
+                "extra copies live) the estimator never priced",
+                limit=round(cfg.estimate_max_ratio * est),
+                observed=round(measured))
+
+
+class _OomPreflight:
+    RULE_ID = "oom-preflight"
+    RULE_DOC = ("predicted peak HBM exceeds the chip budget — the job "
+                "WILL OOM; refuse before dispatch")
+
+    @staticmethod
+    def check(obs: MemObservations,
+              cfg: MemLintConfig) -> Iterable[MemFinding]:
+        budget = cfg.hbm_budget_bytes
+        if not budget:
+            return
+        # best available peak: the compiled program's own number, else
+        # the analytic estimate, else the header's steady state
+        need = obs.peak_bytes
+        source = "memory_analysis peak"
+        if need is None:
+            need, source = obs.model_estimate_bytes, "analytic estimate"
+        if need is None:
+            need, source = (obs.resident_bytes or None), "entry header"
+        if need is not None and need > budget:
+            yield MemFinding(
+                _OomPreflight.RULE_ID, cfg.program,
+                f"predicted peak HBM ({source}) exceeds the chip budget "
+                "— the job would OOM after compile+warmup; refused "
+                "before any chip time is spent (raise "
+                "memlint.hbm_budget_bytes only if the datasheet is "
+                "wrong for this part)",
+                limit=round(budget), observed=round(need))
+
+
+class _Contract:
+    RULE_ID = "contract"
+    RULE_DOC = ("committed per-(program, config) memory bounds: peak/"
+                "temp/args ceilings + the aliased-pairs floor "
+                "(contracts/*.json, shrink-only)")
+
+    @staticmethod
+    def check(obs: MemObservations,
+              cfg: MemLintConfig) -> Iterable[MemFinding]:
+        if not cfg.contract:
+            return []
+        findings, _deferred = check_contract(obs, cfg.contract,
+                                             cfg.program)
+        return findings
+
+
+ALL_RULES = (
+    _Donation,
+    _DoubleDonation,
+    _Residency,
+    _OomPreflight,
+    _Contract,
+)
+
+RULE_IDS = tuple(r.RULE_ID for r in ALL_RULES)
+
+
+def select_rules(ids):
+    by_id = {r.RULE_ID: r for r in ALL_RULES}
+    unknown = [i for i in ids if i not in by_id]
+    if unknown:
+        raise KeyError(f"unknown memlint rule(s) {unknown} "
+                       f"(known: {sorted(by_id)})")
+    return [by_id[i] for i in ids]

@@ -6,6 +6,7 @@ from typing import Dict, List, Sequence
 
 from deepspeed_tpu.analysis.rules import (
     config_keys,
+    donation,
     lock_discipline,
     metric_names,
     retracing,
@@ -22,6 +23,7 @@ ALL_RULES = (
     silent_except,
     config_keys,
     metric_names,
+    donation,
 )
 
 RULE_IDS: List[str] = [r.RULE_ID for r in ALL_RULES]

@@ -1,0 +1,166 @@
+"""donation: hot-path jit sites that thread state without donating it.
+
+The bug class: a ``jax.jit``/``pjit`` wrapper whose traced function
+takes the engine's train state (or a FastGen KV pool) as an argument
+but never declares ``donate_argnums``/``donate_argnames`` — XLA then
+keeps the OLD state buffers alive across the call (no
+``input_output_alias`` in the lowered entry), silently doubling
+steady-state HBM residency for the biggest tensors in the program.
+memlint catches the compiled symptom (un-aliased donated leaves); this
+rule catches the SOURCE-level cause before anything compiles.
+
+Heuristics (zero-false-positive posture, like config-key):
+
+* a jit call site is in scope when the wrapped callable is resolvable —
+  a lambda argument, or a name bound by a ``def`` in the same module —
+  and its FIRST parameter is state-shaped by name
+  (:data:`STATE_PARAM_NAMES`: ``state`` / ``pool`` / ``kv_pool`` /
+  ``kv_cache``). ``params`` is deliberately NOT in the set: inference
+  parameters are reused every call and must not be donated.
+* a missing ``donate_argnums``/``donate_argnames`` keyword is a
+  finding; an explicitly EMPTY literal (``donate_argnums=()``) is a
+  finding too (it reads as donation while donating nothing);
+* a NON-literal donate expression (``donate_argnums=donate`` where a
+  branch may resolve to ``()``) is flagged as *conditional* donation —
+  where the undonated branch is deliberate double-buffering (e.g. the
+  ``_offload_param_stream`` branches in ``runtime/engine.py``),
+  suppress with the reason, so every undonated state-threading site in
+  the tree is visibly intentional.
+
+Deliberately-undonated read-only sites (an eager fwd/bwd that returns
+grads while ``apply`` owns the state donation) carry a
+``# dslint: disable=donation`` with the reason, same posture as
+wall-clock's timestamp suppressions.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    enclosing_class,
+    import_aliases,
+    is_jit_wrapper,
+    resolve_call,
+)
+
+RULE_ID = "donation"
+RULE_DOC = ("jax.jit/pjit sites threading engine/KV state without "
+            "donate_argnums (undonated state doubles HBM residency)")
+
+#: first-parameter names that mean "this callable threads mutable
+#: engine/KV state the caller replaces with the result". ``params`` is
+#: excluded on purpose — inference params are reused, never donated.
+STATE_PARAM_NAMES = ("state", "pool", "kv_pool", "kv_cache")
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+def _first_param(fn: ast.AST) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    pos = list(getattr(args, "posonlyargs", []) or []) + list(args.args)
+    if not pos:
+        return None
+    first = pos[0]
+    if first.arg == "self" and len(pos) > 1:
+        first = pos[1]
+    return first.arg
+
+
+def _named_functions(tree: ast.AST) -> Dict[str, ast.AST]:
+    """name -> def, module-wide (lexical scoping is good enough for the
+    heuristic: jit sites wrap functions defined nearby)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _wrapped_first_param(call: ast.Call,
+                         named: Dict[str, ast.AST]) -> Optional[str]:
+    """First parameter name of the callable a jit call wraps, where
+    resolvable (lambda literal or same-module def); None otherwise —
+    unresolvable wrappees are out of scope by design."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return _first_param(target)
+    if isinstance(target, ast.Name) and target.id in named:
+        return _first_param(named[target.id])
+    return None
+
+
+def _donate_kind(call: ast.Call) -> str:
+    """'present' | 'empty' | 'conditional' | 'absent' for the call's
+    donate keyword."""
+    for kw in call.keywords:
+        if kw.arg in _DONATE_KWARGS:
+            val = kw.value
+            if isinstance(val, (ast.Tuple, ast.List)):
+                return "present" if val.elts else "empty"
+            if isinstance(val, ast.Constant):
+                return "present" if val.value not in ((), []) else "empty"
+            return "conditional"
+    return "absent"
+
+
+def check(project: Project):
+    for src in project.files:
+        aliases = import_aliases(src.tree)
+        add_parents(src.tree)
+        named = _named_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = resolve_call(node, aliases)
+            if not is_jit_wrapper(name) or "shard_map" in (name or ""):
+                continue   # shard_map has no donate_argnums
+            first = _wrapped_first_param(node, named)
+            if first not in STATE_PARAM_NAMES:
+                continue
+            kind = _donate_kind(node)
+            if kind == "present":
+                continue
+            cls = enclosing_class(node)
+            where = f"{cls.name}." if cls is not None else ""
+            if kind == "conditional":
+                msg = (f"jit site threads {first!r} with a CONDITIONAL "
+                       "donate_argnums (a branch may donate nothing) — "
+                       "if the undonated branch is deliberate "
+                       "double-buffering, suppress with the reason")
+            else:
+                spelled = ("donate_argnums=() donates nothing"
+                           if kind == "empty" else
+                           "no donate_argnums/donate_argnames")
+                msg = (f"jit site threads {first!r} but {spelled} — "
+                       "undonated state keeps old AND new buffers live "
+                       "(no input_output_alias in the lowered entry), "
+                       "doubling steady-state HBM for the biggest "
+                       "tensors; donate, or suppress with the reason if "
+                       "the state is read-only here")
+            yield Finding(
+                RULE_ID, src.rel_path, node.lineno, msg,
+                anchor=f"donation/{where}{first}/{_site_index(src, node)}",
+                end_line=node.end_lineno or node.lineno)
+
+
+def _site_index(src, node) -> int:
+    """Source-order occurrence index of this jit site among all jit
+    sites in the file (line-number-free baseline keys, wall-clock's
+    anchor discipline)."""
+    cache = getattr(src, "_dslint_donation_sites", None)
+    if cache is None:
+        aliases = import_aliases(src.tree)
+        sites = [n for n in ast.walk(src.tree)
+                 if isinstance(n, ast.Call)
+                 and is_jit_wrapper(resolve_call(n, aliases))]
+        sites.sort(key=lambda n: (n.lineno, n.col_offset))
+        cache = src._dslint_donation_sites = {
+            id(n): i + 1 for i, n in enumerate(sites)}
+    return cache.get(id(node), 0)

@@ -226,6 +226,62 @@ def max_micro_batch(info: ModelInfo, *, hbm_bytes: int, zero_stage: int,
     return max(0, (hbm_bytes - fixed.total) // per_mb)
 
 
+def peak_bytes_from_stats(mem: Any) -> Optional[float]:
+    """Peak HBM of one compiled program from its memory-analysis legs:
+    ``args + temp + output − alias`` (aliased outputs write into their
+    donated arguments' buffers — counting both sides would double the
+    donated state). THE one copy of this formula — the autotuner's
+    refinement, ``compiled_memory_bytes``, and memlint's contract
+    observations all read it, so the pre-flight gate and the pruning
+    model can never disagree about what "peak" means.
+
+    ``mem`` is either a ``CompiledMemoryStats`` object or the
+    observatory's plain-dict view (``ledger.memory_stats_dict``).
+    """
+    if mem is None:
+        return None
+    get = mem.get if isinstance(mem, dict) else \
+        lambda k, d=0.0: getattr(mem, k, d)
+    args = get("argument_size_in_bytes", 0.0) or 0.0
+    temp = get("temp_size_in_bytes", 0.0) or 0.0
+    out = get("output_size_in_bytes", 0.0) or 0.0
+    alias = get("alias_size_in_bytes", 0.0) or 0.0
+    if not (args or temp or out):
+        return None
+    return float(args + temp + out - alias)
+
+
+def predicted_state_bytes_per_device(engine) -> Optional[float]:
+    """Per-device resident-state bytes the ZeRO partitioning math
+    predicts: each state leaf's shard shape (its live NamedSharding)
+    times dtype width — exactly what stage N promises to leave on a
+    chip. THE one copy of this math (the observatory step report and
+    hlolint's/memlint's residency legs all import it);
+    ``memory_analysis().argument_size_in_bytes`` measures what the
+    compiled step actually holds."""
+    try:
+        import jax
+        import numpy as np
+
+        total = 0.0
+        for leaf in jax.tree.leaves(engine.state):
+            sharding = getattr(leaf, "sharding", None)
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                shape = sharding.shard_shape(tuple(shape))
+            total += float(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+        return total
+    except (ImportError, TypeError, ValueError) as e:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.debug(f"ZeRO memory prediction failed "
+                     f"({type(e).__name__}: {e})")
+        return None
+
+
 def compiled_memory_bytes(compiled: Any) -> Optional[int]:
     """Exact HBM need of a compiled step from XLA's memory analysis.
 
@@ -233,11 +289,8 @@ def compiled_memory_bytes(compiled: Any) -> Optional[int]:
     backends; returns None where the backend doesn't report (CPU tests).
     """
     try:
-        ma = compiled.memory_analysis()
-        if ma is None:
-            return None
-        return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
-                   + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+        peak = peak_bytes_from_stats(compiled.memory_analysis())
+        return int(peak) if peak is not None else None
     except Exception as e:
         from deepspeed_tpu.utils.logging import logger
 

@@ -35,7 +35,11 @@ _HIGHER_EXACT = ("value", "mfu", "vs_baseline", "vs_ceiling",
 _HIGHER_SUFFIX = ("gbps",)
 _LOWER_PREFIX = ("ttft_", "tpot_", "e2e_")
 _LOWER_EXACT = ("rel_err", "overhead_factor", "moe_dropped_frac",
-                "peak_host_rss_mb", "peak_bytes_in_use")
+                "peak_host_rss_mb", "peak_bytes_in_use",
+                # compiled-program memory_analysis legs (memlint): the
+                # lowered step's own peak/temp bytes are reproducible
+                # per program, so they diff like perf numbers
+                "device_peak_bytes", "temp_bytes")
 # bytes_in_use is an END-OF-ENTRY allocator snapshot, not a peak — it
 # moves with GC/donation timing run-to-run, so it is shown in rows but
 # never direction-compared (peaks are; they're reproducible)

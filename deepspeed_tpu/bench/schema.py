@@ -137,6 +137,11 @@ def validate_memory(mem: Any, where: str) -> List[str]:
         return [f"{where}: memory must be a dict"]
     if "peak_host_rss_mb" in mem and not is_number(mem["peak_host_rss_mb"]):
         errs.append(f"{where}: memory.peak_host_rss_mb must be a number")
+    for key in ("device_peak_bytes", "temp_bytes"):
+        # compiled-program memory_analysis legs (memlint's bench
+        # satellite): optional, but numbers when present
+        if key in mem and not is_number(mem[key]):
+            errs.append(f"{where}: memory.{key} must be a number")
     if "device" in mem and mem["device"] is not None \
             and not isinstance(mem["device"], dict):
         errs.append(f"{where}: memory.device must be a dict or null")

@@ -69,6 +69,17 @@ def _text_summary(report: Dict[str, Any]) -> str:
             f"{mem['predicted']['state_bytes_per_device'] / 1e6:.1f} MB"
             + (f" (args/predicted = {mem['args_vs_predicted_state']})"
                if "args_vs_predicted_state" in mem else ""))
+    al = mem.get("aliasing")
+    if al:
+        # the memlint memory verdict: donation honored (every donated
+        # state leaf aliased, none doubly) + the compiled peak
+        verdict = "ok" if not al["double_aliased"] else \
+            f"{al['double_aliased']} DOUBLE-ALIASED"
+        lines.append(
+            f"  memory verdict: donation {al['aliased_pairs']}/"
+            f"{al['entry_params']} entry params aliased ({verdict})"
+            + (f", peak {mem['peak_bytes'] / 1e6:.1f} MB "
+               "(args+temp+out-alias)" if "peak_bytes" in mem else ""))
     for phase, row in (report.get("phases") or {}).items():
         dom = (f", dominant: {row['dominant_collective']}"
                if row.get("dominant_collective") else "")

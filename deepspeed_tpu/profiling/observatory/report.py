@@ -171,33 +171,18 @@ def phase_verdicts(ledger: CollectiveLedger,
 
 def _zero_memory_prediction(engine) -> Optional[Dict[str, float]]:
     """Per-device resident-state bytes the ZeRO partitioning math
-    predicts: each state leaf's shard shape (its live NamedSharding)
-    times dtype width. This is exactly what stage N promises to leave on
-    a chip — ``memory_analysis().argument_size_in_bytes`` measures what
-    the compiled step actually holds."""
-    try:
-        import jax
-        import numpy as np
+    predicts — delegates to ``autotuning/memory_model.
+    predicted_state_bytes_per_device``, THE one copy of that math
+    (memlint's residency pass and the autotuner share it)."""
+    from deepspeed_tpu.autotuning.memory_model import (
+        predicted_state_bytes_per_device,
+    )
 
-        total = 0.0
-        leaves = jax.tree.leaves(engine.state)
-        for leaf in leaves:
-            sharding = getattr(leaf, "sharding", None)
-            shape = getattr(leaf, "shape", None)
-            dtype = getattr(leaf, "dtype", None)
-            if shape is None or dtype is None:
-                continue
-            if sharding is not None and hasattr(sharding, "shard_shape"):
-                shape = sharding.shard_shape(tuple(shape))
-            total += float(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
-        return {"state_bytes_per_device": total,
-                "zero_stage": engine.zero_stage}
-    except (ImportError, TypeError, ValueError) as e:
-        from deepspeed_tpu.utils.logging import logger
-
-        logger.debug(f"ZeRO memory prediction failed "
-                     f"({type(e).__name__}: {e})")
+    total = predicted_state_bytes_per_device(engine)
+    if total is None:
         return None
+    return {"state_bytes_per_device": total,
+            "zero_stage": engine.zero_stage}
 
 
 def _tracer_phase_walls() -> Dict[str, float]:
@@ -317,6 +302,13 @@ def step_report(engine,
     memory: Dict[str, Any] = {}
     if mem:
         memory["measured"] = mem
+        from deepspeed_tpu.autotuning.memory_model import (
+            peak_bytes_from_stats,
+        )
+
+        peak = peak_bytes_from_stats(mem)
+        if peak is not None:
+            memory["peak_bytes"] = peak
     predicted = _zero_memory_prediction(engine)
     if predicted:
         memory["predicted"] = predicted
@@ -324,6 +316,20 @@ def step_report(engine,
         if measured_args and predicted["state_bytes_per_device"]:
             memory["args_vs_predicted_state"] = round(
                 measured_args / predicted["state_bytes_per_device"], 3)
+    # memlint's donation evidence, from the SAME retained header text
+    # (tools/step-report renders this as the memory verdict line)
+    try:
+        from deepspeed_tpu.analysis.memlint import observe_hlo
+
+        mobs = observe_hlo(ledger.hlo_text)
+        if mobs.n_params:
+            memory["aliasing"] = {
+                "entry_params": mobs.n_params,
+                "aliased_pairs": mobs.aliased_pairs,
+                "double_aliased": len(mobs.double_aliased),
+            }
+    except (ImportError, ValueError):
+        pass
 
     verdicts = [r["verdict"] for r in phases.values()]
     overall_verdict = (max(set(verdicts), key=verdicts.count)

@@ -315,6 +315,43 @@ class HlolintSectionConfig:
 
 
 @dataclasses.dataclass
+class MemlintSectionConfig:
+    """Compiled-program MEMORY contract enforcement at initialize
+    (``deepspeed_tpu/analysis/memlint`` — hlolint's memory-side
+    sibling; README "Memory contracts").
+
+    ``enabled`` lints the engine's REAL lowered train step once at
+    initialize (the same cached observatory lowering hlolint and the
+    ledger share — no extra compile): donation/aliasing verification
+    over the entry header, residency vs the ZeRO partitioning-math
+    prediction, and the OOM pre-flight gate. ``contract`` names a
+    committed memory contract JSON to hold the step to on top.
+    ``hbm_budget_bytes`` sets the pre-flight budget explicitly — 0
+    (default) means the chip's datasheet HBM capacity
+    (``utils/chip_specs``); the datasheet-less CPU tier arms the gate
+    only from an explicit budget. With ``fail_on_violation`` (default)
+    a violation refuses the job before any chip time is spent."""
+    enabled: bool = False
+    contract: str = ""
+    hbm_budget_bytes: int = 0
+    fail_on_violation: bool = True
+
+    def validate(self) -> None:
+        if self.contract and not isinstance(self.contract, str):
+            raise DeepSpeedConfigError(
+                f"memlint.contract must be a path string, got "
+                f"{type(self.contract).__name__}")
+        if not isinstance(self.hbm_budget_bytes, (int, float)) \
+                or isinstance(self.hbm_budget_bytes, bool) \
+                or self.hbm_budget_bytes < 0:
+            raise DeepSpeedConfigError(
+                "memlint.hbm_budget_bytes must be a non-negative byte "
+                f"count (0 = datasheet capacity), got "
+                f"{self.hbm_budget_bytes!r}")
+        self.hbm_budget_bytes = int(self.hbm_budget_bytes)
+
+
+@dataclasses.dataclass
 class ServingSectionConfig:
     """Serving resilience front-end (``deepspeed_tpu/serving``).
 
@@ -788,6 +825,8 @@ class DeepSpeedTPUConfig:
         default_factory=FleetSectionConfig)
     hlolint: HlolintSectionConfig = dataclasses.field(
         default_factory=HlolintSectionConfig)
+    memlint: MemlintSectionConfig = dataclasses.field(
+        default_factory=MemlintSectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

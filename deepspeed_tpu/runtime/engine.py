@@ -415,6 +415,7 @@ class DeepSpeedTPUEngine:
             f"mesh={self.mesh_manager} micro_bs={self.train_micro_batch_size()} "
             f"gas={self.gradient_accumulation_steps()}")
         self._enforce_hlolint()
+        self._enforce_memlint()
 
     def _enforce_hlolint(self) -> None:
         """Compiled-program contract enforcement at initialize (the
@@ -440,6 +441,54 @@ class DeepSpeedTPUEngine:
                 f"hlolint: {len(findings)} compiled-program contract "
                 f"violation(s) in the lowered train step — first: "
                 f"{findings[0].render()} (set hlolint.fail_on_violation "
+                "false to proceed anyway)")
+
+    def _memlint_budget_bytes(self) -> Optional[float]:
+        """The OOM pre-flight budget: the explicit
+        ``memlint.hbm_budget_bytes`` when set, else the chip's datasheet
+        HBM capacity (``utils/chip_specs``). None on the datasheet-less
+        CPU tier without an explicit budget — the gate stays disarmed
+        there rather than inheriting a TPU part's capacity."""
+        explicit = self.config.memlint.hbm_budget_bytes
+        if explicit:
+            return float(explicit)
+        from deepspeed_tpu.utils.chip_specs import chip_hbm_bytes
+
+        try:
+            kind = getattr(jax.devices()[0], "device_kind", "")
+        except (RuntimeError, IndexError):
+            return None
+        cap = chip_hbm_bytes(kind)
+        return float(cap) if cap else None
+
+    def _enforce_memlint(self) -> None:
+        """Memory-contract enforcement at initialize (the ``"memlint"``
+        config section — hlolint's memory-side sibling): donation/
+        aliasing verification, residency vs the ZeRO prediction, the
+        committed memory contract, and the OOM pre-flight gate. Reuses
+        the SAME cached lowering hlolint/the ledger read; with
+        ``fail_on_violation`` a violation — including a predicted peak
+        over the HBM budget — refuses the job BEFORE any chip time is
+        spent."""
+        mcfg = self.config.memlint
+        if not mcfg.enabled:
+            return
+        findings = self.lint_memory(contract=mcfg.contract or None,
+                                    hbm_budget_bytes=self._memlint_budget_bytes())
+        if not findings:
+            log_dist("memlint: compiled train step memory clean"
+                     + (f" (contract {mcfg.contract})"
+                        if mcfg.contract else ""))
+            return
+        for f in findings:
+            log_dist(f"memlint: {f.render()}")
+        if mcfg.fail_on_violation:
+            from deepspeed_tpu.analysis.memlint import MemLintViolation
+
+            raise MemLintViolation(
+                f"memlint: {len(findings)} memory contract violation(s) "
+                f"in the lowered train step — first: "
+                f"{findings[0].render()} (set memlint.fail_on_violation "
                 "false to proceed anyway)")
 
     # ------------------------------------------------------------------ #
@@ -1307,6 +1356,22 @@ class DeepSpeedTPUEngine:
 
         return lint_engine(self, contract=contract, seq_len=seq_len)
 
+    def lint_memory(self, contract: Optional[str] = None,
+                    seq_len: Optional[int] = None,
+                    hbm_budget_bytes: Optional[float] = None) -> List:
+        """memlint over THIS engine's lowered fused train step — the
+        ``tools/memlint --live`` path in library form (donation/aliasing
+        verification, residency vs the ZeRO partitioning-math
+        prediction, the OOM pre-flight at ``hbm_budget_bytes``, plus a
+        committed memory ``contract`` when named). The linted program
+        is the SAME cached lowering ``lint_step``/the ledger read — a
+        memory lint never pays a second compile. Returns the
+        violations (empty = clean)."""
+        from deepspeed_tpu.analysis.memlint import lint_engine
+
+        return lint_engine(self, contract=contract, seq_len=seq_len,
+                           hbm_budget_bytes=hbm_budget_bytes)
+
     @staticmethod
     def _count_tokens(stacked: PyTree) -> int:
         """Token count of one stacked step window (global batch)."""
@@ -1908,6 +1973,10 @@ class DeepSpeedTPUEngine:
 
         state_sh = self._state_shardings()
         donate = () if self._offload_param_stream else (0,)
+        # offload_param_stream parks the master pinned-host and streams
+        # slices in-program: the device state is a transient copy the
+        # host master outlives, so NOT donating is the deliberate
+        # double-buffer there  # dslint: disable=donation
         return jax.jit(multi,
                        in_shardings=(self._in_state_shardings(), None),
                        out_shardings=(state_sh, None),
@@ -2719,6 +2788,9 @@ class DeepSpeedTPUEngine:
                     params_buf=(state.get("gathered")
                                 if self._param_buffer else None))
 
+            # state is READ-ONLY here (returns loss+grads; the eager
+            # path's apply() owns the state donation); donating would
+            # invalidate self.state mid-window  # dslint: disable=donation
             self._compiled["fwd_bwd"] = jax.jit(fwd_bwd)
         batch = self._shard_batch(batch)
         if self.config.wall_clock_breakdown:
@@ -2810,6 +2882,8 @@ class DeepSpeedTPUEngine:
                 params = self._compute_params(state["master"])
                 return self.model_spec.loss_fn(params, b)
 
+            # eval reads state and returns a scalar loss — donating
+            # would destroy the live train state  # dslint: disable=donation
             self._compiled["eval"] = jax.jit(ev)
         batch = self._shard_batch(batch)
         with self.mesh:
@@ -2833,6 +2907,8 @@ class DeepSpeedTPUEngine:
                 params = self._compute_params(state["master"])
                 return self.model_spec.apply_fn(params, b)
 
+            # predict reads state and returns logits — donating would
+            # destroy the live train state  # dslint: disable=donation
             self._compiled["predict"] = jax.jit(pr)
         batch = self._shard_batch(batch)
         with self.mesh:
@@ -3090,7 +3166,10 @@ class DeepSpeedTPUEngine:
                     norm = norm / scale
                 return {"loss": loss, "grad_norm": norm}
 
-            self._compiled["probe"] = jax.jit(probe)
+            # probe_microbatch is side-effect-free BY CONTRACT (the
+            # guardian bisect replays batches against it) — donation
+            # would mutate the state it promises to leave untouched
+            self._compiled["probe"] = jax.jit(probe)  # dslint: disable=donation
         self._materialize_master()
         batch = self._shard_batch(micro)
         with self.mesh:

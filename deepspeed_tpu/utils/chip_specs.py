@@ -18,6 +18,18 @@ PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5 lite": 197.0,
 HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5 lite": 819.0,
             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
 
+_GiB = 1024 ** 3
+
+# HBM CAPACITY bytes per chip, by TPU generation — the referent for the
+# memlint OOM pre-flight gate (a predicted peak over this refuses the
+# job before any chip time is spent). CPU hosts have no datasheet row:
+# the gate there arms only from an explicit memlint.hbm_budget_bytes.
+# v5p's datasheet 95 is decimal GB, not GiB — reading it as GiB would
+# overstate the budget ~7.4 GB and let the gate pass a job that OOMs.
+HBM_CAPACITY_BYTES = {"v4": 32 * _GiB, "v5e": 16 * _GiB,
+                      "v5 lite": 16 * _GiB, "v5p": 95 * 10 ** 9,
+                      "v6e": 32 * _GiB, "v6 lite": 32 * _GiB}
+
 
 def chip_peak_tflops(device_kind: str,
                      default: Optional[float] = None) -> Optional[float]:
@@ -38,4 +50,17 @@ def chip_hbm_gbps(device_kind: str,
     for key, bw in HBM_GBPS.items():
         if key in kind:
             return bw
+    return default
+
+
+def chip_hbm_bytes(device_kind: str,
+                   default: Optional[int] = None) -> Optional[int]:
+    """Datasheet HBM capacity bytes for a PJRT ``device_kind``;
+    ``default`` (usually None) when unrecognized — the datasheet-less
+    CPU tier must opt in with an explicit budget, never inherit a TPU
+    part's capacity."""
+    kind = (device_kind or "").lower()
+    for key, cap in HBM_CAPACITY_BYTES.items():
+        if key in kind:
+            return cap
     return default

@@ -13,9 +13,10 @@ setup(
     description="TPU-native distributed training & inference framework "
                 "(DeepSpeed-compatible API on JAX/XLA/Pallas)",
     packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
-    # the committed compiled-program contracts hlolint enforces
-    # (analysis/hlolint/contracts/*.json) ship with the package
-    package_data={"deepspeed_tpu.analysis.hlolint": ["contracts/*.json"]},
+    # the committed compiled-program contracts hlolint/memlint enforce
+    # (analysis/{hlolint,memlint}/contracts/*.json) ship with the package
+    package_data={"deepspeed_tpu.analysis.hlolint": ["contracts/*.json"],
+                  "deepspeed_tpu.analysis.memlint": ["contracts/*.json"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "orbax-checkpoint", "einops"],
     extras_require={
@@ -29,6 +30,7 @@ setup(
             "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
             "dslint=deepspeed_tpu.analysis.__main__:main",
             "hlolint=deepspeed_tpu.analysis.hlolint.__main__:main",
+            "memlint=deepspeed_tpu.analysis.memlint.__main__:main",
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
             "bench-diff=deepspeed_tpu.bench.cli:main",
             "step-report=deepspeed_tpu.profiling.observatory.__main__:main",

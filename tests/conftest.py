@@ -97,6 +97,15 @@ def pytest_configure(config):
         "observatory_fixtures/*.hlo.txt are enforced against "
         "analysis/hlolint/contracts/ here)")
     config.addinivalue_line(
+        "markers", "memlint: compiled-program MEMORY contract-checker "
+        "tests (donation/aliasing verification over the committed HLO "
+        "fixtures' entry headers, residency vs the ZeRO prediction, "
+        "shrink-only memory contracts, the OOM pre-flight refusal at "
+        "initialize, the PR-14 double-donation shape caught statically "
+        "— tier-1-eligible under JAX_PLATFORMS=cpu; the seven committed "
+        "observatory_fixtures/*.hlo.txt are enforced against "
+        "analysis/memlint/contracts/ here)")
+    config.addinivalue_line(
         "markers", "overload: serving burst/shedding tests (CPU backend, "
         "tier-1-eligible). Each runs under a SIGALRM per-test timeout "
         "(default 120s; overload(timeout_s=N) overrides) so a Python-level "

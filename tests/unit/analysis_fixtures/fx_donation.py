@@ -1,0 +1,44 @@
+"""donation fixture (parsed by dslint tests, never imported)."""
+import jax
+
+
+def step_fn(state, batch):
+    return state, batch
+
+
+def loss_fn(params, batch):
+    return params, batch
+
+
+def make_bad():
+    return jax.jit(step_fn)                       # finding: absent
+
+
+def make_bad_lambda():
+    return jax.jit(lambda state, b: (state, b))   # finding: absent
+
+
+def make_bad_empty():
+    return jax.jit(step_fn, donate_argnums=())    # finding: empty
+
+
+def make_conditional(stream):
+    donate = () if stream else (0,)
+    return jax.jit(step_fn, donate_argnums=donate)   # finding: conditional
+
+
+def make_ok():
+    return jax.jit(step_fn, donate_argnums=(0,))  # ok: donated
+
+
+def make_ok_params():
+    return jax.jit(loss_fn)                       # ok: params are reused
+
+
+def make_ok_suppressed():
+    # read-only state: apply() owns the donation  # dslint: disable=donation
+    return jax.jit(step_fn)
+
+
+def make_ok_unresolvable(fn):
+    return jax.jit(fn)                            # ok: wrappee unknown

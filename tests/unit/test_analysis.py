@@ -122,6 +122,36 @@ class TestWallClock:
                            "time.time/interval_bad/2"]
 
 
+class TestDonation:
+    def test_findings(self):
+        # absent / lambda-absent / empty-literal / conditional all fire;
+        # donated, params-first, suppressed, and unresolvable sites don't
+        fs = _lint_fixture("fx_donation.py", "donation")
+        by_line = {}
+        src = open(os.path.join(FIXTURES, "fx_donation.py")).read()
+        for i, ln in enumerate(src.splitlines(), 1):
+            if "# finding" in ln or "# ok" in ln:
+                by_line[i] = ln
+        flagged = {f.line for f in fs}
+        expect_flagged = {i for i, ln in by_line.items()
+                          if "# finding" in ln}
+        expect_clean = {i for i, ln in by_line.items() if "# ok" in ln}
+        assert flagged == expect_flagged, (flagged, expect_flagged)
+        assert not flagged & expect_clean
+
+    def test_conditional_message_names_suppression_path(self):
+        fs = _lint_fixture("fx_donation.py", "donation")
+        conditional = [f for f in fs if "CONDITIONAL" in f.message]
+        assert len(conditional) == 1
+        assert "suppress with the reason" in conditional[0].message
+
+    def test_anchors_are_line_number_free_and_distinct(self):
+        fs = _lint_fixture("fx_donation.py", "donation")
+        anchors = [f.anchor for f in fs]
+        assert len(anchors) == len(set(anchors))
+        assert all(a.startswith("donation/") for a in anchors)
+
+
 class TestSilentExcept:
     def test_findings(self):
         fs = _lint_fixture("fx_silent_except.py", "silent-except")
@@ -193,6 +223,27 @@ class TestConfigKeys:
         # consumed too (they are — by this section among others)
         generic = consumed_attr_keys(proj, {"enabled", "contract"})
         assert generic == {"enabled", "contract"}
+
+    def test_memlint_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for the memory contract checker (ISSUE 15):
+        # the "memlint" section's keys must stay OUT of the dead-key
+        # ledger and stay actually consumed (the engine reads them in
+        # _enforce_memlint/_memlint_budget_bytes — dropping the read
+        # would silently turn the OOM pre-flight decorative)
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        memlint_keys = {"memlint", "hbm_budget_bytes"}
+        assert not memlint_keys & set(DEAD_KEYS), (
+            "memlint section keys declared dead — runtime/engine.py "
+            "consumes them (_enforce_memlint/_memlint_budget_bytes)")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, memlint_keys)
+        assert consumed == memlint_keys, (
+            f"memlint keys no longer consumed: "
+            f"{memlint_keys - consumed}")
 
     def test_dead_key_ledger_entries_are_actually_dead(self):
         # every DEAD_KEYS entry must be honest: not read as a config attr

@@ -1,0 +1,718 @@
+"""memlint — compiled-program memory contract checker (ISSUE 15).
+
+Four layers of coverage, mirroring test_hlolint.py (the collective-side
+sibling):
+
+1. Entry-header parsing + the rule passes over synthetic headers and
+   the committed fixtures: donation (un-aliased donated leaves),
+   double-donation (one buffer under two donated leaves — the PR 14
+   ``Execute()`` abort shape, caught statically with the leaf path
+   named), residency (args vs the ZeRO prediction; analytic-estimate
+   blowup), oom-preflight.
+2. The memory contract system: observation extraction, floor/ceiling
+   directions, deferred live-tier bounds (never silently clean), and
+   the shrink-only refusal matrix (loosened ceiling / lowered floor /
+   dropped bound all refused; tighten + ``--allow-loosen`` pass).
+3. The committed seven-fixture/seven-contract enforcement + the CLI
+   exit-code matrix (subprocess): clean=0, seeded tightened-ceiling
+   violation=1 with contract/observed numbers, unreadable=2,
+   ``--write-contract`` bootstrap.
+4. Live enforcement: ``engine.lint_memory`` over the real lowered step,
+   the ``"memlint"`` config section's OOM pre-flight refusing
+   initialize BEFORE dispatch, the PR-14 aliasing shape seeded in a
+   subprocess, and bench.py's refuse-to-record gate
+   (``BENCH_MEMLINT=0`` override).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.memlint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "observatory_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+MEMLINT = os.path.join(REPO_ROOT, "tools", "memlint")
+
+
+def fixture_path(stem):
+    return os.path.join(FIXTURES, stem + ".hlo.txt")
+
+
+def fixture_text(stem):
+    with open(fixture_path(stem)) as f:
+        return f.read()
+
+
+def committed_contract(stem):
+    from deepspeed_tpu.analysis.memlint import contracts_dir
+
+    return os.path.join(contracts_dir(), stem + ".json")
+
+
+def run_cli(*args, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, MEMLINT, *args],
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO_ROOT, timeout=300)
+
+
+#: a synthetic module header: 3 params (2 donated+aliased, 1 batch),
+#: 4 outputs (2 aliased back, 2 fresh metrics)
+HEADER = (
+    "HloModule jit_train_step, is_scheduled=true, input_output_alias={ "
+    "{0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, "
+    "entry_computation_layout={(f32[8,4]{1,0}, f32[16]{0}, "
+    "s32[2,8]{1,0})->(f32[8,4]{1,0}, f32[16]{0}, f32[], f32[])}, "
+    "num_partitions=8\n")
+
+#: same layout, but output {1} re-aliases param 0 — one donated buffer
+#: claimed by two outputs
+HEADER_DOUBLE = HEADER.replace(
+    "{1}: (1, {}, may-alias)", "{1}: (0, {}, may-alias)")
+
+#: donation dropped entirely
+HEADER_NO_ALIAS = (
+    "HloModule jit_train_step, is_scheduled=true, "
+    "entry_computation_layout={(f32[8,4]{1,0}, f32[16]{0}, "
+    "s32[2,8]{1,0})->(f32[8,4]{1,0}, f32[16]{0}, f32[], f32[])}, "
+    "num_partitions=8\n")
+
+
+# --------------------------------------------------------------------- #
+# entry-header parsing
+# --------------------------------------------------------------------- #
+class TestHeaderParsing:
+    def test_alias_entries_and_layout_bytes(self):
+        from deepspeed_tpu.analysis.memlint import (
+            parse_entry_layout,
+            parse_input_output_alias,
+        )
+
+        aliases = parse_input_output_alias(HEADER)
+        assert [(a.output_index, a.param) for a in aliases] == \
+            [((0,), 0), ((1,), 1)]
+        assert all(a.kind == "may-alias" for a in aliases)
+        params, outputs = parse_entry_layout(HEADER)
+        assert params == [8 * 4 * 4, 16 * 4, 2 * 8 * 4]
+        assert outputs == [8 * 4 * 4, 16 * 4, 4, 4]
+
+    def test_observations(self):
+        from deepspeed_tpu.analysis.memlint import observe_hlo
+
+        obs = observe_hlo(HEADER)
+        assert obs.n_params == 3 and obs.n_outputs == 4
+        assert obs.args_bytes == 128 + 64 + 64
+        assert obs.output_bytes == 128 + 64 + 8
+        assert obs.aliased_pairs == 2 and obs.aliased_params == 2
+        assert obs.aliased_bytes == 128 + 64
+        assert obs.double_aliased == []
+        assert obs.resident_bytes == \
+            obs.args_bytes + obs.output_bytes - obs.aliased_bytes
+
+    def test_double_alias_detected(self):
+        from deepspeed_tpu.analysis.memlint import observe_hlo
+
+        obs = observe_hlo(HEADER_DOUBLE)
+        assert obs.double_aliased == [0]
+
+    def test_committed_fixtures_donate_everything_but_the_batch(self):
+        # every committed fixture donates its whole state tree: exactly
+        # one entry parameter (the tokens batch) stays un-aliased
+        from deepspeed_tpu.analysis.memlint import observe_hlo
+
+        for name in sorted(os.listdir(FIXTURES)):
+            if not name.endswith(".hlo.txt"):
+                continue
+            obs = observe_hlo(fixture_text(name[:-len(".hlo.txt")]))
+            assert obs.n_params - obs.aliased_params == 1, name
+            assert obs.double_aliased == [], name
+            assert obs.args_bytes > 0 and obs.output_bytes > 0, name
+
+
+# --------------------------------------------------------------------- #
+# rule passes
+# --------------------------------------------------------------------- #
+def _lint_text(text, **cfg_kwargs):
+    from deepspeed_tpu.analysis.memlint import (
+        MemLintConfig,
+        lint_hlo_memory,
+    )
+
+    return lint_hlo_memory(text, MemLintConfig(program="t", **cfg_kwargs))
+
+
+class TestDonationRule:
+    def test_unaliased_donated_leaves_fire_with_numbers(self):
+        # the config says 2 donated leaves; header aliases 2 — clean
+        assert not [f for f in _lint_text(HEADER, donated_params=2)
+                    if f.rule == "donation"]
+        # claiming 3 donated leaves means one was never aliased
+        fs = [f for f in _lint_text(HEADER, donated_params=3)
+              if f.rule == "donation"]
+        assert len(fs) == 1
+        assert fs[0].limit == 3 and fs[0].observed == 2
+
+    def test_zero_alias_regression_fires(self):
+        fs = [f for f in _lint_text(HEADER_NO_ALIAS)
+              if f.rule == "donation"]
+        assert fs and "aliases NOTHING" in fs[0].message
+
+    def test_no_donation_config_is_silent(self):
+        fs = _lint_text(HEADER_NO_ALIAS, expect_donation=False)
+        assert not [f for f in fs
+                    if f.rule in ("donation", "double-donation")]
+
+
+class TestDoubleDonationRule:
+    def test_param_aliased_twice_fires(self):
+        fs = [f for f in _lint_text(HEADER_DOUBLE)
+              if f.rule == "double-donation"]
+        assert len(fs) == 1 and "parameter 0" in fs[0].message
+
+    def test_duplicate_buffer_leaves_name_paths(self):
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            iter_rule_findings,
+            observe_hlo,
+        )
+
+        obs = observe_hlo(HEADER)
+        obs.duplicate_buffer_leaves = [
+            ("['gathered']['w']", "['master']['w']")]
+        fs = [f for f in iter_rule_findings(obs, MemLintConfig())
+              if f.rule == "double-donation"]
+        assert len(fs) == 1
+        assert "['gathered']['w']" in fs[0].message
+        assert "['master']['w']" in fs[0].message
+        assert "donate the same buffer twice" in fs[0].message
+
+
+class TestResidencyRule:
+    def test_args_over_predicted_ceiling_fires(self):
+        fs = [f for f in _lint_text(
+            HEADER, donated_params=2, predicted_state_bytes=100.0,
+            args_vs_predicted_max=2.0) if f.rule == "residency"]
+        assert len(fs) == 1
+        assert fs[0].limit == 2.0 and fs[0].observed == 2.56
+        # a generous ceiling is clean
+        assert not [f for f in _lint_text(
+            HEADER, donated_params=2, predicted_state_bytes=100.0,
+            args_vs_predicted_max=3.0) if f.rule == "residency"]
+
+    def test_estimate_blowup_fires(self):
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            iter_rule_findings,
+            observe_hlo,
+        )
+
+        obs = observe_hlo(HEADER)
+        obs.model_estimate_bytes = 10.0
+        obs.peak_bytes = 10_000.0
+        fs = [f for f in iter_rule_findings(
+            obs, MemLintConfig(donated_params=2))
+            if f.rule == "residency"]
+        assert fs and "memory-model estimate" in fs[0].message
+
+
+class TestOomPreflight:
+    def test_budget_below_peak_refuses(self):
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            iter_rule_findings,
+            observe_hlo,
+        )
+
+        obs = observe_hlo(HEADER)
+        obs.peak_bytes = 10_000.0
+        fs = [f for f in iter_rule_findings(
+            obs, MemLintConfig(donated_params=2,
+                               hbm_budget_bytes=1_000.0))
+            if f.rule == "oom-preflight"]
+        assert len(fs) == 1
+        assert fs[0].limit == 1000 and fs[0].observed == 10000
+        assert "memory_analysis peak" in fs[0].message
+
+    def test_no_budget_disarms(self):
+        fs = [f for f in _lint_text(HEADER, donated_params=2)
+              if f.rule == "oom-preflight"]
+        assert not fs
+
+    def test_text_tier_falls_back_to_header_bytes(self):
+        fs = [f for f in _lint_text(HEADER, donated_params=2,
+                                    hbm_budget_bytes=10.0)
+              if f.rule == "oom-preflight"]
+        assert fs and "entry header" in fs[0].message
+
+
+# --------------------------------------------------------------------- #
+# contracts
+# --------------------------------------------------------------------- #
+class TestContracts:
+    def _obs(self):
+        from deepspeed_tpu.analysis.memlint import observe_hlo
+
+        return observe_hlo(HEADER)
+
+    def test_floor_and_ceiling_directions(self):
+        from deepspeed_tpu.analysis.memlint import check_contract
+
+        obs = self._obs()
+        fs, deferred = check_contract(
+            obs, {"args_bytes_max": 100, "aliased_pairs_min": 5}, "t")
+        assert {f.message.split()[0] for f in fs} == \
+            {"args_bytes", "aliased_pairs"}
+        assert not deferred
+        fs, _ = check_contract(
+            obs, {"args_bytes_max": 10_000, "aliased_pairs_min": 1}, "t")
+        assert fs == []
+
+    def test_live_tier_bounds_defer_on_text(self):
+        from deepspeed_tpu.analysis.memlint import check_contract
+
+        fs, deferred = check_contract(
+            self._obs(), {"peak_bytes_max": 1, "temp_bytes_max": 1}, "t")
+        assert fs == []
+        assert sorted(deferred) == ["peak_bytes_max", "temp_bytes_max"]
+
+    def test_unknown_bound_key_is_loud(self):
+        from deepspeed_tpu.analysis.memlint import (
+            ContractError,
+            check_contract,
+        )
+
+        with pytest.raises(ContractError, match="unknown bound key"):
+            check_contract(self._obs(), {"args_bytez_max": 1}, "t")
+
+    def test_bootstrap_pins_current_numbers(self):
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            bootstrap_contract,
+            check_contract,
+        )
+
+        obs = self._obs()
+        doc = bootstrap_contract(obs, MemLintConfig(
+            program="t", world=8, donated_params=2))
+        body = doc["contract"]
+        assert body["args_bytes_max"] == obs.args_bytes
+        assert body["aliased_pairs_min"] == obs.aliased_pairs
+        assert "peak_bytes_max" not in body   # not observed → not pinned
+        fs, _ = check_contract(obs, body, "t")
+        assert fs == []
+
+    def test_write_contract_is_shrink_only(self, tmp_path):
+        # the refusal matrix: loosened ceiling, lowered floor, and
+        # dropped bound are all refused; tightening and --allow-loosen
+        # pass
+        from deepspeed_tpu.analysis.memlint import (
+            ContractError,
+            MemLintConfig,
+            bootstrap_contract,
+            write_contract,
+        )
+
+        obs = self._obs()
+        doc = bootstrap_contract(obs, MemLintConfig(program="t",
+                                                    donated_params=2))
+        path = str(tmp_path / "c.json")
+        write_contract(path, doc)
+
+        import copy
+
+        loosened = copy.deepcopy(doc)
+        loosened["contract"]["args_bytes_max"] += 1
+        with pytest.raises(ContractError, match="args_bytes_max"):
+            write_contract(path, loosened)
+
+        lowered = copy.deepcopy(doc)
+        lowered["contract"]["aliased_pairs_min"] -= 1
+        with pytest.raises(ContractError, match="aliased_pairs_min"):
+            write_contract(path, lowered)
+
+        dropped = copy.deepcopy(doc)
+        del dropped["contract"]["aliased_pairs_min"]
+        with pytest.raises(ContractError, match="dropped"):
+            write_contract(path, dropped)
+
+        tightened = copy.deepcopy(doc)
+        tightened["contract"]["args_bytes_max"] -= 1
+        write_contract(path, tightened)     # tighter: fine
+        write_contract(path, loosened, allow_loosen=True)  # explicit
+
+    def test_malformed_contract_is_loud(self, tmp_path):
+        from deepspeed_tpu.analysis.memlint import (
+            ContractError,
+            load_contract,
+        )
+
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        with pytest.raises(ContractError, match="malformed"):
+            load_contract(str(p))
+        p.write_text(json.dumps({"version": 99, "contract": {}}))
+        with pytest.raises(ContractError, match="malformed"):
+            load_contract(str(p))
+
+
+class TestLiveDeferredBounds:
+    def test_live_unobservable_bound_is_a_finding_not_silent(
+            self, monkeypatch, tmp_path):
+        # the live tier is the enforcement point text lints defer to —
+        # a peak ceiling the backend can't observe (no memory_analysis
+        # number) must come back as a violation there, never vanish
+        import deepspeed_tpu.analysis.memlint as ml
+
+        obs = ml.observe_hlo(HEADER)     # text tier: peak/temp None
+        monkeypatch.setattr(ml, "engine_observations",
+                            lambda engine, seq_len=None: obs)
+
+        class _Eng:
+            dp_world_size = 8
+            zero_stage = 3
+            state = {"w": 1.0}
+
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({
+            "version": 1, "program": "t", "config": {},
+            "contract": {"peak_bytes_max": 123}}))
+        found = ml.lint_engine(_Eng(), contract=str(p))
+        hits = [f for f in found if f.rule == "contract"
+                and "unobservable" in f.message]
+        assert hits and hits[0].limit == 123, \
+            [f.render() for f in found]
+
+
+class TestCommittedContracts:
+    def test_every_fixture_has_a_memory_contract_and_lints_clean(self):
+        # the tier-1 teeth: all seven committed fixture/contract pairs
+        from deepspeed_tpu.analysis.memlint import (
+            fixture_pairs,
+            lint_fixture,
+        )
+
+        pairs = fixture_pairs(FIXTURES)
+        assert len(pairs) == 7
+        for hlo_path, contract_path in pairs:
+            fs = lint_fixture(hlo_path, contract_path)
+            assert fs == [], (hlo_path, [f.render() for f in fs])
+
+    def test_contracts_pin_the_residency_ceiling(self):
+        # every committed sidecar pins the generation-time prediction so
+        # the args_vs_predicted ceiling enforces WITHOUT an engine
+        from deepspeed_tpu.analysis.memlint import load_contract
+
+        for stem in ("zero2_tiny_step", "zero3_tiny_step"):
+            data = load_contract(committed_contract(stem))
+            assert data["config"]["predicted_state_bytes"] > 0
+            assert data["contract"]["args_vs_predicted_max"] > 0
+            assert data["config"]["donated_params"] == \
+                data["contract"]["aliased_pairs_min"]
+
+    def test_unpaired_fixture_is_loud(self, tmp_path):
+        from deepspeed_tpu.analysis.memlint import (
+            ContractError,
+            fixture_pairs,
+        )
+
+        fdir = tmp_path / "fx"
+        fdir.mkdir()
+        (fdir / "orphan.hlo.txt").write_text(HEADER)
+        with pytest.raises(ContractError, match="without a contract"):
+            fixture_pairs(str(fdir))
+
+
+# --------------------------------------------------------------------- #
+# CLI exit-code matrix (subprocess)
+# --------------------------------------------------------------------- #
+class TestCli:
+    def test_fixtures_mode_clean_exit_0(self):
+        proc = run_cli("--fixtures")
+        assert proc.returncode == 0, proc.stderr
+        assert "clean (7 program(s))" in proc.stdout
+
+    def test_tightened_ceiling_seeds_violation_exit_1(self, tmp_path):
+        # the acceptance leg: a seeded tightened ceiling exits 1 naming
+        # the rule and the contract=/observed= numbers
+        data = json.load(open(committed_contract("zero3_tiny_step")))
+        data["contract"]["args_bytes_max"] = 1
+        bad = tmp_path / "zero3_tiny_step.json"
+        bad.write_text(json.dumps(data))
+        proc = run_cli(fixture_path("zero3_tiny_step"),
+                       "--contract", str(bad))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "[contract]" in proc.stderr
+        assert "contract=1" in proc.stderr
+        assert "observed=" in proc.stderr
+
+    def test_unaliased_donation_violation_exit_1(self):
+        # claiming more donated leaves than the header aliases = the
+        # silent-donation-regression shape, named with numbers
+        proc = run_cli(fixture_path("zero3_tiny_step"),
+                       "--donated-params", "99")
+        assert proc.returncode == 1
+        assert "[donation]" in proc.stderr
+        assert "contract=99" in proc.stderr and "observed=62" in proc.stderr
+
+    def test_unreadable_hlo_exit_2(self):
+        proc = run_cli("/nonexistent/step.hlo.txt")
+        assert proc.returncode == 2
+        assert "error" in proc.stderr
+
+    def test_unreadable_contract_exit_2(self, tmp_path):
+        p = tmp_path / "broken.json"
+        p.write_text("{nope")
+        proc = run_cli(fixture_path("zero3_tiny_step"),
+                       "--contract", str(p))
+        assert proc.returncode == 2
+
+    def test_nothing_to_lint_exit_2(self):
+        proc = run_cli()
+        assert proc.returncode == 2
+
+    def test_write_contract_bootstrap_then_enforce(self, tmp_path):
+        out = tmp_path / "c.json"
+        proc = run_cli(fixture_path("zero2_tiny_step"),
+                       "--world", "8", "--zero-stage", "2",
+                       "--donated-params", "62",
+                       "--write-contract", str(out))
+        assert proc.returncode == 0, proc.stderr
+        assert out.exists()
+        proc = run_cli(fixture_path("zero2_tiny_step"),
+                       "--contract", str(out))
+        assert proc.returncode == 0, proc.stderr
+        # the freshly-bootstrapped contract refuses to loosen
+        data = json.load(open(out))
+        data["contract"]["args_bytes_max"] += 1
+        loose = tmp_path / "loose.hlo.txt"
+        loose.write_text(fixture_text("zero2_tiny_step"))
+        proc = run_cli(str(loose), "--world", "8",
+                       "--write-contract", str(out))
+        assert proc.returncode in (0, 2)   # identical numbers: no loosen
+
+    def test_list_rules_and_json_format(self):
+        proc = run_cli("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("donation", "double-donation", "residency",
+                     "oom-preflight", "contract"):
+            assert rule in proc.stdout
+        proc = run_cli("--fixtures", "--format", "json")
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is True and doc["programs"] == 7
+        assert doc["deferred_bounds"] == []
+
+
+# --------------------------------------------------------------------- #
+# live enforcement
+# --------------------------------------------------------------------- #
+def _tiny_cfg(zero, **extra):
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+           "zero_optimization": zero, "steps_per_print": 10 ** 9}
+    cfg.update(extra)
+    return cfg
+
+
+_SMALL = dict(dtype="float32", hidden_size=32, num_layers=2,
+              num_heads=2, max_seq_len=16, vocab_size=64)
+
+
+class TestLiveEngine:
+    @pytest.mark.slow
+    def test_lint_memory_clean_on_zero3(self):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec,
+                                    config=_tiny_cfg({"stage": 3}))
+        found = engine.lint_memory(seq_len=16)
+        assert found == [], [f.render() for f in found]
+
+    def test_oom_preflight_refuses_at_initialize_before_dispatch(self):
+        # the acceptance leg: hbm_budget_bytes below the predicted peak
+        # refuses the job at initialize — no train step ever dispatches
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.analysis.memlint import MemLintViolation
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        with pytest.raises(MemLintViolation, match="oom-preflight"):
+            dst.initialize(model=spec, config=_tiny_cfg(
+                {"stage": 2},
+                memlint={"enabled": True, "hbm_budget_bytes": 1000}))
+
+    @pytest.mark.slow
+    def test_oom_preflight_fail_on_violation_false_proceeds(self):
+        # fail_on_violation=False logs the violation and proceeds
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec, config=_tiny_cfg(
+            {"stage": 2},
+            memlint={"enabled": True, "hbm_budget_bytes": 1000,
+                     "fail_on_violation": False}))
+        assert engine is not None
+
+    @pytest.mark.slow
+    def test_memlint_section_clean_under_datasheet_budget(self):
+        # on the datasheet-less CPU tier with no explicit budget the
+        # pre-flight stays disarmed and a healthy engine passes clean
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec, config=_tiny_cfg(
+            {"stage": 2}, memlint={"enabled": True}))
+        assert engine is not None
+        assert engine._memlint_budget_bytes() is None
+
+    @pytest.mark.slow
+    def test_live_contract_roundtrip_and_tighten(self, tmp_path):
+        # bootstrap a contract FROM the live program (live-tier bounds
+        # included on this backend), enforce clean, then tighten the
+        # peak ceiling → violation with numbers
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            bootstrap_contract,
+            engine_observations,
+            write_contract,
+        )
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec,
+                                    config=_tiny_cfg({"stage": 3}))
+        import jax
+
+        obs = engine_observations(engine, seq_len=16)
+        assert obs.peak_bytes and obs.temp_bytes is not None
+        cfg = MemLintConfig(
+            program="train_step", world=engine.dp_world_size,
+            zero_stage=3,
+            donated_params=len(jax.tree.leaves(engine.state)))
+        doc = bootstrap_contract(obs, cfg)
+        assert "peak_bytes_max" in doc["contract"]
+        assert "temp_bytes_max" in doc["contract"]
+        path = tmp_path / "live.json"
+        write_contract(str(path), doc)
+        found = engine.lint_memory(contract=str(path), seq_len=16)
+        assert found == [], [f.render() for f in found]
+        doc["contract"]["peak_bytes_max"] = 1
+        path2 = tmp_path / "tight.json"
+        write_contract(str(path2), doc)
+        found = engine.lint_memory(contract=str(path2), seq_len=16)
+        assert any(f.rule == "contract" and f.limit == 1
+                   for f in found), [f.render() for f in found]
+
+    def test_bench_gate_in_process_override(self, monkeypatch, tmp_path):
+        # the real bench.py memlint gate: violating contract raises the
+        # refuse-to-record error; BENCH_MEMLINT=0 disarms; an
+        # explicitly-named unreadable contract fails the row
+        import importlib.util
+
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        sp = importlib.util.spec_from_file_location(
+            "_bench_mod_memlint", os.path.join(REPO_ROOT, "bench.py"))
+        bench = importlib.util.module_from_spec(sp)
+        sp.loader.exec_module(bench)
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec,
+                                    config=_tiny_cfg({"stage": 2}))
+        # a contract with an impossible floor
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({
+            "version": 1, "program": "train_step", "config": {},
+            "contract": {"aliased_pairs_min": 10 ** 6}}))
+        monkeypatch.setenv("BENCH_MEMLINT_CONTRACT", str(bad))
+        monkeypatch.delenv("BENCH_MEMLINT", raising=False)
+        with pytest.raises(RuntimeError, match="refusing to record"):
+            bench._memlint_entry_gate(engine, 16)
+        monkeypatch.setenv("BENCH_MEMLINT", "0")
+        assert bench._memlint_entry_gate(engine, 16) is None
+        monkeypatch.delenv("BENCH_MEMLINT", raising=False)
+        monkeypatch.delenv("BENCH_MEMLINT_CONTRACT", raising=False)
+        assert bench._memlint_entry_gate(engine, 16) is None
+        monkeypatch.setenv("BENCH_MEMLINT_CONTRACT", "/nope/typo.json")
+        with pytest.raises(RuntimeError, match="cannot enforce"):
+            bench._memlint_entry_gate(engine, 16)
+
+    @pytest.mark.slow
+    def test_step_report_carries_the_aliasing_block(self):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.comm.mesh import reset_mesh
+
+        reset_mesh()
+        spec = dst.causal_lm_spec("tiny", **_SMALL)
+        engine, *_ = dst.initialize(model=spec,
+                                    config=_tiny_cfg({"stage": 3}))
+        report = engine.step_report(seq_len=16, fold=False)
+        al = report["memory"].get("aliasing")
+        assert al and al["aliased_pairs"] >= al["entry_params"] - 1
+        assert al["double_aliased"] == 0
+        assert report["memory"].get("peak_bytes", 0) > 0
+
+
+#: subprocess body: seed the PR 14 aliasing shape — state['gathered']
+#: refreshed with a NO-OP same-dtype cast, which ALIASES the master
+#: leaves instead of copying — and prove memlint reports it statically
+#: with the leaf path named, BEFORE Execute would abort.
+_PR14_CHILD = r"""
+import jax
+import deepspeed_tpu as dst
+
+config = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+          "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 3}, "steps_per_print": 10 ** 9}
+spec = dst.causal_lm_spec("tiny", dtype="float32", hidden_size=32,
+                          num_layers=2, num_heads=2, max_seq_len=16,
+                          vocab_size=64)
+engine, *_ = dst.initialize(model=spec, config=config)
+assert "gathered" in engine.state, "double buffer absent on this config"
+clean = engine.lint_memory(seq_len=16)
+assert clean == [], [f.render() for f in clean]
+# the bug PR 14 live-repro'd: a no-op cast in the buffer refresh
+engine.state["gathered"] = jax.tree.map(lambda p: p.astype(p.dtype),
+                                        engine.state["master"])
+found = engine.lint_memory(seq_len=16)
+dd = [f for f in found if f.rule == "double-donation"]
+assert dd, [f.render() for f in found]
+assert any("['gathered']" in f.message and "['master']" in f.message
+           for f in dd), [f.render() for f in dd]
+assert any("donate the same buffer twice" in f.message for f in dd)
+print("PR14-SHAPE-CAUGHT", len(dd))
+"""
+
+
+@pytest.mark.slow
+class TestPr14AliasingShape:
+    def test_memlint_catches_the_abort_statically(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   JAX_THREEFRY_PARTITIONABLE="true")
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        proc = subprocess.run([sys.executable, "-c", _PR14_CHILD],
+                              capture_output=True, text=True, env=env,
+                              cwd=REPO_ROOT, timeout=480)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "PR14-SHAPE-CAUGHT" in proc.stdout

@@ -134,6 +134,8 @@ _SEQ_LEN = 32   # the lowered token shape every fixture pins
 
 def _generate_one(stem: str, out_dir: str) -> str:
     """Child-mode body: runs under PINNED_ENV in a fresh process."""
+    import jax
+
     import deepspeed_tpu as dst
     from deepspeed_tpu.profiling.observatory.hlo import (
         asyncify_hlo,
@@ -155,10 +157,36 @@ def _generate_one(stem: str, out_dir: str) -> str:
     if fx.get("mesh"):
         config["mesh"] = dict(fx["mesh"])
     engine, *_ = dst.initialize(model=spec, config=config)
-    ledger, _ = ledger_for_engine(engine, fold=False, seq_len=_SEQ_LEN)
+    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=_SEQ_LEN)
     full_text = ledger.hlo_text
-    header = full_text.splitlines()[0]
+    lines = full_text.splitlines()
+    # the module header block: line 0 plus any header continuation that
+    # carries the entry's donation directives / parameter layout —
+    # memlint's text tier reads input_output_alias + the entry layout
+    # from the committed fixture, so these lines are load-bearing
+    header = "\n".join(dict.fromkeys(
+        ln for i, ln in enumerate(lines)
+        if i == 0 or ln.startswith("HloModule")
+        or "input_output_alias=" in ln
+        or "entry_computation_layout=" in ln))
     body = "\n".join(iter_collective_lines(full_text))
+    # live memory observations for --write-memory-contracts: the parent
+    # process (no jax backend) bootstraps the memlint sidecar contracts
+    # from the committed fixture TEXT plus these generation-time numbers
+    from deepspeed_tpu.autotuning.memory_model import (
+        predicted_state_bytes_per_device,
+    )
+
+    memobs = {
+        "memory_analysis": mem,
+        "predicted_state_bytes": predicted_state_bytes_per_device(engine),
+        "donated_params": len(jax.tree.leaves(engine.state)),
+        "expect_donation": not getattr(engine, "_offload_param_stream",
+                                       False),
+        "zero_stage": engine.zero_stage,
+        "world": engine.dp_world_size,
+    }
+    print("MEMOBS " + json.dumps(memobs, sort_keys=True))
     if fx.get("asyncify"):
         body = asyncify_hlo(body)
     banner_lines = [
@@ -228,6 +256,42 @@ def _regen_contract(stem: str, hlo_path: str, contracts_out: str,
     print(f"regen: contract {out}")
 
 
+def _regen_memory_contract(stem: str, hlo_path: str, memobs: dict,
+                           contracts_out: str,
+                           allow_loosen: bool) -> None:
+    """Bootstrap/retighten the memlint SIDECAR contract for one fixture:
+    text-tier bounds from the committed fixture's entry header, live-tier
+    bounds (peak/temp) from the generation subprocess's
+    ``memory_analysis`` numbers, the predicted state pinned into the
+    config block so ``--fixtures`` can enforce the residency ceiling
+    with no engine."""
+    from deepspeed_tpu.analysis.memlint import (
+        MemLintConfig,
+        bootstrap_contract as mem_bootstrap,
+        observe_hlo,
+        write_contract as mem_write,
+    )
+    from deepspeed_tpu.autotuning.memory_model import peak_bytes_from_stats
+
+    with open(hlo_path) as f:
+        obs = observe_hlo(f.read())
+    mem = memobs.get("memory_analysis") or None
+    if mem:
+        obs.temp_bytes = mem.get("temp_size_in_bytes")
+        obs.alias_size_bytes = mem.get("alias_size_in_bytes")
+        obs.peak_bytes = peak_bytes_from_stats(mem)
+    obs.predicted_state_bytes = memobs.get("predicted_state_bytes")
+    cfg = MemLintConfig(
+        program=stem, world=int(memobs.get("world") or 8),
+        zero_stage=int(memobs.get("zero_stage") or 0),
+        expect_donation=bool(memobs.get("expect_donation", True)),
+        donated_params=memobs.get("donated_params"))
+    doc = mem_bootstrap(obs, cfg, hlo_name=stem + ".hlo.txt")
+    out = os.path.join(contracts_out, stem + ".json")
+    mem_write(out, doc, allow_loosen=allow_loosen)
+    print(f"regen: memory contract {out}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="regen_hlo_fixtures",
@@ -244,9 +308,18 @@ def main(argv=None) -> int:
     p.add_argument("--write-contracts", action="store_true",
                    help="also rebootstrap each fixture's hlolint "
                         "contract (shrink-only unless --allow-loosen)")
+    p.add_argument("--write-memory-contracts", action="store_true",
+                   help="also rebootstrap each fixture's memlint "
+                        "SIDECAR memory contract from the fixture "
+                        "header + the generation subprocess's live "
+                        "memory_analysis numbers (shrink-only unless "
+                        "--allow-loosen)")
     p.add_argument("--contracts-out", default=None,
                    help="contract output dir (default: the committed "
                         "analysis/hlolint/contracts)")
+    p.add_argument("--memory-contracts-out", default=None,
+                   help="memory contract output dir (default: the "
+                        "committed analysis/memlint/contracts)")
     p.add_argument("--allow-loosen", action="store_true",
                    help="permit contract regeneration to LOOSEN "
                         "committed bounds (deliberate program changes)")
@@ -280,6 +353,15 @@ def main(argv=None) -> int:
 
         contracts_out = contracts_dir()
     os.makedirs(contracts_out, exist_ok=True)
+    mem_contracts_out = args.memory_contracts_out
+    if mem_contracts_out is None:
+        from deepspeed_tpu.analysis.memlint import (
+            contracts_dir as mem_contracts_dir,
+        )
+
+        mem_contracts_out = mem_contracts_dir()
+    if args.write_memory_contracts:
+        os.makedirs(mem_contracts_out, exist_ok=True)
     failures = 0
     for stem in stems:
         env = dict(os.environ, **PINNED_ENV)
@@ -293,6 +375,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             continue
         hlo_path = proc.stdout.strip().splitlines()[-1]
+        memobs = {}
+        for line in proc.stdout.splitlines():
+            if line.startswith("MEMOBS "):
+                memobs = json.loads(line[len("MEMOBS "):])
         print(f"regen: {hlo_path}")
         if args.write_contracts:
             try:
@@ -301,6 +387,15 @@ def main(argv=None) -> int:
             except Exception as e:
                 failures += 1
                 print(f"regen: contract for {stem} FAILED: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+        if args.write_memory_contracts:
+            try:
+                _regen_memory_contract(stem, hlo_path, memobs,
+                                       mem_contracts_out,
+                                       args.allow_loosen)
+            except Exception as e:
+                failures += 1
+                print(f"regen: memory contract for {stem} FAILED: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
     return 1 if failures else 0
 

@@ -845,6 +845,48 @@ def autotune_smoke():
     }
 
 
+def autotune_plan_roundtrip():
+    """The PLAN engine (autotuning/planner.py) end to end on THIS
+    backend: enumerate the overlap-knob space, analytically refuse the
+    canary through memlint's oom-preflight, rank by analytic price, cache
+    the plan, and prove a fresh engine initialize LOADS it (cache-hit
+    counter +1, planned knobs applied). Dry-run pricing only — the
+    per-candidate lowering leg is the tools/plan CLI's job; this row
+    evidences the cache round-trip every training run depends on."""
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.autotuning.planner import (PlanEngine, plan_path,
+                                                  write_plan)
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    spec = dst.causal_lm_spec("tiny", dtype="float32", max_seq_len=32)
+    base = {"train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"data": jax.device_count()},
+            "steps_per_print": 10 ** 9}
+    cache_dir = tempfile.mkdtemp(prefix="bench_plan_")
+    planner = PlanEngine(spec, base, seq_len=32)
+    doc = planner.run(dry_run=True)
+    write_plan(plan_path(cache_dir, doc["key"]), doc)
+    mesh_mod.reset_mesh()
+    engine, *_ = dst.initialize(model=spec, config={
+        **base, "autotuning": {"enabled": True,
+                               "plan_cache_dir": cache_dir}})
+    pred = doc.get("predicted") or {}
+    return {
+        "candidates": len(doc["candidates"]),
+        "oom_refused": doc["counters"]["oom_refused"],
+        "priced": doc["counters"]["priced"],
+        "winner_pred_step_ms": round(
+            (pred.get("total_s") or 0.0) * 1e3, 4),
+        "plan_cache_hit_roundtrip": engine._plan_status == "hit",
+    }
+
+
 def _run_cpu_world8(snippet: str, timeout: int = 900):
     """Run a snippet in a subprocess on the 8-virtual-device CPU mesh and
     parse its last stdout line as JSON (error row on failure)."""
@@ -1163,6 +1205,7 @@ SUITE_SCHEDULE = [
     ("autotp_inference_gpt2_generate", inference_bench, 240, 90),
     ("offload_param_memory", offload_param_memory_evidence, 240, 100),
     ("autotune_smoke", autotune_smoke, 300, 120),
+    ("autotune_plan", autotune_plan_roundtrip, 240, 60),
     ("comm_cpu_mesh_world8", comm_cpu_mesh_world8, 240, 90),
     ("comm_bw_onchip", comm_bw_onchip, 120, 30),
 ]
@@ -1253,39 +1296,38 @@ def _entry_guardian_stats() -> dict:
         return {}
 
 
+def _entry_plan_stats() -> dict:
+    """This entry's autotune plan-cache verdict (schema v2.3 ``plan``
+    block). Each entry is its own subprocess, so the process-wide
+    hit/miss counters ARE this row's engines: any hit → the row ran
+    under a cached plan; any miss → it planned from scratch; neither →
+    autotuning disabled (the default for most lanes)."""
+    try:
+        from deepspeed_tpu import telemetry
+
+        def total(name):
+            counter = telemetry.get_registry().counter(name)
+            return int(sum(v for _, v in counter.labels_items()))
+
+        if total("autotune_plan_cache_hits_total"):
+            return {"status": "hit"}
+        if total("autotune_plan_cache_misses_total"):
+            return {"status": "miss"}
+        return {"status": "disabled"}
+    except Exception:
+        return {}
+
+
 def _run_entry_subprocess(name: str, timeout: float):
     """Run one suite entry in a child process so an XLA OOM/abort in a
     deliberately-HBM-tight config can't take the headline JSON down with it,
-    and a hung one costs its own timeout, not the bench."""
-    import signal
-    import subprocess
+    and a hung one costs its own timeout, not the bench. The machinery
+    (own session + group-kill, last-JSON-line contract) lives in
+    ``deepspeed_tpu/bench/subproc.py`` — shared with the plan engine's
+    measured-confirmation windows."""
+    from deepspeed_tpu.bench.subproc import run_entry_subprocess
 
-    # own session + group-kill on timeout: entries that spawn grandchildren
-    # (converge_real_text -> tools/converge_lane.py) must not leave an
-    # orphan training run burning the chip under later entries
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--entry", name],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True)
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
-        # a slow entry must cost ITS row, not the whole headline JSON line
-        return {"error": f"entry timed out after {int(timeout)}s"}
-    proc = type("R", (), {"stdout": stdout, "stderr": stderr,
-                          "returncode": proc.returncode})
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no output"]
-    return {"error": f"rc={proc.returncode}: {tail[0][:180]}"}
+    return run_entry_subprocess(__file__, name, timeout)
 
 
 def _logs_to_stderr():
@@ -1534,6 +1576,9 @@ def main():
                 guardian = _entry_guardian_stats()
                 if guardian:
                     row["guardian"] = guardian
+                plan_stats = _entry_plan_stats()
+                if plan_stats:
+                    row["plan"] = plan_stats
             print(json.dumps(row))
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:200]}))

@@ -64,7 +64,8 @@ __all__ = [
     "contract_observations", "contracts_dir", "fixture_pairs",
     "iter_rule_findings", "load_contract", "program_stem",
     "select_rules", "write_contract", "lint_hlo", "lint_ledger",
-    "lint_fixture", "lint_engine", "default_fixtures_dir",
+    "lint_fixture", "lint_engine", "engine_contract",
+    "default_fixtures_dir",
 ]
 
 
@@ -126,30 +127,23 @@ def default_fixtures_dir(start: Optional[str] = None) -> Optional[str]:
     return None
 
 
-def lint_engine(engine, contract: Optional[str] = None,
-                seq_len: Optional[int] = None,
-                rules=None) -> List[HloFinding]:
-    """Lint a live engine's lowered fused train step.
-
-    The program is the SAME one ``_dispatch_train_step`` runs
-    (``ledger_for_engine`` mirrors ``_select_step_builder`` and caches
-    the lowering), and the lint config is derived from the engine's
-    resolved state: wire format and quant flags from ``_wire_format()`` /
-    ``_compressed``, the async expectation from the overlap plan AND the
-    backend (the CPU tier lowers sync-only — honest ``expect_async=
-    False``), the fence-defeat floor from the live bucket plan, and the
-    replication budgets from the parameter tree + grad-accumulation
-    schedule. ``contract`` (a path) additionally applies the committed
-    contract rule.
-    """
+def _engine_lint_config(engine, ledger, mem,
+                        cdata: Optional[Dict[str, Any]] = None
+                        ) -> LintConfig:
+    """The live-engine LintConfig derivation — the ONE copy shared by
+    ``lint_engine`` (enforcement) and ``engine_contract`` (the plan
+    engine's contract emission): wire format and quant flags from
+    ``_wire_format()`` / ``_compressed``, the async expectation from
+    the overlap plan AND the backend (the CPU tier lowers sync-only —
+    honest ``expect_async=False``), the fence-defeat floor from the
+    live bucket plan, and the replication budgets from the parameter
+    tree + grad-accumulation schedule."""
     import jax
 
-    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
     from deepspeed_tpu.profiling.observatory.report import (
         _zero_memory_prediction,
     )
 
-    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=seq_len)
     plan = engine.overlap_plan()
     compressed = getattr(engine, "_compressed", None) or {}
     planned = None
@@ -175,7 +169,6 @@ def lint_engine(engine, contract: Optional[str] = None,
         logger.debug(f"hlolint bucket-plan derivation skipped "
                      f"({type(e).__name__}: {e})")
     predicted = _zero_memory_prediction(engine) or {}
-    cdata = load_contract(contract) if contract else None
     cfg = LintConfig(
         program=ledger.program, world=ledger.world,
         zero_stage=engine.zero_stage,
@@ -203,7 +196,40 @@ def lint_engine(engine, contract: Optional[str] = None,
         ceiling = (cdata.get("config") or {}).get("args_vs_state_max")
         if ceiling:
             cfg.args_vs_state_max = float(ceiling)
+    return cfg
+
+
+def lint_engine(engine, contract: Optional[str] = None,
+                seq_len: Optional[int] = None,
+                rules=None) -> List[HloFinding]:
+    """Lint a live engine's lowered fused train step.
+
+    The program is the SAME one ``_dispatch_train_step`` runs
+    (``ledger_for_engine`` mirrors ``_select_step_builder`` and caches
+    the lowering), and the lint config is derived from the engine's
+    resolved state (``_engine_lint_config``). ``contract`` (a path)
+    additionally applies the committed contract rule.
+    """
+    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
+
+    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=seq_len)
+    cdata = load_contract(contract) if contract else None
+    cfg = _engine_lint_config(engine, ledger, mem, cdata)
     return lint_ledger(ledger, cfg, rules=rules)
+
+
+def engine_contract(engine, seq_len: Optional[int] = None,
+                    hlo_name: str = "") -> Dict[str, Any]:
+    """Bootstrap a contract document pinning the live engine's lowered
+    step EXACTLY — the plan engine's contract-emission leg: a winning
+    plan is committed as an enforceable hlolint contract, not just a
+    measurement. Same cached lowering as ``lint_engine``; write with
+    ``write_contract`` (shrink-only)."""
+    from deepspeed_tpu.profiling.observatory.ledger import ledger_for_engine
+
+    ledger, mem = ledger_for_engine(engine, fold=False, seq_len=seq_len)
+    cfg = _engine_lint_config(engine, ledger, mem, None)
+    return bootstrap_contract(ledger, cfg, hlo_name=hlo_name)
 
 
 def _leaf_elems(shape_struct) -> int:

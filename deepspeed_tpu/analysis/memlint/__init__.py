@@ -77,7 +77,7 @@ __all__ = [
     "load_contract", "observe_hlo",
     "parse_entry_layout", "parse_input_output_alias", "program_stem",
     "select_rules", "write_contract", "engine_observations",
-    "observe_for_config",
+    "observe_for_config", "engine_contract",
 ]
 
 
@@ -262,29 +262,22 @@ def engine_observations(engine,
     return obs
 
 
-def lint_engine(engine, contract: Optional[str] = None,
-                seq_len: Optional[int] = None,
-                hbm_budget_bytes: Optional[float] = None,
-                rules=None) -> List[MemFinding]:
-    """memlint over a live engine's lowered fused train step.
-
-    Donation intent comes from the engine's REAL dispatch: the step
-    donates state (``donate_argnums=(0,)``) everywhere except the
-    deliberately double-buffered ``_offload_param_stream`` path. The
-    expected donated-leaf count is the live state tree's leaf count;
-    the ZeRO-predicted resident state comes from the live shardings
-    (``memory_model.predicted_state_bytes_per_device`` — the ONE copy
-    of that math); ``contract`` (a path) additionally applies the
-    committed memory contract; ``hbm_budget_bytes`` arms the OOM
-    pre-flight rule.
-    """
+def _engine_lint_config(engine,
+                        hbm_budget_bytes: Optional[float] = None,
+                        cdata: Optional[Dict[str, Any]] = None
+                        ) -> MemLintConfig:
+    """The live-engine MemLintConfig derivation — the ONE copy shared
+    by ``lint_engine`` (enforcement) and ``engine_contract`` (the plan
+    engine's contract emission). Donation intent comes from the
+    engine's REAL dispatch: the step donates state
+    (``donate_argnums=(0,)``) everywhere except the deliberately
+    double-buffered ``_offload_param_stream`` path; the expected
+    donated-leaf count is the live state tree's leaf count."""
     import jax
 
-    obs = engine_observations(engine, seq_len=seq_len)
     expect_donation = not getattr(engine, "_offload_param_stream", False)
     donated = len(jax.tree.leaves(engine.state)) if expect_donation \
         else None
-    cdata = load_contract(contract) if contract else None
     cfg = MemLintConfig(
         program="train_step",
         world=engine.dp_world_size,
@@ -300,6 +293,26 @@ def lint_engine(engine, contract: Optional[str] = None,
         ceiling = (cdata.get("config") or {}).get("args_vs_predicted_max")
         if ceiling:
             cfg.args_vs_predicted_max = float(ceiling)
+    return cfg
+
+
+def lint_engine(engine, contract: Optional[str] = None,
+                seq_len: Optional[int] = None,
+                hbm_budget_bytes: Optional[float] = None,
+                rules=None) -> List[MemFinding]:
+    """memlint over a live engine's lowered fused train step.
+
+    The lint config comes from ``_engine_lint_config`` (real dispatch
+    donation intent + live state tree); the ZeRO-predicted resident
+    state from the live shardings
+    (``memory_model.predicted_state_bytes_per_device`` — the ONE copy
+    of that math); ``contract`` (a path) additionally applies the
+    committed memory contract; ``hbm_budget_bytes`` arms the OOM
+    pre-flight rule.
+    """
+    obs = engine_observations(engine, seq_len=seq_len)
+    cdata = load_contract(contract) if contract else None
+    cfg = _engine_lint_config(engine, hbm_budget_bytes, cdata)
     findings = iter_rule_findings(obs, cfg, rules=rules)
     if cfg.contract and (rules is None
                          or any(r.RULE_ID == "contract" for r in rules)):
@@ -317,3 +330,15 @@ def lint_engine(engine, contract: Optional[str] = None,
                 "fix the backend's memory reporting",
                 limit=cfg.contract.get(key), observed=None))
     return findings
+
+
+def engine_contract(engine, seq_len: Optional[int] = None,
+                    hlo_name: str = "") -> Dict[str, Any]:
+    """Bootstrap a memory contract pinning the live engine's lowered
+    step EXACTLY — the plan engine's contract-emission leg (sidecar to
+    ``hlolint.engine_contract``, same stem convention). Same cached
+    observatory lowering as ``lint_engine``; write with
+    ``write_contract`` (shrink-only)."""
+    obs = engine_observations(engine, seq_len=seq_len)
+    cfg = _engine_lint_config(engine, None, None)
+    return bootstrap_contract(obs, cfg, hlo_name=hlo_name)

@@ -1,10 +1,33 @@
-"""Autotuning (reference ``deepspeed/autotuning/``)."""
+"""Autotuning (reference ``deepspeed/autotuning/``).
+
+Two generations live here:
+
+* the measure-everything :class:`Autotuner` (stage × micro-batch ×
+  remat × offload, memory-model-pruned, every survivor compiled and
+  timed);
+* the observatory-driven plan engine (``planner.py`` — PR 16):
+  enumerate the overlap-knob space, analytically REFUSE infeasible
+  candidates through memlint's ``oom-preflight`` before anything
+  compiles, price survivors via the shared
+  ``observatory.pricing.price_program`` over one lowering each,
+  confirm the top-K with measured child-process windows, and cache the
+  winning plan per ``(model_fingerprint, mesh_shape, wire_format,
+  platform)`` for ``engine._load_autotune_plan`` — front end
+  ``tools/plan`` / the ``plan`` console entry.
+"""
 from deepspeed_tpu.autotuning.autotuner import Autotuner, TuneResult
 from deepspeed_tpu.autotuning.memory_model import (MemoryEstimate, ModelInfo,
                                                    estimate, max_micro_batch)
+from deepspeed_tpu.autotuning.planner import (PLAN_VERSION, Candidate,
+                                              PlanEngine, PlanError,
+                                              load_plan, model_fingerprint,
+                                              plan_key_for_config, plan_path,
+                                              validate_plan, write_plan)
 from deepspeed_tpu.autotuning.tuner import (CostModelTuner, GridSearchTuner,
                                             RandomTuner)
 
 __all__ = ["Autotuner", "TuneResult", "ModelInfo", "MemoryEstimate",
            "estimate", "max_micro_batch", "GridSearchTuner", "RandomTuner",
-           "CostModelTuner"]
+           "CostModelTuner", "PlanEngine", "PlanError", "Candidate",
+           "PLAN_VERSION", "load_plan", "write_plan", "validate_plan",
+           "plan_key_for_config", "plan_path", "model_fingerprint"]

@@ -1,0 +1,231 @@
+"""``tools/plan`` / ``python -m deepspeed_tpu.autotuning`` / the
+``plan`` console entry — the plan engine's front end.
+
+Flow (``planner.PlanEngine``): enumerate the overlap-knob space →
+analytically REFUSE infeasible candidates via memlint's ``oom-preflight``
+(nothing infeasible ever compiles; a ``preflight_canary`` priced against
+a 1-byte budget proves the refusal leg ran) → price survivors by lowering
+each step program once through the shared ``price_program`` → confirm the
+predicted top-K with short measured windows in one-JSON-line child
+processes → cache the winning plan per ``(model_fingerprint, mesh_shape,
+wire_format, platform)`` in ``plan.json`` for
+``engine._load_autotune_plan``, optionally with the enforcing hlolint +
+memlint contract pair (``--write-contracts``).
+
+Exit codes: 0 = plan emitted (schema-valid, cached); 1 = planning failed
+(no feasible candidate, invalid plan); 2 = usage/internal error (bad
+flags, canary not refused).
+
+``--dry-run`` stops before any compilation: enumerate → refuse →
+analytic price → rank, still emitting a schema-valid plan marked
+``"dry_run": true``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="plan",
+        description="Observatory-driven autotuning: emit a cached, "
+                    "contract-backed execution plan for one model+mesh.")
+    p.add_argument("--model", default="tiny",
+                   help="model zoo preset (default: tiny)")
+    p.add_argument("--zero-stage", type=int, default=3, dest="zero_stage")
+    p.add_argument("--seq-len", type=int, default=32, dest="seq_len")
+    p.add_argument("--micro-batch", type=int, default=1, dest="micro_batch")
+    p.add_argument("--devices", type=int, default=None,
+                   help="CPU host device count to force (default: 8 when "
+                        "JAX_PLATFORMS=cpu and unset; 0 = leave env alone)")
+    p.add_argument("--hbm-budget-bytes", type=int, default=None,
+                   dest="hbm_budget_bytes",
+                   help="per-device HBM budget for the OOM pre-flight "
+                        "(default: the live capacity probe)")
+    p.add_argument("--max-candidates", type=int, default=None,
+                   dest="max_candidates")
+    p.add_argument("--top-k", type=int, default=None, dest="top_k",
+                   help="candidates to confirm with measured windows")
+    p.add_argument("--plan-cache-dir", default=None, dest="plan_cache_dir")
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--dry-run", action="store_true", dest="dry_run",
+                   help="analytic only: enumerate, refuse, rank — no "
+                        "compilation, no measurement")
+    p.add_argument("--write-contracts", action="store_true",
+                   dest="write_contracts",
+                   help="emit the winning program's hlolint+memlint "
+                        "contract pair next to the plan")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--entry", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--spec-json", default=None, dest="spec_json",
+                   help=argparse.SUPPRESS)
+    return p
+
+
+def _ensure_devices(n: Optional[int]) -> None:
+    """Force an N-device CPU world BEFORE jax initializes — the tier-1
+    environment sets ``JAX_PLATFORMS=cpu`` but not the host device
+    count, and a 1-device world has no collectives to plan."""
+    if n is None:
+        n = 8 if os.environ.get("JAX_PLATFORMS", "") == "cpu" else 0
+    if n and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _entry_confirm(spec_json: str) -> int:
+    """Child-process measured window (one JSON line on stdout — the
+    bench entry isolation contract)."""
+    import time
+
+    payload = json.loads(spec_json)
+    import jax
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.runtime.dataloader import synthetic_lm_data
+
+    spec = dst.causal_lm_spec(payload["model"], dtype="float32",
+                              max_seq_len=payload["seq_len"])
+    engine, *_ = dst.initialize(model=spec, config=payload["config"])
+    bs = engine.train_micro_batch_size() * engine.dp_world_size
+    data = synthetic_lm_data(batch_size=bs, seq_len=payload["seq_len"],
+                             vocab_size=payload.get("vocab_size", 512))
+    for _ in range(int(payload.get("warmup", 1))):
+        jax.block_until_ready(engine.train_batch(data))
+    steps = max(1, int(payload.get("steps", 3)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(data)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    print(json.dumps({"step_time_s": dt,
+                      "throughput": engine.train_batch_size() / dt}))
+    return 0
+
+
+def _fmt_seconds(s: Optional[float]) -> str:
+    if s is None:
+        return "-"
+    return f"{s * 1e3:.2f}ms"
+
+
+def _render_text(doc: Dict[str, Any], path: str,
+                 contracts: Dict[str, str]) -> str:
+    lines: List[str] = []
+    kf = doc["key_fields"]
+    lines.append(f"plan {doc['key']}")
+    lines.append(f"  model={kf['model_fingerprint']} "
+                 f"mesh={kf['mesh_shape']} wire={kf['wire_format']} "
+                 f"platform={kf['platform']} seq_len={doc['seq_len']} "
+                 f"mb={doc['micro_batch']}")
+    lines.append(f"  hbm_budget={doc['hbm_budget_bytes'] / 2**30:.2f}GiB "
+                 f"dry_run={doc['dry_run']}")
+    lines.append("")
+    lines.append(f"  {'candidate':28} {'verdict':12} {'pred':>10} "
+                 f"{'comm':>10} {'est HBM':>10} {'measured':>10} "
+                 f"{'rel_err':>8}")
+    for c in doc["candidates"]:
+        cost = c.get("predicted") or c.get("analytic") or {}
+        est = c.get("est_hbm_bytes")
+        meas = (c.get("measured") or {}).get("step_time_s")
+        rel = c.get("rel_err")
+        rel_s = f"{rel:.2f}" if rel is not None else "-"
+        est_s = f"{est / 2**20:.1f}MiB" if est else "-"
+        lines.append(
+            f"  {c['name']:28} {c['verdict']:12} "
+            f"{_fmt_seconds(cost.get('total_s')):>10} "
+            f"{_fmt_seconds(cost.get('comm_s')):>10} "
+            f"{est_s:>10} {_fmt_seconds(meas):>10} {rel_s:>8}")
+        if c.get("refusal"):
+            lines.append(f"      refused: {c['refusal']}")
+    lines.append("")
+    counters = doc["counters"]
+    lines.append("  " + "  ".join(f"{k}={v}" for k, v in
+                                  sorted(counters.items())))
+    lines.append(f"  winner: {doc['winner']}  knobs: "
+                 + json.dumps(doc["knobs"], sort_keys=True))
+    lines.append(f"  plan written: {path}")
+    for kind, cpath in sorted(contracts.items()):
+        lines.append(f"  {kind} contract: {cpath}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.entry:
+        if args.entry != "confirm" or not args.spec_json:
+            print("unknown --entry (internal flag)", file=sys.stderr)
+            return 2
+        return _entry_confirm(args.spec_json)
+    _ensure_devices(args.devices)
+
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.autotuning.planner import (
+        PlanEngine,
+        PlanError,
+        plan_path,
+        write_plan,
+    )
+    from deepspeed_tpu.runtime.config import AutotuningSectionConfig
+
+    dcfg = AutotuningSectionConfig()
+    cache_dir = args.plan_cache_dir or dcfg.plan_cache_dir
+    top_k = dcfg.confirm_top_k if args.top_k is None else args.top_k
+    max_cands = (dcfg.max_candidates if args.max_candidates is None
+                 else args.max_candidates)
+    try:
+        spec = dst.causal_lm_spec(args.model, dtype="float32",
+                                  max_seq_len=args.seq_len)
+    except (KeyError, ValueError, TypeError) as e:
+        print(f"unknown model preset {args.model!r}: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    base_config = {
+        "train_micro_batch_size_per_gpu": args.micro_batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": args.zero_stage},
+        "mesh": {"data": jax.device_count()},
+        "steps_per_print": 10 ** 9,
+    }
+    engine = PlanEngine(
+        spec, base_config, seq_len=args.seq_len,
+        hbm_budget_bytes=args.hbm_budget_bytes,
+        max_candidates=max_cands, confirm_top_k=top_k,
+        steps=args.steps, warmup=args.warmup)
+    try:
+        doc = engine.run(dry_run=args.dry_run)
+    except PlanError as e:
+        msg = str(e)
+        print(f"plan failed: {msg}", file=sys.stderr)
+        return 2 if "canary" in msg else 1
+    contracts: Dict[str, str] = {}
+    try:
+        path = write_plan(plan_path(cache_dir, doc["key"]), doc)
+        if args.write_contracts and not args.dry_run:
+            contracts = engine.emit_contracts(doc, cache_dir)
+            doc["contracts"] = {k: os.path.basename(v)
+                                for k, v in contracts.items()}
+            write_plan(path, doc)
+    except (PlanError, OSError) as e:
+        print(f"plan emit failed: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(dict(doc, plan_path=path), indent=2,
+                         sort_keys=True))
+    else:
+        print(_render_text(doc, path, contracts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

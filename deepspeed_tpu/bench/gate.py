@@ -24,6 +24,17 @@ Environment knobs:
 * ``BENCH_GATE=0``        — skip the gate entirely (bench.py exits 0)
 * ``BENCH_GATE_THRESHOLD``— regression threshold as a fraction
   (default 0.05 = 5%), applied to headline and per-entry metrics alike.
+* ``BENCH_GATE_NOISE``    — per-platform noise band as a fraction:
+  a regression whose magnitude is INSIDE the band is reported under
+  ``noise_within_band`` (a warning in the gate info) instead of failing
+  the run. Unset = derived from committed same-platform history (2x the
+  relative sample stddev of the last 5 clean headline rounds, capped at
+  0.25); ``0`` disables the band (every past-threshold regression
+  fails, the pre-PR-16 behavior). Rationale: the CPU lane's r08 fired
+  on a ~5.5% headline drift with zero code changes — same-platform
+  history says that lane's round-to-round noise floor is ~14%, and a
+  gate that cries wolf inside its own noise floor trains people to
+  ignore it.
 """
 from __future__ import annotations
 
@@ -75,6 +86,57 @@ def _has_gateable_entries(record: Dict[str, Any]) -> bool:
         if flatten_metrics(entry.get("metrics") or {}):
             return True
     return False
+
+
+#: noise-band derivation window and ceiling: the band is evidence from
+#: recent history, not a licence — five clean rounds bound "recent", and
+#: a lane so noisy its 2-sigma exceeds 25% shouldn't silently waive
+#: quarter-sized regressions (cap it and let a human look)
+NOISE_WINDOW = 5
+NOISE_BAND_CAP = 0.25
+
+
+def platform_noise_band(records, platform: Optional[str],
+                        metric: Optional[str]) -> Optional[float]:
+    """The fraction below which a same-platform regression is noise.
+
+    ``BENCH_GATE_NOISE`` overrides (``0`` disables). Otherwise: 2x the
+    relative sample stddev of the last ``NOISE_WINDOW`` clean
+    (``rc == 0``) same-platform, same-headline-metric,
+    headline-bearing records, capped at ``NOISE_BAND_CAP``; fewer than
+    2 samples (or no declared platform) = no band (None).
+    """
+    env = os.environ.get("BENCH_GATE_NOISE")
+    if env is not None:
+        try:
+            band = float(env)
+        except ValueError:
+            return None
+        return band if band > 0 else None
+    if not platform:
+        return None
+    vals = []
+    for rec in records or []:
+        if rec.get("rc") != 0:
+            continue
+        if history_mod.record_platform(rec) != platform:
+            continue
+        head = (rec.get("result") or {}).get("headline") or {}
+        if metric and head.get("metric") and head["metric"] != metric:
+            continue
+        value = head.get("value")
+        if is_number(value) and value > 0:
+            vals.append(float(value))
+    vals = vals[-NOISE_WINDOW:]
+    if len(vals) < 2:
+        return None
+    mean = sum(vals) / len(vals)
+    if not mean:
+        return None
+    var = sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)
+    rel = (var ** 0.5) / mean
+    band = min(2.0 * rel, NOISE_BAND_CAP)
+    return band or None
 
 
 def gate_threshold() -> float:
@@ -151,6 +213,23 @@ def run_gate(fresh_result: Dict[str, Any],
         gated = [r for r in diff["regressions"]
                  if r.get("where") not in NOISY_ENTRIES]
         ignored = len(diff["regressions"]) - len(gated)
+        # per-platform noise band: a numeric regression whose magnitude
+        # sits inside the lane's own measured round-to-round noise floor
+        # WARNS (noise_within_band) instead of failing the run; error
+        # transitions (delta_frac None) always gate — an error is never
+        # noise
+        band = platform_noise_band(records, fresh_plat,
+                                   fresh_metric
+                                   if isinstance(fresh_metric, str)
+                                   else None)
+        if band:
+            info["noise_band"] = round(band, 4)
+            within = [r for r in gated
+                      if r.get("delta_frac") is not None
+                      and abs(r["delta_frac"]) <= band]
+            if within:
+                gated = [r for r in gated if r not in within]
+                info["noise_within_band"] = within
         info.update({
             "baseline": label,
             "baseline_recovered": bool(baseline.get("recovered")),

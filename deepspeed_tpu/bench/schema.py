@@ -76,11 +76,12 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2.2
+SCHEMA_VERSION = 2.3
 
 #: versions validate_result accepts — v2 records predate the ``comms``
-#: block, v2.1 the ``guardian`` block; otherwise shape-identical
-SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2)
+#: block, v2.1 the ``guardian`` block, v2.2 the ``plan`` block
+#: (autotune plan-cache verdict per entry); otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -89,7 +90,7 @@ RECORD_VERSION = 1
 # else inside an entry dict is treated as a metric
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
                          "elapsed_s", "skipped_reason", "error", "note",
-                         "comms", "overlap_fraction", "guardian")
+                         "comms", "overlap_fraction", "guardian", "plan")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -193,6 +194,28 @@ def validate_guardian(block: Any, where: str) -> List[str]:
     return errs
 
 
+#: engine plan-cache statuses a v2.3 ``plan`` block may carry
+PLAN_STATUSES = ("disabled", "miss", "hit", "stale")
+
+
+def validate_plan_block(block: Any, where: str) -> List[str]:
+    """Validate a v2.3 ``plan`` block: the entry engine's autotune
+    plan-cache verdict (``engine._plan_status``) plus the plan key it
+    looked up — per ROW, so a history round shows which lanes ran under
+    a cached plan and which planned from scratch."""
+    if not isinstance(block, dict):
+        return [f"{where}: plan must be a dict"]
+    errs: List[str] = []
+    status = block.get("status")
+    if status not in PLAN_STATUSES:
+        errs.append(f"{where}: plan.status must be one of "
+                    f"{PLAN_STATUSES}, got {status!r}")
+    key = block.get("key")
+    if key is not None and not isinstance(key, str):
+        errs.append(f"{where}: plan.key must be a string or absent")
+    return errs
+
+
 def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
     if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
         return [f"{where}: overlap_fraction must be a number in [0, 1]"]
@@ -233,6 +256,8 @@ def validate_entry(entry: Any, name: str) -> List[str]:
         errs += validate_guardian(entry["guardian"], where)
     if "overlap_fraction" in entry:
         errs += validate_overlap_fraction(entry["overlap_fraction"], where)
+    if "plan" in entry:
+        errs += validate_plan_block(entry["plan"], where)
     return errs
 
 
@@ -375,7 +400,8 @@ def normalize_entry_row(row: Any,
         out["skipped_reason"] = str(row.pop("skipped_reason"))
     if "error" in row:
         out["error"] = str(row.pop("error"))
-    for key in ("trace_phases", "telemetry", "memory", "comms", "guardian"):
+    for key in ("trace_phases", "telemetry", "memory", "comms", "guardian",
+                "plan"):
         if key in row:
             val = row.pop(key)
             if val:

@@ -1,0 +1,63 @@
+"""One-JSON-line child processes: the bench entry isolation contract.
+
+Every bench suite entry — and now every autotune confirmation window —
+runs in its OWN child process so an XLA OOM/abort in a deliberately
+HBM-tight config can't take the parent's JSON artifact down with it,
+and a hung one costs its own timeout, not the whole run. The child's
+contract: print exactly ONE JSON object as its LAST stdout line
+(logging goes to stderr); the parent parses backwards from the tail so
+stray stdout above it is harmless.
+
+Extracted from bench.py's ``_run_entry_subprocess`` (PR 9) so the plan
+engine's measured-confirmation windows reuse the identical machinery —
+own session + process-group SIGKILL on timeout (children that spawn
+grandchildren must not leave an orphan training run burning the chip
+under later candidates).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+def run_json_subprocess(argv: List[str], timeout: float,
+                        env: Optional[Dict[str, str]] = None) -> dict:
+    """Run ``argv`` as a child; return its last stdout JSON line.
+
+    On timeout the child's whole process GROUP is SIGKILLed and an
+    ``{"error": ...}`` dict comes back — a slow child costs ITS row,
+    never the caller's artifact. On a non-JSON exit the stderr tail
+    rides in the error string (first 180 chars) for the artifact's
+    forensics. Never raises on child failure.
+    """
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+        env=dict(os.environ, **env) if env else None)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        return {"error": f"entry timed out after {int(timeout)}s"}
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    tail = (stderr or "").strip().splitlines()[-1:] or ["no output"]
+    return {"error": f"rc={proc.returncode}: {tail[0][:180]}"}
+
+
+def run_entry_subprocess(script: str, name: str, timeout: float) -> dict:
+    """bench.py's per-entry child: ``python <script> --entry <name>``."""
+    return run_json_subprocess(
+        [sys.executable, os.path.abspath(script), "--entry", name],
+        timeout)

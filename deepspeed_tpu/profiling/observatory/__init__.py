@@ -18,6 +18,11 @@ artifact itself:
   **compute/comm overlap meter**: a programmatic ``jax.profiler`` capture
   parsed into busy intervals, with a documented fenced-timer fallback
   estimator so the CPU tier exercises the full path;
+* :mod:`~deepspeed_tpu.profiling.observatory.pricing` — **candidate
+  pricing**: ``price_program(hlo_text, config) -> PredictedCost``, the
+  one pure copy of the per-phase comm/compute roofline math shared by
+  the step report, bench's ``comms`` block, and the autotuning plan
+  engine;
 * :mod:`~deepspeed_tpu.profiling.observatory.report` — the **roofline
   step report**: cost-analysis flops/bytes + ledger + memory analysis +
   trace-phase percentiles → a compute/comm/host-bound verdict per phase.
@@ -45,6 +50,11 @@ from deepspeed_tpu.profiling.observatory.overlap import (
     measure_overlap,
     overlap_from_intervals,
 )
+from deepspeed_tpu.profiling.observatory.pricing import (
+    PredictedCost,
+    price_ledger,
+    price_program,
+)
 from deepspeed_tpu.profiling.observatory.report import (
     bench_comms_block,
     step_report,
@@ -57,4 +67,5 @@ __all__ = [
     "ledger_for_engine", "ledger_for_fastgen",
     "estimate_overlap", "measure_overlap", "overlap_from_intervals",
     "step_report", "validate_report", "bench_comms_block",
+    "PredictedCost", "price_ledger", "price_program",
 ]

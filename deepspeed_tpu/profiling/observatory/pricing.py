@@ -1,0 +1,239 @@
+"""Candidate pricing: ONE pure function from a program's HLO text to a
+predicted step cost.
+
+``price_program(hlo_text, config) -> PredictedCost`` is the single copy
+of the roofline pricing math that the planner (``autotuning/planner.py``),
+the step report (``report.py``), and bench.py's per-entry ``comms`` block
+all share. Before this module the per-phase comm pricing, the
+``_COMPUTE_SHARE`` fwd/bwd split, and the streamed-update step-compute
+estimate lived inline in ``report.py`` — three call sites would have had
+to re-derive them for the plan engine and drift was guaranteed.
+
+The model, per phase (fwd / bwd / step):
+
+* **comm leg** — each ledger op's ``BW.predicted_seconds(kind, bytes,
+  group, link_gbps)`` summed into the phase its subsystem bills to
+  (``SUBSYSTEM_PHASE``: ZeRO-3 gathers + MoE dispatch + pipeline
+  handoffs → fwd, grad sync → bwd, the deferred update publish and
+  everything else → step);
+* **compute leg** — whole-step FLOPs at the chip peak split 1:2 between
+  fwd and bwd (``COMPUTE_SHARE``); the step phase is the elementwise
+  optimizer update, priced as MEMORY-bound streaming:
+  ``update_elems / shard × bytes_per_elem / (hbm_gbps × 1e9)``;
+* **phase cost** — ``max(compute, comm)`` when the engine overlaps that
+  phase (fwd/bwd under ``overlap_comm``, step under ``overlap_step``),
+  else ``compute + comm`` (serial);
+* **total** — the sum over phases: the predicted seconds one optimizer
+  step costs under this candidate's program.
+
+Fallback rates (both documented nominal figures, NOT measurements):
+
+* ``link_gbps`` defaults to ``comm.bandwidth.DEFAULT_LINK_GBPS``
+  (10 GB/s) when the chip has no datasheet ICI rate — the CPU tier;
+* ``hbm_gbps`` defaults to ``DEFAULT_UPDATE_GBPS`` (10 GB/s, one host
+  core's stream rate) when the chip has no datasheet HBM rate — same
+  tier.  On real chips pass ``chip_link_gbps`` / ``chip_hbm_gbps``.
+
+Pure by construction: no engine, no lowering, no device — callers bring
+the HLO text (a committed fixture, a fresh lowering, a dump) and a plain
+config dict, and get arithmetic back.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.comm import bandwidth as BW
+from deepspeed_tpu.profiling.observatory.ledger import (
+    CollectiveLedger,
+    build_ledger,
+)
+
+PHASES = ("fwd", "bwd", "step")
+
+#: subsystem → the engine phase its collectives bill to
+SUBSYSTEM_PHASE = {
+    "zero_param_gather": "fwd",
+    "moe_dispatch": "fwd",
+    "pipeline_handoff": "fwd",
+    "zero_grad_sync": "bwd",
+    "zero_param_update": "step",   # the deferred post-update publish
+    "other": "step",
+}
+
+#: bytes one optimizer update streams per parameter element — the
+#: update is MEMORY-bound (elementwise; pricing it at the matmul peak
+#: would understate it by orders of magnitude on any real chip): Adam
+#: reads+writes fp32 master and two fp32 moments and reads the fp32
+#: grad ≈ 7 × 4B streams. The documented Adam default;
+#: ``update_bytes_per_elem`` derives the real figure from the
+#: optimizer's moment count.
+UPDATE_BYTES_PER_ELEM = 28.0
+
+#: host memory bandwidth used when the backend has no datasheet HBM
+#: rate (the CPU tier) — the compute-side twin of
+#: ``comm.bandwidth.DEFAULT_LINK_GBPS``: a documented nominal rate so
+#: the estimator path still produces a step-phase estimate instead of a
+#: structural zero (one host core streams ~10 GB/s)
+DEFAULT_UPDATE_GBPS = 10.0
+
+#: fwd/bwd compute split when only whole-step FLOPs are known (the
+#: standard 1:2 fwd:bwd ratio; optimizer flops are noise at LM scale)
+COMPUTE_SHARE = {"fwd": 1.0 / 3.0, "bwd": 2.0 / 3.0, "step": 0.0}
+
+
+def update_bytes_per_elem(n_moments: Optional[int]) -> float:
+    """Streamed fp32 bytes per master element for ONE update: the grad
+    read + master read/write + a read/write per optimizer moment tree
+    ((3 + 2·moments) × 4B — Adam's two moments give the documented
+    ``UPDATE_BYTES_PER_ELEM``; SGD's single moment ~20B). ``None`` =
+    moment count unknown → the Adam default."""
+    if n_moments is None:
+        return UPDATE_BYTES_PER_ELEM
+    return (3 + 2 * int(n_moments)) * 4.0
+
+
+def phase_comm_seconds(ledger: CollectiveLedger,
+                       link_gbps: float) -> Dict[str, float]:
+    """Predicted serialized wire seconds per engine phase."""
+    out = {p: 0.0 for p in PHASES}
+    for op in ledger.ops:
+        phase = SUBSYSTEM_PHASE.get(op.subsystem or "other", "step")
+        out[phase] += BW.predicted_seconds(op.kind, op.size_bytes,
+                                           op.group_size, link_gbps)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedCost:
+    """One candidate program's predicted step economics — the planner's
+    ranking key and the step report's roofline legs, from one math."""
+    program: str
+    total_s: float                      # predicted seconds per step
+    comm_s: float                       # serialized wire time, all phases
+    compute_s: float                    # compute legs, all phases
+    wire_bytes: int                     # total collective payload bytes
+    link_gbps: float
+    phase_comm_s: Dict[str, float]
+    phase_compute_s: Dict[str, float]
+    phase_cost_s: Dict[str, float]      # per-phase max/sum under overlap
+    peak_hbm_bytes: Optional[float] = None   # from memory stats if given
+    dominant_collective: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {
+            "program": self.program,
+            "total_s": round(self.total_s, 6),
+            "comm_s": round(self.comm_s, 6),
+            "compute_s": round(self.compute_s, 6),
+            "wire_bytes": self.wire_bytes,
+            "link_gbps": self.link_gbps,
+            "phase_comm_s": {p: round(v, 6)
+                             for p, v in self.phase_comm_s.items()},
+            "phase_compute_s": {p: round(v, 6)
+                                for p, v in self.phase_compute_s.items()},
+            "phase_cost_s": {p: round(v, 6)
+                             for p, v in self.phase_cost_s.items()},
+        }
+        if self.peak_hbm_bytes is not None:
+            out["peak_hbm_bytes"] = self.peak_hbm_bytes
+        if self.dominant_collective:
+            out["dominant_collective"] = self.dominant_collective
+        return out
+
+
+def price_ledger(ledger: CollectiveLedger, *,
+                 link_gbps: float,
+                 total_compute_s: Optional[float] = None,
+                 update_elems: Optional[int] = None,
+                 update_shard: int = 1,
+                 n_moments: Optional[int] = None,
+                 hbm_gbps: Optional[float] = None,
+                 overlap_comm: bool = True,
+                 overlap_step: bool = False,
+                 peak_hbm_bytes: Optional[float] = None) -> PredictedCost:
+    """Price an already-parsed ledger (the live-engine path — callers
+    that lowered a program keep its ledger and memory stats)."""
+    comm = phase_comm_seconds(ledger, link_gbps)
+    compute = {p: (total_compute_s or 0.0) * COMPUTE_SHARE[p]
+               for p in PHASES}
+    if update_elems:
+        rate = (hbm_gbps or DEFAULT_UPDATE_GBPS) * 1e9
+        compute["step"] = (update_elems / max(int(update_shard), 1)
+                           * update_bytes_per_elem(n_moments) / rate)
+    cost: Dict[str, float] = {}
+    for p in PHASES:
+        overlapped = overlap_step if p == "step" else overlap_comm
+        cost[p] = (max(compute[p], comm[p]) if overlapped
+                   else compute[p] + comm[p])
+    return PredictedCost(
+        program=ledger.program,
+        total_s=sum(cost.values()),
+        comm_s=sum(comm.values()),
+        compute_s=sum(compute.values()),
+        wire_bytes=ledger.total_bytes(),
+        link_gbps=link_gbps,
+        phase_comm_s=comm,
+        phase_compute_s=compute,
+        phase_cost_s=cost,
+        peak_hbm_bytes=peak_hbm_bytes,
+        dominant_collective=ledger.dominant_kind(),
+    )
+
+
+def price_program(hlo_text: str,
+                  config: Optional[Dict[str, Any]] = None) -> PredictedCost:
+    """Price one compiled program's step cost from its HLO text alone.
+
+    ``config`` keys (all optional; fallbacks are the documented nominal
+    rates above, NOT silent zeros):
+
+    * ``program`` / ``world`` / ``zero_stage`` — ledger attribution
+      hints (defaults: ``"program"`` / 1 / 0);
+    * ``link_gbps`` — per-chip interconnect rate; default
+      ``comm.bandwidth.DEFAULT_LINK_GBPS`` (the CPU-tier nominal);
+    * ``cost_flops`` + ``peak_flops`` — whole-step FLOPs and the chip
+      peak; together they produce the fwd/bwd compute legs
+      (``COMPUTE_SHARE`` 1:2 split). Absent either, fwd/bwd compute is
+      0 and those phases price as pure wire time;
+    * ``update_elems`` / ``update_shard`` / ``n_moments`` /
+      ``hbm_gbps`` — the step phase's streamed-update estimate
+      (per-chip: elems/shard × (3+2·moments)×4B at ``hbm_gbps``;
+      default rate ``DEFAULT_UPDATE_GBPS``);
+    * ``overlap_comm`` / ``overlap_step`` — whether fwd+bwd / step
+      price as ``max(compute, comm)`` (overlapped) or the serial sum;
+    * ``memory_stats`` — a ``memory_analysis()`` dict; its
+      args+temp+out−alias peak rides into ``peak_hbm_bytes``.
+    """
+    opts = dict(config or {})
+    ledger = build_ledger(
+        hlo_text,
+        program=opts.get("program", "program"),
+        world=int(opts.get("world", 1) or 1),
+        zero_stage=int(opts.get("zero_stage", 0) or 0),
+        cost_flops=opts.get("cost_flops"),
+        cost_bytes_accessed=opts.get("cost_bytes_accessed"),
+    )
+    total_compute_s = None
+    flops, peak = opts.get("cost_flops"), opts.get("peak_flops")
+    if flops and peak:
+        total_compute_s = float(flops) / float(peak)
+    peak_hbm = None
+    if opts.get("memory_stats"):
+        from deepspeed_tpu.autotuning.memory_model import (
+            peak_bytes_from_stats,
+        )
+
+        peak_hbm = peak_bytes_from_stats(opts["memory_stats"])
+    return price_ledger(
+        ledger,
+        link_gbps=float(opts.get("link_gbps") or BW.DEFAULT_LINK_GBPS),
+        total_compute_s=total_compute_s,
+        update_elems=opts.get("update_elems"),
+        update_shard=int(opts.get("update_shard", 1) or 1),
+        n_moments=opts.get("n_moments"),
+        hbm_gbps=opts.get("hbm_gbps"),
+        overlap_comm=bool(opts.get("overlap_comm", True)),
+        overlap_step=bool(opts.get("overlap_step", False)),
+        peak_hbm_bytes=peak_hbm,
+    )

@@ -45,65 +45,29 @@ from deepspeed_tpu.profiling.observatory.overlap import (
     estimate_overlap,
     measure_overlap,
 )
+# the pricing math lives in pricing.py (ONE copy shared with the plan
+# engine and bench); re-exported here for the pre-extraction importers
+from deepspeed_tpu.profiling.observatory.pricing import (  # noqa: F401
+    COMPUTE_SHARE as _COMPUTE_SHARE,
+    DEFAULT_UPDATE_GBPS,
+    PHASES,
+    SUBSYSTEM_PHASE,
+    UPDATE_BYTES_PER_ELEM,
+    phase_comm_seconds as _phase_comm_seconds,
+    update_bytes_per_elem,
+)
 
 REPORT_VERSION = 1
 
-#: subsystem → the engine phase its collectives bill to
-SUBSYSTEM_PHASE = {
-    "zero_param_gather": "fwd",
-    "moe_dispatch": "fwd",
-    "pipeline_handoff": "fwd",
-    "zero_grad_sync": "bwd",
-    "zero_param_update": "step",   # the deferred post-update publish
-    "other": "step",
-}
-
-#: bytes one optimizer update streams per parameter element — the
-#: update is MEMORY-bound (elementwise; pricing it at the matmul peak
-#: would understate it by orders of magnitude on any real chip): Adam
-#: reads+writes fp32 master and two fp32 moments and reads the fp32
-#: grad ≈ 7 × 4B streams. The step phase's compute leg, priced only
-#: when the engine's bucketed update is active (the serial step bills
-#: its update to wall, not to an overlap estimate). The documented
-#: Adam default; ``_update_bytes_per_elem`` derives the real figure
-#: from the engine's optimizer moment count.
-UPDATE_BYTES_PER_ELEM = 28.0
-
-
-def _update_bytes_per_elem(engine) -> float:
-    """Streamed fp32 bytes per master element for ONE update: the grad
-    read + master read/write + a read/write per optimizer moment tree
-    ((3 + 2·moments) × 4B — Adam's two moments give the documented
-    ``UPDATE_BYTES_PER_ELEM``; SGD's single moment ~20B)."""
-    names = getattr(getattr(engine, "optimizer", None),
-                    "moment_names", None)
-    if names is None:
-        return UPDATE_BYTES_PER_ELEM
-    return (3 + 2 * len(names)) * 4.0
-
-#: host memory bandwidth used when the backend has no datasheet HBM
-#: rate (the CPU tier) — the compute-side twin of
-#: ``comm.bandwidth.DEFAULT_LINK_GBPS``: a documented nominal rate so
-#: the estimator path still produces a step-phase estimate instead of a
-#: structural zero (one host core streams ~10 GB/s)
-DEFAULT_UPDATE_GBPS = 10.0
-
-#: fwd/bwd compute split when only whole-step FLOPs are known (the
-#: standard 1:2 fwd:bwd ratio; optimizer flops are noise at LM scale)
-_COMPUTE_SHARE = {"fwd": 1.0 / 3.0, "bwd": 2.0 / 3.0, "step": 0.0}
-
-PHASES = ("fwd", "bwd", "step")
 VERDICTS = ("compute-bound", "comm-bound", "host-bound")
 
 
-def _phase_comm_seconds(ledger: CollectiveLedger,
-                        link_gbps: float) -> Dict[str, float]:
-    out = {p: 0.0 for p in PHASES}
-    for op in ledger.ops:
-        phase = SUBSYSTEM_PHASE.get(op.subsystem or "other", "step")
-        out[phase] += BW.predicted_seconds(op.kind, op.size_bytes,
-                                           op.group_size, link_gbps)
-    return out
+def _update_bytes_per_elem(engine) -> float:
+    """Streamed update bytes per master element from the live engine's
+    optimizer moment count (``pricing.update_bytes_per_elem``)."""
+    names = getattr(getattr(engine, "optimizer", None),
+                    "moment_names", None)
+    return update_bytes_per_elem(len(names) if names is not None else None)
 
 
 def _phase_dominant_kind(ledger: CollectiveLedger) -> Dict[str, Optional[str]]:
@@ -477,7 +441,9 @@ def bench_comms_block(engine,
         walls.update(_tracer_phase_walls())
         wall = (walls.get("train_step")
                 or sum(walls.get(p, 0.0) for p in PHASES))
-    comm_s = ledger.predicted_comm_seconds(link)
+    from deepspeed_tpu.profiling.observatory.pricing import price_ledger
+
+    comm_s = price_ledger(ledger, link_gbps=link).comm_s
     overlap = estimate_overlap(wall, comm_s, compute_s) if wall and wall > 0 \
         else None
     led = ledger.to_dict(link_gbps=link, max_ops=0)

@@ -352,6 +352,48 @@ class MemlintSectionConfig:
 
 
 @dataclasses.dataclass
+class AutotuningSectionConfig:
+    """Observatory-driven plan engine (``deepspeed_tpu/autotuning/planner``).
+
+    Reuses the reference's ``"autotuning"`` section name (previously
+    accepted-and-ignored on TPU) for the TPU-native plan cache:
+    ``enabled`` makes the engine look up a committed plan for its
+    ``(model_fingerprint, mesh_shape, wire_format, platform)`` key under
+    ``plan_cache_dir`` at initialize and apply the planned knobs to any
+    knob the user left at its default (explicit JSON settings always
+    win). ``fail_on_stale`` refuses initialize when the user's explicit
+    config CONTRADICTS the cached plan (a stale plan silently mis-tuned
+    a job once; the refusal names the conflicting knobs) — off, the
+    conflict logs and the user's values stand. ``confirm_top_k`` /
+    ``max_candidates`` bound the planner's measured-confirmation windows
+    and enumerated candidate count when ``tools/plan`` builds the cache.
+    """
+    enabled: bool = False
+    plan_cache_dir: str = "autotune_plans"
+    confirm_top_k: int = 2
+    max_candidates: int = 64
+    fail_on_stale: bool = False
+
+    def validate(self) -> None:
+        if not isinstance(self.plan_cache_dir, str):
+            raise DeepSpeedConfigError(
+                "autotuning.plan_cache_dir must be a path string, got "
+                f"{type(self.plan_cache_dir).__name__}")
+        if not isinstance(self.confirm_top_k, int) \
+                or isinstance(self.confirm_top_k, bool) \
+                or self.confirm_top_k < 0:
+            raise DeepSpeedConfigError(
+                "autotuning.confirm_top_k must be a non-negative int, "
+                f"got {self.confirm_top_k!r}")
+        if not isinstance(self.max_candidates, int) \
+                or isinstance(self.max_candidates, bool) \
+                or self.max_candidates < 1:
+            raise DeepSpeedConfigError(
+                "autotuning.max_candidates must be a positive int, got "
+                f"{self.max_candidates!r}")
+
+
+@dataclasses.dataclass
 class ServingSectionConfig:
     """Serving resilience front-end (``deepspeed_tpu/serving``).
 
@@ -792,9 +834,10 @@ class ProgressiveLayerDropConfig:
 
 
 # CUDA-only reference sections accepted and ignored (keeps real DeepSpeed JSON
-# configs loadable); each logs once when present.
+# configs loadable); each logs once when present. "autotuning" left this
+# list in PR 16 — it now configures the TPU-native plan engine.
 _IGNORED_SECTIONS = (
-    "amp", "autotuning", "aio", "hybrid_engine", "compression_training",
+    "amp", "aio", "hybrid_engine", "compression_training",
     "sparse_attention", "zero_allow_untested_optimizer", "communication_data_type",
     "elasticity",
 )
@@ -827,6 +870,8 @@ class DeepSpeedTPUConfig:
         default_factory=HlolintSectionConfig)
     memlint: MemlintSectionConfig = dataclasses.field(
         default_factory=MemlintSectionConfig)
+    autotuning: AutotuningSectionConfig = dataclasses.field(
+        default_factory=AutotuningSectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)
@@ -941,6 +986,12 @@ def load_config(config) -> DeepSpeedTPUConfig:
             logger.warning(f"config section {section!r} is not applicable on TPU — ignored")
             config.pop(section)
     cfg = config_from_dict(DeepSpeedTPUConfig, config)
+    # which zero_optimization knobs the USER spelled out, verbatim — the
+    # plan engine's apply/stale logic needs "explicitly set" vs "left at
+    # default", and a dataclass can't tell the difference after the fact
+    zo = config.get("zero_optimization")
+    cfg._explicit_zero_keys = frozenset(zo) if isinstance(zo, dict) \
+        else frozenset()
     # launcher/env defaults (deepspeed_tpu.launcher --resume_dir /
     # --auto_resume): explicit JSON settings always win
     import os as _os

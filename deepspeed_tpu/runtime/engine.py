@@ -92,6 +92,11 @@ class DeepSpeedTPUEngine:
         # they RAISE: a mis-sized subgroup silently degrading to exact
         # full-world collectives is the config no-op class of bug
         zcfg = self.config.zero_optimization
+        # cached autotune plan ("autotuning" config section): applied HERE,
+        # before ANY knob is consumed — zero_hpz_partition_size feeds the
+        # subgroup resolution just below, the bucket/overlap keys feed
+        # _setup_overlap_scheduler — so a loaded plan covers all of them
+        self._load_autotune_plan(zcfg)
         subgroup = zcfg.mics_shard_size or (
             zcfg.zero_hpz_partition_size if zcfg.zero_hpz_partition_size > 1 else 0)
         if subgroup:
@@ -588,6 +593,108 @@ class DeepSpeedTPUEngine:
             logger.warning("qwZ/qgZ and 1-bit transport are mutually "
                            "exclusive — using 1-bit transport")
             self._compressed = None
+
+    # ------------------------------------------------------------------ #
+    # autotune plan cache ("autotuning" section; autotuning/planner.py)
+    # ------------------------------------------------------------------ #
+    def _load_autotune_plan(self, zcfg) -> None:
+        """Load and apply the cached autotune plan for this engine's
+        ``(model_fingerprint, mesh_shape, wire_format, platform)`` key.
+
+        Called at the TOP of ``__init__`` — before the hpZ subgroup
+        resolution and the overlap scheduler consume any of the planned
+        knobs. A knob the user ALSO set explicitly (tracked via
+        ``_explicit_zero_keys`` from ``load_config``) is never
+        overwritten: agreement is a hit, contradiction is a STALE plan —
+        refused outright under ``autotuning.fail_on_stale``, else the
+        explicit value wins with a loud warning. ``self._plan_status``
+        ∈ {disabled, miss, hit, stale} for bench/report consumers.
+        """
+        self._plan_status = "disabled"
+        self._plan_key: Optional[str] = None
+        self._plan_doc: Optional[Dict] = None
+        acfg = self.config.autotuning
+        if acfg is None or not acfg.enabled:
+            return
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.autotuning import planner as _planner
+
+        key, _fields = _planner.plan_key_for_config(self.config,
+                                                    self.model_spec)
+        self._plan_key = key
+        path = _planner.plan_path(acfg.plan_cache_dir, key)
+        miss = telemetry.counter(
+            "autotune_plan_cache_misses_total",
+            "engine initializations with no usable cached plan")
+        if not os.path.exists(path):
+            self._plan_status = "miss"
+            miss.inc()
+            return
+        try:
+            doc = _planner.load_plan(path)
+        except _planner.PlanError as e:
+            if acfg.fail_on_stale:
+                raise DeepSpeedConfigError(
+                    f"autotuning.fail_on_stale: cached plan {path} is "
+                    f"unreadable or schema-invalid ({e}) — regenerate it "
+                    "with tools/plan or unset fail_on_stale") from None
+            logger.warning(f"cached autotune plan {path} invalid — "
+                           f"ignored ({e})")
+            self._plan_status = "miss"
+            miss.inc()
+            return
+        explicit = getattr(self.config, "_explicit_zero_keys", None)
+        from deepspeed_tpu.runtime.config import ZeroConfig as _ZC
+
+        defaults = _ZC()
+        conflicts, applied = [], []
+        for k, v in doc["knobs"].items():
+            cur = getattr(zcfg, k, None)
+            is_explicit = (k in explicit if explicit is not None
+                           else cur != getattr(defaults, k, None))
+            if is_explicit:
+                if cur != v:
+                    conflicts.append(f"{k}: config={cur!r} plan={v!r}")
+                continue
+            if k == "zero_hpz_partition_size" and v and int(v) > 1:
+                # the subgroup IS the zshard axis: the planner's hpZ
+                # candidates shrink 'data' by the subgroup width (same
+                # device world, data x zshard layout) — mirror that, or
+                # skip the knob when this mesh can't host it (an
+                # explicit data axis not divisible by the subgroup)
+                mesh = self.config.mesh
+                if mesh.zshard == 1 and mesh.data > 1 \
+                        and mesh.data % int(v) == 0:
+                    mesh.data //= int(v)
+                elif mesh.data > 0 and mesh.zshard == 1:
+                    logger.warning(
+                        f"autotune plan knob zero_hpz_partition_size={v} "
+                        f"does not divide mesh.data={mesh.data} — knob "
+                        "skipped")
+                    continue
+            setattr(zcfg, k, v)
+            applied.append(k)
+        if conflicts:
+            self._plan_status = "stale"
+            detail = "; ".join(conflicts)
+            if acfg.fail_on_stale:
+                raise DeepSpeedConfigError(
+                    f"autotuning.fail_on_stale: engine config contradicts "
+                    f"cached plan {path} ({detail}) — re-run tools/plan "
+                    "for this config or drop the conflicting explicit "
+                    "keys")
+            logger.warning(
+                f"cached autotune plan {path} is STALE against explicit "
+                f"config keys ({detail}) — explicit values kept; planned "
+                f"values applied only to: {applied or 'none'}")
+            return
+        self._plan_status = "hit"
+        self._plan_doc = doc
+        telemetry.counter(
+            "autotune_plan_cache_hits_total",
+            "engine initializations that applied a cached plan").inc()
+        log_dist(f"autotune plan {key} applied "
+                 f"(winner={doc.get('winner')}, knobs={applied})")
 
     # ------------------------------------------------------------------ #
     # overlap scheduler (parallel/overlap.py — README "Overlap scheduler")

@@ -34,6 +34,7 @@ setup(
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
             "bench-diff=deepspeed_tpu.bench.cli:main",
             "step-report=deepspeed_tpu.profiling.observatory.__main__:main",
+            "plan=deepspeed_tpu.autotuning.__main__:main",
         ],
     },
     # tools/dslint + tools/bench-diff are checkout-only shims; the

@@ -127,6 +127,13 @@ def pytest_configure(config):
         "replicas — CPU backend, tier-1-eligible under JAX_PLATFORMS=cpu; "
         "the zero-lost-uid / zero-KV-leak invariants are the acceptance "
         "criteria)")
+    config.addinivalue_line(
+        "markers", "autotune: observatory-driven plan-engine tests "
+        "(plan schema + canary enforcement, analytic OOM refusal, "
+        "plan-key purity, engine plan-cache hit/stale/fail_on_stale, "
+        "bench gate noise band, predicted-state pins against the "
+        "committed memlint contracts — tier-1-eligible under "
+        "JAX_PLATFORMS=cpu on the 8-device virtual mesh)")
 
 
 @pytest.hookimpl(wrapper=True)

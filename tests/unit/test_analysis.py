@@ -245,6 +245,32 @@ class TestConfigKeys:
             f"memlint keys no longer consumed: "
             f"{memlint_keys - consumed}")
 
+    def test_autotuning_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for the plan cache (ISSUE 16): the
+        # "autotuning" section's keys must stay OUT of the dead-key
+        # ledger and stay actually consumed — the engine reads them in
+        # _load_autotune_plan and the tools/plan front end reads the
+        # planner defaults; a refactor that drops the read would turn
+        # the plan cache decorative (the reference's autotuning section
+        # was exactly that kind of accepted-and-ignored key for 15 PRs)
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        autotuning_keys = {"autotuning", "plan_cache_dir",
+                           "confirm_top_k", "max_candidates",
+                           "fail_on_stale"}
+        assert not autotuning_keys & set(DEAD_KEYS), (
+            "autotuning section keys declared dead — "
+            "runtime/engine.py consumes them (_load_autotune_plan) and "
+            "autotuning/__main__.py reads the section defaults")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, autotuning_keys)
+        assert consumed == autotuning_keys, (
+            f"autotuning keys no longer consumed: "
+            f"{autotuning_keys - consumed}")
+
     def test_dead_key_ledger_entries_are_actually_dead(self):
         # every DEAD_KEYS entry must be honest: not read as a config attr
         # anywhere in the package (the rule flags per-site; this pins the

@@ -1,0 +1,477 @@
+"""Plan-engine tests (``deepspeed_tpu/autotuning/planner`` + the engine
+plan cache, ISSUE 16).
+
+The acceptance scenario lives here end-to-end on the 8-device virtual
+CPU mesh: a ``--dry-run`` plan must analytically REFUSE at least one
+deliberately-infeasible candidate with memlint's ``oom-preflight`` rule
+named, rank survivors by predicted step cost with per-candidate
+comm/HBM numbers, and write a ``plan.json`` a fresh
+``deepspeed_tpu.initialize`` loads as a cache HIT (counter +1, knobs
+applied) — while an engine whose explicit config CONTRADICTS the cached
+plan is refused under ``autotuning.fail_on_stale``.
+
+The predicted-state pins at the bottom are the satellite: the analytic
+``memory_model.predicted_state_bytes_per_device`` the planner's OOM
+pre-flight leans on is pinned against the committed
+``analysis/memlint/contracts/*.json`` ``predicted_state_bytes`` values
+for all seven observatory fixtures — the refusal gate and the enforced
+memory contracts must never drift apart silently.
+"""
+import importlib.util
+import json
+import os
+
+import jax
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.autotuning import planner
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.runtime.config import load_config
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+pytestmark = pytest.mark.autotune
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+REGEN = os.path.join(REPO_ROOT, "tools", "regen_hlo_fixtures.py")
+
+
+def _spec():
+    return dst.causal_lm_spec("tiny", dtype="float32", num_layers=2,
+                              max_seq_len=64)
+
+
+def _base_config(stage=3, **zero_extra):
+    zero = {"stage": stage}
+    zero.update(zero_extra)
+    return {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+        "mesh": {"data": 8},
+        "steps_per_print": 10 ** 9,
+    }
+
+
+def _valid_doc(**over):
+    doc = {
+        "plan_version": planner.PLAN_VERSION,
+        "key": "abc123def456-data8-exact-cpu",
+        "key_fields": {"model_fingerprint": "abc123def456",
+                       "mesh_shape": "data8", "wire_format": "exact",
+                       "platform": "cpu"},
+        "seq_len": 32, "micro_batch": 1,
+        "knobs": {"reduce_bucket_size": 4096, "overlap_comm": True},
+        "predicted": {"total_s": 0.01},
+        "counters": {"priced": 1, "oom_refused": 1},
+        "candidates": [
+            {"name": "b4096_step0", "knobs": {"reduce_bucket_size": 4096},
+             "verdict": planner.VERDICT_PRICED},
+            {"name": planner.CANARY_NAME, "knobs": {},
+             "verdict": planner.VERDICT_OOM_REFUSED,
+             "refusal": "oom-preflight: predicted peak exceeds budget"},
+        ],
+    }
+    doc.update(over)
+    return doc
+
+
+# --------------------------------------------------------------------- #
+# plan document schema
+# --------------------------------------------------------------------- #
+class TestPlanSchema:
+    def test_valid_doc_passes(self):
+        assert planner.validate_plan(_valid_doc()) == []
+
+    def test_missing_required_key_is_named(self):
+        doc = _valid_doc()
+        del doc["counters"]
+        errs = planner.validate_plan(doc)
+        assert any("counters" in e for e in errs)
+
+    def test_version_mismatch_rejected(self):
+        errs = planner.validate_plan(_valid_doc(plan_version=99))
+        assert any("plan_version" in e for e in errs)
+
+    def test_unknown_applied_knob_rejected(self):
+        doc = _valid_doc(knobs={"reduce_bucket_size": 4096,
+                                "cpu_offload": True})
+        errs = planner.validate_plan(doc)
+        assert any("cpu_offload" in e for e in errs)
+
+    def test_plan_without_a_refused_candidate_is_invalid(self):
+        # canary enforcement at the SCHEMA level: a plan whose run never
+        # exercised the oom-preflight refusal leg is not trustworthy
+        doc = _valid_doc()
+        doc["candidates"] = [c for c in doc["candidates"]
+                             if c["verdict"] != planner.VERDICT_OOM_REFUSED]
+        errs = planner.validate_plan(doc)
+        assert any("oom_refused" in e for e in errs)
+
+    def test_write_refuses_invalid_and_roundtrips_valid(self, tmp_path):
+        path = str(tmp_path / "x.plan.json")
+        with pytest.raises(planner.PlanError, match="refusing to write"):
+            planner.write_plan(path, _valid_doc(plan_version=99))
+        assert not os.path.exists(path)
+        planner.write_plan(path, _valid_doc())
+        assert planner.load_plan(path) == _valid_doc()
+
+    def test_load_garbage_raises_plan_error(self, tmp_path):
+        path = tmp_path / "bad.plan.json"
+        path.write_text("{not json")
+        with pytest.raises(planner.PlanError, match="cannot read"):
+            planner.load_plan(str(path))
+        with pytest.raises(planner.PlanError, match="invalid plan"):
+            p2 = tmp_path / "empty.plan.json"
+            p2.write_text("{}")
+            planner.load_plan(str(p2))
+
+    def test_validator_never_raises_on_garbage(self):
+        for garbage in (None, 7, "x", [], {"plan_version": "one"}):
+            assert planner.validate_plan(garbage)    # errors, not a raise
+
+
+# --------------------------------------------------------------------- #
+# plan identity — the key both sides compute from config alone
+# --------------------------------------------------------------------- #
+class TestPlanKey:
+    def test_mesh_shape_token(self):
+        assert planner.mesh_shape_token({"data": 8}) == "data8"
+        assert planner.mesh_shape_token(
+            {"data": 4, "tensor": 2, "pipe": 1}) == "data4.tensor2"
+        assert planner.mesh_shape_token({"data": 1}) == "single"
+
+    def test_model_fingerprint_stable_and_shape_sensitive(self):
+        fp1 = planner.model_fingerprint(_spec())
+        fp2 = planner.model_fingerprint(_spec())
+        assert fp1 == fp2 and len(fp1) == 12
+        wider = dst.causal_lm_spec("tiny", dtype="float32", num_layers=4,
+                                   max_seq_len=64)
+        assert planner.model_fingerprint(wider) != fp1
+
+    def test_wire_format_mirrors_the_engine_resolution(self):
+        shape = {"data": 8}
+        exact = load_config(_base_config(stage=2))
+        assert planner.wire_format_from_config(exact, shape) == "exact"
+        qz = load_config(_base_config(stage=3,
+                                      zero_quantized_weights=True))
+        assert planner.wire_format_from_config(qz, shape) == "qz"
+        loco = load_config(_base_config(stage=2,
+                                        zero_quantized_gradients=True,
+                                        loco_error_feedback=True))
+        assert planner.wire_format_from_config(loco, shape) == "qz+loco"
+        # a 1-device world has no wire to compress
+        assert planner.wire_format_from_config(qz, {"data": 1}) == "exact"
+
+    def test_key_is_pure_in_the_config(self):
+        cfg = load_config(_base_config(stage=3))
+        k1, f1 = planner.plan_key_for_config(cfg, _spec())
+        k2, f2 = planner.plan_key_for_config(load_config(
+            _base_config(stage=3)), _spec())
+        assert k1 == k2 and f1 == f2
+        assert f1["platform"] == jax.default_backend()
+        assert f1["mesh_shape"] == "data8"
+        assert k1 == "-".join(f1[k] for k in (
+            "model_fingerprint", "mesh_shape", "wire_format", "platform"))
+
+
+# --------------------------------------------------------------------- #
+# the plan engine, analytic leg (--dry-run: nothing compiles)
+# --------------------------------------------------------------------- #
+class TestPlanEngineDryRun:
+    def _engine(self, stage=3, budget=8 << 30, **kw):
+        return planner.PlanEngine(_spec(), _base_config(stage=stage),
+                                  seq_len=32, hbm_budget_bytes=budget,
+                                  confirm_top_k=0, **kw)
+
+    def test_canary_is_refused_with_the_rule_named(self):
+        doc = self._engine().run(dry_run=True)
+        assert planner.validate_plan(doc) == []
+        canary = next(c for c in doc["candidates"]
+                      if c["name"] == planner.CANARY_NAME)
+        assert canary["verdict"] == planner.VERDICT_OOM_REFUSED
+        assert "oom-preflight" in canary["refusal"]
+        assert canary["est_hbm_bytes"] > planner.CANARY_BUDGET_BYTES
+        assert doc["counters"][planner.VERDICT_OOM_REFUSED] >= 1
+
+    def test_survivors_are_priced_and_the_winner_is_cheapest(self):
+        doc = self._engine().run(dry_run=True)
+        priced = [c for c in doc["candidates"]
+                  if c["verdict"] == planner.VERDICT_PRICED]
+        assert len(priced) == doc["counters"][planner.VERDICT_PRICED] >= 6
+        for c in priced:
+            # per-candidate comm + HBM numbers ride in the doc
+            assert {"total_s", "comm_s", "compute_s",
+                    "wire_bytes"} <= set(c["analytic"])
+            assert c["analytic"]["comm_s"] > 0
+            assert c["est_hbm_bytes"] > 0
+        best = min(c["analytic"]["total_s"] for c in priced)
+        assert doc["predicted"]["total_s"] == best
+        assert doc["winner"] in {c["name"] for c in priced}
+        assert doc["dry_run"] is True
+
+    def test_stage3_enumerates_prefetch_and_hpz(self):
+        names = [c.name for c in self._engine().enumerate_candidates()]
+        assert "hpz4" in names                      # world 8, stage 3
+        cands = self._engine().enumerate_candidates()
+        buckets = [c for c in cands if c.name.startswith("b")]
+        assert all("stage3_prefetch_bucket_size" in c.knobs
+                   for c in buckets)
+        assert all("allgather_bucket_size" not in c.knobs
+                   for c in buckets)
+
+    def test_stage2_enumerates_allgather_and_no_hpz(self):
+        cands = self._engine(stage=2).enumerate_candidates()
+        names = [c.name for c in cands]
+        assert not any(n.startswith("hpz") for n in names)
+        buckets = [c for c in cands if c.name.startswith("b")]
+        assert all("allgather_bucket_size" in c.knobs for c in buckets)
+
+    def test_quantized_wire_adds_qgz_blocks_and_cheaper_bytes(self):
+        eng = planner.PlanEngine(
+            _spec(), _base_config(stage=2, zero_quantized_gradients=True,
+                                  loco_error_feedback=True),
+            seq_len=32, hbm_budget_bytes=8 << 30, confirm_top_k=0)
+        doc = eng.run(dry_run=True)
+        qgz = [c for c in doc["candidates"]
+               if c["name"].startswith("qgz_block")]
+        assert {c["name"] for c in qgz} == {"qgz_block1024",
+                                            "qgz_block4096"}
+        assert all(c["info"]["qgz_block"] in (1024, 4096) for c in qgz)
+        # int8 + per-block scales beats 4 B/elem fp32 grads on the wire
+        exact = self._engine(stage=2).run(dry_run=True)
+        q_bytes = min(c["analytic"]["wire_bytes"]
+                      for c in doc["candidates"] if c.get("analytic"))
+        e_bytes = min(c["analytic"]["wire_bytes"]
+                      for c in exact["candidates"] if c.get("analytic"))
+        assert q_bytes < e_bytes
+
+    def test_infeasible_budget_refuses_everything_loudly(self):
+        eng = self._engine(budget=1000)
+        with pytest.raises(planner.PlanError, match="no feasible"):
+            eng.run(dry_run=True)
+
+    def test_refusal_names_the_oom_preflight_rule(self):
+        eng = self._engine()
+        cand = planner.Candidate(name="doomed", knobs={
+            "reduce_bucket_size": 4096, "overlap_comm": True})
+        refusal = eng.refuse_candidate(cand, budget=1)
+        assert refusal and "oom-preflight" in refusal
+        assert cand.est_hbm_bytes > 1
+        # the same candidate under a sane budget is feasible
+        assert eng.refuse_candidate(cand, budget=8 << 30) is None
+
+    def test_unrefused_canary_is_an_internal_error(self, monkeypatch):
+        eng = self._engine()
+        monkeypatch.setattr(eng, "refuse_candidate",
+                            lambda cand, budget=None: None)
+        with pytest.raises(planner.PlanError, match="canary"):
+            eng.run(dry_run=True)
+
+
+# --------------------------------------------------------------------- #
+# engine plan cache — hit / miss / stale / fail_on_stale
+# --------------------------------------------------------------------- #
+class TestEnginePlanCache:
+    def _plan_for(self, base, cache_dir):
+        eng = planner.PlanEngine(_spec(), base, seq_len=32,
+                                 hbm_budget_bytes=8 << 30,
+                                 confirm_top_k=0)
+        doc = eng.run(dry_run=True)
+        planner.write_plan(planner.plan_path(cache_dir, doc["key"]), doc)
+        return doc
+
+    def _counter(self, name):
+        from deepspeed_tpu import telemetry
+
+        return telemetry.counter(name)
+
+    def test_cache_hit_applies_knobs_and_counts(self, tmp_path):
+        base = _base_config(stage=2)
+        doc = self._plan_for(base, str(tmp_path))
+        hits = self._counter("autotune_plan_cache_hits_total")
+        before = hits.value()
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=dict(
+            base, autotuning={"enabled": True,
+                              "plan_cache_dir": str(tmp_path)}))
+        assert engine._plan_status == "hit"
+        assert engine._plan_key == doc["key"]
+        assert hits.value() == before + 1
+        z = engine.config.zero_optimization
+        assert z.reduce_bucket_size == doc["knobs"]["reduce_bucket_size"]
+        assert z.overlap_comm == doc["knobs"]["overlap_comm"]
+        assert z.overlap_step == doc["knobs"]["overlap_step"]
+
+    def test_cache_miss_counts_and_proceeds(self, tmp_path):
+        misses = self._counter("autotune_plan_cache_misses_total")
+        before = misses.value()
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=dict(
+            _base_config(stage=2),
+            autotuning={"enabled": True,
+                        "plan_cache_dir": str(tmp_path / "empty")}))
+        assert engine._plan_status == "miss"
+        assert misses.value() == before + 1
+
+    def test_disabled_without_the_section(self):
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(),
+                                    config=_base_config(stage=2))
+        assert engine._plan_status == "disabled"
+
+    def test_contradicting_engine_refused_under_fail_on_stale(
+            self, tmp_path):
+        base = _base_config(stage=2)
+        self._plan_for(base, str(tmp_path))
+        stale = _base_config(stage=2, reduce_bucket_size=1234)
+        mesh_mod.reset_mesh()
+        with pytest.raises(DeepSpeedConfigError, match="fail_on_stale"):
+            dst.initialize(model=_spec(), config=dict(
+                stale, autotuning={"enabled": True,
+                                   "plan_cache_dir": str(tmp_path),
+                                   "fail_on_stale": True}))
+
+    def test_stale_warns_and_keeps_the_explicit_value(self, tmp_path):
+        base = _base_config(stage=2)
+        self._plan_for(base, str(tmp_path))
+        stale = _base_config(stage=2, reduce_bucket_size=1234)
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=dict(
+            stale, autotuning={"enabled": True,
+                               "plan_cache_dir": str(tmp_path)}))
+        assert engine._plan_status == "stale"
+        assert engine.config.zero_optimization.reduce_bucket_size == 1234
+
+    def test_invalid_cached_plan_is_a_miss_not_a_crash(self, tmp_path):
+        base = _base_config(stage=2)
+        doc = self._plan_for(base, str(tmp_path))
+        path = planner.plan_path(str(tmp_path), doc["key"])
+        with open(path, "w") as f:
+            f.write("{not json")
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=dict(
+            base, autotuning={"enabled": True,
+                              "plan_cache_dir": str(tmp_path)}))
+        assert engine._plan_status == "miss"
+
+    def test_hpz_knob_shrinks_the_data_axis(self, tmp_path):
+        # the subgroup IS the zshard axis: a planned hpZ knob on a flat
+        # data=8 mesh must re-shape it to data=2 x zshard=4, exactly as
+        # the planner's candidate configs do
+        base = _base_config(stage=3)
+        doc = self._plan_for(base, str(tmp_path))
+        doc["knobs"] = dict(doc["knobs"], zero_hpz_partition_size=4)
+        path = planner.plan_path(str(tmp_path), doc["key"])
+        planner.write_plan(path, doc)
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=_spec(), config=dict(
+            base, autotuning={"enabled": True,
+                              "plan_cache_dir": str(tmp_path)}))
+        assert engine._plan_status == "hit"
+        assert engine.config.zero_optimization.zero_hpz_partition_size == 4
+        assert engine.config.mesh.data == 2
+        assert engine.config.mesh.zshard == 4
+
+
+# --------------------------------------------------------------------- #
+# tools/plan front end (in-process: the tier-1 env already forced the
+# 8-device CPU world, so _ensure_devices is a no-op here)
+# --------------------------------------------------------------------- #
+class TestPlanCli:
+    def _main(self, *argv):
+        from deepspeed_tpu.autotuning.__main__ import main
+
+        return main(list(argv))
+
+    def test_dry_run_emits_a_schema_valid_plan(self, tmp_path, capsys):
+        rc = self._main("--model", "tiny", "--zero-stage", "3",
+                        "--dry-run", "--format", "json",
+                        "--plan-cache-dir", str(tmp_path))
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert planner.validate_plan(doc) == []
+        assert os.path.exists(doc["plan_path"])
+        assert planner.load_plan(doc["plan_path"])["key"] == doc["key"]
+        canary = next(c for c in doc["candidates"]
+                      if c["name"] == planner.CANARY_NAME)
+        assert "oom-preflight" in canary["refusal"]
+
+    def test_text_format_renders_the_candidate_table(self, tmp_path,
+                                                     capsys):
+        rc = self._main("--model", "tiny", "--zero-stage", "2",
+                        "--dry-run", "--plan-cache-dir", str(tmp_path))
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "winner:" in out and "refused: oom-preflight" in out
+        assert "plan written:" in out
+
+    def test_unknown_model_exits_2(self, tmp_path, capsys):
+        rc = self._main("--model", "no_such_model", "--dry-run",
+                        "--plan-cache-dir", str(tmp_path))
+        assert rc == 2
+        assert "unknown model" in capsys.readouterr().err
+
+    def test_infeasible_budget_exits_1(self, tmp_path, capsys):
+        rc = self._main("--model", "tiny", "--dry-run",
+                        "--hbm-budget-bytes", "1000",
+                        "--plan-cache-dir", str(tmp_path))
+        assert rc == 1
+        assert "no feasible" in capsys.readouterr().err
+
+    def test_unrefused_canary_exits_2(self, tmp_path, capsys,
+                                      monkeypatch):
+        monkeypatch.setattr(planner.PlanEngine, "refuse_candidate",
+                            lambda self, cand, budget=None: None)
+        rc = self._main("--model", "tiny", "--dry-run",
+                        "--plan-cache-dir", str(tmp_path))
+        assert rc == 2
+        assert "canary" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------- #
+# predicted-state pins against the committed memlint contracts
+# --------------------------------------------------------------------- #
+def _regen_module():
+    spec = importlib.util.spec_from_file_location("regen_hlo_fixtures",
+                                                  REGEN)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_PINNED_STEMS = (
+    "zero2_tiny_step", "zero3_tiny_step", "moe_tiny_step",
+    "zero3_bucketed_async_step", "zero2_exact_bucketed_step",
+    "zero3_qwz_update_defer_async_step", "zero2_qgz_bucketed_async_step",
+)
+
+
+class TestPredictedStatePins:
+    @pytest.mark.parametrize("stem", _PINNED_STEMS)
+    def test_analytic_state_bytes_match_the_committed_contract(self, stem):
+        from deepspeed_tpu.analysis.memlint import contracts_dir
+        from deepspeed_tpu.autotuning import memory_model as mm
+
+        fx = _regen_module().FIXTURE_SPECS[stem]
+        spec_kwargs = dict(fx["spec"])
+        model = spec_kwargs.pop("model")
+        spec = dst.causal_lm_spec(model, dtype="float32", **spec_kwargs)
+        config = {
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "zero_optimization": dict(fx["zero"]),
+            "steps_per_print": 10 ** 9,
+        }
+        config.update(fx.get("batch") or {})
+        if fx.get("mesh"):
+            config["mesh"] = dict(fx["mesh"])
+        mesh_mod.reset_mesh()
+        engine, *_ = dst.initialize(model=spec, config=config)
+        with open(os.path.join(contracts_dir(), stem + ".json")) as f:
+            contract = json.load(f)
+        pinned = contract["config"]["predicted_state_bytes"]
+        assert mm.predicted_state_bytes_per_device(engine) == pinned
+        assert contract["config"]["world"] == engine.dp_world_size
+        assert contract["config"]["zero_stage"] == engine.zero_stage

@@ -428,6 +428,91 @@ class TestGate:
         assert info["noisy_regressions_ignored"] == 1
 
 
+class TestNoiseBand:
+    """Per-platform noise band (ISSUE 16 satellite): a regression inside
+    the lane's own measured round-to-round noise floor warns instead of
+    failing — the CPU lane's r08 fired on a ~5.5% drift with zero code
+    changes against a ~14% same-platform noise floor."""
+
+    def _cpu_history(self, tmp_path, values=(90.0, 100.0)):
+        # values land in file order: the LAST one is the gate baseline;
+        # all of them feed the noise-band stddev
+        path = str(tmp_path / "history.jsonl")
+        for i, v in enumerate(values):
+            res = make_result(v)
+            res["headline"]["platform"] = "cpu"
+            history_mod.append_record(
+                history_mod.record_from_result(res, f"r{90 + i}"), path)
+        return path
+
+    def _fresh(self, value):
+        res = make_result(value)
+        res["headline"]["platform"] = "cpu"
+        return res
+
+    def test_band_derived_from_same_platform_history(self, tmp_path):
+        path = self._cpu_history(tmp_path)
+        records, _ = history_mod.load_history(path)
+        band = gate.platform_noise_band(
+            records, "cpu", make_result()["headline"]["metric"])
+        # [90, 100]: sample stddev 7.07, mean 95 → 2σ_rel ≈ 0.1489
+        assert band == pytest.approx(0.1489, abs=1e-3)
+        # under 2 samples or no declared platform → no band
+        assert gate.platform_noise_band(records[:1], "cpu", None) is None
+        assert gate.platform_noise_band(records, None, None) is None
+
+    def test_band_is_capped(self, tmp_path):
+        path = self._cpu_history(tmp_path, values=(10.0, 100.0))
+        records, _ = history_mod.load_history(path)
+        band = gate.platform_noise_band(records, "cpu", None)
+        assert band == gate.NOISE_BAND_CAP
+
+    def test_env_override_and_disable(self, monkeypatch):
+        monkeypatch.setenv("BENCH_GATE_NOISE", "0.2")
+        assert gate.platform_noise_band([], None, None) == 0.2
+        monkeypatch.setenv("BENCH_GATE_NOISE", "0")
+        assert gate.platform_noise_band([], "cpu", None) is None
+        monkeypatch.setenv("BENCH_GATE_NOISE", "garbage")
+        assert gate.platform_noise_band([], "cpu", None) is None
+
+    def test_within_band_regression_warns_not_fails(self, tmp_path):
+        # -8% vs the r91 baseline: past the 5% threshold, inside the
+        # ~14.9% derived band → reported under noise_within_band, rc 0
+        path = self._cpu_history(tmp_path)
+        rc, info = gate.run_gate(self._fresh(92.0), history_path=path)
+        assert rc == gate.GATE_OK and info["ok"]
+        assert info["noise_band"] == pytest.approx(0.1489, abs=1e-3)
+        assert info["noise_within_band"]
+        assert not info["regressions"]
+
+    def test_beyond_band_regression_still_fails(self, tmp_path):
+        path = self._cpu_history(tmp_path)
+        rc, info = gate.run_gate(self._fresh(80.0), history_path=path)
+        assert rc == gate.GATE_REGRESSED
+        assert info["regressions"]
+
+    def test_noise_zero_restores_the_strict_gate(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("BENCH_GATE_NOISE", "0")
+        path = self._cpu_history(tmp_path)
+        rc, info = gate.run_gate(self._fresh(92.0), history_path=path)
+        assert rc == gate.GATE_REGRESSED
+        assert "noise_band" not in info
+
+    def test_error_transition_always_gates(self, tmp_path, monkeypatch):
+        # an error is never noise: even a sky-high band must not waive a
+        # measured → errored headline (delta_frac is None there)
+        monkeypatch.setenv("BENCH_GATE_NOISE", "10")
+        path = self._cpu_history(tmp_path)
+        fresh = self._fresh(92.0)
+        for side in (fresh, fresh["headline"]):
+            side["value"] = 0
+            side["error"] = "entry timed out after 123s"
+        rc, info = gate.run_gate(fresh, history_path=path)
+        assert rc == gate.GATE_REGRESSED
+        assert any(r["delta_frac"] is None for r in info["regressions"])
+
+
 class TestBenchDiffCli:
     def test_r05_injected_regression_flagged_from_the_recovered_record(
             self, tmp_path, capsys):

@@ -99,6 +99,35 @@ class TestSchemaValidator:
         errs = schema.validate_result(res)
         assert any("total_s" in e for e in errs)
 
+    def test_plan_block_carries_the_cache_verdict(self):
+        # v2.3: each entry row may carry the engine's autotune plan-cache
+        # verdict — a history round then shows which lanes ran under a
+        # cached plan and which planned from scratch
+        entry = {"metrics": {"tokens_per_sec_chip": 5.0},
+                 "plan": {"status": "hit",
+                          "key": "abc123-data8-exact-cpu"}}
+        res = make_result(entries={"autotune_plan": entry})
+        assert schema.validate_result(res) == []
+        entry["plan"] = {"status": "disabled"}     # key absent is fine
+        assert schema.validate_result(res) == []
+        entry["plan"] = {"status": "banana"}
+        assert any("plan.status" in e
+                   for e in schema.validate_result(res))
+        entry["plan"] = {"status": "hit", "key": 7}
+        assert any("plan.key" in e for e in schema.validate_result(res))
+        entry["plan"] = "hit"
+        assert any("plan must be a dict" in e
+                   for e in schema.validate_result(res))
+
+    def test_normalize_hoists_plan_out_of_the_flat_row(self):
+        # the raw --entry row is flat: the plan block must land as a
+        # STRUCTURAL entry key, not get swept into metrics (where a dict
+        # value would also be ungateable)
+        row = {"candidates": 8, "plan": {"status": "hit"}}
+        out = schema.normalize_entry_row(row)
+        assert out["plan"] == {"status": "hit"}
+        assert "plan" not in out["metrics"]
+
     def test_validator_never_raises_on_garbage(self):
         for garbage in (None, 7, "x", [], {"headline": 3, "entries": 4},
                         {"schema_version": "two"}):

@@ -201,7 +201,12 @@ class TestPlanGating:
 # numerics: bucketed + double-buffered == serial, per stage
 # --------------------------------------------------------------------- #
 class TestParity:
-    @pytest.mark.parametrize("stage", [1, 2, 3])
+    # stage 3 (hardest: sharded params + prefetch + deferred publish)
+    # carries the tier-1 pin; stages 1-2 ride the slow lane for the
+    # 870s budget
+    @pytest.mark.parametrize("stage", [
+        pytest.param(1, marks=pytest.mark.slow),
+        pytest.param(2, marks=pytest.mark.slow), 3])
     def test_exact_step_allclose_serial(self, stage):
         e_on = _engine(stage, True, **FORCING)
         assert e_on.overlap_plan()["param_buffer"]

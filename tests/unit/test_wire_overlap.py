@@ -143,6 +143,12 @@ class TestWireTransforms:
 # engine composition: bucketed wire == unbucketed wire, tracks exact
 # --------------------------------------------------------------------- #
 class TestComposedParity:
+    # tier-1 keeps ONE composed-parity engine pin
+    # (test_composed_tracks_exact_within_parity_band — the CONVERGE-band
+    # pin); the sibling identity/exactness variants each build 2-3 more
+    # engines over the same wire and ride the slow lane to hold the
+    # 870s tier-1 budget (same move as test_step_overlap's heavy pins)
+    @pytest.mark.slow
     @pytest.mark.parametrize("stage", [2, 3])
     def test_composed_loco_matches_unbucketed(self, stage):
         # the identity pin: with an exact forward (qgZ only — chunked
@@ -166,6 +172,7 @@ class TestComposedParity:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-6)
 
+    @pytest.mark.slow
     def test_qwz_only_keeps_exact_gradients(self):
         # quant_weights WITHOUT quant_grads + overlap: the bucketed
         # formulation must bucket EXACT reduces — gradients may not be
@@ -185,6 +192,7 @@ class TestComposedParity:
         e_off, l_off = _train(dict(base, overlap_comm=False), steps=4)
         np.testing.assert_allclose(l_on, l_off, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_composed_qz_matches_straight_through(self):
         # plain qgZ: overlap ON routes through the bucketed
         # (reduce-outside-vjp) formulation, overlap OFF keeps the
@@ -210,6 +218,7 @@ class TestComposedParity:
         for a, b in zip(exact, composed):
             assert abs(a - b) < 0.35, (exact, composed)
 
+    @pytest.mark.slow
     def test_trio_composed_hpz_qwz_qgz_loco(self):
         # the FULL ZeRO++ trio ON the overlap scheduler: hpZ subgroup
         # gathers ride the chunk plan, qwZ gathers are chunk-sliced
@@ -228,6 +237,7 @@ class TestComposedParity:
         for a, b in zip(exact, quant):
             assert abs(a - b) < 0.5, (exact, quant)
 
+    @pytest.mark.slow
     def test_rebucketing_preserves_loco_state(self):
         # residuals are keyed per LEAF — the bucket plan only orders the
         # sends. Two engines differing ONLY in reduce_bucket_size (and
@@ -551,6 +561,10 @@ def _wire_batch():
 
 @pytest.mark.chaos
 class TestComposedPreemption:
+    # slow lane: the heaviest single tier-1 test (~40s — subprocess
+    # twin + resume); SIGTERM-resume stays tier-1-covered by
+    # test_chaos/test_guardian's sigterm pins
+    @pytest.mark.slow
     def test_sigterm_resume_restores_loco_residuals(self, tmp_path):
         from deepspeed_tpu.checkpoint import fault_tolerance as ftmod
 

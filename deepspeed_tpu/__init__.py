@@ -44,10 +44,17 @@ def initialize(
     config: Any = None,
     mesh_param: Any = None,
     config_params: Any = None,
+    mesh_manager: Any = None,
 ) -> Tuple[DeepSpeedTPUEngine, Any, Any, Any]:
     """Initialize the engine (reference ``deepspeed.initialize`` signature,
     ``deepspeed/__init__.py:93``). Returns (engine, optimizer, dataloader,
-    lr_scheduler) like the reference."""
+    lr_scheduler) like the reference.
+
+    ``mesh_manager`` (a ``comm.mesh.MeshManager``) pins the engine to an
+    explicitly-built mesh instead of the config-derived one — the elastic
+    agent's engine factory uses it to build a world-M engine on a host
+    that physically has N devices (``initialize_mesh(cfg,
+    devices=jax.devices()[:M])``)."""
     config = config if config is not None else config_params
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
@@ -55,7 +62,8 @@ def initialize(
         raise ValueError("deepspeed_tpu.initialize requires a ModelSpec via `model=`")
 
     engine = DeepSpeedTPUEngine(
-        model=model, config=config, optimizer=optimizer, lr_scheduler=lr_scheduler)
+        model=model, config=config, optimizer=optimizer, lr_scheduler=lr_scheduler,
+        mesh_manager=mesh_manager)
 
     from deepspeed_tpu.monitor.monitor import MonitorMaster
 

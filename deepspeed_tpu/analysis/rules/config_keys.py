@@ -53,7 +53,9 @@ EXTRA_KEYS = {
     "weight_quantization",   # inference/quantization (reference spelling)
     "post_init_quant",       # inference/quantization (reference spelling)
     "compression_training",  # compression/compress.plan_compression
-    "elasticity",            # elasticity/elasticity.compute_elastic_config
+    # "elasticity" left this set in PR 17: it is now a DeepSpeedTPUConfig
+    # dataclass field (ElasticitySectionConfig) — declared in the schema
+    # proper, like "autotuning" before it
     "micro_batch",           # autotuning candidate dicts share the name
 }
 

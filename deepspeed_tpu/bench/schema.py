@@ -71,17 +71,30 @@ records load and validate unchanged::
 All guardian counts diff lower-is-better, so ``bench-diff`` flags an
 anomaly-ridden round (a 0 → nonzero move surfaces as an explicit
 zero-baseline row).
+
+Schema v2.4 adds one more OPTIONAL per-entry key — earlier records load
+and validate unchanged::
+
+    "elastic": {            # world-elastic resume accounting
+      "from_world": int, "to_world": int,   # source/destination dp world
+      "convert_s": number,  # native → universal conversion wall time
+      "reshard_s": number,  # load_universal_checkpoint wall time
+    },
+
+carried by the ``elastic_resume`` lane; ``bench-diff`` treats the wall
+times lower-is-better.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2.3
+SCHEMA_VERSION = 2.4
 
 #: versions validate_result accepts — v2 records predate the ``comms``
 #: block, v2.1 the ``guardian`` block, v2.2 the ``plan`` block
-#: (autotune plan-cache verdict per entry); otherwise shape-identical
-SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3)
+#: (autotune plan-cache verdict per entry), v2.3 the ``elastic`` block
+#: (world-elastic resume wall times); otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3, 2.4)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -90,7 +103,8 @@ RECORD_VERSION = 1
 # else inside an entry dict is treated as a metric
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
                          "elapsed_s", "skipped_reason", "error", "note",
-                         "comms", "overlap_fraction", "guardian", "plan")
+                         "comms", "overlap_fraction", "guardian", "plan",
+                         "elastic")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -216,6 +230,24 @@ def validate_plan_block(block: Any, where: str) -> List[str]:
     return errs
 
 
+def validate_elastic_block(block: Any, where: str) -> List[str]:
+    """Validate a v2.4 ``elastic`` block: world-elastic resume accounting
+    (the ``elastic_resume`` lane) — source/destination worlds plus the
+    conversion and reshard-load wall times."""
+    if not isinstance(block, dict):
+        return [f"{where}: elastic must be a dict"]
+    errs: List[str] = []
+    for key in ("from_world", "to_world"):
+        val = block.get(key)
+        if not isinstance(val, int) or isinstance(val, bool) or val <= 0:
+            errs.append(f"{where}: elastic.{key} must be a positive int")
+    for key in ("convert_s", "reshard_s"):
+        if key in block and (not is_number(block[key]) or block[key] < 0):
+            errs.append(f"{where}: elastic.{key} must be a non-negative "
+                        "number")
+    return errs
+
+
 def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
     if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
         return [f"{where}: overlap_fraction must be a number in [0, 1]"]
@@ -258,6 +290,8 @@ def validate_entry(entry: Any, name: str) -> List[str]:
         errs += validate_overlap_fraction(entry["overlap_fraction"], where)
     if "plan" in entry:
         errs += validate_plan_block(entry["plan"], where)
+    if "elastic" in entry:
+        errs += validate_elastic_block(entry["elastic"], where)
     return errs
 
 
@@ -401,7 +435,7 @@ def normalize_entry_row(row: Any,
     if "error" in row:
         out["error"] = str(row.pop("error"))
     for key in ("trace_phases", "telemetry", "memory", "comms", "guardian",
-                "plan"):
+                "plan", "elastic"):
         if key in row:
             val = row.pop(key)
             if val:

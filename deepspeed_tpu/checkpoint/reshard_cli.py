@@ -1,0 +1,167 @@
+"""``reshard``: convert a committed native checkpoint to universal form.
+
+The offline half of elastic worlds (``docs/tutorials/elasticity.md``): a
+zero-3 job checkpointed at world N becomes a topology-free universal dir
+any world M can restore (``engine.load_universal_checkpoint``). The
+conversion goes through the PR 2 commit protocol — a killed run leaves a
+complete committed dir or an ignorable ``.tmp``, never a half tree.
+
+``--dry-run`` converts nothing: it prices each candidate world through
+the placement oracle (``elasticity/placement.py`` — memlint's
+``oom-preflight`` rule) and prints the per-mesh verdict, so an operator
+knows BEFORE a resize whether the acquired world can hold the job.
+
+Exit codes (dslint-shaped, shared with ``tools/memlint``):
+
+* ``0`` — converted (or every surveyed world has a feasible mesh)
+* ``1`` — checkpoint corrupt (``CheckpointCorruptError``), or a surveyed
+  world was refused by the placement oracle on every candidate mesh
+* ``2`` — unreadable/missing inputs or usage errors
+
+Console entry: ``reshard`` (setup.py); shim: ``tools/reshard``.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, List, Optional
+
+_RED = "\x1b[31m"
+_GREEN = "\x1b[32m"
+_RESET = "\x1b[0m"
+
+
+def _c(text: str, color: str, enable: bool) -> str:
+    return f"{color}{text}{_RESET}" if enable else text
+
+
+def _model_info_from_state(state: Any, seq_len: int):
+    """Exact param count off the loaded master tree; architecture fields
+    stay 0 (the memory model prices state terms exactly and treats
+    activations as unknown — same contract as
+    ``placement.model_info_from_manifest``)."""
+    import numpy as np
+
+    from deepspeed_tpu.autotuning import memory_model as mm
+
+    n = 0
+    for leaf in _leaves(state.get("master", {})):
+        n += int(np.asarray(leaf).size)
+    return mm.ModelInfo(num_params=n, seq_len=seq_len)
+
+
+def _leaves(tree: Any) -> List[Any]:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _survey(info, worlds: List[int], hpz: List[int], args,
+            color: bool) -> int:
+    """Print the oracle verdict per candidate mesh for every world.
+    Returns 1 when any world has NO feasible candidate, else 0."""
+    from deepspeed_tpu.elasticity.placement import PlacementOracle
+
+    oracle = PlacementOracle(
+        info, zero_stage=args.zero_stage, micro_batch=args.micro_batch,
+        seq_len=args.seq_len, precision=args.precision,
+        hbm_budget_bytes=args.hbm_budget_bytes)
+    if not oracle.armed:
+        print("placement oracle: DISARMED (no HBM budget resolvable on "
+              "this host and no --hbm-budget-bytes) — every candidate "
+              "accepted")
+    rc = 0
+    for world in worlds:
+        chosen, surveyed = oracle.choose(world, hpz)
+        for cand, refusal in surveyed:
+            need = oracle.estimate_bytes(cand)
+            if refusal is None:
+                verdict = _c("feasible", _GREEN, color)
+                print(f"  {cand.name:<16} {verdict}  "
+                      f"(~{need / 2**30:.2f} GiB/chip)")
+            else:
+                verdict = _c("REFUSED", _RED, color)
+                print(f"  {cand.name:<16} {verdict}  {refusal}")
+        if chosen is None:
+            print(_c(f"world {world}: no feasible mesh — a resize to "
+                     f"{world} devices would be refused at plan time",
+                     _RED, color))
+            rc = 1
+        else:
+            print(f"world {world}: would place as {chosen.name}")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="reshard",
+        description="Convert a committed native deepspeed_tpu checkpoint "
+                    "to the universal (world-elastic) format; --dry-run "
+                    "prints the placement-oracle verdict per candidate "
+                    "mesh instead.")
+    p.add_argument("checkpoint_dir", help="native checkpoint root")
+    p.add_argument("out_dir", nargs="?", default=None,
+                   help="universal output dir (required unless --dry-run)")
+    p.add_argument("--tag", default=None,
+                   help="checkpoint tag (default: the committed 'latest')")
+    p.add_argument("--dry-run", action="store_true",
+                   help="price candidate meshes through the placement "
+                        "oracle; convert nothing")
+    p.add_argument("--candidate-worlds", type=int, nargs="+", default=[],
+                   metavar="N", help="world sizes to survey")
+    p.add_argument("--hpz", type=int, nargs="+", default=[], metavar="Z",
+                   help="hpZ subgroup sizes to offer per world")
+    p.add_argument("--hbm-budget-bytes", type=float, default=None,
+                   help="per-chip HBM budget (default: chip datasheet; "
+                        "oracle disarmed when neither resolves)")
+    p.add_argument("--zero-stage", type=int, default=3)
+    p.add_argument("--micro-batch", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--precision", default="float32")
+    p.add_argument("--no-color", action="store_true")
+    args = p.parse_args(argv)
+
+    color = sys.stdout.isatty() and not args.no_color
+    if not args.dry_run and args.out_dir is None:
+        p.error("out_dir is required unless --dry-run")
+
+    from deepspeed_tpu.checkpoint.fault_tolerance import (
+        CheckpointCorruptError,
+    )
+    from deepspeed_tpu.checkpoint.universal import (
+        _load_native_state,
+        convert_to_universal,
+    )
+
+    try:
+        if args.dry_run:
+            state, tag = _load_native_state(args.checkpoint_dir, args.tag)
+            info = _model_info_from_state(state, args.seq_len)
+            print(f"checkpoint {args.checkpoint_dir} (tag={tag}): "
+                  f"{info.num_params} params")
+            if not args.candidate_worlds:
+                print("no --candidate-worlds given — nothing to survey")
+                return 0
+            return _survey(info, args.candidate_worlds, args.hpz, args,
+                           color)
+        out = convert_to_universal(args.checkpoint_dir, args.out_dir,
+                                   tag=args.tag)
+        print(f"universal checkpoint written to {out}")
+        if args.candidate_worlds:
+            state, _ = _load_native_state(args.checkpoint_dir, args.tag)
+            return _survey(_model_info_from_state(state, args.seq_len),
+                           args.candidate_worlds, args.hpz, args, color)
+        return 0
+    except CheckpointCorruptError as e:
+        print(_c(f"corrupt checkpoint: {e}", _RED, color), file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(_c(f"not found: {e}", _RED, color), file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(_c(f"unreadable: {e}", _RED, color), file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,18 +12,32 @@ reference needs UCP for. This module supplies the **interchange format**: a
 flat on-disk tree of one directory per parameter holding fp32 master +
 optimizer-state arrays as plain ``.npy`` (inspectable, editable, rsyncable),
 with a JSON manifest. Use cases: surgery (edit single params), migrating
-between frameworks, resuming with a *different optimizer* (drop moments), and
-guaranteed independence from orbax layout versioning.
+between frameworks, resuming with a *different optimizer* (drop moments),
+guaranteed independence from orbax layout versioning — and **world-size-
+elastic resume**: per-rank state with a leading world dim (the LoCo
+``loco_err`` residuals, the 1-bit ``worker_error`` buffers) is stored with
+its source world recorded and re-partitioned sum-preservingly onto the
+destination world at load (``elasticity`` docs; ZeRO++ hpZ 2306.10209).
+
+Durability: conversion writes through the PR 2 commit protocol
+(``checkpoint/fault_tolerance.py``) — tmp dir → fsync → ``COMMITTED``
+marker with a per-file size/CRC32 manifest → atomic rename — so a killed
+conversion can never leave a half-written universal dir that
+``read_manifest`` later trusts, and ``load_atom`` verifies each atom's
+CRC against the marker before handing it to the engine.
 
 Layout::
 
     <out>/
+      COMMITTED                   # commit marker: per-file size + CRC32
       universal_manifest.json     # param list, shapes/dtypes, counters
       zero/<param-path>/fp32.npy  # master weight (fp32)
-      zero/<param-path>/<moment>.npy  # optimizer moments, same tree paths
+      zero/<param-path>/<moment>.npy    # optimizer moments, same tree paths
+      zero/<param-path>/loco_err.npy    # per-rank residual rows (world, *shape)
       client_state.json
 
-CLI::
+CLI: ``tools/reshard`` / the ``reshard`` console entry
+(``checkpoint/reshard_cli.py``); the legacy module CLI below stays::
 
     python -m deepspeed_tpu.checkpoint.universal <ckpt_dir> <out_dir> [--tag TAG]
 """
@@ -32,13 +46,28 @@ from __future__ import annotations
 import argparse
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+from deepspeed_tpu.checkpoint.fault_tolerance import (
+    COMMIT_MARKER,
+    CheckpointCorruptError,
+    commit_tag,
+    crc32_file,
+    read_marker,
+    tmp_dir_for,
+)
 
 PyTree = Any
 
 MANIFEST = "universal_manifest.json"
+
+#: per-rank state trees carrying a leading world dim: name → where the
+#: tree lives in the engine state ("state" = top level, "opt" = inside
+#: state["opt"]). These are the ONLY leaves whose on-disk shape depends
+#: on the source world; everything else is a global array.
+RANK_STATE_TREES = {"loco_err": "state", "worker_error": "opt"}
 
 
 def _flatten(tree: PyTree) -> Dict[str, np.ndarray]:
@@ -52,10 +81,10 @@ def _flatten(tree: PyTree) -> Dict[str, np.ndarray]:
     return flat
 
 
-def convert_to_universal(checkpoint_dir: str, out_dir: str,
-                         tag: Optional[str] = None) -> str:
-    """Offline conversion (the ``ds_to_universal`` analog). Host-only: no
-    accelerator needed; reads the orbax state as numpy."""
+def _load_native_state(checkpoint_dir: str, tag: Optional[str] = None):
+    """Restore the committed native checkpoint's state tree as host numpy
+    (shared by :func:`convert_to_universal` and the ``reshard --dry-run``
+    placement probe). Returns ``(state, tag)``."""
     import orbax.checkpoint as ocp
 
     from deepspeed_tpu.checkpoint.engine import read_latest_tag
@@ -68,37 +97,83 @@ def convert_to_universal(checkpoint_dir: str, out_dir: str,
     try:
         state = ckptr.restore(state_path)
     except ValueError:
-        # checkpoints written by a MULTI-PROCESS run carry distributed
-        # array metadata; restoring on one host needs an explicit
-        # "just give me numpy" per leaf
+        # checkpoints written at a DIFFERENT device topology carry
+        # sharding metadata this host can't honor; restoring needs an
+        # explicit "just give me numpy" per leaf
         import jax
 
-        tree = dict(ckptr.metadata(state_path).item_metadata)
+        tree = ckptr.metadata(state_path)
+        # orbax API drift: newer versions wrap the tree in a metadata
+        # object, older ones return the tree itself
+        tree = getattr(tree, "item_metadata", tree)
         args = jax.tree.map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree)
         state = ckptr.restore(state_path, restore_args=args)
+    return state, tag
 
-    os.makedirs(out_dir, exist_ok=True)
+
+def convert_to_universal(checkpoint_dir: str, out_dir: str,
+                         tag: Optional[str] = None,
+                         fsync: bool = True) -> str:
+    """Offline conversion (the ``ds_to_universal`` analog). Host-only: no
+    accelerator needed; reads the orbax state as numpy.
+
+    The universal dir is published through the commit protocol: atoms
+    land in ``<out>.tmp``, are fsynced, get a ``COMMITTED`` marker with
+    per-file CRC32s, and one atomic rename makes the dir visible — a
+    conversion killed at any point leaves either a complete committed
+    dir or an ignorable tmp dir, never a half tree."""
+    state, tag = _load_native_state(checkpoint_dir, tag)
+
+    out_dir = os.path.abspath(out_dir)
+    root, base = os.path.dirname(out_dir) or ".", os.path.basename(out_dir)
+    os.makedirs(root, exist_ok=True)
+    tmp = tmp_dir_for(root, base)
+    if os.path.exists(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
     master_flat = _flatten(state["master"])
     manifest: Dict[str, Any] = {
-        "format": "deepspeed_tpu_universal/1",
+        "format": "deepspeed_tpu_universal/2",
         "source_tag": tag,
         "step": int(np.asarray(state.get("step", 0))),
         "params": {},
         "optimizer_moments": [],
         "optimizer_scalars": {},
+        # per-rank trees present in this checkpoint: name → {"location",
+        # "world"} — the load path re-partitions their leading world dim
+        "rank_state": {},
     }
     for name, arr in master_flat.items():
-        d = os.path.join(out_dir, "zero", name)
+        d = os.path.join(tmp, "zero", name)
         os.makedirs(d, exist_ok=True)
         np.save(os.path.join(d, "fp32.npy"), arr.astype(np.float32))
         manifest["params"][name] = {"shape": list(arr.shape),
                                     "dtype": str(arr.dtype)}
 
+    def _save_rank_tree(tree_name: str, subtree: PyTree) -> None:
+        sub_flat = _flatten(subtree)
+        world = None
+        for name, arr in sub_flat.items():
+            d = os.path.join(tmp, "zero", name)
+            os.makedirs(d, exist_ok=True)
+            np.save(os.path.join(d, f"{tree_name}.npy"), arr)
+            world = int(arr.shape[0]) if arr.ndim else None
+        manifest["rank_state"][tree_name] = {
+            "location": RANK_STATE_TREES[tree_name], "world": world}
+
     opt = state.get("opt", {})
     for moment, subtree in opt.items():
         if moment == "step":
             manifest["optimizer_scalars"]["step"] = int(np.asarray(subtree))
+            continue
+        if moment in RANK_STATE_TREES:
+            # per-rank rows (1-bit worker_error): NOT a world-free moment
+            # — store with its source world for elastic re-partitioning
+            _save_rank_tree(moment, subtree)
             continue
         sub_flat = _flatten(subtree)
         # param-shaped moments land next to their param; scalars → manifest
@@ -106,26 +181,34 @@ def convert_to_universal(checkpoint_dir: str, out_dir: str,
                 a.ndim > 0 for a in sub_flat.values()):
             manifest["optimizer_moments"].append(moment)
             for name, arr in sub_flat.items():
-                d = os.path.join(out_dir, "zero", name)
+                d = os.path.join(tmp, "zero", name)
                 os.makedirs(d, exist_ok=True)
                 np.save(os.path.join(d, f"{moment}.npy"), arr)
         else:
             manifest["optimizer_scalars"][moment] = {
                 k: v.tolist() for k, v in sub_flat.items()}
 
-    # fp16/scaler state etc. (anything besides master/opt/step) → scalars
+    # fp16/scaler state etc. (anything besides master/opt/step and the
+    # per-rank trees) → scalars; LoCo residuals → rank atoms
     for k in state:
-        if k not in ("master", "opt", "step"):
-            manifest["optimizer_scalars"][k] = _jsonable(state[k])
+        if k in ("master", "opt", "step"):
+            continue
+        if k in RANK_STATE_TREES:
+            _save_rank_tree(k, state[k])
+            continue
+        manifest["optimizer_scalars"][k] = _jsonable(state[k])
 
     cs_path = os.path.join(checkpoint_dir, tag, "client_state.json")
     if os.path.exists(cs_path):
         with open(cs_path) as f:
             client_state = json.load(f)
-        with open(os.path.join(out_dir, "client_state.json"), "w") as f:
+        with open(os.path.join(tmp, "client_state.json"), "w") as f:
             json.dump(client_state, f)
-    with open(os.path.join(out_dir, MANIFEST), "w") as f:
+    with open(os.path.join(tmp, MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)
+
+    commit_tag(root, tmp, base, step=manifest["step"], fsync=fsync,
+               extra={"universal_format": 2, "source_tag": tag})
     return out_dir
 
 
@@ -137,15 +220,89 @@ def _jsonable(tree: PyTree):
         isinstance(x, (int, float)) else x, tree)
 
 
+def _commit_files(universal_dir: str) -> Dict[str, Any]:
+    """The committed per-file manifest (size + CRC32) of a universal dir;
+    raises :class:`CheckpointCorruptError` when the dir was never
+    committed (torn conversion, pre-protocol layout)."""
+    root = os.path.dirname(os.path.abspath(universal_dir)) or "."
+    marker = read_marker(root, os.path.basename(
+        os.path.abspath(universal_dir)))
+    if marker is None:
+        raise CheckpointCorruptError(
+            f"universal checkpoint {universal_dir!r} has no "
+            f"{COMMIT_MARKER} marker — torn or pre-protocol conversion; "
+            "re-run tools/reshard against the native checkpoint")
+    return marker.get("files", {})
+
+
 def read_manifest(universal_dir: str) -> Dict[str, Any]:
+    _commit_files(universal_dir)   # committed dirs only
     with open(os.path.join(universal_dir, MANIFEST)) as f:
         return json.load(f)
 
 
-def load_atom(universal_dir: str, param_name: str,
-              kind: str = "fp32") -> np.ndarray:
-    return np.load(os.path.join(universal_dir, "zero", param_name,
-                                f"{kind}.npy"))
+def load_atom(universal_dir: str, param_name: str, kind: str = "fp32",
+              verify: bool = True,
+              _files: Optional[Dict[str, Any]] = None) -> np.ndarray:
+    """Load one atom, verifying its CRC32 against the commit manifest.
+
+    A corrupt, truncated, or missing atom raises a structured
+    :class:`CheckpointCorruptError` NAMING the atom — never a bare
+    ``KeyError``/``ValueError`` from deep inside numpy. ``_files`` lets
+    a bulk loader amortize the marker read across atoms."""
+    atom = f"zero/{param_name}/{kind}.npy"
+    path = os.path.join(universal_dir, "zero", param_name, f"{kind}.npy")
+    if verify:
+        files = _files if _files is not None else _commit_files(universal_dir)
+        info = files.get(atom.replace("/", os.sep)) or files.get(atom)
+        if info is None:
+            raise CheckpointCorruptError(
+                f"atom {atom!r} is not in the commit manifest of "
+                f"{universal_dir!r} — the conversion never wrote it")
+        if not os.path.exists(path):
+            raise CheckpointCorruptError(
+                f"atom {atom!r} is committed but missing on disk "
+                f"({universal_dir!r})")
+        size = os.path.getsize(path)
+        if size != info.get("size"):
+            raise CheckpointCorruptError(
+                f"atom {atom!r} size mismatch: {size} != "
+                f"{info.get('size')} (truncated write?)")
+        if "crc32" in info and crc32_file(path) != info["crc32"]:
+            raise CheckpointCorruptError(
+                f"atom {atom!r} failed CRC32 verification — bit rot or "
+                "partial overwrite; restore from the native checkpoint")
+    try:
+        return np.load(path)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"atom {atom!r} unreadable as .npy: {e}") from e
+
+
+def repartition_rank_rows(arr: np.ndarray, new_world: int) -> np.ndarray:
+    """Sum-preserving re-partition of a per-rank leading world dim.
+
+    The invariant: the SUM over rank rows is the total un-communicated
+    error (LoCo residual / 1-bit worker_error) — it must survive a world
+    change exactly, or the next quantized reduce silently loses (or
+    double-counts) feedback. Shrinking folds contiguous old-rank groups
+    into each new rank; growing places the old rows in the first slots
+    and zero-fills (new ranks start with no accumulated error)."""
+    old_world = int(arr.shape[0])
+    new_world = int(new_world)
+    if old_world == new_world:
+        return arr
+    out = np.zeros((new_world,) + arr.shape[1:], dtype=arr.dtype)
+    if new_world < old_world and old_world % new_world == 0:
+        g = old_world // new_world
+        out[:] = arr.reshape((new_world, g) + arr.shape[1:]).sum(axis=1)
+    elif new_world > old_world:
+        out[:old_world] = arr
+    else:
+        # non-dividing shrink: round-robin fold (still sum-preserving)
+        for i in range(old_world):
+            out[i % new_world] += arr[i]
+    return out
 
 
 def _unflatten_like(template: PyTree, flat: Dict[str, np.ndarray],
@@ -167,20 +324,44 @@ def _unflatten_like(template: PyTree, flat: Dict[str, np.ndarray],
     return jax.tree_util.tree_map_with_path(one, template)
 
 
+def _load_rank_tree(universal_dir: str, manifest: Dict[str, Any],
+                    tree_name: str, template: PyTree, new_world: int,
+                    files: Dict[str, Any]) -> PyTree:
+    """Per-rank tree atoms → re-partitioned rows shaped for ``new_world``,
+    unflattened like the engine's live template."""
+    flat = {}
+    for name in manifest["params"]:
+        if not os.path.exists(os.path.join(
+                universal_dir, "zero", name, f"{tree_name}.npy")):
+            continue
+        arr = load_atom(universal_dir, name, tree_name, _files=files)
+        flat[name] = repartition_rank_rows(arr, new_world)
+    return _unflatten_like(template, flat, fallback=template)
+
+
 def load_universal_into_engine(engine, universal_dir: str,
                                load_optimizer_states: bool = True) -> None:
     """Restore a universal checkpoint into a live engine at ANY topology —
-    the reference's ``load_universal_checkpoint`` path. Atoms are placed
-    according to the engine's own sharding policy (device_put shards on the
-    fly; each host only materializes its addressable slice lazily via jit)."""
+    the reference's ``load_universal_checkpoint`` path, extended for
+    elastic worlds: optimizer moments re-shard through the engine's own
+    sharding policy (global atoms + ``device_put``); per-rank trees
+    (LoCo residuals, 1-bit worker errors) are re-partitioned from the
+    SOURCE world onto the engine's ``_dp_manual_world``; and the
+    guardian/loader/host-RNG exact-resume client state (PR 13) is
+    threaded back so the batch stream continues where the old world
+    left off."""
     import jax
 
+    files = _commit_files(universal_dir)
     manifest = read_manifest(universal_dir)
     master_np = {}
     for name in manifest["params"]:
-        master_np[name] = load_atom(universal_dir, name, "fp32")
+        master_np[name] = load_atom(universal_dir, name, "fp32",
+                                    _files=files)
     new_master = _unflatten_like(engine.state["master"], master_np)
 
+    new_world = int(getattr(engine, "_dp_manual_world", 1))
+    rank_state = manifest.get("rank_state", {})
     new_state = dict(engine.state)
     # the derived double buffer is never restored — dropping it here
     # (and from the shardings) skips a full-model device_put that
@@ -188,18 +369,44 @@ def load_universal_into_engine(engine, universal_dir: str,
     new_state.pop("gathered", None)
     new_state["master"] = new_master
     if load_optimizer_states:
+        new_state["opt"] = dict(new_state["opt"])
         for moment in manifest["optimizer_moments"]:
             if moment not in new_state["opt"]:
                 continue
-            flat = {name: load_atom(universal_dir, name, moment)
+            flat = {name: load_atom(universal_dir, name, moment,
+                                    _files=files)
                     for name in manifest["params"]
                     if os.path.exists(os.path.join(
                         universal_dir, "zero", name, f"{moment}.npy"))}
             new_state["opt"][moment] = _unflatten_like(
-                new_state["opt"][moment], flat, fallback=new_state["opt"][moment])
+                new_state["opt"][moment], flat,
+                fallback=new_state["opt"][moment])
         if "step" in manifest["optimizer_scalars"]:
             new_state["opt"]["step"] = np.int32(
                 manifest["optimizer_scalars"]["step"])
+        # per-rank state: only trees BOTH sides know about restore; an
+        # engine without LoCo/1-bit ignores the atoms, an engine with
+        # them but no atoms keeps its zero-initialized rows
+        for tree_name, where in RANK_STATE_TREES.items():
+            if tree_name not in rank_state:
+                continue
+            if where == "opt" and tree_name in new_state["opt"]:
+                new_state["opt"][tree_name] = _load_rank_tree(
+                    universal_dir, manifest, tree_name,
+                    new_state["opt"][tree_name], new_world, files)
+            elif where == "state" and tree_name in new_state:
+                new_state[tree_name] = _load_rank_tree(
+                    universal_dir, manifest, tree_name,
+                    new_state[tree_name], new_world, files)
+    # fp16 loss-scaler state + skip counters are world-free scalars: a
+    # bit-coherent resume must not reset the scale ramp
+    scalars = manifest.get("optimizer_scalars", {})
+    for key in ("scaler", "skips"):
+        if key in new_state and key in scalars:
+            new_state[key] = jax.tree.map(
+                lambda live, saved: np.asarray(
+                    saved, dtype=np.asarray(live).dtype),
+                new_state[key], scalars[key])
     new_state["step"] = np.int32(manifest.get("step", 0))
 
     shardings = dict(engine._state_shardings())
@@ -216,13 +423,31 @@ def load_universal_into_engine(engine, universal_dir: str,
             cs = json.load(f)
         engine.global_steps = int(cs.get("global_steps", engine.global_steps))
         engine.micro_steps = int(cs.get("micro_steps", 0))
+        # skipped_steps is a read-only view of state["skips"], restored
+        # above with the scaler scalars — nothing to set here
         if engine.lr_scheduler is not None and cs.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(cs["lr_scheduler"])
+        if getattr(engine, "_curriculum", None) is not None \
+                and cs.get("curriculum"):
+            engine._curriculum.load_state_dict(cs["curriculum"])
+        if cs.get("np_rng"):
+            try:
+                engine._np_rng.bit_generator.state = cs["np_rng"]
+            except (TypeError, ValueError):
+                pass   # incompatible generator: fresh stream
+        # guardian/loader exact-resume state: restore through an attached
+        # guardian, and keep the raw client state so a guardian attached
+        # AFTER this load still picks it up (engine.load_checkpoint
+        # contract — TrainingGuardian.__init__ consumes it)
+        engine._restored_client_state = cs
+        if getattr(engine, "_guardian", None) is not None:
+            engine._guardian.restore_client_state(cs)
 
 
 def main() -> None:
     p = argparse.ArgumentParser(
-        description="Convert a deepspeed_tpu checkpoint to universal format")
+        description="Convert a deepspeed_tpu checkpoint to universal format"
+                    " (see also: tools/reshard)")
     p.add_argument("checkpoint_dir")
     p.add_argument("out_dir")
     p.add_argument("--tag", default=None)

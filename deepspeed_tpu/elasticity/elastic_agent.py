@@ -1,4 +1,4 @@
-"""Elastic agent: supervised training with checkpoint-based recovery.
+"""Elastic agent: supervised training with world-elastic recovery.
 
 Parity: reference ``elasticity/elastic_agent.py`` (``DSElasticAgent`` :32 —
 extends torch-elastic's ``LocalElasticAgent``: monitors workers, restarts
@@ -6,16 +6,25 @@ them through the rendezvous on failure or scale events). On TPU there is no
 per-GPU worker fleet to babysit inside one host — failure modes are slice
 preemption/resize and software faults — so the agent is a **supervision
 loop**: run the training function; on a restartable failure, re-probe the
-device topology, rebuild the mesh-bound engine through the user's factory,
-reload the latest (topology-free) checkpoint, and continue. Batch-size
+device topology, consult the placement oracle (``elasticity/placement.py``
+— memlint's ``oom-preflight`` gate over candidate mesh shapes, so an
+infeasible acquired world is refused analytically, never discovered by an
+OOM at dispatch), rebuild the mesh-bound engine through the user's factory
+at the acquired world, reload the latest checkpoint — through the
+**universal resharding path** when the world changed, which re-partitions
+optimizer moments, LoCo residual rows, and the guardian/loader
+exact-resume state onto the new mesh — and continue. Batch-size
 compatibility across sizes comes from ``compute_elastic_config``
 (``elasticity.py``).
+
+Config: the validated ``"elasticity"`` section (``runtime/config.py``) —
+``ElasticAgentConfig.from_section`` lifts it.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -27,7 +36,9 @@ class RestartableFailure(Exception):
     ``reason`` labels the restart accounting
     (``elastic_restarts_total{reason}``): ``"failure"`` for generic
     faults, ``"guardian"`` when the training guardian escalates an
-    exhausted rollback budget (``runtime/guardian.py``)."""
+    exhausted rollback budget (``runtime/guardian.py``), and
+    ``"preemption"`` when the slice is being reclaimed — the reason the
+    rebuild may come back at a DIFFERENT world size."""
 
     def __init__(self, *args, reason: str = "failure"):
         super().__init__(*args)
@@ -43,6 +54,35 @@ class ElasticAgentConfig:
     restart_backoff_s: float = 1.0
     restart_backoff_max_s: float = 60.0
     reload_on_restart: bool = True
+    # the smallest world the job is allowed to continue at — a resize
+    # below this is a terminal condition, not a silent slow resume
+    min_world_size: int = 1
+    # hpZ subgroup sizes offered to the placement oracle per acquired
+    # world (only divisors of the world are surveyed)
+    hpz_candidates: Tuple[int, ...] = ()
+    # where to write/find the universal (resharding) form of the native
+    # checkpoint on a world change; "" = <checkpoint_dir>/universal
+    universal_dir: str = ""
+
+    @classmethod
+    def from_section(cls, section: Any) -> "ElasticAgentConfig":
+        """Lift the validated ``"elasticity"`` config section
+        (``runtime/config.py`` ``ElasticitySectionConfig``)."""
+        return cls(
+            max_restarts=section.max_restarts,
+            restart_backoff_s=section.restart_backoff_s,
+            restart_backoff_max_s=section.restart_backoff_max_s,
+            reload_on_restart=section.reload_on_restart,
+            min_world_size=section.min_world_size,
+            hpz_candidates=tuple(section.hpz_candidates),
+            universal_dir=section.universal_dir,
+        )
+
+
+class WorldTooSmall(RuntimeError):
+    """The acquired device world is below ``min_world_size`` — terminal:
+    resuming anyway would silently run the job at a fraction of its
+    provisioned throughput."""
 
 
 class ElasticAgent:
@@ -52,34 +92,127 @@ class ElasticAgent:
     current topology (typically ``deepspeed_tpu.initialize`` with an elastic
     batch config). ``train_fn(engine, start_step) -> None`` runs the loop and
     is expected to checkpoint periodically to ``checkpoint_dir``.
+    ``placement_oracle`` (``elasticity/placement.PlacementOracle``) gates
+    every (re)build: the acquired world's candidate meshes are priced
+    analytically and a fully-refused world raises
+    :class:`~deepspeed_tpu.elasticity.placement.PlacementRefused` instead
+    of letting the rebuild OOM.
     """
 
     def __init__(self, engine_factory: Callable[[int], Any],
                  train_fn: Callable[[Any, int], None],
                  checkpoint_dir: Optional[str] = None,
-                 config: Optional[ElasticAgentConfig] = None):
+                 config: Optional[ElasticAgentConfig] = None,
+                 placement_oracle: Optional[Any] = None):
         self.engine_factory = engine_factory
         self.train_fn = train_fn
         self.checkpoint_dir = checkpoint_dir
         self.config = config or ElasticAgentConfig()
+        self.placement_oracle = placement_oracle
         self.restarts = 0
+        self.world_size: Optional[int] = None   # world of the live engine
 
-    def _build(self) -> Tuple[Any, int]:
+    # ------------------------------------------------------------ build
+    def _probe_world(self) -> int:
         import jax
 
+        n = jax.device_count()
+        if n < self.config.min_world_size:
+            raise WorldTooSmall(
+                f"acquired world {n} is below elasticity.min_world_size="
+                f"{self.config.min_world_size} — refusing to resume")
+        return n
+
+    def _consult_oracle(self, n: int) -> None:
+        """Analytic feasibility of the acquired world BEFORE any engine
+        build: every refused candidate is logged; a fully-refused world
+        raises the structured ``PlacementRefused``."""
+        if self.placement_oracle is None:
+            return
+        from deepspeed_tpu.elasticity.placement import PlacementRefused
+
+        chosen, surveyed = self.placement_oracle.choose(
+            n, self.config.hpz_candidates)
+        for cand, refusal in surveyed:
+            if refusal:
+                log_dist(f"elastic agent: placement oracle refused "
+                         f"{cand.name}: {refusal}")
+        if chosen is None:
+            raise PlacementRefused(n, surveyed)
+        log_dist(f"elastic agent: placement oracle accepted {chosen.name}")
+
+    def _universal_dir(self) -> str:
+        import os
+
+        return self.config.universal_dir or os.path.join(
+            self.checkpoint_dir, "universal")
+
+    def _reload(self, engine, n: int) -> int:
+        """Restore the newest committed checkpoint into ``engine``. A
+        same-world rebuild takes the native path; a CHANGED world goes
+        through universal resharding — convert the committed native tag
+        (commit-protocol write) and re-partition onto the new mesh."""
+        import json
+        import os
+
+        from deepspeed_tpu.checkpoint.engine import read_latest_tag
+
+        tag = read_latest_tag(self.checkpoint_dir)
+        if tag is None:
+            log_dist("elastic agent: no checkpoint yet, cold start")
+            return 0
+        # the world the checkpoint was WRITTEN at: a fresh agent process
+        # (post-preemption relaunch) has world_size=None but must still
+        # reshard if the relaunched host acquired a different world
+        saved_world = self.world_size
+        cs_path = os.path.join(self.checkpoint_dir, tag, "client_state.json")
+        try:
+            with open(cs_path) as f:
+                saved_world = int(json.load(f).get(
+                    "world_size", saved_world or n))
+        except (OSError, ValueError, TypeError):
+            pass
+        if saved_world is not None and n != saved_world:
+            from deepspeed_tpu.checkpoint.universal import (
+                convert_to_universal,
+            )
+
+            uni = os.path.join(self._universal_dir(), tag)
+            if not os.path.exists(uni):
+                convert_to_universal(self.checkpoint_dir, uni, tag=tag)
+            engine.load_universal_checkpoint(uni)
+            log_dist(f"elastic agent: resharded {saved_world}→{n} "
+                     f"via {uni} (step {engine.global_steps})")
+        else:
+            engine.load_checkpoint(self.checkpoint_dir)
+            log_dist(f"elastic agent: resumed at step "
+                     f"{engine.global_steps}")
+        return engine.global_steps
+
+    def _build(self) -> Tuple[Any, int]:
+        from deepspeed_tpu import telemetry
         from deepspeed_tpu.comm.mesh import reset_mesh
 
         reset_mesh()
-        n = jax.device_count()
+        n = self._probe_world()
+        self._consult_oracle(n)
         engine = self.engine_factory(n)
         start_step = 0
         if self.checkpoint_dir and self.config.reload_on_restart:
             try:
-                engine.load_checkpoint(self.checkpoint_dir)
-                start_step = engine.global_steps
-                log_dist(f"elastic agent: resumed at step {start_step}")
+                start_step = self._reload(engine, n)
             except FileNotFoundError:
                 log_dist("elastic agent: no checkpoint yet, cold start")
+        if self.world_size is not None and n != self.world_size:
+            telemetry.counter(
+                "elastic_resizes_total",
+                "engine rebuilds at a DIFFERENT world size than the "
+                "previous build, by direction").inc(
+                    direction="up" if n > self.world_size else "down")
+        self.world_size = n
+        telemetry.gauge(
+            "elastic_world_size",
+            "device world of the elastic agent's live engine").set(n)
         return engine, start_step
 
     def backoff_s(self, restart: int) -> float:
@@ -94,11 +227,13 @@ class ElasticAgent:
         ``max_restarts`` times (exponential backoff between attempts).
         Returns the last engine."""
         from deepspeed_tpu import telemetry
+        from deepspeed_tpu.telemetry.tracing import safe_dump_flight
 
         tm_restarts = telemetry.counter(
             "elastic_restarts_total",
             "supervised restarts performed by the elastic agent, by "
-            "failure reason (guardian = escalated rollback budget)")
+            "failure reason (guardian = escalated rollback budget; "
+            "preemption = slice reclaim, may resize the world)")
         tm_exhausted = telemetry.counter(
             "elastic_restart_exhausted_total",
             "elastic-agent runs that gave up after max_restarts")
@@ -119,10 +254,6 @@ class ElasticAgent:
                     # dump so the give-up is explained, then the STRUCTURED
                     # failure propagates — never a crash loop, never a
                     # swallowed error (no-op unless telemetry.tracing)
-                    from deepspeed_tpu.telemetry.tracing import (
-                        safe_dump_flight,
-                    )
-
                     safe_dump_flight(
                         "elastic_exhausted",
                         note=f"restarts={self.restarts - 1} "
@@ -134,4 +265,38 @@ class ElasticAgent:
                     f"elastic agent: restart {self.restarts}/"
                     f"{self.config.max_restarts} (reason={reason}) "
                     f"after: {e} (backoff {backoff:.1f}s)")
+                # the pre-rebuild flight dump: the seconds of timeline
+                # LEADING INTO the failure ride along before the old
+                # engine's trace ring is superseded by the rebuild's
+                safe_dump_flight(
+                    "elastic_resize",
+                    note=f"restart {self.restarts} reason={reason} "
+                         f"world={self.world_size}: {e}")
                 time.sleep(backoff)
+
+
+def agent_from_config(engine_factory: Callable[[int], Any],
+                      train_fn: Callable[[Any, int], None],
+                      ds_config: Any,
+                      checkpoint_dir: Optional[str] = None,
+                      placement_oracle: Optional[Any] = None
+                      ) -> Optional[ElasticAgent]:
+    """Build an :class:`ElasticAgent` from a full ``DeepSpeedTPUConfig``'s
+    validated ``"elasticity"`` section. Returns ``None`` when the section
+    is disabled — callers fall back to running ``train_fn`` unsupervised."""
+    cfg = ds_config.elasticity
+    if not cfg.enabled:
+        return None
+    return ElasticAgent(engine_factory, train_fn,
+                        checkpoint_dir=checkpoint_dir,
+                        config=ElasticAgentConfig.from_section(cfg),
+                        placement_oracle=placement_oracle)
+
+
+def probe_world_sizes(candidates: Sequence[int]) -> Tuple[int, ...]:
+    """The subset of ``candidates`` at or below the live device count —
+    the worlds a resize could actually acquire right now."""
+    import jax
+
+    n = jax.device_count()
+    return tuple(c for c in candidates if 0 < int(c) <= n)

@@ -1,0 +1,166 @@
+"""Placement oracle: analytic accept/refuse of candidate mesh shapes.
+
+The memlint ``oom-preflight`` gate (PR 15) promoted from an at-initialize
+check into the **planning** surface the elastic agent and ``tools/reshard``
+consult BEFORE building anything: given the model's analytic memory need
+(``autotuning/memory_model``) and an HBM budget, each candidate mesh for
+the acquired world is priced and either accepted or refused with the
+rule's finding text. Refusal is analytic — the retry after a preemption
+must never discover infeasibility by OOM-crashing at dispatch (the
+autotuning planner's ``refuse_candidate`` applies the same rule to knob
+candidates; this module applies it to world/subgroup shapes).
+
+Nothing here compiles or touches devices: a verdict is pure arithmetic
+over the manifest/spec-derived :class:`~deepspeed_tpu.autotuning.
+memory_model.ModelInfo`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.autotuning import memory_model as mm
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshCandidate:
+    """One candidate layout for an acquired world: a plain dp mesh, or a
+    ZeRO++-style hpZ subgroup (``zshard``) carved out of it."""
+    world: int
+    zshard: int = 1   # 1 = no secondary partition; >1 = hpZ subgroup size
+
+    @property
+    def name(self) -> str:
+        return (f"world{self.world}" if self.zshard <= 1
+                else f"world{self.world}_hpz{self.zshard}")
+
+    @property
+    def dp_shards(self) -> int:
+        """The partition width optimizer/parameter state is sharded over:
+        the hpZ subgroup when present (state lives in the subgroup;
+        replicated across subgroups — the memory-relevant width), else
+        the full world."""
+        return self.zshard if self.zshard > 1 else self.world
+
+
+def candidate_meshes(world: int,
+                     hpz_sizes: Sequence[int] = ()) -> List[MeshCandidate]:
+    """Candidate layouts for ``world`` devices: the plain dp mesh first
+    (widest sharding = least HBM per chip), then each requested hpZ
+    subgroup size that actually divides the world."""
+    cands = [MeshCandidate(world=world)]
+    for hpz in hpz_sizes:
+        hpz = int(hpz)
+        if 1 < hpz < world and world % hpz == 0:
+            cands.append(MeshCandidate(world=world, zshard=hpz))
+    return cands
+
+
+class PlacementOracle:
+    """Prices candidate meshes through memlint's ``oom-preflight`` rule.
+
+    ``hbm_budget_bytes=None`` falls back to the chip datasheet
+    (``memory_model.hbm_capacity_bytes``); on a datasheet-less host tier
+    with no explicit budget the oracle is DISARMED — every candidate is
+    accepted, matching the engine's own ``_memlint_budget_bytes``
+    behavior (an unpriceable gate must not refuse real work)."""
+
+    def __init__(self, info: mm.ModelInfo, *, zero_stage: int = 3,
+                 micro_batch: int = 1, seq_len: Optional[int] = None,
+                 precision: str = "float32",
+                 offload_optimizer: bool = False,
+                 hbm_budget_bytes: Optional[float] = None):
+        self.info = info
+        self.zero_stage = int(zero_stage)
+        self.micro_batch = int(micro_batch)
+        self.seq_len = int(seq_len or info.seq_len)
+        self.precision = precision
+        self.offload_optimizer = bool(offload_optimizer)
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = float(mm.hbm_capacity_bytes() or 0)
+        self.hbm_budget_bytes = float(hbm_budget_bytes or 0)
+
+    @property
+    def armed(self) -> bool:
+        return self.hbm_budget_bytes > 0
+
+    def estimate_bytes(self, cand: MeshCandidate) -> int:
+        est = mm.estimate(
+            self.info, zero_stage=self.zero_stage,
+            dp_shards=cand.dp_shards, micro_batch=self.micro_batch,
+            seq_len=self.seq_len, precision=self.precision,
+            offload_optimizer=self.offload_optimizer)
+        return int(est.total)
+
+    def verdict(self, cand: MeshCandidate) -> Optional[str]:
+        """Refusal text (the oom-preflight finding) or None = feasible.
+        Analytic only: nothing compiles, no device is touched."""
+        if not self.armed:
+            return None
+        from deepspeed_tpu.analysis.memlint import (
+            MemLintConfig,
+            MemObservations,
+            iter_rule_findings,
+            select_rules,
+        )
+
+        need = self.estimate_bytes(cand)
+        obs = MemObservations(model_estimate_bytes=float(need))
+        cfg = MemLintConfig(program=cand.name,
+                            hbm_budget_bytes=self.hbm_budget_bytes)
+        findings = iter_rule_findings(
+            obs, cfg, rules=select_rules(["oom-preflight"]))
+        if findings:
+            return "; ".join(f"{f.rule}: {f.message} "
+                             f"(need {f.observed}, budget {f.limit})"
+                             for f in findings)
+        return None
+
+    def survey(self, candidates: Sequence[MeshCandidate]
+               ) -> List[Tuple[MeshCandidate, Optional[str]]]:
+        """Every candidate with its verdict, input order preserved."""
+        return [(c, self.verdict(c)) for c in candidates]
+
+    def choose(self, world: int, hpz_sizes: Sequence[int] = ()
+               ) -> Tuple[Optional[MeshCandidate],
+                          List[Tuple[MeshCandidate, Optional[str]]]]:
+        """First feasible candidate for ``world`` (None = every candidate
+        refused) plus the full surveyed list for logging/CLI output."""
+        surveyed = self.survey(candidate_meshes(world, hpz_sizes))
+        for cand, refusal in surveyed:
+            if refusal is None:
+                return cand, surveyed
+        return None, surveyed
+
+
+class PlacementRefused(RuntimeError):
+    """Every candidate mesh for the acquired world was analytically
+    refused by the placement oracle — the job cannot fit; structured so
+    the supervisor sees WHY instead of an OOM at dispatch."""
+
+    def __init__(self, world: int,
+                 surveyed: Sequence[Tuple[MeshCandidate, Optional[str]]]):
+        self.world = world
+        self.surveyed = list(surveyed)
+        lines = "; ".join(f"{c.name}: {r}" for c, r in surveyed if r)
+        super().__init__(
+            f"placement oracle refused every candidate mesh for world "
+            f"{world}: {lines}")
+
+
+def model_info_from_manifest(manifest: Any,
+                             seq_len: Optional[int] = None) -> mm.ModelInfo:
+    """A :class:`ModelInfo` priced straight off a universal-checkpoint
+    manifest (``tools/reshard --dry-run`` has no live ModelSpec): the
+    param count is the sum of atom shapes — exact; the architecture
+    fields stay 0, which the memory model treats as "activations
+    unknown" (state terms still price exactly)."""
+    import numpy as np
+
+    n = 0
+    for info in manifest.get("params", {}).values():
+        n += int(np.prod(info.get("shape") or [1]))
+    mi = mm.ModelInfo(num_params=int(n), seq_len=seq_len or 1024)
+    log_dist(f"placement: priced {n} params from universal manifest")
+    return mi

@@ -394,6 +394,68 @@ class AutotuningSectionConfig:
 
 
 @dataclasses.dataclass
+class ElasticitySectionConfig:
+    """World-size-elastic training (``deepspeed_tpu/elasticity/``;
+    README "Elastic worlds").
+
+    Consumed by :class:`~deepspeed_tpu.elasticity.elastic_agent.
+    ElasticAgent` via ``ElasticAgentConfig.from_section``: ``enabled``
+    marks the run as supervise-and-resize (the launcher/driver decides
+    to wrap ``train`` in an agent); ``max_restarts`` /
+    ``restart_backoff_s`` / ``restart_backoff_max_s`` bound the
+    supervised restart loop; ``reload_on_restart`` reloads the newest
+    committed checkpoint on every rebuild — through the universal
+    RESHARDING path when the acquired world differs from the
+    checkpointed one. ``min_world_size`` is the floor below which a
+    resize is terminal rather than a silent slow resume.
+    ``hpz_candidates`` lists ZeRO++ hpZ subgroup sizes the placement
+    oracle surveys per acquired world (non-divisors are skipped).
+    ``universal_dir`` overrides where the resharding conversion lands
+    ("" = ``<checkpoint_dir>/universal``). NOTE: the legacy reference
+    keys (``elastic_training``/``micro_batch_sizes`` …) stay handled by
+    ``elasticity/elasticity.compute_elastic_config`` — this section
+    configures the TPU-native agent, not the batch-size solver."""
+    enabled: bool = False
+    max_restarts: int = 3
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 60.0
+    reload_on_restart: bool = True
+    min_world_size: int = 1
+    hpz_candidates: list = dataclasses.field(default_factory=list)
+    universal_dir: str = ""
+
+    def validate(self) -> None:
+        if not isinstance(self.max_restarts, int) \
+                or isinstance(self.max_restarts, bool) \
+                or self.max_restarts < 0:
+            raise DeepSpeedConfigError(
+                "elasticity.max_restarts must be a non-negative int, "
+                f"got {self.max_restarts!r}")
+        if self.restart_backoff_s <= 0 \
+                or self.restart_backoff_max_s < self.restart_backoff_s:
+            raise DeepSpeedConfigError(
+                "elasticity restart backoff must satisfy 0 < "
+                "restart_backoff_s <= restart_backoff_max_s, got "
+                f"{self.restart_backoff_s} / {self.restart_backoff_max_s}")
+        if not isinstance(self.min_world_size, int) \
+                or isinstance(self.min_world_size, bool) \
+                or self.min_world_size < 1:
+            raise DeepSpeedConfigError(
+                "elasticity.min_world_size must be a positive int, got "
+                f"{self.min_world_size!r}")
+        if not isinstance(self.hpz_candidates, (list, tuple)) or any(
+                not isinstance(h, int) or isinstance(h, bool) or h < 1
+                for h in self.hpz_candidates):
+            raise DeepSpeedConfigError(
+                "elasticity.hpz_candidates must be a list of positive "
+                f"ints (subgroup sizes), got {self.hpz_candidates!r}")
+        if not isinstance(self.universal_dir, str):
+            raise DeepSpeedConfigError(
+                "elasticity.universal_dir must be a path string, got "
+                f"{type(self.universal_dir).__name__}")
+
+
+@dataclasses.dataclass
 class ServingSectionConfig:
     """Serving resilience front-end (``deepspeed_tpu/serving``).
 
@@ -511,7 +573,21 @@ class FleetSectionConfig:
     age passes the ``hedge_percentile`` of observed completion
     latencies (floored at ``hedge_min_s``); first completion wins and
     the loser is cancelled. ``migrate_on_drain`` moves in-flight work
-    off a draining replica instead of waiting it out."""
+    off a draining replica instead of waiting it out.
+
+    Autoscaling (``serving/fleet.FleetAutoscaler``; README "Elastic
+    worlds"): driven by telemetry the frontends already export — mean
+    active requests per ready replica (queue depth), the worst
+    replica's KV-pool utilization, and the p99 of observed completion
+    latency (the TTFT proxy when no request has finished yet). Scale-out
+    adds a replica when queue depth exceeds ``scale_out_queue_depth``,
+    KV utilization exceeds ``scale_out_kv_util``, or p99 latency
+    exceeds ``scale_out_p99_latency_s`` (0 disables that trigger);
+    scale-in drains+migrates the least-loaded replica when queue depth
+    falls below ``scale_in_queue_depth`` AND KV pressure is off. Both
+    directions respect ``autoscale_min_replicas`` /
+    ``autoscale_max_replicas`` and wait ``autoscale_cooldown_ticks``
+    ticks between scale events (resize thrash protection)."""
     min_ready_replicas: int = 1
     max_attempts: int = 3
     retry_backoff_s: float = 0.05
@@ -523,6 +599,13 @@ class FleetSectionConfig:
     hedge_min_s: float = 0.05
     migrate_on_drain: bool = True
     max_result_history: int = 4096
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 8
+    scale_out_queue_depth: float = 8.0
+    scale_in_queue_depth: float = 1.0
+    scale_out_kv_util: float = 0.85
+    scale_out_p99_latency_s: float = 0.0
+    autoscale_cooldown_ticks: int = 8
 
     def validate(self) -> None:
         if self.min_ready_replicas < 1:
@@ -557,6 +640,33 @@ class FleetSectionConfig:
             raise DeepSpeedConfigError(
                 "fleet.max_result_history must be >= 1, got "
                 f"{self.max_result_history}")
+        if not (1 <= self.autoscale_min_replicas
+                <= self.autoscale_max_replicas):
+            raise DeepSpeedConfigError(
+                "fleet autoscale bounds must satisfy 1 <= "
+                "autoscale_min_replicas <= autoscale_max_replicas, got "
+                f"{self.autoscale_min_replicas} / "
+                f"{self.autoscale_max_replicas}")
+        if self.scale_in_queue_depth >= self.scale_out_queue_depth:
+            raise DeepSpeedConfigError(
+                "fleet.scale_in_queue_depth must be below "
+                "scale_out_queue_depth (equal thresholds oscillate), got "
+                f"{self.scale_in_queue_depth} >= "
+                f"{self.scale_out_queue_depth}")
+        if not (0.0 < self.scale_out_kv_util <= 1.0):
+            raise DeepSpeedConfigError(
+                "fleet.scale_out_kv_util must be in (0, 1], got "
+                f"{self.scale_out_kv_util}")
+        if self.scale_out_p99_latency_s < 0:
+            raise DeepSpeedConfigError(
+                "fleet.scale_out_p99_latency_s must be >= 0 (0 disables "
+                f"the latency trigger), got {self.scale_out_p99_latency_s}")
+        if not isinstance(self.autoscale_cooldown_ticks, int) \
+                or isinstance(self.autoscale_cooldown_ticks, bool) \
+                or self.autoscale_cooldown_ticks < 0:
+            raise DeepSpeedConfigError(
+                "fleet.autoscale_cooldown_ticks must be a non-negative "
+                f"int, got {self.autoscale_cooldown_ticks!r}")
 
 
 @dataclasses.dataclass
@@ -835,11 +945,11 @@ class ProgressiveLayerDropConfig:
 
 # CUDA-only reference sections accepted and ignored (keeps real DeepSpeed JSON
 # configs loadable); each logs once when present. "autotuning" left this
-# list in PR 16 — it now configures the TPU-native plan engine.
+# list in PR 16 (TPU-native plan engine); "elasticity" in PR 17 (it now
+# configures the world-elastic agent — ElasticitySectionConfig).
 _IGNORED_SECTIONS = (
     "amp", "aio", "hybrid_engine", "compression_training",
     "sparse_attention", "zero_allow_untested_optimizer", "communication_data_type",
-    "elasticity",
 )
 
 
@@ -872,6 +982,8 @@ class DeepSpeedTPUConfig:
         default_factory=MemlintSectionConfig)
     autotuning: AutotuningSectionConfig = dataclasses.field(
         default_factory=AutotuningSectionConfig)
+    elasticity: ElasticitySectionConfig = dataclasses.field(
+        default_factory=ElasticitySectionConfig)
     activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
         default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = dataclasses.field(default_factory=FlopsProfilerConfig)

@@ -3324,6 +3324,10 @@ class DeepSpeedTPUEngine:
             # host RNG (data-efficiency sampling: PLD masks, LTD indices) —
             # auto_resume must not replay or skip sampled randomness
             "np_rng": self._np_rng.bit_generator.state,
+            # the world this checkpoint was written at — a fresh elastic
+            # agent process compares it against the acquired world to
+            # decide native reload vs universal resharding
+            "world_size": int(self.dp_world_size),
         })
         if self._guardian is not None:
             # loader position + quarantine list + detector bands ride every
@@ -3466,11 +3470,18 @@ class DeepSpeedTPUEngine:
                                   load_optimizer_states: bool = True) -> None:
         """Load a universal (per-param atom) checkpoint at ANY topology
         (reference ``load_universal_checkpoint``; converter:
-        ``deepspeed_tpu.checkpoint.universal``)."""
+        ``deepspeed_tpu.checkpoint.universal``): the world-elastic resume
+        path. Master weights and optimizer moments land on this engine's
+        mesh whatever world they were saved at; per-rank residual trees
+        (LoCo ``loco_err``, onebit ``worker_error``) are re-partitioned
+        sum-preservingly onto ``_dp_manual_world``; the guardian/loader
+        exact-resume client state rides along so the batch sequence
+        continues where the old world left off."""
         from deepspeed_tpu.checkpoint.universal import load_universal_into_engine
 
         load_universal_into_engine(self, universal_dir, load_optimizer_states)
-        log_dist(f"loaded universal checkpoint from {universal_dir}")
+        log_dist(f"loaded universal checkpoint from {universal_dir} "
+                 f"(world {self._dp_manual_world})")
 
     # ------------------------------------------------------------------ #
     def get_fp32_params(self) -> PyTree:

@@ -44,7 +44,10 @@ from deepspeed_tpu.serving.circuit import (  # noqa: F401
     OPEN,
     CircuitBreaker,
 )
-from deepspeed_tpu.serving.fleet import FleetRouter  # noqa: F401
+from deepspeed_tpu.serving.fleet import (  # noqa: F401
+    FleetAutoscaler,
+    FleetRouter,
+)
 from deepspeed_tpu.serving.frontend import (  # noqa: F401
     ACTIVE,
     COMPLETED,

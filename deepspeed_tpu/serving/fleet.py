@@ -32,6 +32,13 @@ guarantees to the fleet:
   restarts via ``replace_replica``; the fleet registers ``/healthz`` /
   ``/readyz`` probes on the exposition registry reporting quorum
   (ready iff ≥ ``min_ready_replicas`` replicas are routable).
+* **autoscaling** (:class:`FleetAutoscaler`) — scale-out/in policy over
+  the signals the frontends already export (queue depth per ready
+  replica, KV-pool utilization, p99 completion latency), built on the
+  same drain/migrate machinery: ``add_replica`` makes a new frontend
+  routable immediately; scale-in drains the victim with migration and
+  only closes it once quiesced, so a resize in either direction can
+  never lose an admitted request.
 
 Single-threaded like the frontends it owns: one loop calls ``submit`` /
 ``run_tick``; the health probes are the only cross-thread readers and
@@ -215,6 +222,16 @@ class FleetRouter:
 
     def replicas(self) -> List[ServingFrontend]:
         return [rep.frontend for rep in self._replicas]
+
+    def latency_quantile(self, q: float) -> Optional[float]:
+        """``q``-quantile of observed fleet completion latencies (the
+        hedge threshold's sample window), or None before any completion —
+        the autoscaler's p99 signal."""
+        if not self._lat_samples:
+            return None
+        ordered = sorted(self._lat_samples)
+        idx = min(len(ordered) - 1, int(len(ordered) * q))
+        return ordered[idx]
 
     def result(self, uid: int) -> RequestResult:
         """Fleet terminal record for ``uid``, or its live ``active`` view
@@ -774,6 +791,40 @@ class FleetRouter:
         self._refresh_gauges()
         return old
 
+    def add_replica(self, new_frontend: ServingFrontend) -> None:
+        """Scale-out: install a new replica, immediately routable. Waiting
+        retries re-place onto it in the same call — a scale-out triggered
+        by ``no_ready_replica`` backpressure takes effect at once."""
+        if any(r.name == new_frontend.name for r in self._replicas):
+            raise ValueError(
+                f"replica name {new_frontend.name!r} collides with a "
+                "live replica")
+        self._replicas.append(_Replica(new_frontend))
+        self._retry_due()
+        self._refresh_gauges()
+
+    def remove_replica(self, which) -> ServingFrontend:
+        """Scale-in: migrate any in-flight work off the replica (no
+        attempt penalty — shrinking the fleet is not a failure), close
+        its frontend, and drop it from the routing set. The last replica
+        cannot be removed. Returns the closed frontend; callers wanting
+        a graceful shrink ``drain()`` first and wait on ``quiesced()``
+        so the migration set is empty by the time this runs."""
+        rep = self._resolve_replica(which)
+        if len(self._replicas) == 1:
+            raise ValueError("cannot remove the last replica of a fleet")
+        self._failover_replica(rep, "drain", count_attempt=False,
+                               backoff=False)
+        # a removed name must not poison waiting requests' excluded sets:
+        # the name may be reused by a future scale-out
+        for r in self._active.values():
+            r.excluded.discard(rep.name)
+        self._replicas.remove(rep)
+        rep.frontend.close()
+        self._retry_due()
+        self._refresh_gauges()
+        return rep.frontend
+
     # ------------------------------------------------------------------ #
     # health quorum
     # ------------------------------------------------------------------ #
@@ -838,3 +889,149 @@ class FleetRouter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class FleetAutoscaler:
+    """Scale-out/in policy over a :class:`FleetRouter`.
+
+    Decisions run off three signals the fleet already measures — no new
+    instrumentation on the hot path:
+
+    * **queue depth**: mean active fleet requests per ready replica;
+      above ``scale_out_queue_depth`` → out, below ``scale_in_queue_depth``
+      (with more than the floor running) → in.
+    * **KV pressure**: the max ``kv_utilization`` across ready replicas;
+      above ``scale_out_kv_util`` → out (a near-full pool is about to
+      preempt — adding a replica beats thrashing the one that's full).
+    * **p99 latency**: the fleet completion-latency p99; above
+      ``scale_out_p99_latency_s`` (when > 0 — 0 disables the signal) → out.
+
+    Scale-out calls ``replica_factory(name) -> ServingFrontend`` and
+    installs the result immediately. Scale-in is the zero-loss path:
+    drain the least-loaded ready replica WITH migration, then keep
+    watching ``quiesced()`` across ticks and only close+remove once its
+    last in-flight copy is gone — an admitted request can never be lost
+    to a shrink. One resize at a time, ``autoscale_cooldown_ticks``
+    between decisions, bounded by ``autoscale_min/max_replicas``.
+
+    Drive it with ``tick()`` after each ``router.run_tick()``; it is as
+    single-threaded as the router it steers. Events:
+    ``fleet_scale_events_total{direction,reason}``.
+    """
+
+    def __init__(self, router: FleetRouter, replica_factory,
+                 config=None, replica_prefix: str = "scale"):
+        self.router = router
+        self.replica_factory = replica_factory
+        self.cfg = config if config is not None else router.cfg
+        self.cfg.validate()
+        self.replica_prefix = replica_prefix
+        self._cooldown = 0
+        self._seq = 0
+        self._victim: Optional[str] = None   # scale-in drain in flight
+        self.events: List[Dict[str, str]] = []
+        self._tm_scale = telemetry.counter(
+            "fleet_scale_events_total",
+            "autoscaler resize events by direction and triggering reason "
+            "(queue_depth / kv_pressure / latency / idle)")
+
+    # ------------------------------------------------------------ signals
+    def signals(self) -> Dict[str, float]:
+        """The decision inputs, as measured this instant."""
+        router = self.router
+        ready = max(1, router.ready_count())
+        kv = 0.0
+        for rep in router._replicas:
+            if router._replica_ready(rep):
+                kv = max(kv, rep.frontend.engine.kv_utilization(0))
+        p99 = router.latency_quantile(0.99)
+        return {
+            "queue_depth": router.active_count() / ready,
+            "kv_util": kv,
+            "p99_latency_s": p99 if p99 is not None else 0.0,
+        }
+
+    def _decide(self, sig: Dict[str, float]):
+        """(direction, reason) or None. Scale-out wins ties: shedding
+        load is the failure mode that costs users, idle capacity only
+        costs chips."""
+        n = len(self.router._replicas)
+        if n < self.cfg.autoscale_max_replicas:
+            if sig["queue_depth"] > self.cfg.scale_out_queue_depth:
+                return "out", "queue_depth"
+            if sig["kv_util"] > self.cfg.scale_out_kv_util:
+                return "out", "kv_pressure"
+            if 0 < self.cfg.scale_out_p99_latency_s < sig["p99_latency_s"]:
+                return "out", "latency"
+        if n > self.cfg.autoscale_min_replicas \
+                and sig["queue_depth"] < self.cfg.scale_in_queue_depth:
+            return "in", "idle"
+        return None
+
+    def _next_name(self) -> str:
+        live = {rep.name for rep in self.router._replicas}
+        while True:
+            name = f"{self.replica_prefix}-{self._seq}"
+            self._seq += 1
+            if name not in live:
+                return name
+
+    def _record(self, direction: str, reason: str) -> None:
+        self._tm_scale.inc(direction=direction, reason=reason)
+        self.events.append({"direction": direction, "reason": reason})
+        self._cooldown = self.cfg.autoscale_cooldown_ticks
+
+    # ------------------------------------------------------------ driving
+    def pending(self) -> bool:
+        """A scale-in victim is still draining."""
+        return self._victim is not None
+
+    def tick(self) -> Optional[str]:
+        """One policy pass. Returns the action taken ("out", "in",
+        "in_pending") or None."""
+        router = self.router
+        if self._victim is not None:
+            # finish the in-flight shrink before any new decision — and
+            # before the cooldown clock, so a long drain can't stack a
+            # second resize right behind the first
+            if router._by_name(self._victim) is None:
+                self._victim = None      # replaced/removed under us
+            elif router.quiesced(self._victim):
+                router.remove_replica(self._victim)
+                logger.info(
+                    f"fleet autoscaler: scale-in complete, removed "
+                    f"{self._victim} ({len(router._replicas)} replicas)")
+                self._victim = None
+            else:
+                return "in_pending"
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        decision = self._decide(self.signals())
+        if decision is None:
+            return None
+        direction, reason = decision
+        if direction == "out":
+            name = self._next_name()
+            fe = self.replica_factory(name)
+            router.add_replica(fe)
+            logger.info(
+                f"fleet autoscaler: scale-out +{name} (reason={reason}, "
+                f"{len(router._replicas)} replicas)")
+        else:
+            # least-loaded ready replica quiesces fastest and loses the
+            # least migration work
+            cands = [rep for rep in router._replicas
+                     if router._replica_ready(rep)]
+            if len(cands) <= self.cfg.autoscale_min_replicas:
+                return None
+            victim = min(cands,
+                         key=lambda rep: (rep.frontend.active_count(),
+                                          rep.name))
+            self._victim = victim.name
+            router.drain(victim.name, migrate=True)
+            logger.info(
+                f"fleet autoscaler: scale-in draining {victim.name} "
+                f"(reason={reason})")
+        self._record(direction, reason)
+        return direction

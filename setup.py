@@ -35,6 +35,7 @@ setup(
             "bench-diff=deepspeed_tpu.bench.cli:main",
             "step-report=deepspeed_tpu.profiling.observatory.__main__:main",
             "plan=deepspeed_tpu.autotuning.__main__:main",
+            "reshard=deepspeed_tpu.checkpoint.reshard_cli:main",
         ],
     },
     # tools/dslint + tools/bench-diff are checkout-only shims; the

@@ -128,6 +128,14 @@ def pytest_configure(config):
         "the zero-lost-uid / zero-KV-leak invariants are the acceptance "
         "criteria)")
     config.addinivalue_line(
+        "markers", "elastic: world-size-elastic tests (universal-resume "
+        "bit-coherence matrix 8→{4,2} on sub-meshes of the 8-device "
+        "virtual host, placement-oracle refusal, reshard CLI exit codes, "
+        "ElasticAgent resharding rebuilds incl. a REAL subprocess kill + "
+        "forced device-count change — CPU backend, tier-1-eligible under "
+        "JAX_PLATFORMS=cpu; heavy uninterrupted-twin comparisons ride "
+        "the slow lane)")
+    config.addinivalue_line(
         "markers", "autotune: observatory-driven plan-engine tests "
         "(plan schema + canary enforcement, analytic OOM refusal, "
         "plan-key purity, engine plan-cache hit/stale/fail_on_stale, "

@@ -271,6 +271,60 @@ class TestConfigKeys:
             f"autotuning keys no longer consumed: "
             f"{autotuning_keys - consumed}")
 
+    def test_elasticity_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for elastic worlds (ISSUE 17): the
+        # "elasticity" section graduated from EXTRA_KEYS to a validated
+        # DeepSpeedTPUConfig field, and its keys must stay actually
+        # consumed — the elastic agent reads them (ElasticAgent /
+        # agent_from_config, elasticity/elastic_agent.py); dropping a
+        # read would silently turn supervised resharding resume
+        # decorative, the reference's accepted-and-ignored failure mode
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            EXTRA_KEYS,
+            consumed_attr_keys,
+        )
+
+        elasticity_keys = {"elasticity", "max_restarts",
+                           "restart_backoff_s", "restart_backoff_max_s",
+                           "reload_on_restart", "min_world_size",
+                           "hpz_candidates", "universal_dir"}
+        assert "elasticity" not in EXTRA_KEYS, (
+            "elasticity must stay a declared schema section "
+            "(DeepSpeedTPUConfig.elasticity), not an EXTRA_KEYS escape")
+        assert not elasticity_keys & set(DEAD_KEYS), (
+            "elasticity section keys declared dead — "
+            "elasticity/elastic_agent.py consumes them")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, elasticity_keys)
+        assert consumed == elasticity_keys, (
+            f"elasticity keys no longer consumed: "
+            f"{elasticity_keys - consumed}")
+
+    def test_fleet_autoscale_keys_stay_consumed_and_undeclared(self):
+        # the autoscaler half of ISSUE 17: the fleet section's autoscale
+        # keys drive serving/fleet.FleetAutoscaler — a dropped read
+        # would leave the fleet permanently at its boot size while the
+        # config claims elasticity
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            consumed_attr_keys,
+        )
+
+        autoscale_keys = {"autoscale_min_replicas",
+                          "autoscale_max_replicas",
+                          "scale_out_queue_depth", "scale_in_queue_depth",
+                          "scale_out_kv_util", "scale_out_p99_latency_s",
+                          "autoscale_cooldown_ticks"}
+        assert not autoscale_keys & set(DEAD_KEYS), (
+            "fleet autoscale keys declared dead — "
+            "serving/fleet.py FleetAutoscaler consumes them")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, autoscale_keys)
+        assert consumed == autoscale_keys, (
+            f"fleet autoscale keys no longer consumed: "
+            f"{autoscale_keys - consumed}")
+
     def test_dead_key_ledger_entries_are_actually_dead(self):
         # every DEAD_KEYS entry must be honest: not read as a config attr
         # anywhere in the package (the rule flags per-site; this pins the

@@ -92,6 +92,42 @@ class TestSchemaValidator:
         assert any("unexpected key" in e
                    for e in schema.validate_result(res))
 
+    def test_elastic_block_roundtrips(self):
+        res = make_result(entries={"elastic_resume": {
+            "metrics": {"reshard_s": 0.32},
+            "elastic": {"from_world": 8, "to_world": 4,
+                        "convert_s": 0.215, "reshard_s": 0.324},
+            "elapsed_s": 12.0,
+        }})
+        assert schema.validate_result(res) == []
+
+    def test_elastic_block_requires_positive_worlds(self):
+        res = make_result(entries={"elastic_resume": {
+            "metrics": {"reshard_s": 0.3},
+            "elastic": {"from_world": 8, "to_world": 0}}})
+        assert any("elastic.to_world" in e
+                   for e in schema.validate_result(res))
+        res["entries"]["elastic_resume"]["elastic"] = {
+            "from_world": True, "to_world": 4}
+        assert any("elastic.from_world" in e
+                   for e in schema.validate_result(res))
+
+    def test_elastic_wall_times_non_negative(self):
+        res = make_result(entries={"elastic_resume": {
+            "metrics": {"reshard_s": 0.3},
+            "elastic": {"from_world": 8, "to_world": 4,
+                        "reshard_s": -1.0}}})
+        assert any("elastic.reshard_s" in e
+                   for e in schema.validate_result(res))
+
+    def test_pre_elastic_versions_still_validate(self):
+        # back-compat: a v2.3 record (predates the elastic block) and a
+        # v2.4 record without any elastic block both load unchanged
+        for version in (2.3, schema.SCHEMA_VERSION):
+            res = make_result()
+            res["schema_version"] = version
+            assert schema.validate_result(res) == [], version
+
     def test_trace_phase_stats_must_be_complete(self):
         res = make_result(entries={"headline": {
             "metrics": {"mfu": 0.4},

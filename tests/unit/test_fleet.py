@@ -34,6 +34,7 @@ from deepspeed_tpu.runtime.config import load_config
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
 from deepspeed_tpu.serving import (
     Admitted,
+    FleetAutoscaler,
     FleetRouter,
     Overloaded,
     Rejected,
@@ -654,3 +655,149 @@ def test_chaos_kill_and_hang_staggered_zero_loss():
     chaos.disarm()
     _assert_no_leaks(engines, free0)
     fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# autoscaling: scale-out under pressure, zero-loss scale-in when idle
+# --------------------------------------------------------------------- #
+class TestAutoscaler:
+    def _factory(self, made):
+        def make(name):
+            fe = ServingFrontend(_engine(seed=40 + len(made)),
+                                 config=dict(SCFG),
+                                 register_health=False, health_name=name)
+            made.append(fe)
+            return fe
+        return make
+
+    def test_add_replica_rejects_name_collision(self):
+        fleet, _ = _fleet(n=2)
+        taken = fleet.replicas()[0].name
+        clash = ServingFrontend(_engine(seed=9), config=dict(SCFG),
+                                register_health=False, health_name=taken)
+        with pytest.raises(ValueError, match="collides"):
+            fleet.add_replica(clash)
+        assert len(fleet.replicas()) == 2
+        clash.close()
+        fleet.close()
+
+    def test_remove_last_replica_refused(self):
+        fleet, _ = _fleet(n=1)
+        with pytest.raises(ValueError, match="last replica"):
+            fleet.remove_replica(0)
+        fleet.close()
+
+    def test_remove_replica_unpoisons_excluded_sets(self):
+        """A removed name must be reusable by a future scale-out: no
+        waiting request may keep it excluded."""
+        fleet, _ = _fleet(n=2)
+        _warm(fleet)
+        victim = fleet.replicas()[1].name
+        fleet.submit(1, _prompt(8))
+        for r in fleet._active.values():
+            r.excluded.add(victim)
+        fleet.remove_replica(victim)
+        assert all(victim not in r.excluded
+                   for r in fleet._active.values())
+        fleet.run_until_drained(2000, deadline_s=20.0)
+        assert fleet.result(1).state == "completed"
+        fleet.close()
+
+    def test_decide_thresholds_and_reasons(self):
+        fleet, _ = _fleet(n=2, fcfg={
+            "autoscale_min_replicas": 1, "autoscale_max_replicas": 4,
+            "scale_out_queue_depth": 2.0, "scale_in_queue_depth": 0.5,
+            "scale_out_kv_util": 0.85, "scale_out_p99_latency_s": 0.0})
+        scaler = FleetAutoscaler(fleet, lambda name: None)
+        idle = {"queue_depth": 0.1, "kv_util": 0.0, "p99_latency_s": 0.0}
+        assert scaler._decide(dict(idle, queue_depth=3.0)) \
+            == ("out", "queue_depth")
+        assert scaler._decide(dict(idle, kv_util=0.95)) \
+            == ("out", "kv_pressure")
+        # latency signal is DISABLED at 0 — a huge p99 must not trigger
+        assert scaler._decide(dict(idle, p99_latency_s=99.0)) \
+            == ("in", "idle")
+        fleet.cfg.scale_out_p99_latency_s = 0.5
+        assert scaler._decide(dict(idle, p99_latency_s=99.0)) \
+            == ("out", "latency")
+        # inside the band: no resize
+        assert scaler._decide(dict(idle, queue_depth=1.0)) is None
+        # at the ceiling, pressure no longer scales out — and a BUSY
+        # fleet never scales in, so the verdict is: hold
+        fleet.cfg.autoscale_max_replicas = 2
+        assert scaler._decide(dict(idle, queue_depth=9.0)) is None
+        assert scaler._decide(idle) == ("in", "idle")
+        # at the floor, idleness no longer scales in
+        fleet.cfg.autoscale_min_replicas = 2
+        assert scaler._decide(idle) is None
+        fleet.close()
+
+    @pytest.mark.overload(timeout_s=300)
+    def test_poisson_burst_scales_out_then_in_zero_loss(self):
+        """The chaos acceptance run for fleet elasticity: a Poisson
+        burst against a 2-replica floor forces a scale-OUT mid-burst;
+        when the burst drains the autoscaler shrinks back to the floor
+        through drain+migrate. Zero lost uids in BOTH directions, zero
+        KV leaks on every engine that ever served (including the
+        scale-in victims), and ``fleet_scale_events_total`` moves in
+        both directions."""
+        engines = [_engine(seed=i) for i in range(2)]
+        ledger = [(e, e.allocator.free_blocks) for e in engines]
+        fleet, _ = _fleet(engines=engines, fcfg={
+            "min_ready_replicas": 1,
+            "autoscale_min_replicas": 2, "autoscale_max_replicas": 4,
+            "scale_out_queue_depth": 1.5, "scale_in_queue_depth": 0.5,
+            "autoscale_cooldown_ticks": 2})
+        _warm(fleet)
+        made = []
+        scaler = FleetAutoscaler(fleet, self._factory(made))
+
+        gen = chaos.OverloadGenerator(vocab_size=512, prompt_len=(4, 16),
+                                      seed=5)
+        all_uids = []
+        peak = 2
+        for wave in range(3):
+            for uid, prompt in gen.burst(8):
+                all_uids.append(uid)
+                res = fleet.submit(uid, prompt)
+                assert isinstance(res, (Admitted, Overloaded))
+            for _ in range(4):
+                fleet.run_tick()
+                scaler.tick()
+                peak = max(peak, len(fleet.replicas()))
+        assert peak > 2, "burst never forced a scale-out"
+        assert made, "scale-out never invoked the replica factory"
+
+        # burst over: drain the fleet while the policy keeps running —
+        # the autoscaler must shrink back to the floor without losing
+        # anything mid-flight
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 120.0:
+            fleet.run_tick()
+            scaler.tick()
+            if not fleet.active_count() and not scaler.pending() \
+                    and len(fleet.replicas()) == 2:
+                break
+        assert len(fleet.replicas()) == 2, \
+            [fe.name for fe in fleet.replicas()]
+        directions = {e["direction"] for e in scaler.events}
+        assert directions == {"out", "in"}, scaler.events
+        for ev in scaler.events:
+            assert telemetry.counter("fleet_scale_events_total").value(
+                direction=ev["direction"], reason=ev["reason"]) >= 1
+
+        # ZERO lost uids across both resize directions
+        for uid in all_uids:
+            assert fleet.result(uid).state in TERMINAL, uid
+        assert _resolved_count() == len(all_uids)
+        assert telemetry.counter("fleet_requests_lost_total").value() == 0
+
+        # zero KV leaks on EVERY engine that ever served — the floor
+        # survivors and the closed scale-in victims alike
+        ledger += [(fe.engine, fe.engine.allocator.n_blocks - 1)
+                   for fe in made]
+        for i, (eng, f0) in enumerate(ledger):
+            assert not eng.seqs, f"engine {i} still tracks {list(eng.seqs)}"
+            assert eng.allocator.free_blocks == f0, \
+                f"engine {i} leaked KV blocks"
+        fleet.close()

@@ -718,6 +718,155 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
     return out
 
 
+def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
+                                n_replicas=3):
+    """Multi-tenant QoS bench: the fleet SLA scenario with one batch-tier
+    tenant flooding ~10× the others while a realtime and a standard
+    tenant send background traffic.
+
+    Same fleet shape as ``fleet_sla_poisson_gpt2`` (3 replicas, one
+    shared parameter tree, Poisson arrivals, mid-burst replica kill) but
+    every request carries a tenant: ``hot`` (batch tier, rate-capped)
+    draws ~10x the traffic of ``rt`` (realtime) and ``std`` (standard).
+    The hot tenant's excess resolves to structured tenant-scoped
+    rejections; the others keep completing. Reports a schema-v2.5
+    ``tenants`` block — per-tenant submitted / terminal-outcome counts
+    (pulled from the fleet's own ``fleet_tenant_*`` counters, so the row
+    IS the accounting the reconciliation invariant pins) plus per-tenant
+    TTFT p50/p99 — and the fleet-wide ``requests_lost`` zero-loss pin."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.fastgen import FastGenEngine
+    from deepspeed_tpu.models import transformer as T
+    from deepspeed_tpu.serving.fleet import FleetRouter
+    from deepspeed_tpu.testing import chaos
+
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(16, 96, n_req)]
+    prompts = [rng.integers(0, 50000, n).tolist() for n in lens]
+    tenant_names = ["rt", "std", "hot"]
+    tenants = [str(t) for t in rng.choice(tenant_names, n_req,
+                                          p=[1 / 12, 1 / 12, 10 / 12])]
+
+    cfg = T.get_model_config(model, max_seq_len=512)
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    engines = [FastGenEngine(cfg, params=params, n_blocks=128,
+                             block_size=32, max_blocks_per_seq=8,
+                             token_budget=128, temperature=0.0, seed=0)
+               for _ in range(n_replicas)]
+    for eng in engines[1:]:
+        eng._ticks = engines[0]._ticks
+    fleet = FleetRouter.build(
+        engines,
+        serving_config={"max_queue": 16,
+                        "default_max_new_tokens": max_new,
+                        "circuit_failure_threshold": 2,
+                        "circuit_backoff_s": 0.2,
+                        "circuit_backoff_max_s": 2.0},
+        fleet_config={"min_ready_replicas": 2, "max_attempts": 4,
+                      "retry_backoff_s": 0.05, "retry_backoff_max_s": 0.5},
+        tenancy_config={
+            "tenants": {
+                "rt": {"tier": "realtime"},
+                "std": {"tier": "standard"},
+                # the flooder: batch tier, hard-capped requests/s — its
+                # excess must bounce with tenant-scoped retry-afters
+                "hot": {"tier": "batch", "requests_per_s": 1.0,
+                        "burst_requests": 3},
+            }})
+    try:
+        for i, fe in enumerate(fleet.replicas()):
+            fe.submit(900 + i, prompts[0][:90], max_new_tokens=max_new)
+            fe.run_until_drained(5_000, deadline_s=180.0)
+        fe0 = fleet.replicas()[0]
+        for i in range(4):
+            fe0.submit(500 + i, prompts[i], max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        fe0.run_until_drained(20_000, deadline_s=180.0)
+        cap_tps = 4 * max_new / (time.perf_counter() - t0)
+
+        lam = 2.0 * cap_tps / max_new
+        arrival = np.cumsum(rng.exponential(1.0 / lam, n_req))
+        kill_at = float(arrival[n_req // 3])
+        uids = [1000 + i for i in range(n_req)]
+        first_tok, done_at, states = {}, {}, {}
+        submitted = set()
+        pending = list(zip(arrival, uids, prompts, tenants))
+        killed_name = None
+        t0 = time.perf_counter()
+        while len(done_at) < n_req and time.perf_counter() - t0 < 300.0:
+            now = time.perf_counter() - t0
+            if killed_name is None and now >= kill_at:
+                killed_name = fleet.replicas()[0].name
+                chaos.arm(f"serving/tick@{killed_name}=fail:1000000")
+            while pending and pending[0][0] <= now:
+                _, uid, pr, ten = pending.pop(0)
+                fleet.submit(uid, pr, max_new_tokens=max_new, tenant=ten)
+                submitted.add(uid)
+            fleet.run_tick()
+            now = time.perf_counter() - t0
+            for uid in submitted:
+                if uid in done_at:
+                    continue
+                res = fleet.result(uid)
+                if res.tokens and uid not in first_tok:
+                    first_tok[uid] = now
+                if res.state != "active":
+                    states[uid] = res.state
+                    done_at[uid] = now
+            if pending and not fleet.active_count():
+                time.sleep(max(0.0, min(0.005, pending[0][0] - now)))
+        # fleet-side per-tenant accounting, straight from the counters
+        sub_ctr = telemetry.counter("fleet_tenant_submitted_total")
+        res_ctr = telemetry.counter("fleet_tenant_resolved_total")
+        tenant_rows = {}
+        for ten in tenant_names:
+            outcomes = {}
+            for state in ("completed", "expired", "failed", "rejected",
+                          "shed"):
+                n = int(res_ctr.value(tenant=ten, outcome=state))
+                if n:
+                    outcomes[state] = n
+            row = {"submitted": int(sub_ctr.value(tenant=ten)),
+                   "outcomes": outcomes}
+            tts = sorted(
+                first_tok[u] - arrival[u - 1000] for u, s in states.items()
+                if s == "completed" and u in first_tok
+                and tenants[u - 1000] == ten)
+            if tts:
+                row["ttft_p50_s"] = round(tts[len(tts) // 2], 3)
+                row["ttft_p99_s"] = round(
+                    tts[min(len(tts) - 1, int(len(tts) * 0.99))], 3)
+            tenant_rows[ten] = row
+    finally:
+        chaos.disarm()
+        fleet.close()
+    del engines, params
+    gc.collect()
+
+    counts = {}
+    for s in states.values():
+        counts[s] = counts.get(s, 0) + 1
+    out = {
+        "replicas": n_replicas,
+        "replica_killed_mid_burst": killed_name or "none",
+        "capacity_probe_tokens_per_sec": round(cap_tps, 1),
+        "requests": n_req,
+        "submitted": len(submitted),
+        "completed": counts.get("completed", 0),
+        "requests_lost": len(submitted) - len(states),
+        "hot_tenant_share": round(tenants.count("hot") / n_req, 2),
+        "tenants": tenant_rows,
+        "single_replica_referent": "fleet_sla_poisson_gpt2",
+    }
+    for s, n in sorted(counts.items()):
+        if s != "completed":
+            out[f"outcome_{s}"] = n
+    return out
+
+
 # prefix for CPU-mesh subprocess snippets: env alone is not enough where a
 # sitecustomize registers a TPU PJRT plugin — pin the platform via config too
 CPU_SNIPPET_PRELUDE = r'''
@@ -1237,6 +1386,7 @@ SUITE_SCHEDULE = [
     ("fastgen_paged_splitfuse_gpt2", fastgen_bench, 360, 150),
     ("fastgen_sla_poisson_gpt2", fastgen_sla_bench, 360, 150),
     ("fleet_sla_poisson_gpt2", fleet_sla_bench, 420, 150),
+    ("fleet_sla_multitenant_gpt2", fleet_sla_multitenant_bench, 420, 150),
     ("moe_ulysses_moe_350m_bf16", lambda: train_bench(
         "moe_350m", zero_stage=2, precision="bf16",
         batch=16, seq_len=1024, gas=4, steps=8,

@@ -83,18 +83,35 @@ and validate unchanged::
 
 carried by the ``elastic_resume`` lane; ``bench-diff`` treats the wall
 times lower-is-better.
+
+Schema v2.5 adds one more OPTIONAL per-entry key — earlier records load
+and validate unchanged::
+
+    "tenants": {            # per-tenant QoS accounting (multi-tenant lanes)
+      name: {
+        "submitted": int,   # requests this tenant submitted to the fleet
+        "outcomes": {state: int},   # terminal-outcome counts; their sum
+                                    # must equal "submitted" exactly
+        "ttft_p50_s": number, "ttft_p99_s": number,   # optional
+      }, ...
+    },
+
+carried by the ``fleet_sla_multitenant_gpt2`` lane. The per-tenant
+reconciliation (submitted == Σ outcomes) is validated structurally here —
+a tenants block that doesn't reconcile is an invalid result.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2.4
+SCHEMA_VERSION = 2.5
 
 #: versions validate_result accepts — v2 records predate the ``comms``
 #: block, v2.1 the ``guardian`` block, v2.2 the ``plan`` block
 #: (autotune plan-cache verdict per entry), v2.3 the ``elastic`` block
-#: (world-elastic resume wall times); otherwise shape-identical
-SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3, 2.4)
+#: (world-elastic resume wall times), v2.4 the ``tenants`` block
+#: (per-tenant QoS accounting); otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3, 2.4, 2.5)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -104,7 +121,7 @@ RECORD_VERSION = 1
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
                          "elapsed_s", "skipped_reason", "error", "note",
                          "comms", "overlap_fraction", "guardian", "plan",
-                         "elastic")
+                         "elastic", "tenants")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -248,6 +265,47 @@ def validate_elastic_block(block: Any, where: str) -> List[str]:
     return errs
 
 
+def validate_tenants_block(block: Any, where: str) -> List[str]:
+    """Validate a v2.5 ``tenants`` block: per-tenant submitted/outcome
+    counts (which must reconcile exactly — submitted == Σ outcomes) plus
+    optional TTFT percentiles."""
+    if not isinstance(block, dict):
+        return [f"{where}: tenants must be a dict"]
+    errs: List[str] = []
+    for name, row in block.items():
+        if not isinstance(row, dict):
+            errs.append(f"{where}: tenants[{name!r}] must be a dict")
+            continue
+        sub = row.get("submitted")
+        if not isinstance(sub, int) or isinstance(sub, bool) or sub < 0:
+            errs.append(f"{where}: tenants[{name!r}].submitted must be a "
+                        "non-negative int")
+            continue
+        outcomes = row.get("outcomes")
+        if not isinstance(outcomes, dict):
+            errs.append(f"{where}: tenants[{name!r}].outcomes must be a "
+                        "dict")
+            continue
+        total = 0
+        bad = False
+        for state, n in outcomes.items():
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+                errs.append(f"{where}: tenants[{name!r}].outcomes"
+                            f"[{state!r}] must be a non-negative int")
+                bad = True
+                continue
+            total += n
+        if not bad and total != sub:
+            errs.append(f"{where}: tenants[{name!r}] does not reconcile: "
+                        f"submitted={sub} but outcomes sum to {total}")
+        for key in ("ttft_p50_s", "ttft_p99_s"):
+            if key in row and row[key] is not None \
+                    and (not is_number(row[key]) or row[key] < 0):
+                errs.append(f"{where}: tenants[{name!r}].{key} must be a "
+                            "non-negative number or null")
+    return errs
+
+
 def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
     if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
         return [f"{where}: overlap_fraction must be a number in [0, 1]"]
@@ -292,6 +350,8 @@ def validate_entry(entry: Any, name: str) -> List[str]:
         errs += validate_plan_block(entry["plan"], where)
     if "elastic" in entry:
         errs += validate_elastic_block(entry["elastic"], where)
+    if "tenants" in entry:
+        errs += validate_tenants_block(entry["tenants"], where)
     return errs
 
 
@@ -435,7 +495,7 @@ def normalize_entry_row(row: Any,
     if "error" in row:
         out["error"] = str(row.pop("error"))
     for key in ("trace_phases", "telemetry", "memory", "comms", "guardian",
-                "plan", "elastic"):
+                "plan", "elastic", "tenants"):
         if key in row:
             val = row.pop(key)
             if val:

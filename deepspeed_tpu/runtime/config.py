@@ -670,6 +670,114 @@ class FleetSectionConfig:
 
 
 @dataclasses.dataclass
+class TenantQuotaConfig:
+    """One tenant's QoS entry inside ``tenancy.tenants`` (see
+    :class:`TenancySectionConfig`). Every quota defaults to 0 =
+    unlimited; ``tier`` places the tenant on the shed ladder (``batch``
+    sheds before ``standard`` before ``realtime``) and picks its default
+    fair-share weight."""
+    tier: str = "standard"       # realtime | standard | batch
+    requests_per_s: float = 0.0  # token-bucket rate limits (0 = none)
+    tokens_per_s: float = 0.0
+    burst_requests: float = 0.0  # bucket capacities (0 = one rate-second)
+    burst_tokens: float = 0.0
+    max_concurrent: int = 0      # live request copies (0 = unlimited)
+    max_kv_blocks: int = 0       # projected KV blocks held (0 = unlimited)
+    weight: float = 0.0          # fair-share weight (0 = tier default)
+
+    def validate(self) -> None:
+        if self.tier not in ("realtime", "standard", "batch"):
+            raise DeepSpeedConfigError(
+                "tenancy tenant tier must be realtime|standard|batch, "
+                f"got {self.tier!r}")
+        for key in ("requests_per_s", "tokens_per_s", "burst_requests",
+                    "burst_tokens", "weight"):
+            if getattr(self, key) < 0:
+                raise DeepSpeedConfigError(
+                    f"tenancy tenant {key} must be >= 0, got "
+                    f"{getattr(self, key)}")
+        if self.max_concurrent < 0 or self.max_kv_blocks < 0:
+            raise DeepSpeedConfigError(
+                "tenancy tenant max_concurrent / max_kv_blocks must be "
+                f">= 0, got {self.max_concurrent} / {self.max_kv_blocks}")
+
+
+@dataclasses.dataclass
+class TenancySectionConfig:
+    """Multi-tenant QoS (``deepspeed_tpu/serving/tenancy.py``; README
+    "Multi-tenant QoS").
+
+    ``tenants`` maps tenant name to a :class:`TenantQuotaConfig` dict;
+    unknown tenants (and untagged traffic, which resolves to the
+    ``"default"`` tenant) fall back to ``default_tier`` with no quotas.
+    ``tier_weights`` sets the fair-share weight per tier (overridable
+    per tenant). Under contended capacity — queue at least
+    ``fair_contention_queue_frac`` of ``serving.max_queue`` full, or KV
+    past the degrade watermark — a tenant whose virtual token counter
+    leads the fair-queueing floor by more than
+    ``fair_share_horizon_tokens`` weighted tokens is turned away with a
+    drain-time retry hint. ``poison_quarantine_threshold`` suspect
+    evictions inside ``poison_quarantine_s`` quarantine the tenant for
+    that window (per-tenant circuit instead of a whole-replica blast).
+    ``max_tenant_labels`` bounds per-tenant metric label cardinality
+    (overflow folds into ``"other"``); ``max_tracked_tenants`` bounds
+    internal registry state (idle tenants evicted LRU-first)."""
+    default_tier: str = "standard"
+    tier_weights: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"realtime": 8.0, "standard": 4.0,
+                                 "batch": 1.0})
+    tenants: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    max_tenant_labels: int = 32
+    max_tracked_tenants: int = 1024
+    fair_share_horizon_tokens: float = 256.0
+    fair_contention_queue_frac: float = 0.5
+    poison_quarantine_threshold: int = 3
+    poison_quarantine_s: float = 30.0
+
+    def validate(self) -> None:
+        if self.default_tier not in ("realtime", "standard", "batch"):
+            raise DeepSpeedConfigError(
+                "tenancy.default_tier must be realtime|standard|batch, "
+                f"got {self.default_tier!r}")
+        for tier, w in self.tier_weights.items():
+            if tier not in ("realtime", "standard", "batch"):
+                raise DeepSpeedConfigError(
+                    f"tenancy.tier_weights has unknown tier {tier!r}")
+            if not isinstance(w, (int, float)) or w <= 0:
+                raise DeepSpeedConfigError(
+                    f"tenancy.tier_weights[{tier!r}] must be > 0, got "
+                    f"{w!r}")
+        if not isinstance(self.tenants, dict):
+            raise DeepSpeedConfigError(
+                "tenancy.tenants must be a dict of tenant name -> quota "
+                f"entry, got {type(self.tenants).__name__}")
+        if self.max_tenant_labels < 1:
+            raise DeepSpeedConfigError(
+                "tenancy.max_tenant_labels must be >= 1, got "
+                f"{self.max_tenant_labels}")
+        if self.max_tracked_tenants < 1:
+            raise DeepSpeedConfigError(
+                "tenancy.max_tracked_tenants must be >= 1, got "
+                f"{self.max_tracked_tenants}")
+        if self.fair_share_horizon_tokens <= 0:
+            raise DeepSpeedConfigError(
+                "tenancy.fair_share_horizon_tokens must be > 0, got "
+                f"{self.fair_share_horizon_tokens}")
+        if not (0.0 < self.fair_contention_queue_frac <= 1.0):
+            raise DeepSpeedConfigError(
+                "tenancy.fair_contention_queue_frac must be in (0, 1], "
+                f"got {self.fair_contention_queue_frac}")
+        if self.poison_quarantine_threshold < 1:
+            raise DeepSpeedConfigError(
+                "tenancy.poison_quarantine_threshold must be >= 1, got "
+                f"{self.poison_quarantine_threshold}")
+        if self.poison_quarantine_s <= 0:
+            raise DeepSpeedConfigError(
+                "tenancy.poison_quarantine_s must be > 0, got "
+                f"{self.poison_quarantine_s}")
+
+
+@dataclasses.dataclass
 class CheckpointSectionConfig:
     """Durable-checkpoint knobs (``checkpoint/fault_tolerance.py``).
 
@@ -976,6 +1084,8 @@ class DeepSpeedTPUConfig:
         default_factory=ServingSectionConfig)
     fleet: FleetSectionConfig = dataclasses.field(
         default_factory=FleetSectionConfig)
+    tenancy: TenancySectionConfig = dataclasses.field(
+        default_factory=TenancySectionConfig)
     hlolint: HlolintSectionConfig = dataclasses.field(
         default_factory=HlolintSectionConfig)
     memlint: MemlintSectionConfig = dataclasses.field(

@@ -14,7 +14,10 @@ pieces every production continuous-batching stack pairs with admission
   (``health.py``),
 * and the layer above one replica: a health-aware fleet router with
   failover, retries, hedging, and zero-loss draining (``fleet.py`` —
-  README "Serving fleet").
+  README "Serving fleet"),
+* multi-tenant QoS: per-tenant quotas, weighted-fair admission, and
+  tier-aware shedding shared fleet-wide (``tenancy.py`` — README
+  "Multi-tenant QoS").
 
 Quick start::
 
@@ -59,3 +62,15 @@ from deepspeed_tpu.serving.frontend import (  # noqa: F401
     ServingFrontend,
 )
 from deepspeed_tpu.serving.health import HealthSurface  # noqa: F401
+from deepspeed_tpu.serving.tenancy import (  # noqa: F401
+    DEFAULT_TENANT,
+    REASON_FAIR_SHARE,
+    REASON_TENANT_CONCURRENCY,
+    REASON_TENANT_KV,
+    REASON_TENANT_QUARANTINED,
+    REASON_TENANT_RATE,
+    TIER_BATCH,
+    TIER_REALTIME,
+    TIER_STANDARD,
+    TenantRegistry,
+)

@@ -59,13 +59,15 @@ class Admitted:
 @dataclasses.dataclass
 class Overloaded:
     """Structured fast rejection. ``retry_after_s`` estimates when the
-    rejecting condition clears (backlog drain time, or the circuit's
-    next probe window)."""
+    rejecting condition clears (backlog drain time, the circuit's next
+    probe window, or — for ``tenant_*`` reasons — the TENANT-scoped
+    window: its bucket refill, quota drain, or quarantine expiry)."""
     uid: int
     reason: str                  # queue_full | kv_pressure | circuit_open
-    retry_after_s: float
+    retry_after_s: float         # | tenant_* (serving/tenancy.py)
     policy: str
     detail: str = ""
+    tenant: str = ""             # resolved tenant the verdict is scoped to
 
 
 @dataclasses.dataclass
@@ -85,6 +87,11 @@ class _Candidate:
     deadline_s: Optional[float]  # absolute, engine clock; None = none
     remaining_tokens: int        # prefill left + decode grant left
     incoming: bool = False
+    # QoS tier rank (tenancy.TIER_RANKS): HIGHER rank sheds FIRST
+    # (batch=2 pays before standard=1 before realtime=0). Everyone
+    # defaulting to the same rank reproduces the pre-tenancy policies
+    # exactly — the ladder only bites when tiers actually differ.
+    tier_rank: int = 1
 
 
 class AdmissionController:
@@ -128,16 +135,43 @@ class AdmissionController:
         """Which live request to shed so ``incoming`` can be admitted.
         ``None`` = shed nobody (reject the incoming request instead).
 
-        ``deadline_aware`` ranks every candidate (incoming included) by
+        The QoS tier ladder applies FIRST: only the cheapest (highest
+        ``tier_rank``) tier present among live + incoming ever pays —
+        ``batch`` sheds before ``standard`` before ``realtime``. When
+        the incoming request itself sits in (or below) that cheapest
+        tier, each policy keeps its pre-tenancy semantics within the
+        tier; when the incoming request OUTRANKS every candidate of the
+        cheapest tier, the ladder sheds from that tier even under
+        ``reject_newest`` (a realtime admission must not bounce off a
+        queue full of batch work).
+
+        ``deadline_aware`` ranks candidates within the chosen tier by
         deadline slack — time left minus estimated time to finish its
         remaining tokens at ``token_seconds`` per token — and sheds the
         most doomed one. A request with no deadline always "meets" it,
-        so an all-deadline-free queue degenerates to reject_newest.
+        so an all-deadline-free same-tier queue degenerates to
+        reject_newest.
+
+        Determinism (pinned by tests): within a tier, identical slack
+        breaks toward the OLDEST (lowest ``age_order``) candidate for
+        ``deadline_aware`` and ``reject_oldest``; the cross-tier
+        ``reject_newest`` shed picks the NEWEST of the cheapest tier.
+        ``age_order`` is a unique admission counter, so every choice is
+        total-ordered.
         """
-        if self.shed_policy == REJECT_NEWEST or not live:
+        if not live:
             return None
+        worst_rank = max(c.tier_rank for c in live + [incoming])
+        pool = [c for c in live if c.tier_rank == worst_rank]
+        incoming_in_pool = incoming.tier_rank == worst_rank
+        if self.shed_policy == REJECT_NEWEST:
+            if incoming_in_pool or not pool:
+                return None   # the incoming request IS the newest payer
+            return max(pool, key=lambda c: c.age_order).uid
         if self.shed_policy == REJECT_OLDEST:
-            return min(live, key=lambda c: c.age_order).uid
+            if not pool:
+                return None   # incoming alone holds the cheapest tier
+            return min(pool, key=lambda c: c.age_order).uid
         # deadline_aware: minimal slack loses; ties (e.g. several already
         # hopeless) break toward the oldest so the choice is deterministic
         def slack(c: _Candidate) -> float:
@@ -145,7 +179,14 @@ class AdmissionController:
                 return float("inf")
             return (c.deadline_s - now) - c.remaining_tokens * token_seconds
 
-        worst = min(live + [incoming], key=lambda c: (slack(c), c.age_order))
+        if not incoming_in_pool:
+            # tier ladder already decided WHO pays (the cheapest tier);
+            # slack only decides WHICH of them — deadline-free members
+            # are shedable here (inf slack ties break toward the oldest)
+            if not pool:
+                return None
+            return min(pool, key=lambda c: (slack(c), c.age_order)).uid
+        worst = min(pool + [incoming], key=lambda c: (slack(c), c.age_order))
         if worst.incoming or slack(worst) == float("inf"):
             return None
         return worst.uid

@@ -75,6 +75,7 @@ from deepspeed_tpu.serving.frontend import (
     RequestResult,
     ServingFrontend,
 )
+from deepspeed_tpu.serving.tenancy import TenantRegistry
 from deepspeed_tpu.utils.logging import logger
 
 #: fleet-level rejection reason when no replica is even a candidate
@@ -97,11 +98,11 @@ class _FleetRequest:
     __slots__ = ("uid", "prompt", "deadline_s", "max_new_tokens",
                  "submit_t", "dispatch_t", "attempts", "excluded",
                  "replica", "hedge", "hedged", "next_retry_t", "carried",
-                 "last_reason")
+                 "last_reason", "tenant")
 
     def __init__(self, uid: int, prompt: List[int],
                  deadline_s: Optional[float], max_new_tokens: int,
-                 submit_t: float):
+                 submit_t: float, tenant: str):
         self.uid = uid
         self.prompt = prompt          # current payload (grows on remat)
         self.deadline_s = deadline_s  # relative to submit_t; None = none
@@ -116,6 +117,8 @@ class _FleetRequest:
         self.next_retry_t: Optional[float] = None
         self.carried: List[int] = []  # tokens folded into prompt by remat
         self.last_reason = ""         # why the last copy was lost
+        self.tenant = tenant          # resolved tenant; rides every
+        # dispatch, failover re-materialization and hedge copy
 
 
 class FleetRouter:
@@ -126,7 +129,7 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence[ServingFrontend], config=None,
                  clock=time.monotonic, register_health: bool = True,
-                 health_name: str = "fleet", seed: int = 0):
+                 health_name: str = "fleet", seed: int = 0, tenancy=None):
         from deepspeed_tpu.runtime.config import FleetSectionConfig
         from deepspeed_tpu.runtime.config_utils import config_from_dict
 
@@ -146,6 +149,18 @@ class FleetRouter:
         names = [r.name for r in self._replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"replica names must be unique, got {names}")
+        # ONE tenant registry for the whole fleet: per-tenant quotas,
+        # fairness counters and quarantines must hold ACROSS replicas
+        # (and through replace_replica / autoscaler resizes — every
+        # install path below adopts the same registry). With no tenancy
+        # given, the first replica's registry becomes the fleet's, so
+        # pre-built frontends sharing one keep it.
+        if tenancy is None:
+            self.tenancy = self._replicas[0].frontend.tenancy
+        else:
+            self.tenancy = TenantRegistry.ensure(tenancy, clock=clock)
+        for rep in self._replicas:
+            rep.frontend.adopt_tenancy(self.tenancy)
         self._active: Dict[int, _FleetRequest] = {}
         # terminal records, insertion-ordered and bounded (same contract
         # as the frontend's result map — sustained overload must not grow
@@ -163,7 +178,8 @@ class FleetRouter:
 
     @classmethod
     def build(cls, engines: Sequence, serving_config=None, fleet_config=None,
-              replica_prefix: str = "replica", **kw) -> "FleetRouter":
+              replica_prefix: str = "replica", tenancy_config=None,
+              **kw) -> "FleetRouter":
         """Convenience: wrap N engines in frontends named
         ``{prefix}-{i}`` (distinct names scope per-replica chaos and
         de-synchronize circuit jitter) and route over them. The replicas
@@ -177,7 +193,7 @@ class FleetRouter:
                                register_health=False,
                                health_name=f"{replica_prefix}-{i}")
                for i, eng in enumerate(engines)]
-        return cls(fes, config=fleet_config, **kw)
+        return cls(fes, config=fleet_config, tenancy=tenancy_config, **kw)
 
     # ------------------------------------------------------------------ #
     def _setup_telemetry(self) -> None:
@@ -210,6 +226,18 @@ class FleetRouter:
             "fleet_ready_replicas", "replicas currently routable")
         self._tm_active = telemetry.gauge(
             "fleet_active_requests", "fleet requests not yet terminal")
+        # per-tenant fleet accounting: submitted == sum over terminal
+        # outcomes, per tenant, fleet-wide (the reconciliation invariant
+        # the chaos tests pin). Labels pass the cardinality guard.
+        self._tm_t_submitted = telemetry.counter(
+            "fleet_tenant_submitted_total",
+            "requests submitted to the fleet, by tenant (duplicate-uid "
+            "rejections excluded — they never get a terminal record)")
+        self._tm_t_resolved = telemetry.counter(
+            "fleet_tenant_resolved_total",
+            "fleet terminal states by tenant and outcome — per tenant, "
+            "its sum over outcomes equals fleet_tenant_submitted_total "
+            "exactly (the multi-tenant reconciliation invariant)")
 
     # ------------------------------------------------------------------ #
     # introspection
@@ -245,7 +273,7 @@ class FleetRouter:
                 res = self._copy_result(rep, uid)
                 if res is not None:
                     tokens += res.tokens
-            return RequestResult(uid, ACTIVE, tokens)
+            return RequestResult(uid, ACTIVE, tokens, tenant=r.tenant)
         return self._results[uid]
 
     def drop_result(self, uid: int) -> None:
@@ -329,8 +357,13 @@ class FleetRouter:
         overloads: List[Overloaded] = []
         rejected: Optional[Rejected] = None
         for rep in self._candidates(len(r.prompt), remaining, r.excluded):
+            # charge_quota=False: the fleet door already debited this
+            # tenant's rate buckets at submit() — a replica dispatch (or
+            # a failover retry) must not charge the client twice. The
+            # replica still enforces quarantine/concurrency/KV/fairness.
             res = rep.frontend.submit(r.uid, r.prompt, deadline_s=deadline,
-                                      max_new_tokens=remaining)
+                                      max_new_tokens=remaining,
+                                      tenant=r.tenant, charge_quota=False)
             if isinstance(res, Admitted):
                 r.replica = rep.name
                 r.attempts += 1
@@ -359,28 +392,40 @@ class FleetRouter:
             return Overloaded(
                 r.uid, reasons.most_common(1)[0][0],
                 round(min(o.retry_after_s for o in overloads), 3), "fleet",
-                detail=f"{len(overloads)} candidate replicas overloaded")
+                detail=f"{len(overloads)} candidate replicas overloaded",
+                tenant=r.tenant)
         if rejected is not None:
             # every candidate rejected replica-locally — surface the last
             return rejected
         return Overloaded(r.uid, REASON_NO_REPLICA, self._retry_hint_s(),
-                          "fleet", detail="no routable replica")
+                          "fleet", detail="no routable replica",
+                          tenant=r.tenant)
 
     # ------------------------------------------------------------------ #
     # admission
     # ------------------------------------------------------------------ #
     def submit(self, uid: int, prompt: Sequence[int],
                deadline_s: Optional[float] = None,
-               max_new_tokens: Optional[int] = None
+               max_new_tokens: Optional[int] = None,
+               tenant: Optional[str] = None
                ) -> Union[Admitted, Overloaded, Rejected]:
         """Admit one request to the fleet. Same contract as the frontend:
         never raises for request-shaped problems; Overloaded/Rejected are
-        also recorded as fleet terminal results for ``result(uid)``."""
+        also recorded as fleet terminal results for ``result(uid)``.
+
+        ``tenant`` (default tenant when omitted) is debited HERE — the
+        fleet door is the client-facing layer, so rate buckets are
+        charged exactly once regardless of how many replicas a request
+        later visits through failover or hedging."""
         prompt = list(prompt)
+        tenant = self.tenancy.resolve(tenant)
         self._tm_submitted.inc()
         if uid in self._active:
             # duplicate of a live fleet uid: reject WITHOUT clobbering the
-            # live request's lifecycle (mirror of the frontend rule)
+            # live request's lifecycle (mirror of the frontend rule).
+            # Deliberately NOT counted in fleet_tenant_submitted_total:
+            # the dup produces no terminal record, so counting it would
+            # break the submitted == Σ resolved reconciliation.
             self._tm_reject.inc(reason="invalid")
             return Rejected(uid, detail=f"uid {uid} is still active")
         if max_new_tokens is None:
@@ -390,8 +435,24 @@ class FleetRouter:
             max_new_tokens = self._replicas[0].frontend.cfg \
                 .default_max_new_tokens
         self._results.pop(uid, None)   # resubmission of a terminal uid
+        self._tm_t_submitted.inc(tenant=self.tenancy.label(tenant))
+        # fleet-level tenant gate: quarantine + rate buckets (debited
+        # once, here). Concurrency/KV/fairness are enforced per replica
+        # at dispatch — the registry is fleet-shared, so those hold
+        # fleet-wide too.
+        gate = self.tenancy.fleet_gate(
+            tenant, len(prompt) + max_new_tokens,
+            self._replicas[0].frontend._token_seconds())
+        if gate is not None:
+            reason, retry, det = gate
+            self._tm_reject.inc(reason=reason)
+            self._record_result(RequestResult(uid, REJECTED, [], reason,
+                                              det, tenant=tenant))
+            self._refresh_gauges()
+            return Overloaded(uid, reason, round(retry, 3), "fleet",
+                              detail=det, tenant=tenant)
         r = _FleetRequest(uid, prompt, deadline_s, max_new_tokens,
-                          self.clock())
+                          self.clock(), tenant)
         verdict = self._try_dispatch(r)
         if isinstance(verdict, Admitted):
             self._active[uid] = r
@@ -399,7 +460,7 @@ class FleetRouter:
             self._tm_reject.inc(reason=verdict.reason)
             self._record_result(RequestResult(
                 uid, REJECTED, [], verdict.reason,
-                getattr(verdict, "detail", "")))
+                getattr(verdict, "detail", ""), tenant=tenant))
         self._refresh_gauges()
         return verdict
 
@@ -417,6 +478,8 @@ class FleetRouter:
         while len(self._results) > self.cfg.max_result_history:
             self._results.pop(next(iter(self._results)))
         self._tm_resolved.inc(outcome=result.state)
+        self._tm_t_resolved.inc(tenant=self.tenancy.label(result.tenant),
+                                outcome=result.state)
 
     def _cancel_copy(self, r: _FleetRequest, name: Optional[str],
                      reason: str) -> None:
@@ -436,7 +499,7 @@ class FleetRouter:
         r.replica = r.hedge = None
         self._record_result(RequestResult(r.uid, state,
                                           tokens[:r.max_new_tokens],
-                                          reason, detail))
+                                          reason, detail, tenant=r.tenant))
 
     def _lose_copy(self, r: _FleetRequest, rep: _Replica, reason: str,
                    count_attempt: bool = True, backoff: bool = True,
@@ -629,7 +692,9 @@ class FleetRouter:
                                         r.excluded | {r.replica}):
                 res = rep.frontend.submit(r.uid, r.prompt,
                                           deadline_s=deadline,
-                                          max_new_tokens=remaining)
+                                          max_new_tokens=remaining,
+                                          tenant=r.tenant,
+                                          charge_quota=False)
                 if isinstance(res, Admitted):
                     r.hedge = rep.name
                     r.hedged = True
@@ -781,6 +846,10 @@ class FleetRouter:
                 "live replica")
         self._failover_replica(rep, "drain", count_attempt=False,
                                backoff=False)
+        # per-tenant quotas survive the swap: the replacement joins the
+        # fleet's shared registry (its own in-flight charges, if any,
+        # transfer over)
+        new_frontend.adopt_tenancy(self.tenancy)
         old = rep.frontend
         old.close()
         rep.frontend = new_frontend
@@ -799,6 +868,7 @@ class FleetRouter:
             raise ValueError(
                 f"replica name {new_frontend.name!r} collides with a "
                 "live replica")
+        new_frontend.adopt_tenancy(self.tenancy)
         self._replicas.append(_Replica(new_frontend))
         self._retry_due()
         self._refresh_gauges()

@@ -69,6 +69,7 @@ from deepspeed_tpu.serving.circuit import (
     CircuitBreaker,
 )
 from deepspeed_tpu.serving.health import HealthSurface
+from deepspeed_tpu.serving.tenancy import TenantRegistry
 from deepspeed_tpu.telemetry import tracing as _tracing
 from deepspeed_tpu.testing.chaos import chaos_point
 from deepspeed_tpu.utils.logging import logger
@@ -89,15 +90,19 @@ class RequestResult:
     tokens: List[int] = dataclasses.field(default_factory=list)
     reason: str = ""
     detail: str = ""
+    # resolved tenant the request ran under ("" only on legacy records
+    # constructed without one — every frontend/fleet path stamps it)
+    tenant: str = ""
 
 
 class _Request:
     __slots__ = ("uid", "max_new_tokens", "degraded", "submit_t", "order",
-                 "abs_deadline", "served")
+                 "abs_deadline", "served", "tenant", "quota_blocks")
 
     def __init__(self, uid: int, max_new_tokens: int, degraded: bool,
                  submit_t: float, order: int,
-                 abs_deadline: Optional[float]):
+                 abs_deadline: Optional[float], tenant: str,
+                 quota_blocks: int):
         self.uid = uid
         self.max_new_tokens = max_new_tokens
         self.degraded = degraded
@@ -105,6 +110,8 @@ class _Request:
         self.order = order
         self.abs_deadline = abs_deadline   # frontend clock; None = none
         self.served = False                # first prefill progress seen
+        self.tenant = tenant               # resolved tenant name
+        self.quota_blocks = quota_blocks   # KV charge held in the registry
 
 
 class ServingFrontend:
@@ -115,7 +122,7 @@ class ServingFrontend:
 
     def __init__(self, engine, config=None,
                  clock=time.monotonic, register_health: bool = True,
-                 health_name: str = "serving"):
+                 health_name: str = "serving", tenancy=None):
         from deepspeed_tpu.runtime.config import ServingSectionConfig
         from deepspeed_tpu.runtime.config_utils import config_from_dict
 
@@ -129,6 +136,12 @@ class ServingFrontend:
         self.engine = engine
         self.cfg = config
         self.clock = clock
+        # per-tenant quotas / fairness / quarantine (serving/tenancy.py):
+        # a TenancySectionConfig, a dict of its keys, an existing
+        # TenantRegistry (fleet replicas SHARE one so quotas hold
+        # fleet-wide), or None — defaults are quota-free, so untagged
+        # single-tenant callers see pre-tenancy behavior exactly
+        self.tenancy = TenantRegistry.ensure(tenancy, clock=clock)
         # resolve the replica NAME first (unique against registered health
         # probes when registering): it scopes this frontend's chaos points
         # and seeds its breaker jitter — a fleet hands out distinct names
@@ -185,10 +198,24 @@ class ServingFrontend:
     @classmethod
     def from_ds_config(cls, engine, config, **kw) -> "ServingFrontend":
         """Build from a full runtime config (dict / JSON path /
-        ``DeepSpeedTPUConfig``), using its ``"serving"`` section."""
+        ``DeepSpeedTPUConfig``), using its ``"serving"`` and
+        ``"tenancy"`` sections."""
         from deepspeed_tpu.runtime.config import load_config
 
-        return cls(engine, config=load_config(config).serving, **kw)
+        full_cfg = load_config(config)
+        kw.setdefault("tenancy", full_cfg.tenancy)
+        return cls(engine, config=full_cfg.serving, **kw)
+
+    def adopt_tenancy(self, registry: TenantRegistry) -> None:
+        """Swap in a SHARED tenant registry (fleet install / rolling
+        restart), re-homing any live charges so fleet-wide quotas stay
+        exact through ``replace_replica`` and autoscaler resizes."""
+        if registry is self.tenancy:
+            return
+        for req in self._reqs.values():
+            self.tenancy.release(req.tenant, req.quota_blocks)
+            registry.transfer_inflight(req.tenant, req.quota_blocks)
+        self.tenancy = registry
 
     # ------------------------------------------------------------------ #
     def _setup_telemetry(self) -> None:
@@ -216,6 +243,26 @@ class ServingFrontend:
         self._tm_poison = telemetry.counter(
             "serving_poison_evictions_total",
             "suspect requests evicted after a failing tick")
+        # per-tenant series: labels pass through the registry's
+        # cardinality guard (over-cap tenants fold into "other")
+        self._tm_t_admit = telemetry.counter(
+            "serving_tenant_admitted_total",
+            "requests admitted past the front-end, by tenant")
+        self._tm_t_reject = telemetry.counter(
+            "serving_tenant_rejected_total",
+            "admission rejections by tenant and reason (capacity "
+            "reasons plus tenant_rate_limited / tenant_concurrency / "
+            "tenant_kv_quota / tenant_fair_share / tenant_quarantined)")
+        self._tm_t_resolved = telemetry.counter(
+            "serving_tenant_resolved_total",
+            "terminal request states by tenant and outcome")
+        self._tm_t_ttft = telemetry.histogram(
+            "serving_tenant_ttft_seconds",
+            "submit() to first prefill progress, by tenant (per-tenant "
+            "p99 TTFT source)")
+        self._tm_t_quar = telemetry.counter(
+            "serving_tenant_quarantines_total",
+            "per-tenant poison quarantines tripped, by tenant")
 
     # ------------------------------------------------------------------ #
     # introspection
@@ -239,7 +286,8 @@ class ServingFrontend:
         """Terminal record for ``uid``, or its live ``active`` view.
         Unknown uids raise KeyError (they were never submitted)."""
         if uid in self._reqs:
-            return RequestResult(uid, ACTIVE, self._tokens_of(uid))
+            return RequestResult(uid, ACTIVE, self._tokens_of(uid),
+                                 tenant=self._reqs[uid].tenant)
         return self._results[uid]
 
     def drop_result(self, uid: int) -> None:
@@ -333,20 +381,32 @@ class ServingFrontend:
     # ------------------------------------------------------------------ #
     def submit(self, uid: int, prompt: Sequence[int],
                deadline_s: Optional[float] = None,
-               max_new_tokens: Optional[int] = None
+               max_new_tokens: Optional[int] = None,
+               tenant: Optional[str] = None,
+               charge_quota: bool = True
                ) -> Union[Admitted, Overloaded, Rejected]:
         """Admit one request through the resilience ladder. Never raises
         for request-shaped problems — invalid requests come back as
         :class:`Rejected`, capacity problems as :class:`Overloaded`
-        (both also recorded as terminal results for ``result(uid)``)."""
+        (both also recorded as terminal results for ``result(uid)``).
+
+        ``tenant`` scopes the request to a QoS tenant (None/"" = the
+        shared default tenant — pre-tenancy callers are unchanged).
+        ``charge_quota=False`` is the fleet-dispatch path: the router
+        already drew the tenant's rate buckets once at ITS front door,
+        so replica-level (re)dispatches of the same request skip the
+        rate check here (concurrency, KV quota, fairness and quarantine
+        still apply — they meter live resources, not offered load)."""
         prompt = list(prompt)
+        tenant = self.tenancy.resolve(tenant)
         if max_new_tokens is None:
             max_new_tokens = self.cfg.default_max_new_tokens
         # request trace opens at the front door so even a rejection has a
         # timeline (no-op if the uid is already live: a duplicate submit
         # must not clobber the live request's trace — its rejection lands
         # as an event on that trace instead)
-        self._tracer.request_begin(uid, prompt_len=len(prompt))
+        self._tracer.request_begin(uid, prompt_len=len(prompt),
+                                   tenant=tenant)
         now = self.clock()
         # the deadline the ENGINE will enforce: an explicit per-request
         # one, else the engine's request_deadline_s default — the shed
@@ -361,13 +421,14 @@ class ServingFrontend:
 
         # 1) validity — never shed a victim for a request that can't run
         if uid in self._reqs or uid in self.engine.seqs:
-            return self._reject_invalid(uid, f"uid {uid} is still active")
+            return self._reject_invalid(uid, f"uid {uid} is still active",
+                                        tenant=tenant)
         if len(prompt) >= self.engine.max_len:
             return self._reject_invalid(
                 uid, f"prompt len {len(prompt)} >= engine max_len "
-                f"{self.engine.max_len}")
+                f"{self.engine.max_len}", tenant=tenant)
         if not prompt:
-            return self._reject_invalid(uid, "empty prompt")
+            return self._reject_invalid(uid, "empty prompt", tenant=tenant)
 
         # 2) circuit open — fail fast INSIDE the backoff window. Once the
         # window expires the request is ADMITTED as the probe vehicle:
@@ -382,11 +443,34 @@ class ServingFrontend:
                     uid, REASON_CIRCUIT_OPEN,
                     retry if retry is not None
                     else self.cfg.circuit_backoff_s,
-                    detail=f"circuit {self.breaker.state}")
+                    detail=f"circuit {self.breaker.state}", tenant=tenant)
 
-        # 3) capacity — queue cap and KV high watermark, shed per policy
+        # 3) tenancy — quotas, rate limits, quarantine, and (under
+        # contended capacity) the weighted-fair share check, BEFORE any
+        # victim is considered: a request its tenant isn't entitled to
+        # run must never shed someone else's work to make room
         tok_s = self._token_seconds()
         blocks_needed = len(prompt) // self.engine.block_size + 1
+        # quota charge covers the decode growth too, not just the prompt
+        # footprint the capacity check projects — released at resolution
+        quota_blocks = (len(prompt) + max_new_tokens) \
+            // self.engine.block_size + 1
+        contended = (
+            len(self._reqs) + 1 >= self.cfg.max_queue
+            * self.tenancy.cfg.fair_contention_queue_frac
+            or self._kv_util(blocks_needed) >= self.cfg.kv_degrade_watermark)
+        gate = self.tenancy.admission_gate(
+            tenant, cost_tokens=len(prompt) + max_new_tokens,
+            blocks=quota_blocks, token_seconds=tok_s,
+            contended=contended, charge_rate=charge_quota)
+        if gate is not None:
+            t_reason, t_retry, t_detail = gate
+            return self._reject_overloaded(uid, t_reason, t_retry,
+                                           detail=t_detail, tenant=tenant)
+
+        # 4) capacity — queue cap and KV high watermark, shed per policy
+        # (victim selection is tier-aware: batch pays before standard
+        # pays before realtime, deadline slack breaking ties in-tier)
         reason = self.ctrl.overload_reason(
             len(self._reqs), self._kv_util(blocks_needed))
         if reason is not None:
@@ -394,7 +478,8 @@ class ServingFrontend:
                 uid=uid, age_order=self._order_counter,
                 deadline_s=(now + eff_deadline_s)
                 if eff_deadline_s is not None else None,
-                remaining_tokens=len(prompt) + max_new_tokens, incoming=True)
+                remaining_tokens=len(prompt) + max_new_tokens, incoming=True,
+                tier_rank=self.tenancy.tier_rank(tenant))
             victim = self.ctrl.pick_victim(
                 self._candidates(), incoming, now, tok_s)
             if victim is not None and reason == "kv_pressure":
@@ -415,9 +500,10 @@ class ServingFrontend:
             if reason is not None:
                 retry = retry_after_from_backlog(
                     self._outstanding_tokens(), tok_s)
-                return self._reject_overloaded(uid, reason, retry)
+                return self._reject_overloaded(uid, reason, retry,
+                                               tenant=tenant)
 
-        # 4) graceful degradation — clamp the grant before anyone sheds.
+        # 5) graceful degradation — clamp the grant before anyone sheds.
         # PROJECTED utilization (incoming prompt included), matching the
         # rejection check: the request that itself pushes the pool into
         # the degrade band must not escape the clamp
@@ -426,18 +512,22 @@ class ServingFrontend:
         if degraded:
             self._tm_degrade.inc()
 
-        # 5) admit (engine put is batch-atomic: raises admit nothing)
+        # 6) admit (engine put is batch-atomic: raises admit nothing)
         try:
             self.engine.put([uid], [prompt], deadline_s=deadline_s)
         except ValueError as e:   # race-shaped residue; treat as invalid
-            return self._reject_invalid(uid, str(e))
+            return self._reject_invalid(uid, str(e), tenant=tenant)
         self._order_counter += 1
         self._reqs[uid] = _Request(
             uid, grant, degraded, now, self._order_counter,
-            (now + eff_deadline_s) if eff_deadline_s is not None else None)
+            (now + eff_deadline_s) if eff_deadline_s is not None else None,
+            tenant, quota_blocks)
+        self.tenancy.charge_admit(tenant, len(prompt) + max_new_tokens,
+                                  quota_blocks)
         self._suspects.append(uid)
         self._results.pop(uid, None)   # resubmission of a terminal uid
         self._tm_admit.inc()
+        self._tm_t_admit.inc(tenant=self.tenancy.label(tenant))
         self._tracer.request_event(uid, "admission", verdict="admitted",
                                    grant=grant, degraded=degraded)
         return Admitted(uid, grant, degraded)
@@ -451,35 +541,42 @@ class ServingFrontend:
             out.append(_Candidate(
                 uid=uid, age_order=req.order, deadline_s=req.abs_deadline,
                 remaining_tokens=seq.prefill_remaining
-                + max(0, req.max_new_tokens - len(seq.generated))))
+                + max(0, req.max_new_tokens - len(seq.generated)),
+                tier_rank=self.tenancy.tier_rank(req.tenant)))
         return out
 
-    def _record_rejection(self, uid: int, reason: str, detail: str) -> None:
+    def _record_rejection(self, uid: int, reason: str, detail: str,
+                          tenant: str = "") -> None:
         """Terminal record for a rejected submission — UNLESS the uid is
         currently active (a duplicate submission must not clobber the
         live request's lifecycle tracking)."""
         self._tm_reject.inc(reason=reason)
+        self._tm_t_reject.inc(tenant=self.tenancy.label(tenant),
+                              reason=reason)
         if uid not in self._reqs:
             self._record_result(RequestResult(uid, REJECTED, [], reason,
-                                              detail))
+                                              detail, tenant=tenant))
             self._tm_resolved.inc(outcome=REJECTED)
+            self._tm_t_resolved.inc(tenant=self.tenancy.label(tenant),
+                                    outcome=REJECTED)
             self._tracer.request_end(uid, REJECTED, reason=reason,
-                                     detail=detail)
+                                     detail=detail, tenant=tenant)
 
-    def _reject_invalid(self, uid: int, detail: str) -> Rejected:
+    def _reject_invalid(self, uid: int, detail: str,
+                        tenant: str = "") -> Rejected:
         self._tracer.request_event(uid, "admission", verdict="rejected",
                                    reason=REASON_INVALID, detail=detail)
-        self._record_rejection(uid, REASON_INVALID, detail)
+        self._record_rejection(uid, REASON_INVALID, detail, tenant=tenant)
         return Rejected(uid, REASON_INVALID, detail)
 
     def _reject_overloaded(self, uid: int, reason: str, retry_after: float,
-                           detail: str = "") -> Overloaded:
+                           detail: str = "", tenant: str = "") -> Overloaded:
         self._tracer.request_event(
             uid, "admission", verdict="overloaded", reason=reason,
             retry_after_s=round(retry_after, 3), detail=detail)
-        self._record_rejection(uid, reason, detail)
+        self._record_rejection(uid, reason, detail, tenant=tenant)
         return Overloaded(uid, reason, round(retry_after, 3),
-                          self.ctrl.shed_policy, detail)
+                          self.ctrl.shed_policy, detail, tenant=tenant)
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -488,17 +585,24 @@ class ServingFrontend:
                  reason: str = "", detail: str = "",
                  flush: bool = True) -> None:
         """Move ``uid`` to a terminal state; frees engine bookkeeping
-        (and its KV blocks) when it was admitted."""
+        (and its KV blocks) when it was admitted, and returns the
+        tenant's registry charges."""
         if flush:
             self.engine.flush([uid])
-        self._reqs.pop(uid, None)
+        req = self._reqs.pop(uid, None)
+        tenant = ""
+        if req is not None:
+            tenant = req.tenant
+            self.tenancy.release(req.tenant, req.quota_blocks)
         if uid in self._suspects:
             self._suspects.remove(uid)
         self._record_result(RequestResult(uid, state, tokens, reason,
-                                          detail))
+                                          detail, tenant=tenant))
         self._tm_resolved.inc(outcome=state)
+        self._tm_t_resolved.inc(tenant=self.tenancy.label(tenant),
+                                outcome=state)
         self._tracer.request_end(uid, state, reason=reason, detail=detail,
-                                 tokens=len(tokens))
+                                 tokens=len(tokens), tenant=tenant)
 
     def _shed(self, uid: int, reason: str) -> None:
         tokens = self._tokens_of(uid)
@@ -515,6 +619,7 @@ class ServingFrontend:
         while self._suspects:
             uid = self._suspects.pop()
             if uid in self._reqs:
+                tenant = self._reqs[uid].tenant
                 self._tm_poison.inc()
                 logger.warning(
                     f"serving: evicting suspect request {uid} after tick "
@@ -522,6 +627,12 @@ class ServingFrontend:
                 self._resolve(uid, FAILED, self._tokens_of(uid),
                               reason="poisoned",
                               detail=f"{type(exc).__name__}: {exc}")
+                # tenant-scoped containment: a tenant repeatedly caught
+                # poisoning ticks trips ITS quarantine — the replica
+                # keeps serving everyone else instead of eating the
+                # whole blast through the breaker
+                if self.tenancy.record_poison(tenant):
+                    self._tm_t_quar.inc(tenant=self.tenancy.label(tenant))
                 return
 
     def last_tick_age_s(self) -> Optional[float]:
@@ -610,6 +721,8 @@ class ServingFrontend:
                 req.served = True
                 wait_s = self.clock() - req.submit_t
                 self._tm_wait.observe(wait_s)
+                self._tm_t_ttft.observe(
+                    wait_s, tenant=self.tenancy.label(req.tenant))
                 self._tracer.request_event(uid, "first_service",
                                            queue_wait_s=round(wait_s, 6))
             if seq.expired:

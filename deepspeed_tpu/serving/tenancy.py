@@ -1,0 +1,455 @@
+"""Multi-tenant QoS: per-tenant quotas, weighted-fair admission, and
+tier-aware shed ranking for the serving stack.
+
+One hot tenant at millions-of-users scale can legally starve every
+other tenant through a FIFO-plus-deadline admission door while
+``requests_lost == 0`` still reads green. This module is the isolation
+layer (ROADMAP 3(b)): every request carries a tenant identity, and the
+:class:`TenantRegistry` is the single bookkeeper the front-ends and the
+fleet router consult before capacity policy even runs:
+
+* **QoS tiers** — ``realtime`` / ``standard`` / ``batch``, ranked for
+  shedding (batch sheds first, realtime last; ``admission.py`` breaks
+  ties within a tier by deadline slack) and weighted for fairness
+  (``tier_weights``, overridable per tenant).
+* **token-bucket rate limits** — requests/s and tokens/s with burst
+  capacity, per tenant. Rate tokens are consumed by the admission
+  ATTEMPT (a rejected attempt still drew from the bucket — retry storms
+  are themselves traffic).
+* **concurrency caps + KV-block quotas** — in-flight request count and
+  projected KV blocks held, charged at admission and released at
+  terminal resolution. Fleet copies (hedges, failover re-dispatches)
+  each count: two live copies really do hold two replicas' resources.
+* **weighted-fair admission** — start-time fair queueing adapted to an
+  admit-or-reject front door: each admission advances the tenant's
+  virtual token counter by ``cost / weight`` (cost is the
+  ``backlog_tokens()``-style prompt+grant estimate). Under contention a
+  tenant whose counter leads the floor (the minimum over tenants with
+  work in flight) by more than ``fair_share_horizon_tokens`` is turned
+  away with a drain-time retry hint — so a flood from one tenant queues
+  behind other tenants' traffic rather than ahead of it, while a lone
+  tenant on an idle box is never throttled (work-conserving).
+* **poison quarantine** — a tenant whose requests repeatedly get
+  evicted as tick-poison suspects trips a per-tenant circuit
+  (``poison_quarantine_threshold`` evictions inside a
+  ``poison_quarantine_s`` window) instead of the whole replica eating
+  the blast; its submissions fast-fail with the remaining window as the
+  retry-after.
+* **label-cardinality guard** — per-tenant metric labels are bounded at
+  ``max_tenant_labels`` distinct values; overflow tenants fold into the
+  ``"other"`` label so an adversarial tenant-id stream cannot grow the
+  telemetry registry without bound. Internal per-tenant state is
+  likewise bounded at ``max_tracked_tenants`` (idle tenants evicted
+  least-recently-seen first).
+
+Shared fleet-wide: ``FleetRouter`` installs ONE registry on every
+replica (including replicas added by ``replace_replica`` /
+``add_replica`` / the autoscaler), so concurrency, KV quotas, fairness
+counters and quarantines hold across the whole fleet, not per replica.
+
+Config: the ``"tenancy"`` section of the runtime JSON config
+(``runtime/config.py:TenancySectionConfig``). Metrics:
+``serving_tenant_*`` / ``fleet_tenant_*`` in the README catalog.
+Single-threaded like the serving loop that drives it.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.serving.admission import retry_after_from_backlog
+from deepspeed_tpu.utils.logging import logger
+
+#: QoS tiers, ranked for the shed ladder: HIGHER rank sheds FIRST
+#: (batch pays before standard pays before realtime).
+TIER_REALTIME = "realtime"
+TIER_STANDARD = "standard"
+TIER_BATCH = "batch"
+TIER_RANKS: Dict[str, int] = {TIER_REALTIME: 0, TIER_STANDARD: 1,
+                              TIER_BATCH: 2}
+
+#: fair-share weights per tier when a tenant doesn't set its own
+#: (higher weight = larger share of contended admission)
+DEFAULT_TIER_WEIGHTS: Dict[str, float] = {
+    TIER_REALTIME: 8.0, TIER_STANDARD: 4.0, TIER_BATCH: 1.0}
+
+#: tenant name untagged traffic resolves to (keeps the pre-tenancy API
+#: back-compatible: a submit() with no tenant behaves as one shared
+#: default tenant with no quotas unless the config says otherwise)
+DEFAULT_TENANT = "default"
+
+#: metric label that over-cap tenants fold into
+OTHER_LABEL = "other"
+
+#: tenancy-scoped rejection reasons (structured ``Overloaded.reason``
+#: values; every one carries a tenant-scoped retry-after)
+REASON_TENANT_RATE = "tenant_rate_limited"
+REASON_TENANT_CONCURRENCY = "tenant_concurrency"
+REASON_TENANT_KV = "tenant_kv_quota"
+REASON_FAIR_SHARE = "tenant_fair_share"
+REASON_TENANT_QUARANTINED = "tenant_quarantined"
+
+
+class TokenBucket:
+    """Deterministic token bucket (injectable timestamps — callers pass
+    ``now``). ``rate <= 0`` means unlimited."""
+
+    __slots__ = ("rate", "burst", "level", "t")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.level = self.burst
+        self.t: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self.t is not None and now > self.t:
+            self.level = min(self.burst,
+                             self.level + (now - self.t) * self.rate)
+        self.t = now
+
+    def peek(self, n: float, now: float) -> bool:
+        """Would ``take(n)`` succeed right now?"""
+        if self.rate <= 0:
+            return True
+        self._refill(now)
+        return self.level >= n
+
+    def take(self, n: float, now: float) -> bool:
+        if self.rate <= 0:
+            return True
+        self._refill(now)
+        if self.level < n:
+            return False
+        self.level -= n
+        return True
+
+    def retry_after(self, n: float, now: float) -> float:
+        """Seconds until ``n`` tokens will be available (0 when they
+        already are)."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill(now)
+        deficit = min(n, self.burst) - self.level
+        return max(0.0, deficit / self.rate)
+
+
+class _TenantState:
+    """Mutable per-tenant bookkeeping (quota charges, fairness counter,
+    quarantine clock). Bounded by ``max_tracked_tenants`` via LRU
+    eviction of idle tenants."""
+
+    __slots__ = ("name", "req_bucket", "tok_bucket", "inflight",
+                 "kv_blocks", "vtime", "poison_marks", "quarantined_until",
+                 "last_seen")
+
+    def __init__(self, name: str, req_bucket: TokenBucket,
+                 tok_bucket: TokenBucket):
+        self.name = name
+        self.req_bucket = req_bucket
+        self.tok_bucket = tok_bucket
+        self.inflight = 0          # live request copies charged
+        self.kv_blocks = 0         # projected KV blocks held
+        self.vtime = 0.0           # fair-queueing virtual token counter
+        self.poison_marks: collections.deque = collections.deque()
+        self.quarantined_until = 0.0
+        self.last_seen = 0.0
+
+
+class TenantRegistry:
+    """Per-tenant quota, fairness, and quarantine bookkeeper shared by
+    the serving front-ends and the fleet router. ``config`` is a
+    ``TenancySectionConfig``, a plain dict of its keys, or None
+    (defaults: one unlimited ``standard``-tier tenant namespace);
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, config=None, clock=time.monotonic):
+        from deepspeed_tpu.runtime.config import (
+            TenancySectionConfig,
+            TenantQuotaConfig,
+        )
+        from deepspeed_tpu.runtime.config_utils import config_from_dict
+
+        if config is None:
+            config = TenancySectionConfig()
+        elif isinstance(config, dict):
+            config = config_from_dict(TenancySectionConfig, config,
+                                      path="tenancy.")
+        else:
+            config.validate()
+        self.cfg = config
+        self.clock = clock
+        # configured per-tenant quota specs (validated at parse time);
+        # unknown tenants share one default-tier unlimited spec
+        self._specs: Dict[str, Any] = {}
+        for name, entry in sorted(config.tenants.items()):
+            spec = entry if not isinstance(entry, dict) else \
+                config_from_dict(TenantQuotaConfig, entry,
+                                 path=f"tenancy.tenants.{name}.")
+            self._specs[name] = spec
+        self._default_spec = TenantQuotaConfig(tier=config.default_tier)
+        self._states: Dict[str, _TenantState] = {}
+        self._vlast = 0.0   # fairness floor holdover while idle
+        # label-cardinality guard: configured tenants get their own
+        # label first (they are the ones operators alert on); dynamic
+        # tenants claim remaining slots first-seen, overflow folds into
+        # OTHER_LABEL
+        self._labels: Dict[str, str] = {}
+        for name in [DEFAULT_TENANT] + sorted(self._specs):
+            if len(self._labels) < config.max_tenant_labels:
+                self._labels[name] = name
+
+    @classmethod
+    def ensure(cls, tenancy, clock=time.monotonic) -> "TenantRegistry":
+        """Coerce None / dict / section config / registry to a registry
+        (an existing registry passes through so it can be shared)."""
+        if isinstance(tenancy, TenantRegistry):
+            return tenancy
+        return cls(tenancy, clock=clock)
+
+    # ------------------------------------------------------------------ #
+    # identity
+    # ------------------------------------------------------------------ #
+    def resolve(self, tenant: Optional[str]) -> str:
+        """Canonical tenant name: untagged traffic maps to the default
+        tenant (back-compat for every pre-tenancy caller)."""
+        if tenant is None or tenant == "":
+            return DEFAULT_TENANT
+        return str(tenant)
+
+    def spec(self, tenant: str):
+        return self._specs.get(tenant, self._default_spec)
+
+    def tier(self, tenant: str) -> str:
+        return self.spec(tenant).tier
+
+    def tier_rank(self, tenant: str) -> int:
+        return TIER_RANKS[self.spec(tenant).tier]
+
+    def weight(self, tenant: str) -> float:
+        qcfg = self.spec(tenant)
+        if qcfg.weight > 0:
+            return qcfg.weight
+        return self.cfg.tier_weights.get(
+            qcfg.tier, DEFAULT_TIER_WEIGHTS[qcfg.tier])
+
+    def label(self, tenant: str) -> str:
+        """Metric label for ``tenant`` — bounded cardinality: past
+        ``max_tenant_labels`` distinct values new tenants fold into
+        ``"other"`` (the registry itself stays bounded regardless)."""
+        tenant = self.resolve(tenant)
+        lbl = self._labels.get(tenant)
+        if lbl is not None:
+            return lbl
+        if len(self._labels) < self.cfg.max_tenant_labels:
+            self._labels[tenant] = tenant
+            return tenant
+        return OTHER_LABEL
+
+    def known_tenants(self) -> List[str]:
+        """Tenants with live bookkeeping (configured or seen)."""
+        return sorted(set(self._specs) | set(self._states)
+                      | {DEFAULT_TENANT})
+
+    # ------------------------------------------------------------------ #
+    # state bookkeeping
+    # ------------------------------------------------------------------ #
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._states.get(tenant)
+        if st is None:
+            if len(self._states) >= self.cfg.max_tracked_tenants:
+                self._evict_idle_state()
+            qcfg = self.spec(tenant)
+            st = _TenantState(
+                tenant,
+                TokenBucket(qcfg.requests_per_s,
+                            qcfg.burst_requests or qcfg.requests_per_s),
+                TokenBucket(qcfg.tokens_per_s,
+                            qcfg.burst_tokens or qcfg.tokens_per_s))
+            self._states[tenant] = st
+        st.last_seen = self.clock()
+        return st
+
+    def _evict_idle_state(self) -> None:
+        """Drop the least-recently-seen tenant with nothing in flight —
+        the bound that keeps an adversarial tenant-id stream from
+        growing registry memory. Tenants with live charges are never
+        evicted (their count is bounded by the concurrency they hold)."""
+        idle = [st for st in self._states.values()
+                if st.inflight == 0 and st.kv_blocks == 0]
+        if not idle:
+            return
+        victim = min(idle, key=lambda st: (st.last_seen, st.name))
+        del self._states[victim.name]
+
+    def _vfloor(self) -> float:
+        """System virtual time: the minimum fairness counter over
+        tenants with work in flight. With nothing in flight the floor
+        holds at the last computed value (an idle system must not wind
+        fairness history backward)."""
+        active = [st.vtime for st in self._states.values()
+                  if st.inflight > 0]
+        if active:
+            self._vlast = min(active)
+        return self._vlast
+
+    # ------------------------------------------------------------------ #
+    # admission gates
+    # ------------------------------------------------------------------ #
+    def quarantine_remaining_s(self, tenant: str,
+                               now: Optional[float] = None) -> float:
+        st = self._states.get(tenant)
+        if st is None:
+            return 0.0
+        if now is None:
+            now = self.clock()
+        return max(0.0, st.quarantined_until - now)
+
+    def fleet_gate(self, tenant: str, cost_tokens: int,
+                   token_seconds: float
+                   ) -> Optional[Tuple[str, float, str]]:
+        """Client-facing gate the FLEET applies once per submission:
+        quarantine + rate buckets (debited here — replica-level
+        re-dispatches of the same request must not re-draw). Returns
+        ``(reason, retry_after_s, detail)`` or None (pass)."""
+        return self._gate(tenant, cost_tokens, blocks=0,
+                          token_seconds=token_seconds, contended=False,
+                          charge_rate=True, resource_checks=False)
+
+    def admission_gate(self, tenant: str, cost_tokens: int, blocks: int,
+                       token_seconds: float, contended: bool,
+                       charge_rate: bool = True
+                       ) -> Optional[Tuple[str, float, str]]:
+        """Replica-level gate the front-end applies before capacity
+        policy: quarantine, rate buckets (skipped when the fleet already
+        charged them — ``charge_rate=False``), concurrency cap, KV-block
+        quota, and — only under ``contended`` capacity — the
+        weighted-fair share check. Returns ``(reason, retry_after_s,
+        detail)`` or None (pass)."""
+        return self._gate(tenant, cost_tokens, blocks, token_seconds,
+                          contended, charge_rate, resource_checks=True)
+
+    def _gate(self, tenant: str, cost_tokens: int, blocks: int,
+              token_seconds: float, contended: bool, charge_rate: bool,
+              resource_checks: bool
+              ) -> Optional[Tuple[str, float, str]]:
+        now = self.clock()
+        st = self._state(tenant)
+        qcfg = self.spec(tenant)
+        remaining = st.quarantined_until - now
+        if remaining > 0:
+            return (REASON_TENANT_QUARANTINED, remaining,
+                    f"tenant {tenant!r} quarantined for poisoning ticks")
+        if charge_rate:
+            req_ok = st.req_bucket.peek(1, now)
+            tok_ok = st.tok_bucket.peek(cost_tokens, now)
+            if not (req_ok and tok_ok):
+                retry = max(st.req_bucket.retry_after(1, now),
+                            st.tok_bucket.retry_after(cost_tokens, now))
+                which = "requests/s" if not req_ok else "tokens/s"
+                return (REASON_TENANT_RATE, max(retry, 0.001),
+                        f"tenant {tenant!r} over its {which} limit")
+            st.req_bucket.take(1, now)
+            st.tok_bucket.take(cost_tokens, now)
+        if not resource_checks:
+            return None
+        if qcfg.max_concurrent > 0 and st.inflight >= qcfg.max_concurrent:
+            retry = retry_after_from_backlog(cost_tokens, token_seconds)
+            return (REASON_TENANT_CONCURRENCY, retry,
+                    f"tenant {tenant!r} at its concurrency cap "
+                    f"({st.inflight}/{qcfg.max_concurrent})")
+        if qcfg.max_kv_blocks > 0 \
+                and st.kv_blocks + blocks > qcfg.max_kv_blocks:
+            retry = retry_after_from_backlog(
+                max(cost_tokens, st.kv_blocks), token_seconds)
+            return (REASON_TENANT_KV, retry,
+                    f"tenant {tenant!r} over its KV-block quota "
+                    f"({st.kv_blocks}+{blocks} > {qcfg.max_kv_blocks})")
+        if contended:
+            lead = max(0.0, st.vtime - self._vfloor())
+            if lead > self.cfg.fair_share_horizon_tokens:
+                excess = (lead - self.cfg.fair_share_horizon_tokens) \
+                    * self.weight(tenant)
+                retry = retry_after_from_backlog(
+                    int(excess) + 1, token_seconds)
+                return (REASON_FAIR_SHARE, retry,
+                        f"tenant {tenant!r} over its fair share under "
+                        f"contention (lead {lead:.0f} weighted tokens)")
+        return None
+
+    # ------------------------------------------------------------------ #
+    # charges
+    # ------------------------------------------------------------------ #
+    def charge_admit(self, tenant: str, cost_tokens: int,
+                     blocks: int) -> None:
+        """Record an admitted copy: concurrency + KV charge, and the
+        fairness counter advances by cost over weight (an idle tenant
+        re-enters at the floor — fairness credit does not bank)."""
+        st = self._state(tenant)
+        if st.inflight == 0:
+            st.vtime = max(st.vtime, self._vfloor())
+        st.vtime += cost_tokens / self.weight(tenant)
+        st.inflight += 1
+        st.kv_blocks += blocks
+
+    def transfer_inflight(self, tenant: str, blocks: int) -> None:
+        """Re-home an already-admitted copy's charges into THIS registry
+        (frontend adoption during fleet install / rolling restart) —
+        no rate debit, no fairness advance: the work was already paid
+        for where it was admitted."""
+        st = self._state(tenant)
+        st.inflight += 1
+        st.kv_blocks += blocks
+
+    def release(self, tenant: str, blocks: int) -> None:
+        """A charged copy reached a terminal state: return its
+        concurrency slot and KV-block charge."""
+        st = self._states.get(tenant)
+        if st is None:
+            return
+        st.inflight = max(0, st.inflight - 1)
+        st.kv_blocks = max(0, st.kv_blocks - blocks)
+
+    # ------------------------------------------------------------------ #
+    # poison quarantine
+    # ------------------------------------------------------------------ #
+    def record_poison(self, tenant: str) -> bool:
+        """A request of this tenant was evicted as a tick-poison
+        suspect. ``poison_quarantine_threshold`` evictions inside a
+        ``poison_quarantine_s`` window trip the per-tenant circuit;
+        returns True exactly when the quarantine newly trips."""
+        now = self.clock()
+        st = self._state(tenant)
+        window = self.cfg.poison_quarantine_s
+        st.poison_marks.append(now)
+        while st.poison_marks and st.poison_marks[0] < now - window:
+            st.poison_marks.popleft()
+        if len(st.poison_marks) >= self.cfg.poison_quarantine_threshold \
+                and st.quarantined_until <= now:
+            st.quarantined_until = now + self.cfg.poison_quarantine_s
+            st.poison_marks.clear()
+            logger.warning(
+                f"tenancy: quarantining tenant {tenant!r} for "
+                f"{self.cfg.poison_quarantine_s}s after repeated "
+                "poison evictions")
+            return True
+        return False
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant bookkeeping view (tests, bench, flight dumps)."""
+        floor = self._vfloor()
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, st in sorted(self._states.items()):
+            out[name] = {
+                "tier": self.tier(name),
+                "inflight": st.inflight,
+                "kv_blocks": st.kv_blocks,
+                "vtime_lead": max(0.0, st.vtime - floor),
+                "quarantine_remaining_s":
+                    self.quarantine_remaining_s(name),
+            }
+        return out

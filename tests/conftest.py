@@ -136,6 +136,14 @@ def pytest_configure(config):
         "JAX_PLATFORMS=cpu; heavy uninterrupted-twin comparisons ride "
         "the slow lane)")
     config.addinivalue_line(
+        "markers", "tenancy: multi-tenant QoS tests (per-tenant quotas, "
+        "weighted-fair admission, tier-aware shedding, tenant-scoped "
+        "poison quarantine, fleet-wide per-tenant accounting — CPU "
+        "backend, tier-1-eligible under JAX_PLATFORMS=cpu; the "
+        "hot-tenant chaos acceptance pins zero-loss + exact per-tenant "
+        "reconciliation through a replica kill AND an autoscale resize "
+        "mid-burst; also registered in pytest.ini)")
+    config.addinivalue_line(
         "markers", "autotune: observatory-driven plan-engine tests "
         "(plan schema + canary enforcement, analytic OOM refusal, "
         "plan-key purity, engine plan-cache hit/stale/fail_on_stale, "

@@ -301,6 +301,41 @@ class TestConfigKeys:
             f"elasticity keys no longer consumed: "
             f"{elasticity_keys - consumed}")
 
+    def test_tenancy_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for multi-tenant QoS (ISSUE 18): the
+        # "tenancy" section is a validated DeepSpeedTPUConfig field and
+        # every key must stay actually consumed — serving/tenancy.py
+        # reads the section + per-tenant quota keys, the frontend reads
+        # the fair-contention threshold; a dropped read would silently
+        # turn a tenant's quota decorative while the config still
+        # promises isolation
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            EXTRA_KEYS,
+            consumed_attr_keys,
+        )
+
+        tenancy_keys = {"tenancy", "default_tier", "tier_weights",
+                        "tenants", "max_tenant_labels",
+                        "max_tracked_tenants", "fair_share_horizon_tokens",
+                        "fair_contention_queue_frac",
+                        "poison_quarantine_threshold",
+                        "poison_quarantine_s",
+                        # per-tenant quota keys (TenantQuotaConfig)
+                        "requests_per_s", "tokens_per_s", "burst_requests",
+                        "burst_tokens", "max_concurrent", "max_kv_blocks"}
+        assert "tenancy" not in EXTRA_KEYS, (
+            "tenancy must stay a declared schema section "
+            "(DeepSpeedTPUConfig.tenancy), not an EXTRA_KEYS escape")
+        assert not tenancy_keys & set(DEAD_KEYS), (
+            "tenancy section keys declared dead — "
+            "serving/tenancy.py consumes them")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, tenancy_keys)
+        assert consumed == tenancy_keys, (
+            f"tenancy keys no longer consumed: "
+            f"{tenancy_keys - consumed}")
+
     def test_fleet_autoscale_keys_stay_consumed_and_undeclared(self):
         # the autoscaler half of ISSUE 17: the fleet section's autoscale
         # keys drive serving/fleet.FleetAutoscaler — a dropped read

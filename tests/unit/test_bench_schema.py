@@ -128,6 +128,57 @@ class TestSchemaValidator:
             res["schema_version"] = version
             assert schema.validate_result(res) == [], version
 
+    def test_tenants_block_roundtrips(self):
+        # v2.5: a measured entry may carry per-tenant accounting
+        res = make_result(entries={"fleet_sla_multitenant_gpt2": {
+            "metrics": {"completed": 12.0},
+            "tenants": {
+                "hot": {"submitted": 15,
+                        "outcomes": {"completed": 3, "rejected": 12},
+                        "ttft_p50_s": 0.04, "ttft_p99_s": 0.22},
+                "rt": {"submitted": 2, "outcomes": {"completed": 2},
+                       "ttft_p50_s": None, "ttft_p99_s": None},
+            },
+            "elapsed_s": 30.0,
+        }})
+        assert schema.validate_result(res) == []
+        assert schema.validate_result(json.loads(json.dumps(res))) == []
+
+    def test_tenants_block_must_reconcile(self):
+        # the invariant IS the schema: submitted != sum(outcomes) is an
+        # invalid bench result, not a soft warning
+        res = make_result(entries={"fleet_sla_multitenant_gpt2": {
+            "metrics": {"completed": 1.0},
+            "tenants": {"hot": {"submitted": 5,
+                                "outcomes": {"completed": 3}}}}})
+        assert any("reconcile" in e for e in schema.validate_result(res))
+
+    def test_tenants_block_shape_errors(self):
+        base = {"metrics": {"completed": 1.0}}
+        bads = [
+            ({"hot": {"outcomes": {}}}, "submitted"),
+            ({"hot": {"submitted": -1, "outcomes": {}}}, "submitted"),
+            ({"hot": {"submitted": 1,
+                      "outcomes": {"completed": -1}}}, "outcomes"),
+            ({"hot": {"submitted": 0, "outcomes": {},
+                      "ttft_p99_s": -0.5}}, "ttft_p99_s"),
+            ({"hot": [1, 2]}, "tenants"),
+            ("not-a-dict", "tenants"),
+        ]
+        for block, needle in bads:
+            res = make_result(entries={
+                "lane": dict(base, tenants=block)})
+            errs = schema.validate_result(res)
+            assert any(needle in e for e in errs), (block, errs)
+
+    def test_pre_tenancy_versions_still_validate(self):
+        # v2–v2.4 records (no tenants block anywhere) load unchanged
+        for version in (2, 2.1, 2.2, 2.3, 2.4, schema.SCHEMA_VERSION):
+            res = make_result(entries={
+                "fleet_sla_gpt2": {"metrics": {"completed": 8.0}}})
+            res["schema_version"] = version
+            assert schema.validate_result(res) == [], version
+
     def test_trace_phase_stats_must_be_complete(self):
         res = make_result(entries={"headline": {
             "metrics": {"mfu": 0.4},

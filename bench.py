@@ -1748,6 +1748,26 @@ def _dslint_gate():
     return new or None
 
 
+def _racelint_gate():
+    """Refuse to record benchmarks from a racelint-dirty tree (mirrors
+    ``BENCH_DSLINT``): an unguarded thread-shared write or a lock-order
+    cycle in the control plane makes every number suspect — the scrape
+    thread, watchdog, or async finalizer may be perturbing (or
+    corrupting) the very counters being recorded. ``BENCH_RACELINT=0``
+    opts out for local what-if runs; the committed history stays gated."""
+    if os.environ.get("BENCH_RACELINT", "1") == "0":
+        return None
+    try:
+        from deepspeed_tpu.analysis import racelint
+
+        new, _ = racelint.lint_repo()
+    except Exception as e:   # a broken linter must not kill benchmarking
+        print(f"bench: racelint gate unavailable ({type(e).__name__}: "
+              f"{e}); proceeding ungated", file=sys.stderr)
+        return None
+    return new or None
+
+
 def main():
     _logs_to_stderr()
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
@@ -1828,6 +1848,18 @@ def main():
             "error": f"dslint: {len(findings)} new non-baselined "
                      "finding(s) — fix or baseline them before recording "
                      "benchmarks (BENCH_DSLINT=0 overrides locally)"}))
+        return 1
+    findings = _racelint_gate()
+    if findings:
+        for f in findings[:20]:
+            print(f"bench: {f.render()}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench refused: racelint found new hazards",
+            "value": 0, "unit": "findings",
+            "error": f"racelint: {len(findings)} new non-baselined "
+                     "concurrency finding(s) — fix or suppress them "
+                     "before recording benchmarks (BENCH_RACELINT=0 "
+                     "overrides locally)"}))
         return 1
 
     elapsed = {}

@@ -47,9 +47,18 @@ KNOWN_RULES = (
     "unknown-suppression",
 )
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*dslint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_\-]+"
-    r"(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+def suppress_re(tool: str) -> "re.Pattern":
+    """The ``# <tool>: disable[-file]=rule[,rule...]`` comment pattern.
+    ONE extractor serves the whole lint family — racelint reuses this
+    (and :class:`SourceFile`) with ``tool="racelint"`` instead of
+    keeping a second copy of the tokenize-based comment scan."""
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*(disable|disable-file)\s*=\s*"
+        r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+_SUPPRESS_RE = suppress_re("dslint")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,14 +88,27 @@ class Finding:
 
 
 class SourceFile:
-    """A parsed file plus its comment-derived suppression tables."""
+    """A parsed file plus its comment-derived suppression tables.
 
-    def __init__(self, path: str, rel_path: str, text: str):
+    ``tool``/``known_rules`` select which lint family's directives the
+    comment scan honors (dslint by default; racelint passes its own) —
+    the tokenize-based extractor itself is shared, not copied.
+    """
+
+    def __init__(self, path: str, rel_path: str, text: str,
+                 tool: str = "dslint",
+                 known_rules: Sequence[str] = KNOWN_RULES):
         self.path = path
         self.rel_path = rel_path
         self.text = text
+        self.tool = tool
+        self.known_rules = tuple(known_rules)
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
+        # line -> REAL comment text on that line (tokenize-confirmed) —
+        # the lockmodel annotation scans key off this so a '# guarded-by:'
+        # quoted inside a string literal is not a declaration
+        self.comments: Dict[int, str] = {}
         # line -> set of rule ids disabled on that line; "all" disables all
         self.line_disables: Dict[int, Set[str]] = {}
         self.file_disables: Set[str] = set()
@@ -107,14 +129,16 @@ class SourceFile:
             return
 
     def _scan_comments(self) -> None:
+        pattern = suppress_re(self.tool)
         for lineno, comment in self._comment_lines():
-            if "dslint" not in comment:
+            self.comments[lineno] = comment
+            if self.tool not in comment:
                 continue
-            for kind, rules in _SUPPRESS_RE.findall(comment):
+            for kind, rules in pattern.findall(comment):
                 ids = {r.strip() for r in rules.split(",") if r.strip()}
-                for bogus in ids - set(KNOWN_RULES):
+                for bogus in ids - set(self.known_rules):
                     self.unknown_suppressions.append((lineno, bogus))
-                ids &= set(KNOWN_RULES)
+                ids &= set(self.known_rules)
                 if kind == "disable-file":
                     self.file_disables |= ids
                 else:
@@ -173,10 +197,14 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def load_project(paths: Sequence[str],
-                 root: Optional[str] = None) -> Tuple[Project, List[Finding]]:
+                 root: Optional[str] = None,
+                 tool: str = "dslint",
+                 known_rules: Sequence[str] = KNOWN_RULES
+                 ) -> Tuple[Project, List[Finding]]:
     """Parse every file; unparseable files become ``parse-error`` findings
     instead of aborting the run (a syntax error in one file must not hide
-    every other file's hazards)."""
+    every other file's hazards). ``tool``/``known_rules`` select which
+    family's suppression directives apply (see :class:`SourceFile`)."""
     if root is None:
         abs_paths = [os.path.abspath(p) for p in paths] or [os.getcwd()]
         common = os.path.commonpath(abs_paths)
@@ -201,7 +229,8 @@ def load_project(paths: Sequence[str],
         try:
             with tokenize.open(path) as f:   # honors PEP 263 encodings
                 text = f.read()
-            files.append(SourceFile(path, rel, text))
+            files.append(SourceFile(path, rel, text, tool=tool,
+                                    known_rules=known_rules))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append(Finding(
                 "parse-error", rel, getattr(e, "lineno", 0) or 0,
@@ -267,9 +296,9 @@ def run_rules(project: Project, rules: Sequence,
         for lineno, bogus in src.unknown_suppressions:
             findings.append(Finding(
                 "unknown-suppression", src.rel_path, lineno,
-                f"'# dslint: disable={bogus}' names no known rule — the "
-                f"comment suppresses NOTHING (known: "
-                f"{', '.join(r for r in KNOWN_RULES if r != 'all')})",
+                f"'# {src.tool}: disable={bogus}' names no known rule — "
+                f"the comment suppresses NOTHING (known: "
+                f"{', '.join(r for r in src.known_rules if r != 'all')})",
                 anchor=f"unknown/{bogus}"))
     for rule in rules:
         for finding in rule.check(project):

@@ -1,0 +1,264 @@
+"""The ONE lock/annotation model shared by dslint and racelint.
+
+Both linters reason about the same three source-level artifacts:
+
+* **guarded-by declarations** — a trailing comment on the assignment that
+  introduces shared state::
+
+      self._metrics = {}       # guarded-by: self._lock
+      _async_thread = None     # guarded-by: _save_lock   (module global)
+      self.last_tick_t = None  # guarded-by: single-writer
+
+* **``with lock:`` scopes** — the lexical acquisition sites; and
+* **``# locked: <lock>`` def-line annotations** — the caller-holds-the-
+  lock contract for helper functions (``_save_state_locked``).
+
+dslint's ``guarded-by`` rule keeps the per-write-site discipline (every
+write of a DECLARED attribute holds its declared lock); racelint consumes
+the same model for the inventory-level questions (is thread-shared state
+covered by ANY policy; what order do locks nest in; what is held across a
+blocking call). Extracting the model here means there is exactly one
+parser for each artifact — a syntax both linters read cannot drift.
+
+Also here: the **lock-object inventory** (``threading.Lock()`` /
+``RLock()`` / ``Condition()`` constructor sites) and the canonical
+cross-file lock identity (``<rel_path>::<Class>.<attr>`` for instance
+locks, ``<rel_path>::<name>`` for module globals) racelint's lock-order
+graph is keyed by.
+
+Stdlib-only, import-free (AST + regex), like the rest of the family.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.analysis.rules._util import (
+    def_line_comment,
+    enclosing_class,
+    enclosing_function,
+    parents,
+)
+
+#: declaration comment on the assignment introducing the state
+DECL_RE = re.compile(r"#\s*guarded-by:\s*([^#]+?)\s*(?:#|$)")
+#: matched against def-line comment TEXT (the '#' is already stripped)
+HELD_RE = re.compile(r"(?:^|\s)locked:\s*([^#]+?)\s*(?:#|$)")
+
+SINGLE_WRITER = "single-writer"
+
+#: method names that mutate their receiver in place (list/dict/set/deque)
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
+            "appendleft", "clear", "add", "discard", "update",
+            "setdefault", "popitem", "sort", "reverse"}
+
+#: threading constructors -> lock kind (the signal-safety rule needs to
+#: know reentrant from non-reentrant; Condition wraps an RLock by default)
+LOCK_CONSTRUCTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+}
+
+#: with-context expressions that LOOK like locks even without a visible
+#: constructor (the receiver name carries the convention)
+_LOCKISH_NAME = re.compile(r"(?:^|[._])(?:[a-z_]*lock|mutex|cv)$",
+                           re.IGNORECASE)
+
+
+def decl_on_line(src, lineno: int) -> Optional[str]:
+    """The ``# guarded-by:`` lock expression declared on ``lineno``.
+    Matches only against the REAL comment token on the line (when the
+    source file carries a tokenize-built comment map) — 'guarded-by:'
+    quoted inside a string literal is prose, not a declaration."""
+    comments = getattr(src, "comments", None)
+    if comments is not None:
+        text = comments.get(lineno)
+    elif 1 <= lineno <= len(src.lines):
+        text = src.lines[lineno - 1]
+    else:
+        text = None
+    if text:
+        m = DECL_RE.search(text)
+        if m:
+            return m.group(1).strip()
+    return None
+
+
+def held_locks(src, fn: ast.AST, chain: bool = True) -> List[str]:
+    """Locks the function declares held via '# locked:'. ``chain=True``
+    (dslint's write-site discipline) also honors ENCLOSING functions'
+    annotations — a helper def'd inside an annotated function inherits
+    its contract; racelint passes ``chain=False`` because a nested def
+    may be a thread target that runs with nothing held."""
+    out = []
+    cur = fn
+    while cur is not None:
+        m = HELD_RE.search(def_line_comment(src.lines, cur))
+        if m:
+            out.append(m.group(1).strip())
+        if not chain:
+            break
+        cur = enclosing_function(cur)
+    return out
+
+
+def write_targets(node) -> List[Tuple[ast.AST, str]]:
+    """Mutation sites of ``node`` as (owning expression, kind) pairs.
+    kind: "rebind" for plain name/attribute targets, "mutate" for
+    subscript stores (``x[k] = v`` / ``del x[k]``) and mutator-method
+    calls (``x.append(...)``) — rebinding a NAME only touches the module
+    global when a ``global`` statement is in force, while mutation
+    reaches the shared object through any reference."""
+    if isinstance(node, ast.Assign):
+        raw = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        raw = [node.target]
+    elif isinstance(node, ast.Delete):
+        raw = list(node.targets)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in MUTATORS:
+        return [(node.func.value, "mutate")]
+    else:
+        return []
+    out: List[Tuple[ast.AST, str]] = []
+    for t in raw:   # unpack `a, b = ...` tuple targets
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Subscript):
+                out.append((e.value, "mutate"))   # x[k] = v mutates x
+            else:
+                out.append((e, "rebind"))
+    return out
+
+
+def collect_declarations(src) -> Tuple[Dict[Tuple[str, str], Tuple[str, int]],
+                                       Dict[str, Tuple[str, int]]]:
+    """((class, attr) -> (lock, decl line), global name -> (lock, line))."""
+    attr_decls: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    global_decls: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(src.tree):
+        for target, kind in write_targets(node):
+            if kind != "rebind":
+                continue   # declarations live on plain assignments
+            lock = decl_on_line(src, node.lineno)
+            if lock is None:
+                continue
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                cls = enclosing_class(node)
+                if cls is not None:
+                    attr_decls[(cls.name, target.attr)] = (lock, node.lineno)
+            elif isinstance(target, ast.Name) and \
+                    enclosing_function(node) is None:
+                global_decls[target.id] = (lock, node.lineno)
+    return attr_decls, global_decls
+
+
+# ------------------------------------------------------------------ #
+# lock-object inventory + canonical identity
+# ------------------------------------------------------------------ #
+def _constructed_kind(value: ast.AST, aliases: Dict[str, str]
+                      ) -> Optional[str]:
+    """Lock kind when ``value`` is a ``threading.*`` lock constructor (or
+    a call whose FIRST argument chain ends in one — ``make_lock(...)``
+    style factories declare their kind via keyword ``reentrant=True``)."""
+    if not isinstance(value, ast.Call):
+        return None
+    from deepspeed_tpu.analysis.rules._util import resolve_call
+
+    name = resolve_call(value, aliases)
+    if name in LOCK_CONSTRUCTORS:
+        return LOCK_CONSTRUCTORS[name]
+    if name and name.rsplit(".", 1)[-1] == "make_lock":
+        for kw in value.keywords:
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                return "rlock" if kw.value.value else "lock"
+        return "lock"
+    return None
+
+
+def lock_inventory(src, aliases: Dict[str, str]) -> Dict[str, str]:
+    """Canonical lock id -> kind for every lock constructed in ``src``
+    (``self._lock = threading.Lock()`` in class C -> ``path::C._lock``;
+    ``_save_lock = threading.RLock()`` at module level -> ``path::_save_lock``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        kind = _constructed_kind(node.value, aliases)
+        if kind is None:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            cls = enclosing_class(node)
+            if cls is not None:
+                out[f"{src.rel_path}::{cls.name}.{target.attr}"] = kind
+        elif isinstance(target, ast.Name) and \
+                enclosing_function(node) is None:
+            out[f"{src.rel_path}::{target.id}"] = kind
+    return out
+
+
+def canonical_lock(expr: ast.AST, src, node: ast.AST) -> Optional[str]:
+    """Cross-file identity of a lock EXPRESSION at an acquisition site:
+    ``self.X`` -> ``path::Class.X`` (per-class — same-named locks of two
+    classes must not unify), bare module global -> ``path::X``, anything
+    else (a parameter, another object's lock) -> None."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        cls = enclosing_class(node)
+        if cls is not None:
+            return f"{src.rel_path}::{cls.name}.{expr.attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        return f"{src.rel_path}::{expr.id}"
+    return None
+
+
+def looks_like_lock(expr: ast.AST, known: Dict[str, str], src,
+                    node: ast.AST) -> bool:
+    """Whether a ``with`` context expression is a lock acquisition: its
+    canonical id is in the constructed-lock inventory, or its name
+    follows the ``*lock``/``mutex`` convention. (``with open(...)``,
+    ``with span(...)`` etc. fall through.)"""
+    cid = canonical_lock(expr, src, node)
+    if cid is not None and cid in known:
+        return True
+    text = ast.unparse(expr) if not isinstance(expr, ast.Call) else ""
+    return bool(text) and bool(_LOCKISH_NAME.search(text))
+
+
+def with_acquisitions(node: ast.AST) -> List[ast.AST]:
+    """The context expressions of a With/AsyncWith statement."""
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in node.items]
+    return []
+
+
+def locks_held_at(src, node: ast.AST, known: Dict[str, str]
+                  ) -> List[Tuple[str, int]]:
+    """(canonical lock id, with-line) for every lock-looking ``with``
+    enclosing ``node``, outermost first — stopping at the nearest
+    function boundary: a nested def's BODY runs when the closure is
+    CALLED (possibly on another thread, long after the ``with`` exited),
+    so an enclosing ``with`` does not hold there. Un-canonical lock-ish
+    contexts are skipped (they cannot alias across files anyway)."""
+    chain: List[Tuple[str, int]] = []
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            break
+        for expr in with_acquisitions(p):
+            if looks_like_lock(expr, known, src, p):
+                cid = canonical_lock(expr, src, p)
+                if cid is not None:
+                    chain.append((cid, p.lineno))
+    chain.reverse()
+    return chain

@@ -1,0 +1,142 @@
+"""racelint — concurrency contracts for the threaded control plane.
+
+The concurrency member of the lint family (dslint → hlolint → memlint →
+racelint). Two halves:
+
+* the **static half** (this package's ``core``/``rules``): import-free
+  AST analysis over ``deepspeed_tpu/`` — thread-roster extraction with
+  cross-module reachability, the shared-state inventory, the lock-order
+  graph with cycle (deadlock) reporting, lock-held-across-blocking, and
+  signal-handler lock safety — checked against the committed shrink-only
+  concurrency contract in ``contracts/``;
+* the **dynamic half** (``sanitizer``): an env-armable instrumented lock
+  (``DSTPU_RACELINT=1``) doing Eraser-style consistent-lockset checking
+  and runtime lock-order cycle detection with acquisition stacks, armed
+  inside the chaos acceptance tests.
+
+CLI (the family contract — exit 0 clean / 1 findings / 2 errors)::
+
+    tools/racelint deepspeed_tpu/
+    python -m deepspeed_tpu.analysis.racelint --format json deepspeed_tpu/
+    python -m deepspeed_tpu.analysis.racelint --list-rules
+
+Suppression: ``# racelint: disable=<rule>`` on (or directly above) the
+line; ``# racelint: disable-file=<rule>`` for a file. The committed
+baseline is EMPTY and stays empty — concurrency findings get fixed or
+suppressed-with-reason in source, never grandfathered.
+
+Shares dslint's machinery instead of copying it: :class:`SourceFile`'s
+tokenize-based suppression extractor (``tool="racelint"``) and the
+``analysis/lockmodel.py`` lock/annotation model are the SAME code
+dslint's guarded-by rule runs on.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.analysis.core import (
+    Finding,
+    Project,
+    load_baseline,
+    load_project,
+    split_baselined,
+    write_baseline,
+)
+from deepspeed_tpu.analysis.racelint.core import (
+    CONTRACT_VERSION,
+    ConcurrencyModel,
+    ContractError,
+    ThreadRoot,
+    bootstrap_contract,
+    contracts_dir,
+    default_contract_path,
+    guarded_inventory,
+    load_contract,
+    write_contract,
+)
+from deepspeed_tpu.analysis.racelint.rules import (
+    ALL_RULES,
+    KNOWN_RULES,
+    RULE_DOCS,
+)
+
+__all__ = [
+    "Finding", "ConcurrencyModel", "ContractError", "ThreadRoot",
+    "KNOWN_RULES", "RULE_DOCS", "CONTRACT_VERSION",
+    "bootstrap_contract", "contracts_dir", "default_contract_path",
+    "default_baseline_path", "guarded_inventory", "load_contract",
+    "write_contract", "write_baseline", "lint", "lint_repo",
+]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def run_racelint(project: Project,
+                 parse_errors: Sequence[Finding] = (),
+                 contract: Optional[dict] = None,
+                 rules: Optional[Sequence[str]] = None
+                 ) -> Tuple[List[Finding], ConcurrencyModel]:
+    """Build the concurrency model, run the rules, apply in-source
+    suppressions. Returns (findings, model) — callers that bootstrap
+    contracts or arm the sanitizer need the model too."""
+    model = ConcurrencyModel(project)
+    findings: List[Finding] = list(parse_errors)
+    for src in project.files:
+        for lineno, bogus in src.unknown_suppressions:
+            findings.append(Finding(
+                "unknown-suppression", src.rel_path, lineno,
+                f"'# {src.tool}: disable={bogus}' names no known rule — "
+                f"the comment suppresses NOTHING (known: "
+                f"{', '.join(r for r in src.known_rules if r != 'all')})",
+                anchor=f"unknown/{bogus}"))
+    active = list(rules) if rules else list(ALL_RULES)
+    for rule_id in active:
+        if rule_id not in ALL_RULES:
+            raise ValueError(f"unknown racelint rule {rule_id!r} "
+                             f"(known: {', '.join(ALL_RULES)})")
+        for f in ALL_RULES[rule_id](model, contract):
+            src = project.file(f.path)
+            if src is not None and src.suppressed(
+                    f.rule, f.line, f.end_line or f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, model
+
+
+def lint(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
+         baseline_path: Optional[str] = None, use_baseline: bool = True,
+         contract_path: Optional[str] = None, use_contract: bool = True,
+         root: Optional[str] = None
+         ) -> Tuple[List[Finding], List[Finding], ConcurrencyModel]:
+    """Run racelint over ``paths``; returns ``(new, baselined, model)``.
+    Defaults use the packaged (empty) baseline and the committed
+    concurrency contract."""
+    project, parse_errors = load_project(
+        paths, root=root, tool="racelint", known_rules=KNOWN_RULES)
+    contract = None
+    if use_contract:
+        path = contract_path or default_contract_path()
+        if os.path.exists(path):
+            contract = load_contract(path)
+        elif contract_path is not None:
+            raise ContractError(f"contract not found: {contract_path}")
+    findings, model = run_racelint(project, parse_errors, contract, rules)
+    if not use_baseline:
+        return findings, [], model
+    bl = load_baseline(baseline_path or default_baseline_path())
+    new, old = split_baselined(findings, bl)
+    return new, old, model
+
+
+def lint_repo() -> Tuple[List[Finding], List[Finding]]:
+    """Lint the installed ``deepspeed_tpu`` package against the
+    committed contract + (empty) baseline — the self-enforcement entry
+    point used by tier-1 and ``bench.py``."""
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    new, old, _ = lint([pkg_root], root=os.path.dirname(pkg_root))
+    return new, old

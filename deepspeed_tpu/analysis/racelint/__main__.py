@@ -1,0 +1,141 @@
+"""``python -m deepspeed_tpu.analysis.racelint`` — the racelint CLI.
+
+Exit codes (the family contract): 0 = clean, 1 = new finding(s),
+2 = usage error / unreadable target or contract / refused loosening.
+
+::
+
+    racelint deepspeed_tpu/                       # text report
+    racelint --format json deepspeed_tpu/         # machine output
+    racelint --list-rules                         # rule catalog
+    racelint --roster deepspeed_tpu/              # print the thread roster
+    racelint --write-contract deepspeed_tpu/      # retighten the contract
+    racelint --write-contract --allow-loosen ...  # deliberate regeneration
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from deepspeed_tpu.analysis.racelint import (
+    ALL_RULES,
+    ContractError,
+    RULE_DOCS,
+    bootstrap_contract,
+    default_contract_path,
+    lint,
+    write_baseline,
+    write_contract,
+)
+
+JSON_SCHEMA_VERSION = 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racelint",
+        description="concurrency contract checker: thread roster, "
+                    "shared-state inventory, lock-order cycles, "
+                    "lock-across-blocking, signal safety — static AST "
+                    "analysis checked against the committed shrink-only "
+                    "concurrency contract")
+    p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                   help="files/directories to lint "
+                        "(default: deepspeed_tpu)")
+    p.add_argument("--root", default=None,
+                   help="path findings are keyed relative to")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--contract", default=None, metavar="FILE",
+                   help="concurrency contract JSON (default: the "
+                        "packaged contracts/deepspeed_tpu.json)")
+    p.add_argument("--no-contract", action="store_true",
+                   help="skip contract drift checks (roster/guard/"
+                        "committed-edge rules)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON (default: the packaged — empty — "
+                        "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, baselined or not")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write current findings as a baseline (the "
+                        "committed one stays EMPTY — this is for "
+                        "triaging a dirty work tree only)")
+    p.add_argument("--write-contract", metavar="FILE", nargs="?",
+                   const="", default=None,
+                   help="write the observed roster/guards/edges as the "
+                        "concurrency contract (default target: the "
+                        "packaged contract path); refuses to LOOSEN an "
+                        "existing contract")
+    p.add_argument("--allow-loosen", action="store_true",
+                   help="permit --write-contract to loosen the "
+                        "committed contract (deliberate regeneration)")
+    p.add_argument("--roster", action="store_true",
+                   help="print the extracted thread roster and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id in ALL_RULES:
+            print(f"{rule_id:22s} {RULE_DOCS[rule_id]}")
+        return 0
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    try:
+        new, baselined, model = lint(
+            args.paths, rules=rules,
+            baseline_path=args.baseline,
+            use_baseline=not args.no_baseline,
+            contract_path=args.contract,
+            use_contract=not args.no_contract,
+            root=args.root)
+    except (FileNotFoundError, ContractError, ValueError) as e:
+        print(f"racelint: error: {e}", file=sys.stderr)
+        return 2
+    if args.roster:
+        for root in sorted(model.roots, key=lambda r: r.root_id):
+            print(root.root_id)
+        return 0
+    if args.write_contract is not None:
+        target = args.write_contract or default_contract_path()
+        try:
+            write_contract(target, bootstrap_contract(model),
+                           allow_loosen=args.allow_loosen)
+        except ContractError as e:
+            print(f"racelint: error: {e}", file=sys.stderr)
+            return 2
+        print(f"racelint: wrote contract {target}")
+        return 0
+    if args.write_baseline:
+        write_baseline(args.write_baseline, new + baselined)
+        print(f"racelint: wrote baseline {args.write_baseline} "
+              f"({len(new) + len(baselined)} entries)")
+        return 0
+    if args.format == "json":
+        print(json.dumps({
+            "schema_version": JSON_SCHEMA_VERSION,
+            "findings": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in baselined],
+            "threads": sorted(r.root_id for r in model.roots),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"racelint: {len(baselined)} baselined finding(s) "
+                  "suppressed (see baseline.json)")
+        if not new:
+            print(f"racelint: clean ({len(model.roots)} thread roots, "
+                  f"{len({e.key for e in model.lock_edges})} lock-order "
+                  "edges)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

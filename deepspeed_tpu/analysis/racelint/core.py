@@ -1,0 +1,708 @@
+"""racelint core: the concurrency model the rules run over.
+
+Static, import-free, stdlib-only (the dslint posture: parse the package,
+never import it). One pass over the project builds:
+
+* the **thread roster** — every entry point code can run on besides the
+  main thread: ``threading.Thread(target=...)`` / ``Timer`` targets,
+  ``signal.signal`` handlers, ``do_*`` methods of HTTP handler classes
+  (each request runs on a ThreadingHTTPServer worker thread), and
+  callbacks registered onto another thread's dispatch loop
+  (``register_health_probe``, ``add_collector``, ``on_stall=``);
+* the **call graph** — cross-MODULE, extending dslint's single-module
+  propagation: ``self.m()`` resolves within the class, bare and aliased
+  names resolve through each file's import table to the defining file,
+  and ``obj.m()`` resolves when ``obj``'s class is knowable (parameter
+  annotation, ``x = ClassName(...)`` local, or a ``self.attr =
+  ClassName(...)`` field). Unresolvable calls are DROPPED, not guessed —
+  racelint's precision posture is "miss quietly rather than cry wolf";
+* per-root **reachability** (BFS over the call graph from each roster
+  entry) — the input to the shared-state and signal-safety rules;
+* the **lock-order graph** — nested ``with lock:`` acquisitions (plus
+  ``# locked:`` caller-holds contracts and one level of call
+  propagation) become directed edges between canonical lock identities
+  (``lockmodel.canonical_lock``), each edge remembering its acquisition
+  site so a cycle report can name BOTH paths.
+
+The committed **concurrency contract** (``contracts/deepspeed_tpu.json``)
+freezes the roster, the guarded-state inventory, and the lock-order edge
+set. It only shrinks: a new thread root, a dropped guard, or a new edge
+that closes a cycle is a finding; ``--write-contract`` refuses to loosen
+without ``--allow-loosen`` (the hlolint convention).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deepspeed_tpu.analysis import lockmodel
+from deepspeed_tpu.analysis.core import Project, SourceFile
+from deepspeed_tpu.analysis.rules._util import (
+    add_parents,
+    dotted_name,
+    import_aliases,
+    parents,
+    resolve_call,
+)
+
+CONTRACT_VERSION = 1
+
+
+class ContractError(ValueError):
+    """Raised for unreadable contracts and refused loosenings."""
+
+
+#: registration calls that hand a callable to ANOTHER thread's dispatch
+#: loop: callee-name suffix -> (positional index of the callable,
+#: keyword name, root kind). Health probes and collectors run on the
+#: exposition scrape thread; ``on_stall`` fires on the watchdog thread.
+CALLBACK_REGISTRARS = {
+    "register_health_probe": (2, "fn", "http"),
+    "add_collector": (0, "fn", "http"),
+}
+CALLBACK_KEYWORDS = {
+    "on_stall": "thread",   # StallWatchdog escalation callback
+}
+
+#: coverage claim on the declaration line of otherwise-shared state:
+#: ``# racelint: single-thread — <reason>`` (all writers provably on one
+#: thread) or ``# racelint: atomic — <reason>`` (a documented lock-free
+#: idiom: GIL-atomic ops + an explicit happens-before edge). The reason
+#: is REQUIRED — an unexplained claim is itself a finding.
+SINGLE_THREAD_RE = re.compile(
+    r"#\s*racelint:\s*(?:single-thread|atomic)\s*(?:[-—:]\s*(.*))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One non-main entry point. ``root_id`` is line-number-free —
+    ``kind:rel_path:qualname`` — so the contract survives edits above."""
+
+    kind: str        # thread | timer | signal | http | callback
+    rel_path: str
+    qualname: str    # dotted def/class chain within the file
+    line: int        # diagnostic only — never part of the identity
+
+    @property
+    def root_id(self) -> str:
+        return f"{self.kind}:{self.rel_path}:{self.qualname}"
+
+    @property
+    def entry(self) -> str:
+        return f"{self.rel_path}::{self.qualname}"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str              # full id: rel_path::qualname
+    node: ast.AST
+    src: SourceFile
+    class_name: Optional[str]
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """Directed lock-order edge: ``outer`` held while ``inner`` acquired."""
+
+    outer: str
+    inner: str
+    site: str       # "path:line via <qualname>" — the acquisition path
+
+    @property
+    def key(self) -> str:
+        return f"{self.outer} -> {self.inner}"
+
+
+class ConcurrencyModel:
+    """Everything the rules need, built once per lint run."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: Dict[str, FuncInfo] = {}
+        self.class_files: Dict[str, str] = {}      # class name -> rel_path
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        self.call_edges: Dict[str, Set[str]] = {}
+        self.roots: List[ThreadRoot] = []
+        self.reach: Dict[str, Set[str]] = {}       # root_id -> func quals
+        self.locks: Dict[str, str] = {}            # canonical id -> kind
+        self.lock_edges: List[LockEdge] = []
+        self.decls: Dict[str, Tuple[dict, dict]] = {}   # rel_path -> decls
+        self._attr_types: Dict[Tuple[str, str], str] = {}   # (cls, attr)->cls
+        self._global_types: Dict[Tuple[str, str], str] = {}  # (rel,name)->cls
+        self._build()
+
+    # ---------------------------------------------------------------- #
+    # construction
+    # ---------------------------------------------------------------- #
+    def _build(self) -> None:
+        for src in self.project.files:
+            add_parents(src.tree)
+            self.aliases[src.rel_path] = import_aliases(src.tree)
+            self.decls[src.rel_path] = lockmodel.collect_declarations(src)
+            self._index_file(src)
+        for src in self.project.files:
+            self.locks.update(
+                lockmodel.lock_inventory(src, self.aliases[src.rel_path]))
+        for src in self.project.files:
+            self._collect_calls(src)
+            self._collect_roots(src)
+            self._collect_lock_edges(src)
+        self._propagate_call_edges_into_lock_order()
+        for root in self.roots:
+            self.reach[root.root_id] = self._bfs(root.entry)
+
+    def _index_file(self, src: SourceFile) -> None:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                self.class_files.setdefault(node.name, src.rel_path)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = _qualname(node)
+                info = FuncInfo(f"{src.rel_path}::{qual}", node, src,
+                                _owning_class(node))
+                self.functions[info.qual] = info
+        # field types: self.attr = ClassName(...) anywhere in a class;
+        # module-global types from `g = ClassName(...)` under a `global`
+        # statement or a module-level `g: Optional[ClassName] = ...`
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node._dslint_parent is src.tree:
+                cls_name = _annotated_class(node.annotation)
+                if cls_name:
+                    self._global_types[(src.rel_path, node.target.id)] = \
+                        cls_name
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                t = node.targets[0]
+                cls_name = _constructed_class(node.value,
+                                              self.aliases[src.rel_path])
+                if cls_name and isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    owner = _enclosing_class_name(node)
+                    if owner:
+                        self._attr_types[(owner, t.attr)] = cls_name
+                elif cls_name and isinstance(t, ast.Name):
+                    fn = _enclosing_def(node)
+                    if fn is None or any(
+                            isinstance(n, ast.Global) and t.id in n.names
+                            for n in ast.walk(fn)):
+                        self._global_types[(src.rel_path, t.id)] = cls_name
+
+    # -- call resolution ------------------------------------------------
+    def _module_file(self, dotted_mod: str) -> Optional[str]:
+        """``deepspeed_tpu.telemetry.spans`` -> its rel_path, if linted."""
+        cand = dotted_mod.replace(".", "/") + ".py"
+        for src in self.project.files:
+            if src.rel_path == cand or \
+                    src.rel_path == dotted_mod.replace(".", "/") + "/__init__.py":
+                return src.rel_path
+        return None
+
+    def _resolve_callable(self, expr: ast.AST, src: SourceFile,
+                          at: ast.AST) -> Optional[str]:
+        """Full qual (``rel_path::qualname``) of a callable EXPRESSION —
+        a thread target, signal handler, or registered callback. None
+        when the receiver's type can't be established."""
+        aliases = self.aliases[src.rel_path]
+        # self.method
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = _enclosing_class_name(at)
+            if cls:
+                return self._method(cls, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            # nearest enclosing-scope def with that name, then module level
+            hit = self._lookup_scoped(expr.id, src, at)
+            if hit:
+                return hit
+            canon = aliases.get(expr.id)
+            if canon and "." in canon:
+                mod, _, fn = canon.rpartition(".")
+                rel = self._module_file(mod)
+                if rel and f"{rel}::{fn}" in self.functions:
+                    return f"{rel}::{fn}"
+            return None
+        # mod.func / obj.method
+        if isinstance(expr, ast.Attribute):
+            name = dotted_name(expr)
+            if name is None:
+                return None
+            head, _, rest = name.partition(".")
+            canon_head = aliases.get(head, head)
+            rel = self._module_file(canon_head)
+            if rel and f"{rel}::{rest}" in self.functions:
+                return f"{rel}::{rest}"
+            # typed receiver: parameter annotation or local construction
+            recv_cls = self._infer_type(head, src, at)
+            if recv_cls and "." not in rest:
+                return self._method(recv_cls, rest)
+        return None
+
+    def _method(self, cls: str, attr: str) -> Optional[str]:
+        rel = self.class_files.get(cls)
+        if rel and f"{rel}::{cls}.{attr}" in self.functions:
+            return f"{rel}::{cls}.{attr}"
+        return None
+
+    def _lookup_scoped(self, name: str, src: SourceFile,
+                       at: ast.AST) -> Optional[str]:
+        """A def named ``name`` in an enclosing scope of ``at`` (closure
+        call), else at module level of the same file."""
+        for p in parents(at):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Module)):
+                # the scope's OWN body (defs nested under if/try/with
+                # included, other functions' interiors not)
+                for child in _own_body(p):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and child.name == name:
+                        return f"{src.rel_path}::{_qualname(child)}"
+        return None
+
+    def _infer_type(self, var: str, src: SourceFile,
+                    at: ast.AST) -> Optional[str]:
+        """Class of a local name: annotation on an enclosing function's
+        parameter, a visible ``var = ClassName(...)`` assignment, or a
+        module-global whose type the index established."""
+        aliases = self.aliases[src.rel_path]
+        glob = self._global_types.get((src.rel_path, var))
+        for p in parents(at):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg in list(p.args.args) + list(p.args.kwonlyargs):
+                    if arg.arg == var and arg.annotation is not None:
+                        ann = arg.annotation
+                        if isinstance(ann, ast.Constant) and \
+                                isinstance(ann.value, str):
+                            return ann.value.split(".")[-1] \
+                                if ann.value.split(".")[-1] \
+                                in self.class_files else None
+                        nm = dotted_name(ann)
+                        if nm and nm.split(".")[-1] in self.class_files:
+                            return nm.split(".")[-1]
+                has_local = False
+                for node in ast.walk(p):
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name) and \
+                            node.targets[0].id == var:
+                        has_local = True
+                        if isinstance(node.value, ast.Call):
+                            cls = _constructed_class(node.value, aliases)
+                            if cls and cls in self.class_files:
+                                return cls
+                    if isinstance(node, ast.Global) and var in node.names:
+                        return glob   # rebinds the MODULE binding
+                # a local binding of unknown type shadows the global
+                return None if has_local else glob
+        return glob
+
+    def _collect_calls(self, src: SourceFile) -> None:
+        for qual, info in list(self.functions.items()):
+            if info.src is not src:
+                continue
+            edges = self.call_edges.setdefault(qual, set())
+            for node in _own_body(info.node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # a nested def runs (if at all) on the threads its
+                    # parent runs on — over-approximate with an edge
+                    edges.add(f"{src.rel_path}::{_qualname(node)}")
+                if isinstance(node, ast.Call):
+                    target = self._resolve_callable(node.func, src, node)
+                    if target:
+                        edges.add(target)
+
+    # -- roster ---------------------------------------------------------
+    def _collect_roots(self, src: SourceFile) -> None:
+        aliases = self.aliases[src.rel_path]
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                if any("HTTPRequestHandler" in (dotted_name(b) or "")
+                       for b in node.bases):
+                    for child in node.body:
+                        if isinstance(child, ast.FunctionDef) and \
+                                child.name.startswith("do_"):
+                            self._add_root("http", src, child, child.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = resolve_call(node, aliases) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if name in ("threading.Thread", "threading.Timer") or \
+                    tail in ("Thread", "Timer"):
+                target = _kwarg(node, "target") or _kwarg(node, "function")
+                if target is None and tail == "Timer" and len(node.args) >= 2:
+                    target = node.args[1]
+                if target is not None:
+                    self._add_callable_root(
+                        "timer" if tail == "Timer" else "thread",
+                        target, src, node)
+            elif name == "signal.signal" and len(node.args) >= 2:
+                self._add_callable_root("signal", node.args[1], src, node)
+            elif tail in CALLBACK_REGISTRARS:
+                idx, kw, kind = CALLBACK_REGISTRARS[tail]
+                fn = _kwarg(node, kw)
+                if fn is None and len(node.args) > idx:
+                    fn = node.args[idx]
+                if fn is not None:
+                    self._add_callable_root(kind, fn, src, node)
+            else:
+                for kw_name, kind in CALLBACK_KEYWORDS.items():
+                    fn = _kwarg(node, kw_name)
+                    if fn is not None and not _is_none(fn):
+                        self._add_callable_root(kind, fn, src, node)
+
+    def _add_callable_root(self, kind: str, expr: ast.AST,
+                           src: SourceFile, at: ast.AST) -> None:
+        qual = self._resolve_callable(expr, src, at)
+        if qual is None:
+            return   # serve_forever-style externals: covered elsewhere
+        rel, _, qn = qual.partition("::")
+        info = self.functions.get(qual)
+        line = info.node.lineno if info else at.lineno
+        root = ThreadRoot(kind, rel, qn, line)
+        if root.root_id not in {r.root_id for r in self.roots}:
+            self.roots.append(root)
+
+    def _add_root(self, kind: str, src: SourceFile, fn: ast.AST,
+                  line: int) -> None:
+        root = ThreadRoot(kind, src.rel_path, _qualname(fn), line)
+        if root.root_id not in {r.root_id for r in self.roots}:
+            self.roots.append(root)
+
+    def _bfs(self, entry: str) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [entry] if entry in self.functions else []
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            frontier.extend(self.call_edges.get(cur, ()))
+        return seen
+
+    # -- lock-order graph ------------------------------------------------
+    def _collect_lock_edges(self, src: SourceFile) -> None:
+        for node in ast.walk(src.tree):
+            for expr in lockmodel.with_acquisitions(node):
+                if not lockmodel.looks_like_lock(expr, self.locks, src, node):
+                    continue
+                inner = lockmodel.canonical_lock(expr, src, node)
+                if inner is None:
+                    continue
+                held = self._held_at(src, node)
+                for outer in held:
+                    if outer != inner:
+                        self._add_lock_edge(outer, inner, src, node)
+
+    def _held_at(self, src: SourceFile, node: ast.AST) -> List[str]:
+        """Canonical locks held when ``node`` executes: lexical ``with``
+        chain above it plus the enclosing def's ``# locked:`` contract."""
+        held = [cid for cid, _ in
+                lockmodel.locks_held_at(src, node, self.locks)]
+        fn = _enclosing_def(node)
+        if fn is not None:
+            for txt in lockmodel.held_locks(src, fn, chain=False):
+                cid = self._canon_lock_text(txt, src, node)
+                if cid:
+                    held.append(cid)
+        return held
+
+    def _canon_lock_text(self, txt: str, src: SourceFile,
+                         at: ast.AST) -> Optional[str]:
+        try:
+            expr = ast.parse(txt.strip(), mode="eval").body
+        except SyntaxError:
+            return None
+        return lockmodel.canonical_lock(expr, src, at)
+
+    def _add_lock_edge(self, outer: str, inner: str, src: SourceFile,
+                       node: ast.AST) -> None:
+        fn = _enclosing_def(node)
+        where = _qualname(fn) if fn is not None else "<module>"
+        self.lock_edges.append(LockEdge(
+            outer, inner, f"{src.rel_path}:{node.lineno} in {where}"))
+
+    def _propagate_call_edges_into_lock_order(self) -> None:
+        """One level of interprocedural propagation: a call made while
+        holding A, to a function whose body acquires B, is an A -> B
+        edge (the classic cross-function deadlock shape)."""
+        top_acquires: Dict[str, List[Tuple[str, int]]] = {}
+        for qual, info in self.functions.items():
+            acq = []
+            for node in _own_body(info.node):
+                for expr in lockmodel.with_acquisitions(node):
+                    if lockmodel.looks_like_lock(expr, self.locks,
+                                                 info.src, node):
+                        cid = lockmodel.canonical_lock(expr, info.src, node)
+                        if cid:
+                            acq.append((cid, node.lineno))
+            if acq:
+                top_acquires[qual] = acq
+        for qual, info in self.functions.items():
+            for node in _own_body(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = self._held_at(info.src, node)
+                if not held:
+                    continue
+                target = self._resolve_callable(node.func, info.src, node)
+                if not target:
+                    continue
+                for inner, line in top_acquires.get(target, ()):
+                    t_info = self.functions[target]
+                    for outer in held:
+                        if outer != inner:
+                            self.lock_edges.append(LockEdge(
+                                outer, inner,
+                                f"{info.src.rel_path}:{node.lineno} in "
+                                f"{_qualname(info.node)} -> "
+                                f"{t_info.src.rel_path}:{line}"))
+
+    # ---------------------------------------------------------------- #
+    # queries the rules use
+    # ---------------------------------------------------------------- #
+    def func_of(self, src: SourceFile, node: ast.AST) -> Optional[str]:
+        fn = _enclosing_def(node)
+        if fn is None:
+            return None
+        return f"{src.rel_path}::{_qualname(fn)}"
+
+    def roots_reaching(self, qual: Optional[str]) -> List[ThreadRoot]:
+        if qual is None:
+            return []
+        return [r for r in self.roots if qual in self.reach[r.root_id]]
+
+    def edge_map(self) -> Dict[Tuple[str, str], List[str]]:
+        out: Dict[Tuple[str, str], List[str]] = {}
+        for e in self.lock_edges:
+            out.setdefault((e.outer, e.inner), []).append(e.site)
+        return out
+
+
+# ------------------------------------------------------------------ #
+# small AST helpers
+# ------------------------------------------------------------------ #
+def _qualname(fn: ast.AST) -> str:
+    parts = [getattr(fn, "name", "<lambda>")]
+    for p in parents(fn):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            parts.append(p.name)
+    return ".".join(reversed(parts))
+
+
+def _owning_class(fn: ast.AST) -> Optional[str]:
+    p = getattr(fn, "_dslint_parent", None)
+    return p.name if isinstance(p, ast.ClassDef) else None
+
+
+def _enclosing_class_name(node: ast.AST) -> Optional[str]:
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p.name
+    return None
+
+
+def _enclosing_def(node: ast.AST) -> Optional[ast.AST]:
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def _own_body(fn: ast.AST):
+    """Walk a function's body WITHOUT descending into nested defs — a
+    nested def's statements belong to the nested function."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _annotated_class(ann: ast.AST) -> Optional[str]:
+    """Bare class name from a module-level annotation — unwraps
+    ``Optional[X]`` / ``"X"`` string forms; CamelCase names only."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        tail = ann.value.strip("\"'").split("[")[-1].rstrip("]")
+        tail = tail.rsplit(".", 1)[-1]
+        return tail if tail[:1].isupper() else None
+    if isinstance(ann, ast.Subscript):
+        return _annotated_class(ann.slice)
+    name = dotted_name(ann)
+    if name:
+        tail = name.rsplit(".", 1)[-1]
+        return tail if tail[:1].isupper() and tail != "Optional" else None
+    return None
+
+
+def _constructed_class(call: ast.Call,
+                       aliases: Dict[str, str]) -> Optional[str]:
+    """Bare class name when ``call`` looks like ``ClassName(...)`` (CamelCase
+    head — the caller validates against the project's class index)."""
+    name = resolve_call(call, aliases)
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return tail if tail[:1].isupper() else None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def single_thread_claim(src: SourceFile, lineno: int
+                        ) -> Tuple[bool, Optional[str]]:
+    """(claimed, reason) for a ``# racelint: single-thread — reason``
+    annotation on ``lineno``. Matches only the real comment token on the
+    line — a claim quoted inside a string literal is prose, not a claim."""
+    text = src.comments.get(lineno)
+    if not text:
+        return False, None
+    m = SINGLE_THREAD_RE.search(text)
+    if not m:
+        return False, None
+    reason = (m.group(1) or "").strip()
+    return True, reason or None
+
+
+# ------------------------------------------------------------------ #
+# cycle detection (both acquisition paths named)
+# ------------------------------------------------------------------ #
+def find_cycles(edges: Dict[Tuple[str, str], List[str]]
+                ) -> List[List[Tuple[str, str]]]:
+    """Elementary cycles in the lock-order digraph, as edge lists. DFS
+    with a path stack — the graphs here are a handful of locks, so no
+    Johnson's needed; each cycle is reported once (smallest-node
+    rotation dedup)."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[Tuple[str, str]]] = []
+    seen_keys: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, cur: str, path: List[str]) -> None:
+        for nxt in sorted(graph.get(cur, ())):
+            if nxt == start and len(path) >= 2:
+                nodes = path[:]
+                i = nodes.index(min(nodes))
+                key = tuple(nodes[i:] + nodes[:i])
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(
+                        [(nodes[j], nodes[(j + 1) % len(nodes)])
+                         for j in range(len(nodes))])
+            elif nxt not in path and nxt > start:
+                # only expand nodes > start: each cycle found exactly
+                # once, from its smallest node
+                path.append(nxt)
+                dfs(start, nxt, path)
+                path.pop()
+
+    for node in sorted(graph):
+        dfs(node, node, [node])
+    return cycles
+
+
+# ------------------------------------------------------------------ #
+# contract
+# ------------------------------------------------------------------ #
+def contracts_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "contracts")
+
+
+def default_contract_path() -> str:
+    return os.path.join(contracts_dir(), "deepspeed_tpu.json")
+
+
+def load_contract(path: str) -> Dict[str, object]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ContractError(f"unreadable contract {path}: {e}") from e
+    if not isinstance(data, dict) or data.get("version") != CONTRACT_VERSION:
+        raise ContractError(
+            f"contract {path}: expected version {CONTRACT_VERSION}")
+    for key, typ in (("threads", list), ("guarded", dict),
+                     ("lock_order_edges", list)):
+        if not isinstance(data.get(key), typ):
+            raise ContractError(
+                f"contract {path}: missing/invalid {key!r}")
+    return data
+
+
+def guarded_inventory(model: ConcurrencyModel) -> Dict[str, str]:
+    """Canonical attr/global key -> declared lock, across the project —
+    the guarded-state inventory the contract commits."""
+    out: Dict[str, str] = {}
+    for rel, (attr_decls, global_decls) in model.decls.items():
+        for (cls, attr), (lock, _) in attr_decls.items():
+            out[f"{rel}::{cls}.{attr}"] = lock
+        for name, (lock, _) in global_decls.items():
+            out[f"{rel}::{name}"] = lock
+    return out
+
+
+def bootstrap_contract(model: ConcurrencyModel,
+                       target: str = "deepspeed_tpu") -> Dict[str, object]:
+    return {
+        "version": CONTRACT_VERSION,
+        "target": target,
+        "threads": sorted(r.root_id for r in model.roots),
+        "guarded": dict(sorted(guarded_inventory(model).items())),
+        "lock_order_edges": sorted({e.key for e in model.lock_edges}),
+    }
+
+
+def _loosenings(old: Dict[str, object], new: Dict[str, object]) -> List[str]:
+    out: List[str] = []
+    added_threads = set(new["threads"]) - set(old["threads"])
+    if added_threads:
+        out.append("new thread roots: " + ", ".join(sorted(added_threads)))
+    for key, lock in old["guarded"].items():
+        if key not in new["guarded"]:
+            out.append(f"guard dropped: {key} (was guarded-by {lock})")
+        elif new["guarded"][key] != lock:
+            out.append(f"guard changed: {key} ({lock} -> "
+                       f"{new['guarded'][key]})")
+    added_edges = set(new["lock_order_edges"]) - set(old["lock_order_edges"])
+    if added_edges:
+        out.append("new lock-order edges: " + ", ".join(sorted(added_edges)))
+    return out
+
+
+def write_contract(path: str, doc: Dict[str, object],
+                   allow_loosen: bool = False) -> None:
+    """Write the concurrency contract, refusing to LOOSEN an existing
+    one: the roster and edge set only shrink, guards only get added
+    (``allow_loosen=True`` is the deliberate-regeneration hatch —
+    contract and code reviewed together)."""
+    if os.path.exists(path) and not allow_loosen:
+        old = load_contract(path)
+        loosened = _loosenings(old, doc)
+        if loosened:
+            raise ContractError(
+                f"refusing to loosen committed concurrency contract "
+                f"{path}: " + "; ".join(loosened)
+                + " (pass --allow-loosen to regenerate deliberately)")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")

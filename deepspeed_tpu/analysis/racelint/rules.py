@@ -1,0 +1,467 @@
+"""racelint rules: the five concurrency hazard classes + contract drift.
+
+Every rule is ``check(model, contract) -> Iterable[Finding]`` over the
+:class:`~deepspeed_tpu.analysis.racelint.core.ConcurrencyModel`; findings
+reuse dslint's line-number-free keying so the (empty) baseline and the
+``# racelint: disable=<rule>`` suppressions behave identically to the
+rest of the family.
+
+Rule catalog:
+
+* ``shared-state`` — an attribute/global written from two thread roots
+  (or from a spawned root AND the main path) with no guarded-by
+  declaration, no consistent lexical lock, and no justified
+  ``# racelint: single-thread`` claim;
+* ``lock-order`` — a cycle in the lock-order graph (observed edges ∪
+  the committed contract's edges), both acquisition paths named;
+* ``lock-across-blocking`` — a lock held across ``.join()`` / sleep /
+  subprocess / socket / fsync / an engine tick;
+* ``signal-safety`` — code reachable from a signal handler acquiring a
+  non-reentrant lock the non-signal paths also take (the classic
+  handler-interrupts-holder self-deadlock);
+* ``thread-roster`` — a thread entry point absent from the committed
+  contract roster (new concurrency must be reviewed in);
+* ``contract-guard`` — a guard the contract committed that the source
+  no longer declares (or declares with a different lock).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from deepspeed_tpu.analysis import lockmodel
+from deepspeed_tpu.analysis.core import Finding
+from deepspeed_tpu.analysis.racelint.core import (
+    ConcurrencyModel,
+    find_cycles,
+    guarded_inventory,
+    single_thread_claim,
+)
+from deepspeed_tpu.analysis.rules._util import dotted_name, resolve_call
+
+KNOWN_RULES = (
+    "shared-state",
+    "lock-order",
+    "lock-across-blocking",
+    "signal-safety",
+    "thread-roster",
+    "contract-guard",
+    "all",
+    "parse-error",
+    "unknown-suppression",
+)
+
+
+# ------------------------------------------------------------------ #
+# shared-state
+# ------------------------------------------------------------------ #
+def check_shared_state(model: ConcurrencyModel,
+                       contract: Optional[dict]) -> Iterable[Finding]:
+    # key -> list of (site node, src, func qual, roots hitting the func)
+    writes: Dict[Tuple[str, str], List[tuple]] = {}
+    decl_sites: Dict[Tuple[str, str], Tuple] = {}
+    for src in model.project.files:
+        for node in ast.walk(src.tree):
+            for target, kind in lockmodel.write_targets(node):
+                key = _state_key(src, node, target)
+                if key is None:
+                    continue
+                qual = model.func_of(src, node)
+                fn = model.functions.get(qual) if qual else None
+                in_init = fn is not None and \
+                    getattr(fn.node, "name", "") == "__init__"
+                at_module = qual is None
+                if kind == "rebind":
+                    # the EARLIEST rebind is the declaration — the line
+                    # guarded-by / single-thread annotations live on
+                    # (attrs first assigned in a setup helper rather
+                    # than __init__ still get a claimable line)
+                    prev = decl_sites.get(key)
+                    if prev is None or node.lineno < prev[1]:
+                        decl_sites[key] = (src, node.lineno)
+                    if in_init or at_module:
+                        # construction happens-before publication
+                        continue
+                roots = frozenset(
+                    r.root_id for r in model.roots_reaching(qual))
+                writes.setdefault(key, []).append((node, src, qual, roots))
+    for key, sites in sorted(writes.items()):
+        spawned: Set[str] = set()
+        main_site = False
+        for (_, _, _, roots) in sites:
+            if roots:
+                spawned |= set(roots)
+            else:
+                main_site = True
+        if not spawned:
+            continue
+        if len(spawned) < 2 and not main_site:
+            continue   # one root, no main competition: thread-confined
+        rel, name = key
+        src = model.project.file(rel)
+        # covered: a guarded-by declaration (dslint enforces the
+        # per-site discipline from there)
+        attr_decls, global_decls = model.decls[rel]
+        if "." in name:
+            cls, attr = name.split(".", 1)
+            covered = (cls, attr) in attr_decls
+        else:
+            covered = name in global_decls
+        if covered:
+            continue
+        # covered: a justified single-thread claim on the declaration
+        decl = decl_sites.get(key)
+        if decl is not None:
+            claimed, reason = single_thread_claim(decl[0], decl[1])
+            if claimed and reason:
+                continue
+            if claimed and not reason:
+                yield Finding(
+                    "shared-state", rel, decl[1],
+                    f"{name}: racelint coverage claim has no reason — "
+                    "write WHY this state is safe ('# racelint: "
+                    "single-thread — <reason>' or '# racelint: atomic "
+                    "— <reason>')",
+                    anchor=f"{name}/unjustified-claim")
+                continue
+        # covered: every write site lexically holds one common lock
+        common: Optional[Set[str]] = None
+        for (node, s, _, _) in sites:
+            held = {cid for cid, _ in
+                    lockmodel.locks_held_at(s, node, model.locks)}
+            common = held if common is None else (common & held)
+            if not common:
+                break
+        if common:
+            continue
+        first = sites[0][0]
+        who = sorted(spawned) + (["main"] if main_site else [])
+        yield Finding(
+            "shared-state", rel, first.lineno,
+            f"{name} is written from {len(who)} thread roots "
+            f"({', '.join(who)}) with no '# guarded-by:' declaration, "
+            "no common lock around every write, and no justified "
+            "'# racelint: single-thread/atomic' claim",
+            anchor=name,
+            end_line=first.end_lineno or first.lineno)
+
+
+def _state_key(src, node, target) -> Optional[Tuple[str, str]]:
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        cls = _cls_name(node)
+        if cls:
+            return (src.rel_path, f"{cls}.{target.attr}")
+        return None
+    if isinstance(target, ast.Name):
+        # only module globals are shared state; locals are thread-private.
+        # A name counts as global when declared at module level OR
+        # rebound under a `global` statement.
+        fn = _def_of(node)
+        if fn is None:
+            return (src.rel_path, target.id)
+        if _declares_global(fn, target.id):
+            return (src.rel_path, target.id)
+        # mutation of a module-level binding through a plain reference
+        if _is_module_binding(src, target.id) and \
+                not _is_local_binding(fn, target.id):
+            return (src.rel_path, target.id)
+    return None
+
+
+def _cls_name(node) -> Optional[str]:
+    from deepspeed_tpu.analysis.rules._util import enclosing_class
+    cls = enclosing_class(node)
+    return cls.name if cls is not None else None
+
+
+def _def_of(node):
+    from deepspeed_tpu.analysis.rules._util import enclosing_function
+    return enclosing_function(node)
+
+
+def _declares_global(fn, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global) and name in node.names:
+            return True
+    return False
+
+
+def _is_module_binding(src, name: str) -> bool:
+    for node in src.tree.body:
+        for t, _ in lockmodel.write_targets(node):
+            if isinstance(t, ast.Name) and t.id == name:
+                return True
+    return False
+
+
+def _is_local_binding(fn, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)) and \
+                isinstance(node.target, ast.Name) and node.target.id == name:
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ #
+# lock-order
+# ------------------------------------------------------------------ #
+def check_lock_order(model: ConcurrencyModel,
+                     contract: Optional[dict]) -> Iterable[Finding]:
+    edges = model.edge_map()
+    # the committed edge set participates: a NEW edge that closes a
+    # cycle against history refuses even if the old path's code moved
+    if contract:
+        for key in contract.get("lock_order_edges", ()):
+            a, _, b = key.partition(" -> ")
+            edges.setdefault((a.strip(), b.strip()), []).append(
+                "committed in the concurrency contract")
+    for cycle in find_cycles(edges):
+        locks = " -> ".join(e[0] for e in cycle) + f" -> {cycle[0][0]}"
+        paths = "; ".join(
+            f"{a} -> {b} at {edges[(a, b)][0]}" for (a, b) in cycle
+            if (a, b) in edges)
+        anchor = "cycle/" + "|".join(sorted({e[0] for e in cycle}))
+        # anchor the finding at the first observed (non-contract) edge
+        site = next((edges[e][0] for e in cycle if e in edges
+                     and not edges[e][0].startswith("committed")), "")
+        rel, line = _site_loc(site)
+        yield Finding(
+            "lock-order", rel, line,
+            f"lock-order cycle {locks} — potential deadlock; "
+            f"acquisition paths: {paths}",
+            anchor=anchor)
+
+
+def _site_loc(site: str) -> Tuple[str, int]:
+    m = re.match(r"([^:]+):(\d+)", site)
+    if m:
+        return m.group(1), int(m.group(2))
+    return "<contract>", 0
+
+
+# ------------------------------------------------------------------ #
+# lock-across-blocking
+# ------------------------------------------------------------------ #
+#: callee shapes that block the calling thread for unbounded/IO time
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.", "urllib.")
+_BLOCKING_EXACT = {"time.sleep", "os.fsync", "os.wait", "select.select"}
+_BLOCKING_ATTRS = {"wait_until_finished", "block_until_ready",
+                   "train_batch", "run_tick", "urlopen"}
+#: ``.join()`` only on receivers that NAME a thread/process/queue —
+#: ``", ".join(...)`` and ``os.path.join`` must not match
+_JOINABLE_RECV = re.compile(r"(thread|proc|process|worker|queue|_httpd)",
+                            re.IGNORECASE)
+
+
+def _blocking_reason(call: ast.Call, aliases: Dict[str, str]
+                     ) -> Optional[str]:
+    name = resolve_call(call, aliases)
+    if name:
+        if name in _BLOCKING_EXACT:
+            return name
+        if any(name.startswith(p) for p in _BLOCKING_PREFIXES):
+            return name
+        if name.rsplit(".", 1)[-1] == "sleep" and \
+                name.split(".")[0] in ("time", "sleep"):
+            return name
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}()"
+        if attr == "join" and not call.args:
+            recv = dotted_name(call.func.value) or ""
+            if _JOINABLE_RECV.search(recv):
+                return f"{recv}.join()"
+    return None
+
+
+def check_lock_across_blocking(model: ConcurrencyModel,
+                               contract: Optional[dict]
+                               ) -> Iterable[Finding]:
+    # one level of propagation: calling a function that ITSELF blocks
+    # (lexically, in its own body) counts as blocking at the call site —
+    # this is how "with _server_lock: server.stop()" gets caught when
+    # the join lives inside stop()
+    fn_blocks: Dict[str, str] = {}
+    for qual, info in model.functions.items():
+        aliases = model.aliases[info.src.rel_path]
+        from deepspeed_tpu.analysis.racelint.core import _own_body
+        for node in _own_body(info.node):
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node, aliases)
+                if reason is not None:
+                    fn_blocks[qual] = reason
+                    break
+    for src in model.project.files:
+        aliases = model.aliases[src.rel_path]
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _blocking_reason(node, aliases)
+            if reason is None:
+                target = model._resolve_callable(node.func, src, node)
+                inner = fn_blocks.get(target or "")
+                if inner is not None:
+                    reason = f"{target} (which blocks on {inner})"
+            if reason is None:
+                continue
+            held = model._held_at(src, node)
+            if not held:
+                continue
+            qual = model.func_of(src, node)
+            yield Finding(
+                "lock-across-blocking", src.rel_path, node.lineno,
+                f"{', '.join(held)} held across blocking call {reason} "
+                "— every other acquirer stalls for the full wait (and a "
+                "join on a thread that needs this lock deadlocks); move "
+                "the blocking call outside the lock",
+                anchor=f"{qual or '<module>'}/{reason}",
+                end_line=node.end_lineno or node.lineno)
+
+
+# ------------------------------------------------------------------ #
+# signal-safety
+# ------------------------------------------------------------------ #
+def check_signal_safety(model: ConcurrencyModel,
+                        contract: Optional[dict]) -> Iterable[Finding]:
+    sig_reach: Set[str] = set()
+    for root in model.roots:
+        if root.kind == "signal":
+            sig_reach |= model.reach[root.root_id]
+    if not sig_reach:
+        return
+    # locks acquired OUTSIDE the signal cone (the ones a handler can
+    # interrupt mid-critical-section)
+    outside: Set[str] = set()
+    acq_by_func: Dict[str, List[Tuple[str, int, str, bool]]] = {}
+    for qual, info in model.functions.items():
+        acqs = _acquisitions(model, info)
+        if acqs:
+            acq_by_func[qual] = acqs
+        if qual not in sig_reach:
+            outside |= {cid for cid, _, _, _ in acqs}
+    for qual in sorted(sig_reach):
+        info = model.functions.get(qual)
+        if info is None:
+            continue
+        for cid, line, how, nonblocking in acq_by_func.get(qual, ()):
+            if nonblocking:
+                continue   # acquire(blocking=False) is the safe idiom
+            if model.locks.get(cid, "lock") != "lock":
+                continue   # RLock/Condition: reentry is legal
+            if cid not in outside:
+                continue   # nothing to interrupt: handler-only lock
+            yield Finding(
+                "signal-safety", info.src.rel_path, line,
+                f"signal-handler path {qual} acquires non-reentrant "
+                f"{cid} ({how}) which the main path also holds — a "
+                "signal landing inside that critical section deadlocks "
+                "the process; use acquire(blocking=False) or an RLock",
+                anchor=f"{qual}/{cid}")
+
+
+def _acquisitions(model: ConcurrencyModel, info
+                  ) -> List[Tuple[str, int, str, bool]]:
+    """(canonical lock, line, how, nonblocking) acquisition sites in a
+    function: ``with lock:`` statements and bare ``.acquire()`` calls."""
+    from deepspeed_tpu.analysis.racelint.core import _own_body
+    out: List[Tuple[str, int, str, bool]] = []
+    for node in _own_body(info.node):
+        for expr in lockmodel.with_acquisitions(node):
+            if lockmodel.looks_like_lock(expr, model.locks, info.src, node):
+                cid = lockmodel.canonical_lock(expr, info.src, node)
+                if cid:
+                    out.append((cid, node.lineno, "with", False))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            cid = lockmodel.canonical_lock(node.func.value, info.src, node)
+            if cid and (cid in model.locks or
+                        lockmodel.looks_like_lock(node.func.value,
+                                                  model.locks,
+                                                  info.src, node)):
+                nonblocking = any(
+                    kw.arg == "blocking" and
+                    isinstance(kw.value, ast.Constant) and
+                    kw.value.value is False
+                    for kw in node.keywords) or (
+                    bool(node.args) and
+                    isinstance(node.args[0], ast.Constant) and
+                    node.args[0].value is False)
+                out.append((cid, node.lineno, ".acquire()", nonblocking))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# contract drift
+# ------------------------------------------------------------------ #
+def check_thread_roster(model: ConcurrencyModel,
+                        contract: Optional[dict]) -> Iterable[Finding]:
+    if not contract:
+        return
+    committed = set(contract.get("threads", ()))
+    for root in model.roots:
+        if root.root_id not in committed:
+            yield Finding(
+                "thread-roster", root.rel_path, root.line,
+                f"new thread entry point {root.root_id} is not in the "
+                "committed concurrency contract — review its shared "
+                "state and re-run --write-contract",
+                anchor=root.root_id)
+
+
+def check_contract_guard(model: ConcurrencyModel,
+                         contract: Optional[dict]) -> Iterable[Finding]:
+    if not contract:
+        return
+    current = guarded_inventory(model)
+    for key, lock in sorted(contract.get("guarded", {}).items()):
+        rel = key.split("::", 1)[0]
+        if model.project.file(rel) is None:
+            continue   # linting a subset: only judge files in scope
+        if key not in current:
+            yield Finding(
+                "contract-guard", rel, 0,
+                f"contract commits {key} as guarded-by {lock} but the "
+                "declaration is gone — removing a guard is a loosening "
+                "(restore it, or regenerate with --allow-loosen)",
+                anchor=key)
+        elif current[key] != lock:
+            yield Finding(
+                "contract-guard", rel, 0,
+                f"contract commits {key} as guarded-by {lock} but the "
+                f"source now declares {current[key]} — changing a guard "
+                "is a loosening (regenerate with --allow-loosen)",
+                anchor=key)
+
+
+#: rule id -> checker, in report order
+ALL_RULES: Dict[str, object] = {
+    "shared-state": check_shared_state,
+    "lock-order": check_lock_order,
+    "lock-across-blocking": check_lock_across_blocking,
+    "signal-safety": check_signal_safety,
+    "thread-roster": check_thread_roster,
+    "contract-guard": check_contract_guard,
+}
+
+RULE_DOCS = {
+    "shared-state": "state written from >=2 thread roots with no "
+                    "guard, no common lock, and no single-thread claim",
+    "lock-order": "cycle in the (observed + committed) lock-order "
+                  "graph — potential deadlock, both paths named",
+    "lock-across-blocking": "lock held across join/sleep/subprocess/"
+                            "socket/fsync/engine-tick",
+    "signal-safety": "signal-handler path acquires a non-reentrant "
+                     "lock the main path also holds",
+    "thread-roster": "thread entry point absent from the committed "
+                     "contract roster",
+    "contract-guard": "a committed guarded-by declaration was removed "
+                      "or changed",
+}

@@ -35,110 +35,33 @@ the dict fills unlocked protects nothing.
 Reads are deliberately unchecked: lock-free reads of atomic scalars are
 a documented idiom here (health probes), and flagging every read would
 drown the real findings.
+
+The lock/annotation model itself (guarded-by declarations, ``# locked:``
+held-lock contracts, write-target classification) lives in
+``analysis/lockmodel.py`` and is shared with racelint — this module
+keeps only the per-write-site discipline rule.
 """
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Optional, Tuple
 
 from deepspeed_tpu.analysis.core import Finding, Project
+from deepspeed_tpu.analysis.lockmodel import (
+    SINGLE_WRITER,
+    collect_declarations as _collect_declarations,
+    held_locks as _held_locks,
+    write_targets as _write_targets,
+)
 from deepspeed_tpu.analysis.rules._util import (
     add_parents,
-    def_line_comment,
     enclosing_class,
     enclosing_function,
     in_with_lock,
-    parents,
 )
 
 RULE_ID = "guarded-by"
 RULE_DOC = ("writes to '# guarded-by:' annotated shared state outside "
             "the declared lock")
-
-_DECL_RE = re.compile(r"#\s*guarded-by:\s*([^#]+?)\s*(?:#|$)")
-# matched against def-line comment TEXT (the '#' is already stripped)
-_HELD_RE = re.compile(r"(?:^|\s)locked:\s*([^#]+?)\s*(?:#|$)")
-
-SINGLE_WRITER = "single-writer"
-
-#: method names that mutate their receiver in place (list/dict/set/deque)
-_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
-             "appendleft", "clear", "add", "discard", "update",
-             "setdefault", "popitem", "sort", "reverse"}
-
-
-def _decl_on_line(src, lineno: int) -> Optional[str]:
-    if 1 <= lineno <= len(src.lines):
-        m = _DECL_RE.search(src.lines[lineno - 1])
-        if m:
-            return m.group(1).strip()
-    return None
-
-
-def _held_locks(src, fn: ast.AST) -> List[str]:
-    """Locks the enclosing function chain declares held via '# locked:'."""
-    out = []
-    cur = fn
-    while cur is not None:
-        m = _HELD_RE.search(def_line_comment(src.lines, cur))
-        if m:
-            out.append(m.group(1).strip())
-        cur = enclosing_function(cur)
-    return out
-
-
-def _write_targets(node) -> List[Tuple[ast.AST, str]]:
-    """Mutation sites of ``node`` as (owning expression, kind) pairs.
-    kind: "rebind" for plain name/attribute targets, "mutate" for
-    subscript stores (``x[k] = v`` / ``del x[k]``) and mutator-method
-    calls (``x.append(...)``) — rebinding a NAME only touches the module
-    global when a ``global`` statement is in force, while mutation
-    reaches the shared object through any reference."""
-    if isinstance(node, ast.Assign):
-        raw = list(node.targets)
-    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-        raw = [node.target]
-    elif isinstance(node, ast.Delete):
-        raw = list(node.targets)
-    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
-            and node.func.attr in _MUTATORS:
-        return [(node.func.value, "mutate")]
-    else:
-        return []
-    out: List[Tuple[ast.AST, str]] = []
-    for t in raw:   # unpack `a, b = ...` tuple targets
-        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-        for e in elts:
-            if isinstance(e, ast.Subscript):
-                out.append((e.value, "mutate"))   # x[k] = v mutates x
-            else:
-                out.append((e, "rebind"))
-    return out
-
-
-def _collect_declarations(src) -> Tuple[Dict[Tuple[str, str], Tuple[str, int]],
-                                        Dict[str, Tuple[str, int]]]:
-    """((class, attr) -> (lock, decl line), global name -> (lock, line))."""
-    attr_decls: Dict[Tuple[str, str], Tuple[str, int]] = {}
-    global_decls: Dict[str, Tuple[str, int]] = {}
-    for node in ast.walk(src.tree):
-        for target, kind in _write_targets(node):
-            if kind != "rebind":
-                continue   # declarations live on plain assignments
-            lock = _decl_on_line(src, node.lineno)
-            if lock is None:
-                continue
-            if isinstance(target, ast.Attribute) and \
-                    isinstance(target.value, ast.Name) and \
-                    target.value.id == "self":
-                cls = enclosing_class(node)
-                if cls is not None:
-                    attr_decls[(cls.name, target.attr)] = (lock, node.lineno)
-            elif isinstance(target, ast.Name) and \
-                    enclosing_function(node) is None:
-                global_decls[target.id] = (lock, node.lineno)
-    return attr_decls, global_decls
 
 
 def _in_init(node: ast.AST) -> bool:

@@ -32,7 +32,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
+from deepspeed_tpu.testing.chaos import chaos_point, sync_point
 from deepspeed_tpu.utils.logging import logger
 
 PyTree = Any
@@ -173,7 +174,9 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         self.inner = inner or OrbaxCheckpointEngine()
         self.queue: "queue.Queue[Optional[Tuple[PyTree, str]]]" = \
             queue.Queue(maxsize=max_queue)
-        self._err: Optional[BaseException] = None
+        self._err_lock = make_lock("decoupled._err_lock")
+        self._err: Optional[BaseException] = None   # guarded-by: self._err_lock
+        self._closed = False    # racelint: single-thread — only close() sets it, and teardown is single-caller (a second close() from another thread is already a caller bug the flag makes harmless)
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
 
@@ -188,7 +191,8 @@ class DecoupledCheckpointEngine(CheckpointEngine):
                 self.inner.save(state, path)
                 self.inner.wait()
             except BaseException as e:  # surfaced on next wait()
-                self._err = e
+                with self._err_lock:
+                    self._err = e
             finally:
                 self.queue.task_done()
 
@@ -200,8 +204,9 @@ class DecoupledCheckpointEngine(CheckpointEngine):
 
     def wait(self) -> None:
         self.queue.join()
-        if self._err is not None:
+        with self._err_lock:
             err, self._err = self._err, None
+        if err is not None:
             raise err
 
     def load(self, path: str, template: PyTree) -> PyTree:
@@ -212,7 +217,14 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         # best-effort: close() runs on engine-teardown paths (often while
         # an ORIGINAL training error is propagating) — a failed queued save
         # must not raise here and mask it, and the drain thread must still
-        # be joined or it leaks holding the last queued state alive
+        # be joined or it leaks holding the last queued state alive.
+        # Idempotent: teardown paths stack (engine destroy + atexit +
+        # test cleanup), and a second put(None) after the drain thread
+        # exited would sit in the queue forever — a THIRD close() would
+        # then block on a full queue with nobody draining it.
+        if self._closed:
+            return
+        self._closed = True
         try:
             self.wait()
         except Exception as e:   # NOT BaseException: a Ctrl-C aimed at a
@@ -227,6 +239,7 @@ class DecoupledCheckpointEngine(CheckpointEngine):
                 f"DecoupledCheckpointEngine.close: queued save had failed "
                 f"({type(e).__name__}: {e}) — teardown continues")
         self.queue.put(None)
+        sync_point("decoupled/close/pre_join")
         self._thread.join(timeout=10)
 
 

@@ -30,9 +30,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
 from deepspeed_tpu.checkpoint import fault_tolerance as ft
 from deepspeed_tpu.checkpoint.fault_tolerance import CheckpointCorruptError
-from deepspeed_tpu.testing.chaos import chaos_point
+from deepspeed_tpu.testing.chaos import chaos_point, sync_point
 from deepspeed_tpu.utils.logging import logger
 
 PyTree = Any
@@ -60,30 +61,40 @@ _async_thread: Optional[threading.Thread] = None    # guarded-by: _save_lock
 # appends to it while finalize_async may HOLD _save_lock joining that same
 # thread — taking the lock in the finalizer would deadlock the drain. The
 # join itself is the happens-before edge that publishes the append.
-_async_error: List[BaseException] = []
+_async_error: List[BaseException] = []   # racelint: atomic — list append/pop are GIL-atomic and thread.join() is the publishing edge (block comment above)
 # serializes save_state/finalize_async across threads (a watchdog-thread
 # emergency save can run concurrently with the training thread's save).
 # RLock: save_state calls finalize_async itself. The SIGNAL-handler path
 # never takes this lock reentrantly mid-save — the engine defers
 # preemption while a save is in flight (engine._saving).
-_save_lock = threading.RLock()
+_save_lock = make_lock("checkpoint._save_lock", reentrant=True)
 
 
 def finalize_async() -> None:
     """Block until an in-flight async save is fully COMMITTED (write
     drained + marker + rename + ``latest``), re-raising any error it hit
-    (reference ``DecoupledCheckpointEngine`` drain semantics)."""
+    (reference ``DecoupledCheckpointEngine`` drain semantics).
+
+    The join runs OUTSIDE ``_save_lock``: the finalizer thread never
+    takes the lock itself, but holding it across the drain would stall
+    every concurrent save/finalize caller — including the SIGTERM
+    emergency-save path — for the full write. Pop the thread under the
+    lock (so two finalizers can't both join it), drain unlocked."""
     global _async_thread
     with _save_lock:
         thread, _async_thread = _async_thread, None
-        if thread is not None:
-            thread.join()
-        elif _async_ckptr is not None:
-            _async_ckptr.wait_until_finished()
-        if _async_error:
-            err = _async_error.pop()
-            _async_error.clear()
-            raise err
+        ckptr = _async_ckptr
+    sync_point("ckpt/finalize/pre_join")
+    if thread is not None:
+        thread.join()
+    elif ckptr is not None:
+        ckptr.wait_until_finished()
+    # the finalizer appended any error BEFORE exiting; join() above is
+    # the happens-before edge that makes this read safe without the lock
+    if _async_error:
+        err = _async_error.pop()
+        _async_error.clear()
+        raise err
 
 
 # Back-compat alias (pre-fault-tolerance name).
@@ -128,8 +139,13 @@ def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                        protect=()) -> None:   # locked: _save_lock
     import orbax.checkpoint as ocp
 
+    # Holding _save_lock across the (retried, sleeping) write is the
+    # DESIGN: the lock's one job is serializing whole save attempts, and
+    # the finalizer thread never takes it, so nothing can deadlock — the
+    # racelint lock-across-blocking suppressions below all carry this
+    # justification.
     global _async_ckptr, _async_thread
-    finalize_async()   # at most one save in flight
+    finalize_async()   # at most one save in flight  # racelint: disable=lock-across-blocking
     os.makedirs(save_dir, exist_ok=True)
     tmp = ft.tmp_dir_for(save_dir, tag)
     if _is_primary():
@@ -172,9 +188,11 @@ def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                 eng.save(state, os.path.join(tmp, "state_fast"))
                 eng.wait()
 
-            ft.with_retries(_write_fast, "write_fast", **retry_kw)
+            ft.with_retries(  # racelint: disable=lock-across-blocking
+                _write_fast, "write_fast", **retry_kw)
             chaos_point("save/mid_write")
-            ft.with_retries(_write_client_state, "client_state", **retry_kw)
+            ft.with_retries(  # racelint: disable=lock-across-blocking
+                _write_client_state, "client_state", **retry_kw)
             _commit_and_publish()
         return
 
@@ -183,7 +201,8 @@ def _save_state_locked(save_dir, tag, state, client_state, save_latest,
             _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         with _span("checkpoint/save"):
             _async_ckptr.save(os.path.join(tmp, "state"), state, force=True)
-            ft.with_retries(_write_client_state, "client_state", **retry_kw)
+            ft.with_retries(  # racelint: disable=lock-across-blocking
+                _write_client_state, "client_state", **retry_kw)
 
         def _finalize():
             try:
@@ -204,9 +223,11 @@ def _save_state_locked(save_dir, tag, state, client_state, save_latest,
                                       force=True)
 
     with _span("checkpoint/save"):
-        ft.with_retries(_write_orbax, "write_orbax", **retry_kw)
+        ft.with_retries(  # racelint: disable=lock-across-blocking
+            _write_orbax, "write_orbax", **retry_kw)
         chaos_point("save/mid_write")
-        ft.with_retries(_write_client_state, "client_state", **retry_kw)
+        ft.with_retries(  # racelint: disable=lock-across-blocking
+            _write_client_state, "client_state", **retry_kw)
         _commit_and_publish()
 
 

@@ -15,13 +15,15 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
+
 _REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 _SRC = os.path.join(_REPO_ROOT, "csrc", "aio", "aio.cpp")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 _SO_PATH = os.path.join(_BUILD_DIR, "libdstpu_aio.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("aio._lib_lock")
 
 
 def _build_library(force: bool = False) -> str:
@@ -38,13 +40,16 @@ def _load() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is None:
+            # build-once REQUIRES holding the lock across the compile:
+            # two threads racing g++ on the same .so is the bug this
+            # lock exists to prevent, hence the racelint suppressions
             try:
-                lib = ctypes.CDLL(_build_library())
+                lib = ctypes.CDLL(_build_library())   # racelint: disable=lock-across-blocking
             except OSError:
                 # a cached .so built on another image (libstdc++/GLIBCXX
                 # mismatch) passes the mtime check but fails to load —
                 # rebuild for THIS toolchain and retry
-                lib = ctypes.CDLL(_build_library(force=True))
+                lib = ctypes.CDLL(_build_library(force=True))   # racelint: disable=lock-across-blocking
             lib.aio_handle_create.restype = ctypes.c_void_p
             lib.aio_handle_create.argtypes = [ctypes.c_int]
             lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]

@@ -40,6 +40,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm as dist
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
 from deepspeed_tpu.comm.mesh import MeshManager, get_mesh_manager
 from deepspeed_tpu.models.api import ModelSpec
 from deepspeed_tpu.ops.optimizer import TPUOptimizer, get_optimizer
@@ -335,7 +336,7 @@ class DeepSpeedTPUEngine:
         self._gc_protect_root: Optional[str] = None
         self._gc_pin_stale = False   # superseded by an in-flight async commit
         self._restored_client_state: Optional[Dict] = None
-        self._tm_skips_lock = threading.Lock()
+        self._tm_skips_lock = make_lock("engine._tm_skips_lock")
 
         # bucketed compute/collective overlap scheduler (ROADMAP item 2;
         # parallel/overlap.py): chunk the layer scan at the prefetch-bucket
@@ -387,7 +388,7 @@ class DeepSpeedTPUEngine:
         self._in_step = False
         self._guard_busy = False   # defer_preemption scope (guardian)
         self._saving = False
-        self._ft_lock = threading.Lock()
+        self._ft_lock = make_lock("engine._ft_lock")
         self._last_save_dir: Optional[str] = None
         self._prev_sig_handlers: Dict[int, Any] = {}
         self._setup_telemetry()
@@ -1163,7 +1164,7 @@ class DeepSpeedTPUEngine:
         endpoint or the monitor bridge publishes."""
         tcfg = self.config.telemetry
         self._tm = None
-        self._watchdog = None
+        self._watchdog = None   # racelint: single-thread — every writer (telemetry setup/teardown and the SIGTERM handler, which CPython delivers between MAIN-thread bytecodes) runs on the main thread; the watchdog thread only calls beat()/check() through its own reference
         self._tm_bridge = None
         self._tm_tokens_per_step = 0
         # device-side overflow/non-finite skip counter, delta-folded into
@@ -1172,7 +1173,7 @@ class DeepSpeedTPUEngine:
         self._tm_skips_seen = 0
         self._tm_fenced_best_s: Optional[float] = None
         self._tm_flops_cache: Optional[float] = None
-        self._tm_flops_lock = threading.Lock()
+        self._tm_flops_lock = make_lock("engine._tm_flops_lock")
         self._tm_owner_thread = threading.get_ident()
         from deepspeed_tpu import telemetry
 

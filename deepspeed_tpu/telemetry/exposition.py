@@ -40,6 +40,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
+from deepspeed_tpu.testing.chaos import sync_point
 from deepspeed_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -110,7 +112,7 @@ def snapshot(registry: MetricsRegistry) -> Dict[str, Any]:
 HealthProbe = Callable[[], Tuple[bool, Dict[str, Any]]]
 
 _health_probes: Dict[str, Dict[str, HealthProbe]] = {"live": {}, "ready": {}}
-_health_lock = threading.Lock()
+_health_lock = make_lock("exposition._health_lock")
 
 
 def register_health_probe(kind: str, name: str, fn: HealthProbe) -> None:
@@ -231,6 +233,7 @@ class MetricsServer:
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]
+        self._stopped = False   # racelint: single-thread — only stop() flips it, and stop() is serialized by stop_metrics_server
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="telemetry-metrics-server",
             daemon=True)
@@ -241,13 +244,19 @@ class MetricsServer:
         return f"http://{self.host}:{self.port}/metrics"
 
     def stop(self) -> None:
+        """Idempotent: a second stop() (engine teardown racing an atexit
+        or signal-path shutdown) is a no-op instead of a double
+        server_close on a dead socket."""
+        if self._stopped:
+            return
+        self._stopped = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=2.0)
 
 
 _server: Optional[MetricsServer] = None
-_server_lock = threading.Lock()
+_server_lock = make_lock("exposition._server_lock")
 
 
 def start_metrics_server(registry: MetricsRegistry,
@@ -263,8 +272,14 @@ def start_metrics_server(registry: MetricsRegistry,
 
 
 def stop_metrics_server() -> None:
+    """Pop the server under the lock, stop it OUTSIDE: stop() joins the
+    HTTP thread, and holding ``_server_lock`` across that join would
+    stall every concurrent start/stop caller for the full drain (a
+    scrape handler blocked on a slow collector holds the join up to its
+    2s timeout)."""
     global _server
     with _server_lock:
-        if _server is not None:
-            _server.stop()
-            _server = None
+        server, _server = _server, None
+    sync_point("exposition/stop/pre_join")
+    if server is not None:
+        server.stop()

@@ -26,6 +26,8 @@ breaking the scrape.
 from __future__ import annotations
 
 import threading
+
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -238,7 +240,7 @@ class Histogram(_Metric):
 class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
-        self._lock = threading.RLock()
+        self._lock = make_lock("registry._lock", reentrant=True)
         self._metrics: Dict[str, _Metric] = {}          # guarded-by: self._lock
         self._collectors: List[Callable[[], Any]] = []  # guarded-by: self._lock
         # watchdog substrate: the last completed span as (name, monotonic

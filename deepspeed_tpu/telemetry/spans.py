@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Optional
 
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
+from deepspeed_tpu.testing.chaos import sync_point
 from deepspeed_tpu.telemetry import tracing as _tracing
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
@@ -105,7 +107,7 @@ class StallWatchdog:
             logger = _l
         self.logger = logger
         self.on_stall = on_stall
-        self._lock = threading.Lock()
+        self._lock = make_lock("watchdog._lock")
         self._last_beat = time.monotonic()  # guarded-by: self._lock
         self._armed = False                 # guarded-by: self._lock
         self._stalled = False               # guarded-by: self._lock
@@ -127,6 +129,9 @@ class StallWatchdog:
 
     def start(self) -> "StallWatchdog":
         if self._thread is None:
+            # restartable: a prior stop() left the event set, and a new
+            # thread would otherwise exit its wait-loop immediately
+            self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name=f"telemetry-watchdog-{self.name}",
                 daemon=True)
@@ -134,10 +139,14 @@ class StallWatchdog:
         return self
 
     def stop(self) -> None:
+        """Idempotent (thread popped before the join, so stacked
+        teardown paths can't double-join); join-with-timeout; no lock
+        held across the join (stop never takes self._lock)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        sync_point("watchdog/stop/pre_join")
+        if thread is not None:
+            thread.join(timeout=2.0)
 
     def check(self, now: Optional[float] = None) -> bool:
         """One deadline check (the thread's body; callable directly in

@@ -50,6 +50,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
 from deepspeed_tpu.utils.logging import logger
 
 #: schema tag written into every export/dump (consumers can gate on it)
@@ -152,7 +153,7 @@ class Tracer:
         # disk of an unattended host (same bounding story as the ring
         # buffer itself). Oldest pruned first; 0 = keep everything.
         self.keep_dumps = keep_dumps
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer._lock")
         self._buf: collections.deque = collections.deque(
             maxlen=max(1, int(capacity)))       # guarded-by: self._lock
         self._open_reqs: Dict[Any, _SpanRecord] = {}  # guarded-by: self._lock
@@ -294,20 +295,25 @@ class Tracer:
                 # leak guard: a caller that never resolves uids must not
                 # grow this map without bound — close out the oldest
                 evicted = self._open_reqs.pop(next(iter(self._open_reqs)))
+                # mutate while still under the lock (a concurrent export
+                # snapshot may hold a reference); push after release
+                evicted.t1 = time.perf_counter()
+                evicted.attrs.setdefault("state", "abandoned")
             self._open_reqs[uid] = rec
         if evicted is not None:
-            evicted.t1 = time.perf_counter()
-            evicted.attrs.setdefault("state", "abandoned")
             self._push(evicted)
 
     def request_event(self, uid: Any, name: str, **attrs) -> None:
         if not self.enabled:
             return
         now = time.perf_counter()
+        # mutate rec UNDER the lock: export_chrome snapshots open request
+        # records and iterates rec.points concurrently — an unlocked
+        # append races that read (the scrape-vs-mutate class)
         with self._lock:
             rec = self._open_reqs.get(uid)
-        if rec is not None:
-            rec.points.append((now, name, dict(attrs)))
+            if rec is not None:
+                rec.points.append((now, name, dict(attrs)))
 
     def request_end(self, uid: Any, state: str, **attrs) -> None:
         """Close ``uid``'s trace with its terminal state; the completed
@@ -315,16 +321,22 @@ class Tracer:
         or tracing enabled mid-request)."""
         if not self.enabled:
             return
+        now = time.perf_counter()
+        # popping rec does NOT give this thread sole ownership: a
+        # concurrent export_chrome may already hold a snapshot reference
+        # and read rec.attrs (``dict(rec.attrs)`` raises if it changes
+        # size mid-copy) — so the terminal-state mutation happens under
+        # the lock too, and only the _push (which re-takes it) is outside
         with self._lock:
             rec = self._open_reqs.pop(uid, None)
-        if rec is None:
-            return
-        rec.t1 = time.perf_counter()
-        rec.attrs["state"] = state
-        for k, v in attrs.items():
-            if v not in (None, ""):
-                rec.attrs[k] = v
-        self._push(rec)
+            if rec is not None:
+                rec.t1 = now
+                rec.attrs["state"] = state
+                for k, v in attrs.items():
+                    if v not in (None, ""):
+                        rec.attrs[k] = v
+        if rec is not None:
+            self._push(rec)
 
     # ------------------------------------------------------------------ #
     # export / flight dumps
@@ -335,31 +347,36 @@ class Tracer:
         real-timestamp ``ts`` (µs) and monotonic ``dur``, instant ``i``
         events for span points, ``pid``/``tid`` on every event, sorted
         by ``ts`` — loadable in Perfetto / ``chrome://tracing``."""
-        with self._lock:
-            recs = list(self._buf) + list(self._open_reqs.values())
         now = time.perf_counter()
         pid = os.getpid()
         events: List[Dict[str, Any]] = []
-        for rec in recs:
-            t1 = rec.t1 if rec.t1 is not None else now
-            args = dict(rec.attrs)
-            args["trace_id"] = rec.trace_id
-            if rec.parent_id:
-                args["parent_span_id"] = rec.parent_id
-            if rec.t1 is None:
-                args["in_flight"] = True
-            events.append({
-                "name": rec.name, "cat": rec.cat, "ph": "X",
-                "ts": self._ts_us(rec.t0),
-                "dur": max(0.0, (t1 - rec.t0) * 1e6),
-                "pid": pid, "tid": rec.tid, "args": args,
-            })
-            for (t, name, attrs) in rec.points:
+        # render under the lock: a snapshot of the record LIST is not
+        # enough — open request records' points/attrs keep mutating
+        # (under this lock, see request_event/request_end), and
+        # ``dict(rec.attrs)`` racing a writer is exactly the
+        # scrape-vs-mutate bug this lock now covers end to end
+        with self._lock:
+            recs = list(self._buf) + list(self._open_reqs.values())
+            for rec in recs:
+                t1 = rec.t1 if rec.t1 is not None else now
+                args = dict(rec.attrs)
+                args["trace_id"] = rec.trace_id
+                if rec.parent_id:
+                    args["parent_span_id"] = rec.parent_id
+                if rec.t1 is None:
+                    args["in_flight"] = True
                 events.append({
-                    "name": name, "cat": rec.cat, "ph": "i", "s": "t",
-                    "ts": self._ts_us(t), "pid": pid, "tid": rec.tid,
-                    "args": dict(attrs, trace_id=rec.trace_id),
+                    "name": rec.name, "cat": rec.cat, "ph": "X",
+                    "ts": self._ts_us(rec.t0),
+                    "dur": max(0.0, (t1 - rec.t0) * 1e6),
+                    "pid": pid, "tid": rec.tid, "args": args,
                 })
+                for (t, name, attrs) in rec.points:
+                    events.append({
+                        "name": name, "cat": rec.cat, "ph": "i", "s": "t",
+                        "ts": self._ts_us(t), "pid": pid, "tid": rec.tid,
+                        "args": dict(attrs, trace_id=rec.trace_id),
+                    })
         events.sort(key=lambda e: e["ts"])
         return {
             "traceEvents": events,

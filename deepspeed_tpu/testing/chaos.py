@@ -38,6 +38,14 @@ checkpoint hot paths that must stay importable everywhere):
     (default 0.05) before returning: the tick-stuck-in-a-device-call shape,
     distinct from a raise — nothing fails, the heartbeat just goes stale
     (``serving/hang`` is armed this way for hang-vs-crash detection tests).
+  * ``seed:s[:max_ms]`` — the interleaving fuzzer, valid only on
+    ``sync:<name>`` points (or the ``sync:*`` wildcard): every hit of a
+    :func:`sync_point` sleeps a delay deterministic in
+    ``(s, point name, hit index)``, uniform in ``[0, max_ms)`` ms
+    (default 2). Same seed ⇒ same schedule (reproducible failures);
+    sweeping seeds explores interleavings. Pairs with the racelint
+    runtime sanitizer: the fuzzer FORCES the bad schedule, the sanitizer
+    CATCHES it (``sync:*=seed:7`` under ``DSTPU_RACELINT=1``).
 
 Injection points: some fault points model *corruption*, not failure — the
 caller asks :func:`chaos_should_fire` whether the armed ``fail`` window
@@ -67,6 +75,8 @@ import contextlib
 import os
 import random
 import threading
+
+from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -91,7 +101,7 @@ class FaultPlan:
         #                         | ("hang", n, stall_s)
         self.rules = dict(rules)
         self._hits: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.FaultPlan._lock")
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -114,10 +124,22 @@ class FaultPlan:
             elif action == "kill":
                 n = int(args[1]) if len(args) > 1 and args[1] else 1
                 rules[point.strip()] = (action, n)
+            elif action == "seed":
+                # interleaving fuzzer: sync:<name>=seed:<s>[:<max_ms>]
+                # — deterministic per-(seed, point, hit) delays at named
+                # scheduling points (see sync_point)
+                if not point.strip().startswith("sync:"):
+                    raise ValueError(
+                        f"'seed' arms only sync points ('sync:<name>' or "
+                        f"'sync:*'), got point {point.strip()!r} "
+                        f"(spec {spec!r})")
+                s = int(args[1]) if len(args) > 1 and args[1] else 0
+                max_ms = float(args[2]) if len(args) > 2 and args[2] else 2.0
+                rules[point.strip()] = ("seed", s, max_ms)
             else:
                 raise ValueError(
-                    f"chaos action must be fail|kill|hang, got {action!r} "
-                    f"(spec {spec!r})")
+                    f"chaos action must be fail|kill|hang|seed, got "
+                    f"{action!r} (spec {spec!r})")
         return cls(rules)
 
     def _account(self, point: str, scope: Optional[str]):
@@ -183,42 +205,81 @@ class FaultPlan:
             return False
         return self._execute(rule, count)
 
+    def sync(self, name: str) -> None:
+        """One hit of scheduling point ``sync:<name>``. A matching
+        ``seed`` rule (exact point, else the ``sync:*`` wildcard) injects
+        a delay that is DETERMINISTIC in (seed, point name, hit index) —
+        re-running with the same seed replays the same adversarial
+        interleaving, a different seed explores a different one. The
+        fail/hang/kill actions also compose onto sync points (crashing
+        INSIDE a shutdown window is a legitimate chaos shape)."""
+        point = f"sync:{name}"
+        with self._lock:
+            rule = self.rules.get(point)
+            if rule is None:
+                rule = self.rules.get("sync:*")
+            if rule is None:
+                return
+            self._hits[point] = count = self._hits.get(point, 0) + 1
+        if rule[0] != "seed":
+            if self._execute(rule, count):
+                raise ChaosError(
+                    f"chaos: injected failure at {point!r} (hit {count})")
+            return
+        seed, max_ms = rule[1], rule[2]
+        # hashlib-free stable hash: Random accepts str seeds but salts
+        # them per-process via PYTHONHASHSEED only for hash(); seeding
+        # with the string itself is version-stable enough for tests
+        rng = random.Random(f"{seed}:{name}:{count}")
+        delay_s = rng.random() * max_ms / 1000.0
+        # sleep(0) is still a GIL yield — even max_ms=0 perturbs order
+        time.sleep(delay_s)
+
     def hits(self, point: str) -> int:
         with self._lock:
             return self._hits.get(point, 0)
 
 
-_armed: Optional[FaultPlan] = None
-_env_checked = False
+# chaos_point() is called from the watchdog, finalizer, and scrape
+# threads, so the armed-plan state needs a real guard. RLock, not Lock:
+# the SIGTERM emergency-save path also reaches chaos_point, and a signal
+# handler interrupting the owning thread must not self-deadlock.
+_arm_lock = make_lock("chaos._arm_lock", reentrant=True)
+_armed: Optional[FaultPlan] = None    # guarded-by: _arm_lock
+_env_checked = False                  # guarded-by: _arm_lock
 
 
 def arm(plan) -> FaultPlan:
     """Arm a plan in-process (a ``FaultPlan`` or a ``DSTPU_CHAOS`` spec
     string). Returns the armed plan for hit-count assertions."""
     global _armed
-    _armed = FaultPlan.parse(plan) if isinstance(plan, str) else plan
-    return _armed
+    parsed = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    with _arm_lock:
+        _armed = parsed
+    return parsed
 
 
 def disarm() -> None:
     global _armed, _env_checked
-    _armed = None
-    _env_checked = True   # an explicit disarm also wins over the env
+    with _arm_lock:
+        _armed = None
+        _env_checked = True   # an explicit disarm also wins over the env
 
 
 def _resolve_plan() -> Optional[FaultPlan]:
     """Lazy env-arm shared by both hook flavors: resolve the armed plan,
     parsing ``DSTPU_CHAOS`` exactly once per process."""
     global _armed, _env_checked
-    if _armed is None:
-        if _env_checked:
-            return None
-        _env_checked = True
-        spec = os.environ.get(CHAOS_ENV)
-        if not spec:
-            return None
-        _armed = FaultPlan.parse(spec)
-    return _armed
+    with _arm_lock:
+        if _armed is None:
+            if _env_checked:
+                return None
+            _env_checked = True
+            spec = os.environ.get(CHAOS_ENV)
+            if not spec:
+                return None
+            _armed = FaultPlan.parse(spec)
+        return _armed
 
 
 def chaos_point(point: str, scope: Optional[str] = None) -> None:
@@ -229,6 +290,21 @@ def chaos_point(point: str, scope: Optional[str] = None) -> None:
     plan = _resolve_plan()
     if plan is not None:
         plan.hit(point, scope=scope)
+
+
+def sync_point(name: str) -> None:
+    """Named SCHEDULING point for the interleaving fuzzer. Production
+    shutdown/handoff windows call this where a thread switch is
+    interesting (between popping a resource under a lock and joining its
+    thread, between queue put and drain, ...). Unarmed it is the same
+    one global-is-None check as :func:`chaos_point`. Armed with
+    ``DSTPU_CHAOS="sync:<name>=seed:<s>[:<max_ms>]"`` (or the
+    ``sync:*`` wildcard), each hit sleeps a delay deterministic in
+    (seed, name, hit index) — the seeded scheduler that forces the
+    adversarial interleavings the racelint sanitizer then observes."""
+    plan = _resolve_plan()
+    if plan is not None:
+        plan.sync(name)
 
 
 def chaos_should_fire(point: str, scope: Optional[str] = None) -> bool:

@@ -16,7 +16,9 @@ setup(
     # the committed compiled-program contracts hlolint/memlint enforce
     # (analysis/{hlolint,memlint}/contracts/*.json) ship with the package
     package_data={"deepspeed_tpu.analysis.hlolint": ["contracts/*.json"],
-                  "deepspeed_tpu.analysis.memlint": ["contracts/*.json"]},
+                  "deepspeed_tpu.analysis.memlint": ["contracts/*.json"],
+                  "deepspeed_tpu.analysis.racelint": ["contracts/*.json",
+                                                      "baseline.json"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "orbax-checkpoint", "einops"],
     extras_require={
@@ -31,6 +33,7 @@ setup(
             "dslint=deepspeed_tpu.analysis.__main__:main",
             "hlolint=deepspeed_tpu.analysis.hlolint.__main__:main",
             "memlint=deepspeed_tpu.analysis.memlint.__main__:main",
+            "racelint=deepspeed_tpu.analysis.racelint.__main__:main",
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
             "bench-diff=deepspeed_tpu.bench.cli:main",
             "step-report=deepspeed_tpu.profiling.observatory.__main__:main",

@@ -144,6 +144,15 @@ def pytest_configure(config):
         "reconciliation through a replica kill AND an autoscale resize "
         "mid-burst; also registered in pytest.ini)")
     config.addinivalue_line(
+        "markers", "racelint: concurrency contract-checker tests (static "
+        "thread-roster/shared-state/lock-order/blocking/signal rules over "
+        "committed fixture files, CLI exit-code matrix, shrink-only "
+        "concurrency contracts, the full self-enforcement pass over "
+        "deepspeed_tpu/ with an EMPTY baseline, and the DYNAMIC lockset/"
+        "lock-order sanitizer catching seeded race + deadlock fixtures "
+        "deterministically under the sync_point interleaving fuzzer — "
+        "AST + threads only, tier-1-eligible under JAX_PLATFORMS=cpu)")
+    config.addinivalue_line(
         "markers", "autotune: observatory-driven plan-engine tests "
         "(plan schema + canary enforcement, analytic OOM refusal, "
         "plan-key purity, engine plan-cache hit/stale/fail_on_stale, "

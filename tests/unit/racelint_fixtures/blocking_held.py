@@ -1,0 +1,29 @@
+"""racelint fixture: locks held across blocking calls.
+
+``drain`` holds ``_lock`` across a ``.join()``; ``tick`` holds it
+across ``time.sleep``. Expected findings: two ``lock-across-blocking``.
+``rebuild`` carries a justified suppression — NOT a finding.
+"""
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+_worker_thread = None
+
+
+def drain():
+    with _lock:
+        if _worker_thread is not None:
+            _worker_thread.join()
+
+
+def tick():
+    with _lock:
+        time.sleep(0.5)
+
+
+def rebuild():
+    with _lock:
+        # build-once requires the lock across the compile
+        subprocess.run(["true"], check=True)   # racelint: disable=lock-across-blocking

@@ -1,0 +1,97 @@
+"""Dynamic racelint fixtures: a seeded RACE and a seeded DEADLOCK the
+runtime sanitizer must catch DETERMINISTICALLY under the sync_point
+interleaving fuzzer — plus a guarded twin it must stay silent on.
+
+Determinism is by construction, not by luck:
+
+* the race pair uses a barrier so each thread provably accesses the
+  shared dict again AFTER the second thread has shown up — whatever
+  order the fuzzer's seeded delays produce, the Eraser intersection
+  ends empty (the two writers hold DISJOINT locks);
+* the deadlock pair runs its two opposite-order acquirers
+  SEQUENTIALLY — the sanitizer detects the cycle from the recorded
+  acquisition ORDER, so the test proves the AB/BA bug without ever
+  risking an actual wedge.
+
+The ``sync_point`` calls are the named scheduling points the fuzzer
+(``DSTPU_CHAOS="sync:*=seed:<s>"``) perturbs.
+"""
+import threading
+
+from deepspeed_tpu.analysis.racelint import sanitizer
+from deepspeed_tpu.testing.chaos import sync_point
+
+
+def seeded_race() -> dict:
+    """Two threads mutate one dict, each under a DIFFERENT lock."""
+    stats: dict = {}
+    sanitizer.watch_object(stats, "dyn_fixtures::race_stats")
+    locks = {"a": sanitizer.make_lock("dyn.race.a"),
+             "b": sanitizer.make_lock("dyn.race.b")}
+    barrier = threading.Barrier(2)
+
+    def writer(key: str) -> None:
+        for _ in range(2):
+            sync_point(f"dyn/race/{key}")
+            with locks[key]:
+                sanitizer.note_access(stats)
+                stats[key] = stats.get(key, 0) + 1
+            barrier.wait()
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in locks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return stats
+
+
+def seeded_deadlock() -> None:
+    """AB then BA acquisition orders — run sequentially, detected from
+    the order graph (no actual deadlock risk)."""
+    lock_a = sanitizer.make_lock("dyn.dead.A")
+    lock_b = sanitizer.make_lock("dyn.dead.B")
+
+    def forward() -> None:
+        with lock_a:
+            sync_point("dyn/dead/forward")
+            with lock_b:
+                pass
+
+    def backward() -> None:
+        with lock_b:
+            sync_point("dyn/dead/backward")
+            with lock_a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+
+def guarded_twin() -> dict:
+    """The healthy shape: same two-writer traffic, ONE shared lock and a
+    consistent A→B nesting — the sanitizer must add no finding."""
+    stats: dict = {}
+    sanitizer.watch_object(stats, "dyn_fixtures::guarded_stats")
+    outer = sanitizer.make_lock("dyn.ok.outer")
+    inner = sanitizer.make_lock("dyn.ok.inner")
+    barrier = threading.Barrier(2)
+
+    def writer(key: str) -> None:
+        for _ in range(2):
+            sync_point(f"dyn/ok/{key}")
+            with outer:
+                with inner:
+                    sanitizer.note_access(stats)
+                    stats[key] = stats.get(key, 0) + 1
+            barrier.wait()
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return stats

@@ -1,0 +1,22 @@
+"""racelint fixture: AB/BA lock-order cycle — potential deadlock.
+
+``transfer`` nests ``_ledger_lock`` then ``_audit_lock``;
+``audit`` nests them the other way round. Expected finding:
+``lock-order`` naming BOTH acquisition paths.
+"""
+import threading
+
+_ledger_lock = threading.Lock()
+_audit_lock = threading.Lock()
+
+
+def transfer():
+    with _ledger_lock:
+        with _audit_lock:
+            return "ok"
+
+
+def audit():
+    with _audit_lock:
+        with _ledger_lock:
+            return "ok"

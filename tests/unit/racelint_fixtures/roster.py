@@ -1,0 +1,28 @@
+"""racelint fixture: one of each thread-root kind for roster extraction.
+
+Expected roots: a ``thread`` (Worker._run), a ``timer`` (_tick), and a
+``signal`` (_on_term). No findings — nothing here shares state.
+"""
+import signal
+import threading
+
+
+def _tick():
+    return "tick"
+
+
+def _on_term(signum, frame):
+    return "term"
+
+
+class Worker:
+    def _run(self):
+        return "ran"
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+        timer = threading.Timer(5.0, _tick)
+        timer.start()
+        signal.signal(signal.SIGTERM, _on_term)
+        return t, timer

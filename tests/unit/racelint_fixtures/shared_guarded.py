@@ -1,0 +1,27 @@
+"""racelint fixture: every thread-shared attribute is COVERED — clean.
+
+Three coverage flavors the shared-state rule accepts: a ``guarded-by``
+declaration honored at the write sites, a ``# racelint: single-thread``
+claim WITH a reason, and a ``# racelint: atomic`` claim WITH a reason.
+"""
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0       # guarded-by: self._lock
+        self.epoch = 0       # racelint: single-thread — only the main loop rebinds it; the worker just reads
+        self.events = []     # racelint: atomic — list.append is GIL-atomic and the join publishes
+        self.thread = threading.Thread(target=self._run)
+        self.thread.start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+        self.events.append("ran")
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+        self.events.append("bumped")

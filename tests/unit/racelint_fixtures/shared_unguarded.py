@@ -1,0 +1,27 @@
+"""racelint fixture: thread-shared attribute with NO covering policy.
+
+``Worker.count`` is written from the spawned worker thread (``_run``)
+and from whatever thread calls ``bump()`` — no guarded-by declaration,
+no lock common to the write sites, no claim. Expected finding:
+``shared-state`` anchored on ``count``.
+
+``Worker.flips`` carries a claim WITHOUT a reason — expected finding:
+``shared-state`` anchored ``flips/unjustified-claim``.
+"""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self.flips = 0   # racelint: single-thread
+        self.thread = threading.Thread(target=self._run)
+        self.thread.start()
+
+    def _run(self):
+        self.count = self.count + 1
+        self.flips += 1
+
+    def bump(self):
+        self.count += 1
+        self.flips += 1

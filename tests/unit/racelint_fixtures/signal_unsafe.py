@@ -1,0 +1,26 @@
+"""racelint fixture: SIGTERM handler acquires a non-reentrant lock the
+main path also holds.
+
+CPython delivers signal handlers between bytecodes ON the main thread —
+if the handler fires while ``step`` holds ``_state_lock``, the
+re-acquire self-deadlocks. Expected finding: ``signal-safety``.
+"""
+import signal
+import threading
+
+_state_lock = threading.Lock()
+_state = {}
+
+
+def _on_term(signum, frame):
+    with _state_lock:
+        _state["drained"] = True
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+
+
+def step():
+    with _state_lock:
+        _state["step"] = _state.get("step", 0) + 1

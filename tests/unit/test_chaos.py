@@ -280,6 +280,71 @@ class TestChaosHarness:
         assert os.path.getsize(torn) < 64 * 4
 
 
+class TestSyncPointFuzzer:
+    """``sync_point`` + the ``seed`` action: the interleaving fuzzer's
+    grammar (``sync:<name>=seed:<s>[:<max_ms>]``), its determinism per
+    seed, and how plain fault actions compose onto sync points."""
+
+    def test_seed_grammar_parses(self):
+        plan = chaos.FaultPlan.parse("sync:a/b=seed:7")
+        assert plan.rules == {"sync:a/b": ("seed", 7, 2.0)}
+        plan = chaos.FaultPlan.parse("sync:a/b=seed:7:25")
+        assert plan.rules == {"sync:a/b": ("seed", 7, 25.0)}
+        plan = chaos.FaultPlan.parse("sync:*=seed:3:0.5")
+        assert plan.rules == {"sync:*": ("seed", 3, 0.5)}
+
+    def test_seed_refuses_non_sync_points(self):
+        # seeded delays only make sense at scheduling points — a seed
+        # rule on a fault point is a spec typo, not a plan
+        with pytest.raises(ValueError):
+            chaos.FaultPlan.parse("train/nan_grads=seed:7")
+
+    def test_seed_delay_is_deterministic_per_seed(self):
+        import random as _random
+
+        chaos.arm("sync:t/p=seed:11:40")
+        # the delay for hit N is random.Random(f"{seed}:{name}:{N}") —
+        # replayable without timing the sleep
+        expected = [
+            _random.Random(f"11:t/p:{n}").random() * 40 / 1000.0
+            for n in (1, 2)   # hit indices are 1-based
+        ]
+        assert expected[0] != expected[1]
+        t0 = time.monotonic()
+        chaos.sync_point("t/p")
+        chaos.sync_point("t/p")
+        assert time.monotonic() - t0 >= expected[0] + expected[1] - 0.01
+
+    def test_sync_wildcard_matches_any_point(self):
+        chaos.arm("sync:*=seed:5:0.1")
+        chaos.sync_point("anything/at/all")
+        chaos.sync_point("something/else")
+        # hits are accounted per POINT (the RNG's per-point hit index),
+        # not per matching rule
+        plan = chaos._resolve_plan()
+        assert plan.hits("sync:anything/at/all") == 1
+        assert plan.hits("sync:something/else") == 1
+
+    def test_exact_rule_wins_over_wildcard(self):
+        chaos.arm("sync:x/y=seed:1:0.1;sync:*=seed:2:0.1")
+        chaos.sync_point("x/y")
+        plan = chaos._resolve_plan()
+        assert plan.hits("sync:x/y") == 1
+        assert plan.hits("sync:*") == 0
+
+    def test_fault_actions_compose_on_sync_points(self):
+        # fail/hang also fire at sync points — a scheduling point can
+        # double as a crash window
+        chaos.arm("sync:q/r=fail:1")
+        with pytest.raises(chaos.ChaosError):
+            chaos.sync_point("q/r")
+        chaos.sync_point("q/r")   # budget spent
+
+    def test_unarmed_sync_point_is_free(self):
+        chaos.disarm()
+        chaos.sync_point("no/plan")   # no plan → no-op
+
+
 # --------------------------------------------------------------------- #
 # subprocess kill tests — a REAL process dies inside the crash window
 # --------------------------------------------------------------------- #

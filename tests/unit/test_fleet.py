@@ -41,9 +41,25 @@ from deepspeed_tpu.serving import (
     ServingFrontend,
 )
 from deepspeed_tpu.serving.circuit import OPEN
+from deepspeed_tpu.analysis.racelint import sanitizer as rl_sanitizer
 from deepspeed_tpu.testing import chaos
 
 pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture
+def racelint_armed():
+    """Run the chaos acceptance with the racelint DYNAMIC sanitizer
+    armed: every control-plane lock acquisition is recorded (lock-order
+    cycles, Eraser locksets) and the healthy paths must add NO finding
+    — the runtime half of the concurrency contract."""
+    rl_sanitizer.arm()
+    rl_sanitizer.reset()
+    yield
+    try:
+        rl_sanitizer.assert_clean()
+    finally:
+        rl_sanitizer.disarm()
 
 CFG = dict(hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128,
            vocab_size=512, dtype="float32")
@@ -579,7 +595,7 @@ class TestQuorumProbes:
 # the chaos acceptance run
 # --------------------------------------------------------------------- #
 @pytest.mark.overload(timeout_s=300)
-def test_chaos_kill_and_hang_staggered_zero_loss():
+def test_chaos_kill_and_hang_staggered_zero_loss(racelint_armed):
     """3 replicas under a burst at 2× one replica's capacity; one replica
     chaos-killed mid-burst, another chaos-HUNG later (staggered). Zero
     lost uids (every uid reaches exactly one terminal state), zero KV

@@ -50,9 +50,25 @@ from deepspeed_tpu.runtime.guardian import (
     AnomalyDetector,
     TrainingGuardian,
 )
+from deepspeed_tpu.analysis.racelint import sanitizer as rl_sanitizer
 from deepspeed_tpu.testing import chaos
 
 pytestmark = pytest.mark.guardian
+
+
+@pytest.fixture
+def racelint_armed():
+    """Run the chaos acceptance with the racelint DYNAMIC sanitizer
+    armed: every control-plane lock acquisition is recorded (lock-order
+    cycles, Eraser locksets) and the healthy paths must add NO finding
+    — the runtime half of the concurrency contract."""
+    rl_sanitizer.arm()
+    rl_sanitizer.reset()
+    yield
+    try:
+        rl_sanitizer.assert_clean()
+    finally:
+        rl_sanitizer.disarm()
 
 
 @pytest.fixture(autouse=True)
@@ -367,7 +383,8 @@ class TestNumericsSentinel:
 # leg 3: rollback + quarantine (chaos acceptance)
 # --------------------------------------------------------------------- #
 class TestGuardianRollback:
-    def test_nan_grads_rollback_matches_uninjected_twin(self, tmp_path):
+    def test_nan_grads_rollback_matches_uninjected_twin(
+            self, tmp_path, racelint_armed):
         """bf16 zero-3 + train/nan_grads: zero weight updates from the
         poisoned step, detection within one log cadence, rollback to the
         committed tag — and the final curve matches the uninjected twin

@@ -1,0 +1,551 @@
+"""racelint: concurrency contracts for the threaded control plane.
+
+Four legs (the PR's acceptance criteria):
+
+1. **Per-rule fixtures** — each committed file under
+   ``racelint_fixtures/`` triggers (or provably does NOT trigger) one
+   rule: shared-state, lock-order, lock-across-blocking, signal-safety,
+   roster extraction, suppressions.
+2. **CLI contract** — exit-code matrix (0 clean / 1 findings / 2
+   errors), JSON schema, ``--roster``, ``--list-rules``.
+3. **Shrink-only contracts** — the refusal matrix for
+   ``--write-contract``: added thread roots, dropped/changed guards,
+   and new lock-order edges all refuse without ``--allow-loosen``;
+   shrinking is always allowed. Plus the lint-time drift rules
+   (``thread-roster`` / ``contract-guard``).
+4. **Self-enforcement + the dynamic sanitizer** — the full racelint
+   pass over ``deepspeed_tpu/`` is clean with an EMPTY baseline, and
+   the runtime lockset/lock-order checker catches the seeded race and
+   seeded deadlock fixtures DETERMINISTICALLY under the ``sync_point``
+   interleaving fuzzer while staying silent on the guarded twin.
+"""
+import copy
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from deepspeed_tpu.analysis import racelint
+from deepspeed_tpu.analysis.racelint import sanitizer
+from deepspeed_tpu.analysis.racelint.__main__ import main as racelint_main
+from deepspeed_tpu.analysis.racelint.core import (
+    ContractError,
+    bootstrap_contract,
+    write_contract,
+)
+from deepspeed_tpu.testing import chaos
+
+pytestmark = pytest.mark.racelint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "racelint_fixtures")
+REPO = os.path.dirname(os.path.dirname(HERE))
+PKG = os.path.join(REPO, "deepspeed_tpu")
+
+
+def _lint(*names, rules=None, contract_path=None, use_contract=False):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    new, old, model = racelint.lint(
+        paths, rules=rules, use_baseline=False,
+        contract_path=contract_path, use_contract=use_contract,
+        root=FIXTURES)
+    return new, model
+
+
+def _fixture_model(*names):
+    _, model = _lint(*names, rules=["thread-roster"])
+    return model
+
+
+# ===================================================================== #
+# leg 1: per-rule fixtures
+# ===================================================================== #
+class TestRuleFixtures:
+    def test_shared_state_unguarded_fires(self):
+        findings, _ = _lint("shared_unguarded.py")
+        rules = [f.rule for f in findings]
+        assert rules == ["shared-state", "shared-state"]
+        by_anchor = {f.anchor: f for f in findings}
+        assert "Worker.flips/unjustified-claim" in by_anchor
+        [count] = [f for f in findings if "count" in f.anchor]
+        assert "2 thread roots" in count.message
+        assert "Worker._run" in count.message   # names the writing root
+
+    def test_shared_state_guarded_is_clean(self):
+        findings, model = _lint("shared_guarded.py")
+        assert findings == []
+        assert len(model.roots) == 1   # the worker thread WAS seen
+
+    def test_lock_order_cycle_names_both_paths(self):
+        findings, _ = _lint("lock_order_cycle.py")
+        assert [f.rule for f in findings] == ["lock-order"]
+        msg = findings[0].message
+        assert "transfer" in msg and "audit" in msg   # both paths named
+        assert "_ledger_lock" in msg and "_audit_lock" in msg
+
+    def test_lock_across_blocking_fires_and_suppression_holds(self):
+        findings, _ = _lint("blocking_held.py")
+        assert [f.rule for f in findings] == ["lock-across-blocking"] * 2
+        msgs = " ".join(f.message for f in findings)
+        assert "join" in msgs and "sleep" in msgs
+        # rebuild() has the justified in-source suppression -> absent
+        assert "subprocess" not in msgs
+
+    def test_signal_safety_fires(self):
+        findings, _ = _lint("signal_unsafe.py")
+        assert [f.rule for f in findings] == ["signal-safety"]
+        assert "_on_term" in findings[0].message
+        assert "_state_lock" in findings[0].message
+
+    def test_roster_extracts_all_kinds(self):
+        model = _fixture_model("roster.py")
+        kinds = sorted(r.kind for r in model.roots)
+        assert kinds == ["signal", "thread", "timer"]
+        quals = {r.qualname for r in model.roots}
+        assert quals == {"Worker._run", "_tick", "_on_term"}
+
+    def test_unknown_suppression_is_a_finding(self, tmp_path):
+        p = tmp_path / "typo.py"
+        p.write_text("x = 1   # racelint: disable=lock-ordre\n")
+        new, _, _ = racelint.lint(
+            [str(p)], use_baseline=False, use_contract=False,
+            root=str(tmp_path))
+        assert [f.rule for f in new] == ["unknown-suppression"]
+        assert "lock-ordre" in new[0].message
+
+    def test_claim_inside_string_literal_is_not_a_declaration(self, tmp_path):
+        # the RULE_DOC shape: 'guarded-by:' quoted in a string constant
+        # must not mint a guarded-inventory entry
+        p = tmp_path / "doc.py"
+        p.write_text('DOC = "writes need a # guarded-by: self._lock note"\n')
+        _, _, model = racelint.lint(
+            [str(p)], use_baseline=False, use_contract=False,
+            root=str(tmp_path))
+        assert racelint.guarded_inventory(model) == {}
+
+
+# ===================================================================== #
+# leg 2: CLI exit-code matrix
+# ===================================================================== #
+class TestCLI:
+    def test_clean_exits_0(self, capsys):
+        rc = racelint_main([os.path.join(FIXTURES, "shared_guarded.py"),
+                            "--no-contract", "--root", FIXTURES])
+        assert rc == 0
+        assert "racelint: clean" in capsys.readouterr().out
+
+    def test_findings_exit_1(self, capsys):
+        rc = racelint_main([os.path.join(FIXTURES, "shared_unguarded.py"),
+                            "--no-contract", "--root", FIXTURES])
+        assert rc == 1
+        assert "[shared-state]" in capsys.readouterr().out
+
+    def test_missing_target_exits_2(self, capsys):
+        rc = racelint_main(["/no/such/dir-racelint", "--no-contract"])
+        assert rc == 2
+        assert "error" in capsys.readouterr().err
+
+    def test_missing_contract_exits_2(self, capsys):
+        rc = racelint_main([os.path.join(FIXTURES, "shared_guarded.py"),
+                            "--contract", "/no/such/contract.json"])
+        assert rc == 2
+
+    def test_unknown_rule_exits_2(self):
+        assert racelint_main([os.path.join(FIXTURES, "shared_guarded.py"),
+                              "--no-contract", "--rules", "nope"]) == 2
+
+    def test_json_schema(self, capsys):
+        rc = racelint_main([os.path.join(FIXTURES, "blocking_held.py"),
+                            "--no-contract", "--format", "json",
+                            "--root", FIXTURES])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema_version"] == 1
+        assert {f["rule"] for f in doc["findings"]} \
+            == {"lock-across-blocking"}
+        for f in doc["findings"]:
+            assert f["key"].startswith("lock-across-blocking::")
+
+    def test_roster_flag(self, capsys):
+        rc = racelint_main([os.path.join(FIXTURES, "roster.py"),
+                            "--no-contract", "--roster",
+                            "--root", FIXTURES])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "thread:roster.py:Worker._run" in out
+        assert "timer:roster.py:_tick" in out
+        assert "signal:roster.py:_on_term" in out
+
+    def test_list_rules(self, capsys):
+        assert racelint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("shared-state", "lock-order", "lock-across-blocking",
+                     "signal-safety", "thread-roster", "contract-guard"):
+            assert rule in out
+
+
+# ===================================================================== #
+# leg 3: shrink-only contracts
+# ===================================================================== #
+class TestContract:
+    def _doc(self):
+        model = _fixture_model("roster.py", "shared_guarded.py")
+        return bootstrap_contract(model, target="fixtures")
+
+    def test_bootstrap_and_identical_rewrite_ok(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        doc = self._doc()
+        write_contract(path, doc)
+        write_contract(path, copy.deepcopy(doc))   # no-op rewrite passes
+        loaded = racelint.load_contract(path)
+        assert loaded["threads"] == doc["threads"]
+
+    def test_new_thread_root_refuses(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        doc = self._doc()
+        write_contract(path, doc)
+        grown = copy.deepcopy(doc)
+        grown["threads"].append("thread:other.py:Sneaky._run")
+        with pytest.raises(ContractError, match="new thread roots"):
+            write_contract(path, grown)
+        write_contract(path, grown, allow_loosen=True)   # the hatch
+
+    def test_dropped_and_changed_guard_refuse(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        doc = self._doc()
+        assert doc["guarded"], "fixture contract must commit a guard"
+        write_contract(path, doc)
+        key = next(iter(doc["guarded"]))
+        dropped = copy.deepcopy(doc)
+        del dropped["guarded"][key]
+        with pytest.raises(ContractError, match="guard dropped"):
+            write_contract(path, dropped)
+        changed = copy.deepcopy(doc)
+        changed["guarded"][key] = "self._other_lock"
+        with pytest.raises(ContractError, match="guard changed"):
+            write_contract(path, changed)
+
+    def test_new_lock_order_edge_refuses_but_shrink_passes(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        doc = self._doc()
+        doc["lock_order_edges"] = ["x::A -> x::B"]
+        write_contract(path, doc)
+        grown = copy.deepcopy(doc)
+        grown["lock_order_edges"].append("x::B -> x::A")
+        with pytest.raises(ContractError, match="new lock-order edges"):
+            write_contract(path, grown)
+        shrunk = copy.deepcopy(doc)
+        shrunk["lock_order_edges"] = []
+        shrunk["threads"] = []
+        write_contract(path, shrunk)   # shrinking never refuses
+
+    def test_lint_time_drift_rules(self, tmp_path):
+        # a contract committing a guard the source no longer declares,
+        # and NOT committing the fixture's thread -> both drift rules fire
+        doc = self._doc()
+        doc["threads"] = []                       # roster drift
+        doc["guarded"]["shared_guarded.py::Guarded.gone"] = "self._lock"
+        path = str(tmp_path / "drift.json")
+        write_contract(path, doc)
+        new, _ = _lint("roster.py", "shared_guarded.py",
+                       contract_path=path, use_contract=True)
+        rules = sorted({f.rule for f in new})
+        assert rules == ["contract-guard", "thread-roster"]
+
+    def test_committed_contract_edges_feed_cycle_detection(self, tmp_path):
+        # one observed edge + the opposite edge committed in the
+        # contract -> cycle, even though no single file shows both
+        doc = self._doc()
+        doc["threads"] = sorted(set(doc["threads"]))
+        doc["lock_order_edges"] = [
+            "lock_order_half.py::_b_lock -> lock_order_half.py::_a_lock"]
+        path = str(tmp_path / "edges.json")
+        write_contract(path, doc)
+        half = tmp_path / "lock_order_half.py"
+        half.write_text(
+            "import threading\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def fwd():\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n")
+        new, _, _ = racelint.lint(
+            [str(half)], use_baseline=False,
+            contract_path=path, use_contract=True, root=str(tmp_path))
+        cyc = [f for f in new if f.rule == "lock-order"]
+        assert len(cyc) == 1
+
+
+# ===================================================================== #
+# leg 4a: self-enforcement over deepspeed_tpu/
+# ===================================================================== #
+@pytest.fixture(scope="module")
+def repo_pass():
+    """ONE full-package pass shared by the self-enforcement tests — the
+    parse + cross-module reachability costs ~15s, and three identical
+    passes were pure tier-1 runtime."""
+    return racelint.lint(
+        [PKG], root=REPO, use_baseline=True, use_contract=True)
+
+
+class TestSelfEnforcement:
+    def test_repo_pass_is_clean(self, repo_pass):
+        new, old, _ = repo_pass
+        assert old == [], "the racelint baseline must stay EMPTY"
+        assert new == [], "racelint findings in deepspeed_tpu/:\n" + \
+            "\n".join(f.render() for f in new)
+
+    def test_baseline_is_empty(self):
+        with open(racelint.default_baseline_path()) as f:
+            doc = json.load(f)
+        assert doc["entries"] == []
+
+    def test_committed_contract_matches_source(self, repo_pass):
+        contract = racelint.load_contract(racelint.default_contract_path())
+        _, _, model = repo_pass
+        # the roster neither grew nor silently shrank vs the commit
+        assert sorted(r.root_id for r in model.roots) \
+            == contract["threads"]
+        assert racelint.guarded_inventory(model) == contract["guarded"]
+
+
+# ===================================================================== #
+# leg 4b: the dynamic sanitizer under the sync_point fuzzer
+# ===================================================================== #
+def _load_dyn():
+    spec = importlib.util.spec_from_file_location(
+        "racelint_dyn_fixtures", os.path.join(FIXTURES, "dyn_fixtures.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def armed_sanitizer():
+    sanitizer.arm()
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.disarm()
+    chaos.disarm()
+
+
+class TestSanitizer:
+    def test_seeded_race_caught_deterministically(self, armed_sanitizer):
+        dyn = _load_dyn()
+        for seed in (1, 2, 3):   # every schedule the fuzzer picks
+            sanitizer.reset()
+            chaos.disarm()
+            chaos.arm(f"sync:*=seed:{seed}:2")
+            stats = dyn.seeded_race()
+            assert stats == {"a": 2, "b": 2}   # the data survived...
+            fs = sanitizer.findings()
+            assert [f["rule"] for f in fs] == ["lockset-race"], \
+                f"seed {seed}: {fs}"
+            assert fs[0]["key"] == "dyn_fixtures::race_stats"
+            assert fs[0]["stack_a"] and fs[0]["stack_b"]   # both sides
+
+    def test_seeded_deadlock_caught_without_wedging(self, armed_sanitizer):
+        dyn = _load_dyn()
+        for seed in (1, 2, 3):
+            sanitizer.reset()
+            chaos.disarm()
+            chaos.arm(f"sync:*=seed:{seed}:2")
+            dyn.seeded_deadlock()   # returns: detection is order-based
+            fs = sanitizer.findings()
+            assert [f["rule"] for f in fs] == ["lock-order-cycle"], \
+                f"seed {seed}: {fs}"
+            assert "dyn.dead.A" in fs[0]["message"]
+            assert "dyn.dead.B" in fs[0]["message"]
+            # BOTH acquisition paths carry stacks
+            assert fs[0]["path_a_stacks"][1] and fs[0]["path_b_stacks"][1]
+
+    def test_guarded_twin_is_silent(self, armed_sanitizer):
+        dyn = _load_dyn()
+        chaos.arm("sync:*=seed:9:2")
+        stats = dyn.guarded_twin()
+        assert stats == {"a": 2, "b": 2}
+        sanitizer.assert_clean()   # no findings on the healthy path
+
+    def test_assert_clean_raises_with_rendered_findings(
+            self, armed_sanitizer):
+        a = sanitizer.make_lock("t.A")
+        b = sanitizer.make_lock("t.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        with pytest.raises(AssertionError, match="lock-order-cycle"):
+            sanitizer.assert_clean()
+
+    def test_disarmed_records_nothing(self):
+        sanitizer.disarm()
+        a = sanitizer.make_lock("off.A")
+        b = sanitizer.make_lock("off.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert sanitizer.findings() == []
+
+    def test_reentrant_lock_self_nesting_is_not_an_edge(
+            self, armed_sanitizer):
+        r = sanitizer.make_lock("t.R", reentrant=True)
+        with r:
+            with r:
+                pass
+        assert sanitizer.findings() == []
+
+    def test_env_arming(self, monkeypatch):
+        sanitizer.disarm()
+        monkeypatch.setenv("DSTPU_RACELINT", "1")
+        # force the lazy env re-check
+        sanitizer._env_checked = False
+        sanitizer._armed = False
+        assert sanitizer.armed()
+        sanitizer.disarm()
+
+    def test_static_model_understands_make_lock_factory(self):
+        # the converted construction sites keep their canonical identity
+        # in the static lock inventory (lockmodel._constructed_kind)
+        _, _, model = racelint.lint(
+            [os.path.join(PKG, "telemetry", "registry.py")],
+            root=REPO, use_baseline=False, use_contract=False)
+        assert model.locks.get(
+            "deepspeed_tpu/telemetry/registry.py::MetricsRegistry._lock"
+        ) == "rlock"
+
+
+class TestShutdownAudit:
+    """Pin the close()/shutdown-ordering fixes from the concurrency
+    audit: idempotent close, join-with-timeout, and NO lock held across
+    a join — each one a regression that used to hang or double-free."""
+
+    def test_metrics_server_stop_is_idempotent(self):
+        from deepspeed_tpu.telemetry.exposition import MetricsServer
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+        server = MetricsServer(MetricsRegistry())
+        server.stop()
+        server.stop()   # used to double-close a dead socket
+
+    def test_stop_metrics_server_is_idempotent(self):
+        from deepspeed_tpu.telemetry import exposition
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+        exposition.start_metrics_server(MetricsRegistry())
+        exposition.stop_metrics_server()
+        exposition.stop_metrics_server()   # popped → no-op
+        assert exposition._server is None
+
+    def test_decoupled_engine_third_close_returns(self):
+        # pre-fix: the 2nd close() put a 2nd None into the queue after
+        # the drain thread had exited; the 3rd then blocked FOREVER on a
+        # full queue with nobody draining it.
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            DecoupledCheckpointEngine,
+            FastCheckpointEngine,
+        )
+        import threading
+
+        eng = DecoupledCheckpointEngine(
+            inner=FastCheckpointEngine(n_threads=1), max_queue=1)
+        t = threading.Thread(
+            target=lambda: [eng.close() for _ in range(3)], daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "third close() wedged on a full queue"
+
+    def test_watchdog_stop_idempotent_and_restartable(self):
+        import time
+
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+        from deepspeed_tpu.telemetry.spans import StallWatchdog
+
+        wd = StallWatchdog(deadline_s=30, registry=MetricsRegistry())
+        wd.start()
+        wd.stop()
+        wd.stop()   # popped → no-op, no double-join
+        # restart: start() must clear the stop event or the new thread
+        # exits its wait-loop immediately
+        wd.start()
+        time.sleep(0.05)
+        assert wd._thread is not None and wd._thread.is_alive()
+        wd.stop()
+        assert wd._thread is None
+
+    def test_finalize_async_joins_outside_save_lock(self):
+        # pin: while finalize_async is blocked joining the writer
+        # thread, a concurrent saver can still take _save_lock — the
+        # SIGTERM emergency-save path must not stall behind a drain.
+        import threading
+
+        from deepspeed_tpu.checkpoint import engine as ckpt_engine
+
+        release = threading.Event()
+        writer = threading.Thread(target=release.wait, daemon=True)
+        writer.start()
+        with ckpt_engine._save_lock:
+            ckpt_engine._async_thread = writer
+        fin = threading.Thread(target=ckpt_engine.finalize_async,
+                               daemon=True)
+        fin.start()
+        try:
+            # wait until the finalizer has popped the thread (i.e. is
+            # inside — or past — its unlocked join)
+            deadline = 100
+            while deadline and ckpt_engine._async_thread is not None:
+                deadline -= 1
+                threading.Event().wait(0.01)
+            assert ckpt_engine._async_thread is None
+            got = ckpt_engine._save_lock.acquire(timeout=2)
+            assert got, "_save_lock held across the finalize join"
+            ckpt_engine._save_lock.release()
+        finally:
+            release.set()
+            fin.join(timeout=5)
+        assert not fin.is_alive()
+
+    def test_tracer_export_concurrent_with_request_mutation(self):
+        # pin the scrape-vs-mutate fix: export_chrome snapshots AND
+        # renders under Tracer._lock, so a concurrent request_end
+        # mutating rec.attrs/points can't blow up the render loop.
+        import threading
+
+        from deepspeed_tpu.telemetry.tracing import Tracer
+
+        tracer = Tracer(enabled=True, capacity=64)
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            uid = 0
+            while not stop.is_set():
+                uid += 1
+                try:
+                    tracer.request_begin(uid, tenant="t")
+                    tracer.request_event(uid, "hop", k=uid)
+                    tracer.request_end(uid, "ok", extra="x" * 8)
+                except Exception as e:   # pragma: no cover - the pin
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=churn, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                doc = tracer.export_chrome()
+                assert isinstance(doc, dict)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+        assert errors == []

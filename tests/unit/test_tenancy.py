@@ -57,9 +57,25 @@ from deepspeed_tpu.serving.tenancy import (
     TenantRegistry,
     TokenBucket,
 )
+from deepspeed_tpu.analysis.racelint import sanitizer as rl_sanitizer
 from deepspeed_tpu.testing import chaos
 
 pytestmark = pytest.mark.tenancy
+
+
+@pytest.fixture
+def racelint_armed():
+    """Run the chaos acceptance with the racelint DYNAMIC sanitizer
+    armed: every control-plane lock acquisition is recorded (lock-order
+    cycles, Eraser locksets) and the healthy paths must add NO finding
+    — the runtime half of the concurrency contract."""
+    rl_sanitizer.arm()
+    rl_sanitizer.reset()
+    yield
+    try:
+        rl_sanitizer.assert_clean()
+    finally:
+        rl_sanitizer.disarm()
 
 CFG = dict(hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128,
            vocab_size=512, dtype="float32")
@@ -725,7 +741,8 @@ class TestChaosAcceptance:
         }}
 
     @pytest.mark.overload(timeout_s=300)
-    def test_hot_tenant_burst_isolation_through_kill_and_resize(self):
+    def test_hot_tenant_burst_isolation_through_kill_and_resize(
+            self, racelint_armed):
         """THE acceptance run: 3-replica fleet, burst traffic with one
         batch-tier tenant flooding ~10x its quota, one replica killed
         AND one autoscale resize mid-burst. The excess resolves to

@@ -596,7 +596,13 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
     work fails over). Reported: p50/p99 TTFT for surviving traffic,
     terminal-outcome counts, failover count, and ``requests_lost`` —
     the count of uids that reached NO terminal state, which the fleet's
-    zero-loss guarantee pins at 0."""
+    zero-loss guarantee pins at 0.
+
+    With the fleet observatory attached (default; ``BENCH_SLO=0``
+    disables, mirroring BENCH_OVERLAP) the row also embeds a
+    schema-v2.6 ``slo`` block: burn-rate verdicts per objective and the
+    goodput/wasted token reconciliation — ``fleet-report <file>``
+    renders it."""
     import jax
     import numpy as np
 
@@ -604,7 +610,18 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
     from deepspeed_tpu.inference.fastgen import FastGenEngine
     from deepspeed_tpu.models import transformer as T
     from deepspeed_tpu.serving.fleet import FleetRouter
+    from deepspeed_tpu.serving.observatory import slo_bench_block
     from deepspeed_tpu.testing import chaos
+
+    # A/B switch for the SLO/observatory layer: two runs differing only
+    # in this knob isolate its (intended-zero) hot-path cost
+    want_slo = os.environ.get("BENCH_SLO", "1") != "0"
+    slo_cfg = {"objectives": [
+        {"name": "fleet_ttft", "metric": "ttft_p99_s",
+         "threshold_s": 10.0, "target": 0.99},
+        {"name": "availability", "metric": "availability",
+         "target": 0.95},
+    ]} if want_slo else None
 
     rng = np.random.default_rng(0)
     lens = [int(x) for x in rng.integers(16, 96, n_req)]
@@ -630,7 +647,8 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
                         "circuit_backoff_s": 0.2,
                         "circuit_backoff_max_s": 2.0},
         fleet_config={"min_ready_replicas": 2, "max_attempts": 4,
-                      "retry_backoff_s": 0.05, "retry_backoff_max_s": 0.5})
+                      "retry_backoff_s": 0.05, "retry_backoff_max_s": 0.5},
+        slo_config=slo_cfg)
     try:
         # warm the exact tick programs the fleet drives (step-path only —
         # generate_all's fused decode scans never run under run_tick);
@@ -678,6 +696,9 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
                     done_at[uid] = now
             if pending and not fleet.active_count():
                 time.sleep(max(0.0, min(0.005, pending[0][0] - now)))
+        # snapshot the observatory BEFORE close (shutdown force-fails
+        # would re-attribute any straggler's tokens as evicted waste)
+        slo_block = slo_bench_block(fleet) if want_slo else None
     finally:
         chaos.disarm()
         fleet.close()
@@ -708,6 +729,8 @@ def fleet_sla_bench(model="gpt2_125m", n_req=12, max_new=12,
         "requests_lost": len(submitted) - len(states),
         "single_replica_referent": "fastgen_sla_poisson_gpt2",
     }
+    if slo_block is not None:
+        out["slo"] = slo_block
     for s, n in sorted(counts.items()):
         if s != "completed":
             out[f"outcome_{s}"] = n
@@ -733,7 +756,12 @@ def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
     ``tenants`` block — per-tenant submitted / terminal-outcome counts
     (pulled from the fleet's own ``fleet_tenant_*`` counters, so the row
     IS the accounting the reconciliation invariant pins) plus per-tenant
-    TTFT p50/p99 — and the fleet-wide ``requests_lost`` zero-loss pin."""
+    TTFT p50/p99 — and the fleet-wide ``requests_lost`` zero-loss pin.
+
+    With the observatory attached (``BENCH_SLO=0`` disables) the row
+    also embeds a schema-v2.6 ``slo`` block whose objectives include a
+    TENANT-scoped TTFT (the realtime tenant) — burn verdicts prove the
+    flooder's excess never spent the realtime tenant's error budget."""
     import jax
     import numpy as np
 
@@ -741,7 +769,16 @@ def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
     from deepspeed_tpu.inference.fastgen import FastGenEngine
     from deepspeed_tpu.models import transformer as T
     from deepspeed_tpu.serving.fleet import FleetRouter
+    from deepspeed_tpu.serving.observatory import slo_bench_block
     from deepspeed_tpu.testing import chaos
+
+    want_slo = os.environ.get("BENCH_SLO", "1") != "0"
+    slo_cfg = {"objectives": [
+        {"name": "rt_ttft", "metric": "ttft_p99_s", "tenant": "rt",
+         "threshold_s": 10.0, "target": 0.99},
+        {"name": "availability", "metric": "availability",
+         "target": 0.95},
+    ]} if want_slo else None
 
     rng = np.random.default_rng(0)
     lens = [int(x) for x in rng.integers(16, 96, n_req)]
@@ -775,7 +812,8 @@ def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
                 # excess must bounce with tenant-scoped retry-afters
                 "hot": {"tier": "batch", "requests_per_s": 1.0,
                         "burst_requests": 3},
-            }})
+            }},
+        slo_config=slo_cfg)
     try:
         for i, fe in enumerate(fleet.replicas()):
             fe.submit(900 + i, prompts[0][:90], max_new_tokens=max_new)
@@ -840,6 +878,7 @@ def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
                 row["ttft_p99_s"] = round(
                     tts[min(len(tts) - 1, int(len(tts) * 0.99))], 3)
             tenant_rows[ten] = row
+        slo_block = slo_bench_block(fleet) if want_slo else None
     finally:
         chaos.disarm()
         fleet.close()
@@ -861,6 +900,8 @@ def fleet_sla_multitenant_bench(model="gpt2_125m", n_req=18, max_new=12,
         "tenants": tenant_rows,
         "single_replica_referent": "fleet_sla_poisson_gpt2",
     }
+    if slo_block is not None:
+        out["slo"] = slo_block
     for s, n in sorted(counts.items()):
         if s != "completed":
             out[f"outcome_{s}"] = n

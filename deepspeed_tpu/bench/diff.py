@@ -69,6 +69,17 @@ def metric_direction(name: str) -> Optional[int]:
         # health improving — an anomaly-ridden round flags loudly (a
         # 0 -> nonzero move surfaces as the explicit zero-baseline row)
         return LOWER_IS_BETTER
+    if name.startswith("slo."):
+        # fleet-observatory accounting: delivering more of what was
+        # computed is the win, burning budget / wasting compute is the
+        # regression. prefix_hit_rate rising means more reuse headroom
+        # was measured, not captured — no direction.
+        if leaf in ("goodput_tokens", "goodput_fraction"):
+            return HIGHER_IS_BETTER
+        if leaf == "worst_burn_rate" or name.startswith(
+                "slo.wasted_tokens."):
+            return LOWER_IS_BETTER
+        return None
     if leaf == "overlap_fraction":
         # fraction of collective time hidden under compute — the ROADMAP
         # item 2 before/after metric
@@ -115,7 +126,7 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
     head_metrics = flatten_metrics(
         {k: v for k, v in head.items()
          if k not in ("trace_phases", "telemetry", "best_row", "memory",
-                      "comms", "guardian")})
+                      "comms", "guardian", "slo")})
     if "memory" in head:
         head_metrics.update(flatten_metrics(head["memory"], "memory"))
     if "comms" in head:
@@ -141,6 +152,8 @@ def comparables(result: Dict[str, Any]) -> Dict[str, Any]:
             metrics.update(flatten_metrics(entry["comms"], "comms"))
         if "guardian" in entry:
             metrics.update(flatten_metrics(entry["guardian"], "guardian"))
+        if "slo" in entry:
+            metrics.update(flatten_metrics(entry["slo"], "slo"))
         if is_number(entry.get("overlap_fraction")):
             metrics["overlap_fraction"] = float(entry["overlap_fraction"])
         out["entries"][name] = {

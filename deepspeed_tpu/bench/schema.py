@@ -99,19 +99,39 @@ and validate unchanged::
 carried by the ``fleet_sla_multitenant_gpt2`` lane. The per-tenant
 reconciliation (submitted == Σ outcomes) is validated structurally here —
 a tenants block that doesn't reconcile is an invalid result.
+
+Schema v2.6 adds one more OPTIONAL per-entry key — earlier records load
+and validate unchanged::
+
+    "slo": {                # fleet-observatory SLO + goodput accounting
+      "objectives": [ {"name": str, "metric": str, ...}, ... ],
+      "verdicts": {name: "ok"|"firing"|"fired_and_cleared"|"no_data"},
+      "worst_burn_rate": number,        # >= 0
+      "goodput_tokens": int,            # tokens computed AND delivered
+      "wasted_tokens": {reason: int},   # reasons from WASTE_REASONS
+      "computed_tokens": int,  # MUST equal goodput + Σ wasted exactly
+      "goodput_fraction": number|null,
+      "prefix_hit_rate": number|null,   # optional, in [0, 1]
+    },
+
+embedded by the fleet lanes (opt out with ``BENCH_SLO=0``). The goodput
+reconciliation (goodput + Σ wasted == computed) is validated EXACTLY —
+an slo block that doesn't reconcile is an invalid result, same contract
+as the tenants block.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-SCHEMA_VERSION = 2.5
+SCHEMA_VERSION = 2.6
 
 #: versions validate_result accepts — v2 records predate the ``comms``
 #: block, v2.1 the ``guardian`` block, v2.2 the ``plan`` block
 #: (autotune plan-cache verdict per entry), v2.3 the ``elastic`` block
 #: (world-elastic resume wall times), v2.4 the ``tenants`` block
-#: (per-tenant QoS accounting); otherwise shape-identical
-SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3, 2.4, 2.5)
+#: (per-tenant QoS accounting), v2.5 the ``slo`` block (fleet-observatory
+#: SLO verdicts + goodput reconciliation); otherwise shape-identical
+SUPPORTED_SCHEMA_VERSIONS = (2, 2.1, 2.2, 2.3, 2.4, 2.5, 2.6)
 
 #: history records (one JSONL line each) wrap a result with provenance
 RECORD_VERSION = 1
@@ -121,7 +141,7 @@ RECORD_VERSION = 1
 ENTRY_STRUCTURAL_KEYS = ("metrics", "trace_phases", "telemetry", "memory",
                          "elapsed_s", "skipped_reason", "error", "note",
                          "comms", "overlap_fraction", "guardian", "plan",
-                         "elastic", "tenants")
+                         "elastic", "tenants", "slo")
 
 _PHASE_STAT_KEYS = ("count", "total_s", "p50_s", "p95_s", "p99_s")
 
@@ -306,6 +326,89 @@ def validate_tenants_block(block: Any, where: str) -> List[str]:
     return errs
 
 
+#: waste attributions a v2.6 ``slo`` block may carry — mirrors
+#: ``deepspeed_tpu.serving.observatory.WASTE_REASONS`` (kept literal
+#: here so validating a result never imports the serving stack)
+SLO_WASTE_REASONS = ("hedge_lost", "failover_replay", "evicted", "shed")
+
+_SLO_VERDICTS = ("ok", "firing", "fired_and_cleared", "no_data")
+
+
+def validate_slo_block(block: Any, where: str) -> List[str]:
+    """Validate a v2.6 ``slo`` block. The goodput reconciliation is
+    exact: goodput_tokens + Σ wasted_tokens == computed_tokens, same
+    zero-tolerance contract as the tenants block."""
+    if not isinstance(block, dict):
+        return [f"{where}: slo must be a dict"]
+    errs: List[str] = []
+    objectives = block.get("objectives", [])
+    if not isinstance(objectives, list):
+        errs.append(f"{where}: slo.objectives must be a list")
+    else:
+        for i, obj in enumerate(objectives):
+            if not isinstance(obj, dict) or not isinstance(
+                    obj.get("name"), str) or not obj.get("name"):
+                errs.append(f"{where}: slo.objectives[{i}] must be a dict "
+                            "with a non-empty 'name'")
+    verdicts = block.get("verdicts", {})
+    if not isinstance(verdicts, dict):
+        errs.append(f"{where}: slo.verdicts must be a dict")
+    else:
+        for name, v in verdicts.items():
+            if v not in _SLO_VERDICTS:
+                errs.append(f"{where}: slo.verdicts[{name!r}] must be one "
+                            f"of {_SLO_VERDICTS}, got {v!r}")
+    if "worst_burn_rate" in block and (
+            not is_number(block["worst_burn_rate"])
+            or block["worst_burn_rate"] < 0):
+        errs.append(f"{where}: slo.worst_burn_rate must be a non-negative "
+                    "number")
+    counts: Dict[str, int] = {}
+    for key in ("goodput_tokens", "computed_tokens"):
+        val = block.get(key)
+        if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+            errs.append(f"{where}: slo.{key} must be a non-negative int")
+        else:
+            counts[key] = val
+    wasted = block.get("wasted_tokens")
+    wasted_total: Optional[int] = None
+    if not isinstance(wasted, dict):
+        errs.append(f"{where}: slo.wasted_tokens must be a dict")
+    else:
+        wasted_total = 0
+        for reason, n in wasted.items():
+            if reason not in SLO_WASTE_REASONS:
+                errs.append(f"{where}: slo.wasted_tokens[{reason!r}] is "
+                            f"not a known reason {SLO_WASTE_REASONS}")
+                wasted_total = None
+                continue
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+                errs.append(f"{where}: slo.wasted_tokens[{reason!r}] must "
+                            "be a non-negative int")
+                wasted_total = None
+                continue
+            if wasted_total is not None:
+                wasted_total += n
+    if ("goodput_tokens" in counts and "computed_tokens" in counts
+            and wasted_total is not None):
+        total = counts["goodput_tokens"] + wasted_total
+        if total != counts["computed_tokens"]:
+            errs.append(
+                f"{where}: slo does not reconcile: goodput + wasted = "
+                f"{total} but computed_tokens={counts['computed_tokens']}")
+    if "goodput_fraction" in block and block["goodput_fraction"] is not None:
+        gf = block["goodput_fraction"]
+        if not is_number(gf) or not (0.0 <= float(gf) <= 1.0):
+            errs.append(f"{where}: slo.goodput_fraction must be a number "
+                        "in [0, 1] or null")
+    if "prefix_hit_rate" in block and block["prefix_hit_rate"] is not None:
+        pr = block["prefix_hit_rate"]
+        if not is_number(pr) or not (0.0 <= float(pr) <= 1.0):
+            errs.append(f"{where}: slo.prefix_hit_rate must be a number "
+                        "in [0, 1] or null")
+    return errs
+
+
 def validate_overlap_fraction(frac: Any, where: str) -> List[str]:
     if not is_number(frac) or not (0.0 <= float(frac) <= 1.0):
         return [f"{where}: overlap_fraction must be a number in [0, 1]"]
@@ -352,6 +455,8 @@ def validate_entry(entry: Any, name: str) -> List[str]:
         errs += validate_elastic_block(entry["elastic"], where)
     if "tenants" in entry:
         errs += validate_tenants_block(entry["tenants"], where)
+    if "slo" in entry:
+        errs += validate_slo_block(entry["slo"], where)
     return errs
 
 
@@ -495,7 +600,7 @@ def normalize_entry_row(row: Any,
     if "error" in row:
         out["error"] = str(row.pop("error"))
     for key in ("trace_phases", "telemetry", "memory", "comms", "guardian",
-                "plan", "elastic", "tenants"):
+                "plan", "elastic", "tenants", "slo"):
         if key in row:
             val = row.pop(key)
             if val:

@@ -25,6 +25,7 @@ prompts stream in (the SplitFuse headline property).
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -233,6 +234,12 @@ class FastGenEngine:
         # large model) would blend into one lifetime mean there
         self._tok_lat_sum = 0.0
         self._tok_lat_n = 0
+        # sliding-window twin (ring of (interval, sum, n) over ~60s):
+        # est_token_seconds prefers the windowed mean so one slow warmup
+        # tick can't skew routing scores and retry-after hints forever
+        self._tok_lat_win: collections.deque = collections.deque()
+        self._tok_lat_win_interval_s = 10.0
+        self._tok_lat_win_intervals = 6
         self._tm_ticks = telemetry.counter(
             "fastgen_ticks_total",
             "engine ticks by kind (mixed SplitFuse / fused decode / "
@@ -306,6 +313,14 @@ class FastGenEngine:
         self._tm_tok_lat.observe(per_token_s, n=n)
         self._tok_lat_sum += per_token_s * n
         self._tok_lat_n += n
+        idx = int(time.perf_counter() // self._tok_lat_win_interval_s)
+        ring = self._tok_lat_win
+        if not ring or ring[-1][0] != idx:
+            ring.append([idx, 0.0, 0])
+        while ring and ring[0][0] <= idx - self._tok_lat_win_intervals:
+            ring.popleft()
+        ring[-1][1] += per_token_s * n
+        ring[-1][2] += n
 
     def _tm_first_token(self, seq: _Seq) -> None:
         if not seq.first_tok_seen:
@@ -778,9 +793,20 @@ class FastGenEngine:
         before the first warm tick/window lands) — what the serving
         front-end turns into retry-after hints and deadline-slack
         estimates. Deliberately per-engine, not the process-global
-        histogram: two engines in one process must not blend rates."""
+        histogram: two engines in one process must not blend rates.
+        Prefers the sliding-window mean (last ~60s) so one slow warmup
+        tick can't skew routing scores forever; the lifetime mean is the
+        fallback once the window has gone quiet."""
         if self._tok_lat_n == 0:
             return None
+        now_idx = int(time.perf_counter() // self._tok_lat_win_interval_s)
+        win_sum = win_n = 0
+        for idx, s, n in self._tok_lat_win:
+            if idx > now_idx - self._tok_lat_win_intervals:
+                win_sum += s
+                win_n += n
+        if win_n:
+            return win_sum / win_n
         return self._tok_lat_sum / self._tok_lat_n
 
     def _snapshot_host(self, seqs) -> tuple:

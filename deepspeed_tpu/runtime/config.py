@@ -778,6 +778,129 @@ class TenancySectionConfig:
 
 
 @dataclasses.dataclass
+class SloObjectiveConfig:
+    """One declarative objective inside ``slo.objectives`` (see
+    :class:`SloSectionConfig`). ``metric`` picks the measured signal:
+    ``ttft_p99_s`` (queue-wait to first service), ``decode_token_p99_s``
+    (per-token decode latency) — both latency objectives need a
+    ``threshold_s`` — or ``availability`` (fraction of terminal requests
+    that completed). ``target`` is the objective itself (e.g. 0.99 =
+    "99% of requests under threshold" / "99% of requests succeed");
+    burn rate is bad-fraction divided by the (1 - target) error budget.
+    ``tenant`` scopes the objective to one tenant's traffic ("" =
+    fleet-wide)."""
+    name: str = ""
+    metric: str = "ttft_p99_s"  # ttft_p99_s | decode_token_p99_s | availability
+    threshold_s: float = 0.0
+    target: float = 0.99
+    tenant: str = ""
+
+    def validate(self) -> None:
+        if not self.name:
+            raise DeepSpeedConfigError(
+                "slo objective entries need a non-empty name (alert "
+                "state and report rows are keyed by it)")
+        if self.metric not in ("ttft_p99_s", "decode_token_p99_s",
+                               "availability"):
+            raise DeepSpeedConfigError(
+                f"slo objective {self.name!r} metric must be ttft_p99_s|"
+                f"decode_token_p99_s|availability, got {self.metric!r}")
+        if not (0.0 < self.target < 1.0):
+            raise DeepSpeedConfigError(
+                f"slo objective {self.name!r} target must be in (0, 1) — "
+                "a target of 1.0 leaves a zero error budget and every "
+                f"burn rate divides by zero — got {self.target}")
+        if self.metric != "availability" and self.threshold_s <= 0:
+            raise DeepSpeedConfigError(
+                f"slo objective {self.name!r} ({self.metric}) needs "
+                f"threshold_s > 0, got {self.threshold_s}")
+
+
+@dataclasses.dataclass
+class SloSectionConfig:
+    """SLO burn-rate engine (``serving/observatory/slo.py``; README
+    "Fleet observatory").
+
+    ``objectives`` is a list of :class:`SloObjectiveConfig` dicts.
+    Each objective is evaluated SRE-workbook style over TWO sliding
+    windows (``fast_window_s`` / ``slow_window_s``): an alert FIRES only
+    while BOTH windows burn error budget faster than
+    ``burn_rate_threshold`` (fast window = responsive, slow window =
+    de-flappers), and clears as soon as either recovers. The
+    request-lifecycle ring keeps the last ``ledger_size`` terminal
+    records (availability objectives and the fleet-report CLI read it).
+    Actions are observe-only by default: ``autoscale_on_burn`` lets a
+    firing objective become a ``slo_burn`` scale-out reason for the
+    ``FleetAutoscaler``; ``shed_on_burn`` tightens the admission
+    ladder's queue bound by ``shed_tighten_frac`` while any objective
+    fires. Both default False so the engine provably changes no
+    decision until the operator opts in."""
+    enabled: bool = True
+    objectives: List[Any] = dataclasses.field(default_factory=list)
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_rate_threshold: float = 14.4
+    ledger_size: int = 2048
+    autoscale_on_burn: bool = False
+    shed_on_burn: bool = False
+    shed_tighten_frac: float = 0.25
+
+    def validate(self) -> None:
+        if not isinstance(self.objectives, list):
+            raise DeepSpeedConfigError(
+                "slo.objectives must be a list of objective entries, got "
+                f"{type(self.objectives).__name__}")
+        if not (0 < self.fast_window_s < self.slow_window_s):
+            raise DeepSpeedConfigError(
+                "slo windows must satisfy 0 < fast_window_s < "
+                f"slow_window_s, got {self.fast_window_s} / "
+                f"{self.slow_window_s}")
+        if self.burn_rate_threshold <= 0:
+            raise DeepSpeedConfigError(
+                "slo.burn_rate_threshold must be > 0, got "
+                f"{self.burn_rate_threshold}")
+        if self.ledger_size < 1:
+            raise DeepSpeedConfigError(
+                f"slo.ledger_size must be >= 1, got {self.ledger_size}")
+        if not (0.0 <= self.shed_tighten_frac < 1.0):
+            raise DeepSpeedConfigError(
+                "slo.shed_tighten_frac must be in [0, 1) — tightening by "
+                "a full 1.0 would close the queue entirely — got "
+                f"{self.shed_tighten_frac}")
+        names = set()
+        for entry in self.objectives:
+            if isinstance(entry, SloObjectiveConfig):
+                obj = entry
+                obj.validate()
+            elif isinstance(entry, dict):
+                from deepspeed_tpu.runtime.config_utils import (
+                    config_from_dict as _cfd)
+                obj = _cfd(SloObjectiveConfig, entry, path="slo.objectives.")
+            else:
+                raise DeepSpeedConfigError(
+                    "slo.objectives entries must be dicts, got "
+                    f"{type(entry).__name__}")
+            if obj.name in names:
+                raise DeepSpeedConfigError(
+                    f"slo.objectives has duplicate name {obj.name!r}")
+            names.add(obj.name)
+
+    def parsed_objectives(self) -> List[SloObjectiveConfig]:
+        """The objectives as validated dataclasses (dict entries from a
+        JSON config are built here; already-typed entries pass through)."""
+        out: List[SloObjectiveConfig] = []
+        for entry in self.objectives:
+            if isinstance(entry, SloObjectiveConfig):
+                out.append(entry)
+            else:
+                from deepspeed_tpu.runtime.config_utils import (
+                    config_from_dict as _cfd)
+                out.append(_cfd(SloObjectiveConfig, entry,
+                                path="slo.objectives."))
+        return out
+
+
+@dataclasses.dataclass
 class CheckpointSectionConfig:
     """Durable-checkpoint knobs (``checkpoint/fault_tolerance.py``).
 
@@ -1086,6 +1209,8 @@ class DeepSpeedTPUConfig:
         default_factory=FleetSectionConfig)
     tenancy: TenancySectionConfig = dataclasses.field(
         default_factory=TenancySectionConfig)
+    slo: SloSectionConfig = dataclasses.field(
+        default_factory=SloSectionConfig)
     hlolint: HlolintSectionConfig = dataclasses.field(
         default_factory=HlolintSectionConfig)
     memlint: MemlintSectionConfig = dataclasses.field(

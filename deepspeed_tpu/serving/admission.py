@@ -112,9 +112,18 @@ class AdmissionController:
 
     # ------------------------------------------------------------------ #
     def overload_reason(self, queue_len: int,
-                        projected_kv_util: float) -> Optional[str]:
-        """Why this admission would overload the engine (None = fits)."""
-        if queue_len >= self.max_queue:
+                        projected_kv_util: float,
+                        tighten: float = 0.0) -> Optional[str]:
+        """Why this admission would overload the engine (None = fits).
+
+        ``tighten`` fractionally shrinks the queue bound (0.25 → admit
+        to 75% of ``max_queue``) — the SLO burn-rate engine's opt-in
+        shed hint while an alert fires; the floor of 1 keeps a tightened
+        replica serving rather than bricked."""
+        bound = self.max_queue
+        if tighten > 0.0:
+            bound = max(1, int(self.max_queue * (1.0 - tighten)))
+        if queue_len >= bound:
             return REASON_QUEUE_FULL
         if projected_kv_util > self.kv_high_watermark:
             return REASON_KV_PRESSURE

@@ -75,6 +75,11 @@ from deepspeed_tpu.serving.frontend import (
     RequestResult,
     ServingFrontend,
 )
+from deepspeed_tpu.serving.observatory import (
+    FleetObservatory,
+    PrefixMeter,
+    SloEngine,
+)
 from deepspeed_tpu.serving.tenancy import TenantRegistry
 from deepspeed_tpu.utils.logging import logger
 
@@ -129,7 +134,8 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence[ServingFrontend], config=None,
                  clock=time.monotonic, register_health: bool = True,
-                 health_name: str = "fleet", seed: int = 0, tenancy=None):
+                 health_name: str = "fleet", seed: int = 0, tenancy=None,
+                 slo=None):
         from deepspeed_tpu.runtime.config import FleetSectionConfig
         from deepspeed_tpu.runtime.config_utils import config_from_dict
 
@@ -159,8 +165,19 @@ class FleetRouter:
             self.tenancy = self._replicas[0].frontend.tenancy
         else:
             self.tenancy = TenantRegistry.ensure(tenancy, clock=clock)
+        # fleet observatory (serving/observatory): lifecycle ledger +
+        # goodput accounting, the SLO burn-rate engine over it (``slo``
+        # is an SloSectionConfig / dict / None — observe-only defaults),
+        # and the prefix-opportunity meter at the fleet door
+        self.slo = SloEngine(config=slo, tenancy=self.tenancy, clock=clock)
+        self.observatory = FleetObservatory(
+            clock=clock, ledger_size=self.slo.cfg.ledger_size)
+        self.slo.observatory = self.observatory
+        self.observatory.slo = self.slo
+        self.prefix = PrefixMeter()
         for rep in self._replicas:
             rep.frontend.adopt_tenancy(self.tenancy)
+            rep.frontend.observatory = self.observatory
         self._active: Dict[int, _FleetRequest] = {}
         # terminal records, insertion-ordered and bounded (same contract
         # as the frontend's result map — sustained overload must not grow
@@ -175,11 +192,14 @@ class FleetRouter:
             self.health_name = name
             telemetry.register_health_probe("live", name, self.liveness)
             telemetry.register_health_probe("ready", name, self.readiness)
+            # /slo rides the same opt-in as the health probes: a fleet
+            # that registers endpoints registers all of them
+            self.slo.register_endpoint()
 
     @classmethod
     def build(cls, engines: Sequence, serving_config=None, fleet_config=None,
               replica_prefix: str = "replica", tenancy_config=None,
-              **kw) -> "FleetRouter":
+              slo_config=None, **kw) -> "FleetRouter":
         """Convenience: wrap N engines in frontends named
         ``{prefix}-{i}`` (distinct names scope per-replica chaos and
         de-synchronize circuit jitter) and route over them. The replicas
@@ -193,7 +213,24 @@ class FleetRouter:
                                register_health=False,
                                health_name=f"{replica_prefix}-{i}")
                for i, eng in enumerate(engines)]
-        return cls(fes, config=fleet_config, tenancy=tenancy_config, **kw)
+        return cls(fes, config=fleet_config, tenancy=tenancy_config,
+                   slo=slo_config, **kw)
+
+    @classmethod
+    def from_ds_config(cls, engines: Sequence, config,
+                       **kw) -> "FleetRouter":
+        """Build from a full runtime config (dict / JSON path /
+        ``DeepSpeedTPUConfig``), using its ``"serving"``, ``"fleet"``,
+        ``"tenancy"`` and ``"slo"`` sections — the deploy-file twin of
+        :meth:`build` (mirrors ``ServingFrontend.from_ds_config``)."""
+        from deepspeed_tpu.runtime.config import load_config
+
+        full_cfg = load_config(config)
+        kw.setdefault("serving_config", full_cfg.serving)
+        kw.setdefault("fleet_config", full_cfg.fleet)
+        kw.setdefault("tenancy_config", full_cfg.tenancy)
+        kw.setdefault("slo_config", full_cfg.slo)
+        return cls.build(engines, **kw)
 
     # ------------------------------------------------------------------ #
     def _setup_telemetry(self) -> None:
@@ -222,6 +259,16 @@ class FleetRouter:
             "fleet_requests_lost_total",
             "in-flight requests force-failed at router shutdown (a clean "
             "drain leaves this at 0 — the chaos tests pin it)")
+        # sliding window matches the fleet TTFT histogram (10 s × 60):
+        # the hedge threshold reads the RECENT completion-latency
+        # percentile from here, so a slow warmup ages out of the hedge
+        # decision instead of inflating it for the process lifetime
+        self._tm_request_s = telemetry.histogram(
+            "fleet_request_seconds",
+            "fleet submit() to fleet completion, wall seconds (windowed "
+            "source for the hedge-threshold percentile and fleet latency "
+            "SLOs)", window_s=600.0, window_intervals=60)
+        self._tm_request_s.set_window_clock(self.clock)
         self._tm_ready = telemetry.gauge(
             "fleet_ready_replicas", "replicas currently routable")
         self._tm_active = telemetry.gauge(
@@ -370,6 +417,15 @@ class FleetRouter:
                 r.dispatch_t = now
                 r.next_retry_t = None
                 self._tm_routed.inc(replica=rep.name)
+                self.observatory.note_hop(
+                    r.uid, "dispatch" if r.attempts == 1 else "retry",
+                    rep.name, reason=r.last_reason)
+                if r.carried:
+                    # this replica will re-prefill every carried token —
+                    # compute the fleet already paid for once on the
+                    # replica that lost the request
+                    self.observatory.note_waste("failover_replay",
+                                                len(r.carried))
                 return res
             if isinstance(res, Rejected):
                 # universal only when the PAYLOAD is invalid for EVERY
@@ -436,6 +492,14 @@ class FleetRouter:
                 .default_max_new_tokens
         self._results.pop(uid, None)   # resubmission of a terminal uid
         self._tm_t_submitted.inc(tenant=self.tenancy.label(tenant))
+        # lifecycle ledger opens at the fleet door; the prefix meter
+        # prices each OFFERED prompt once (hedge/failover re-dispatches
+        # are the same offer, so they are deliberately not re-metered)
+        self.observatory.note_submit(uid, tenant, len(prompt), self.clock())
+        block_size = getattr(self._replicas[0].frontend.engine,
+                             "block_size", 0)
+        if block_size:
+            self.prefix.observe_prompt(prompt, block_size)
         # fleet-level tenant gate: quarantine + rate buckets (debited
         # once, here). Concurrency/KV/fairness are enforced per replica
         # at dispatch — the registry is fleet-shared, so those hold
@@ -446,6 +510,7 @@ class FleetRouter:
         if gate is not None:
             reason, retry, det = gate
             self._tm_reject.inc(reason=reason)
+            self.observatory.note_verdict(uid, reason)
             self._record_result(RequestResult(uid, REJECTED, [], reason,
                                               det, tenant=tenant))
             self._refresh_gauges()
@@ -456,8 +521,10 @@ class FleetRouter:
         verdict = self._try_dispatch(r)
         if isinstance(verdict, Admitted):
             self._active[uid] = r
+            self.observatory.note_verdict(uid, "admitted")
         else:
             self._tm_reject.inc(reason=verdict.reason)
+            self.observatory.note_verdict(uid, verdict.reason)
             self._record_result(RequestResult(
                 uid, REJECTED, [], verdict.reason,
                 getattr(verdict, "detail", ""), tenant=tenant))
@@ -480,6 +547,13 @@ class FleetRouter:
         self._tm_resolved.inc(outcome=result.state)
         self._tm_t_resolved.inc(tenant=self.tenancy.label(result.tenant),
                                 outcome=result.state)
+        # every token in a terminal result IS delivered to the caller —
+        # partial expired/failed output included — so it is goodput; the
+        # discarded copies were already attributed by note_waste at the
+        # moment each copy lost
+        self.observatory.note_goodput(len(result.tokens))
+        self.observatory.note_terminal(result.uid, result.state,
+                                       result.reason, len(result.tokens))
 
     def _cancel_copy(self, r: _FleetRequest, name: Optional[str],
                      reason: str) -> None:
@@ -491,10 +565,22 @@ class FleetRouter:
             rep.frontend.drop_result(r.uid)
 
     def _resolve(self, r: _FleetRequest, state: str, tokens: List[int],
-                 reason: str = "", detail: str = "") -> None:
+                 reason: str = "", detail: str = "",
+                 discard_reason: str = "hedge_lost") -> None:
         """Fleet terminal resolution: cancel every remaining copy (KV
-        blocks released on every replica) then record once."""
+        blocks released on every replica) then record once. Any copy
+        still generating when the request resolves is a discarded
+        duplicate stream — its progress is waste (``discard_reason``;
+        the default covers the common case of a losing hedge copy,
+        shutdown passes ``evicted``)."""
         for name in (r.replica, r.hedge):
+            if name is not None:
+                rep = self._by_name(name)
+                if rep is not None:
+                    snap = rep.frontend.rematerialize(r.uid)
+                    if snap is not None and snap["generated"]:
+                        self.observatory.note_waste(
+                            discard_reason, len(snap["generated"]))
             self._cancel_copy(r, name, reason=f"fleet_{state}")
         r.replica = r.hedge = None
         self._record_result(RequestResult(r.uid, state,
@@ -521,11 +607,21 @@ class FleetRouter:
         r.excluded.add(rep.name)
         r.last_reason = reason
         self._tm_failover.inc(reason=reason)
+        self.observatory.note_hop(
+            r.uid, "migration" if reason == "drain" else "failover",
+            rep.name, reason=reason)
         other = r.hedge if not is_hedge else r.replica
         if other is not None:
             # the surviving copy (same payload, greedy-deterministic
             # stream) carries on; don't fold the loser's tokens — the
-            # survivor has its own copy of the same stream
+            # survivor has its own copy of the same stream, so the
+            # loser's progress is pure discarded computation
+            lost_n = (len(snap["generated"]) if snap is not None
+                      else len(tokens or []))
+            if lost_n:
+                self.observatory.note_waste(
+                    {"shed": "shed", "failed": "evicted"}.get(
+                        reason, "hedge_lost"), lost_n)
             if is_hedge:
                 self._tm_hedges.inc(outcome="lost")
             else:
@@ -646,6 +742,7 @@ class FleetRouter:
                         r.replica = None
                     rep.frontend.drop_result(r.uid)
                     self._lat_samples.append(now - r.submit_t)
+                    self._tm_request_s.observe(now - r.submit_t)
                     self._resolve(r, COMPLETED, r.carried + res.tokens)
                 elif res.state == EXPIRED:
                     # the deadline is request-global: the other copy is on
@@ -663,6 +760,12 @@ class FleetRouter:
                     self._lose_copy(r, rep, res.state, tokens=res.tokens)
 
     def _hedge_threshold_s(self) -> float:
+        # the windowed histogram quantile is the primary source (it ages
+        # out a cold-start's slow completions; the ring buffer doesn't);
+        # the ring remains the fallback for clocks the window can't serve
+        wq = self._tm_request_s.windowed_quantile(self.cfg.hedge_percentile)
+        if wq is not None:
+            return max(self.cfg.hedge_min_s, wq)
         if not self._lat_samples:
             return self.cfg.hedge_min_s
         ordered = sorted(self._lat_samples)
@@ -700,6 +803,12 @@ class FleetRouter:
                     r.hedged = True
                     self._tm_hedges.inc(outcome="spawned")
                     self._tm_routed.inc(replica=rep.name)
+                    self.observatory.note_hop(r.uid, "hedge", rep.name)
+                    if r.carried:
+                        # the hedge copy re-prefills the carried tokens
+                        # exactly as a failover re-dispatch would
+                        self.observatory.note_waste("failover_replay",
+                                                    len(r.carried))
                 break   # one placement attempt per scan — no storms
 
     def _retry_due(self) -> None:
@@ -768,6 +877,7 @@ class FleetRouter:
         self._detect_failures()   # a tick may have just opened a circuit
         self._retry_due()         # ...and its failed-over work can often
         self._refresh_gauges()    # re-place on a survivor immediately
+        self.slo.evaluate()       # burn rates see this tick's terminals
         return ticked
 
     def run_until_drained(self, max_ticks: int = 10_000,
@@ -850,6 +960,7 @@ class FleetRouter:
         # fleet's shared registry (its own in-flight charges, if any,
         # transfer over)
         new_frontend.adopt_tenancy(self.tenancy)
+        new_frontend.observatory = self.observatory
         old = rep.frontend
         old.close()
         rep.frontend = new_frontend
@@ -869,6 +980,7 @@ class FleetRouter:
                 f"replica name {new_frontend.name!r} collides with a "
                 "live replica")
         new_frontend.adopt_tenancy(self.tenancy)
+        new_frontend.observatory = self.observatory
         self._replicas.append(_Replica(new_frontend))
         self._retry_due()
         self._refresh_gauges()
@@ -945,7 +1057,9 @@ class FleetRouter:
         a clean shutdown drains first."""
         for r in list(self._active.values()):
             self._tm_lost.inc()
-            self._resolve(r, FAILED, list(r.carried), reason="shutdown")
+            self._resolve(r, FAILED, list(r.carried), reason="shutdown",
+                          discard_reason="evicted")
+        self.slo.close()
         if self.health_name is not None:
             telemetry.unregister_health_probe("live", self.health_name)
             telemetry.unregister_health_probe("ready", self.health_name)
@@ -1003,7 +1117,7 @@ class FleetAutoscaler:
         self._tm_scale = telemetry.counter(
             "fleet_scale_events_total",
             "autoscaler resize events by direction and triggering reason "
-            "(queue_depth / kv_pressure / latency / idle)")
+            "(queue_depth / kv_pressure / latency / slo_burn / idle)")
 
     # ------------------------------------------------------------ signals
     def signals(self) -> Dict[str, float]:
@@ -1033,6 +1147,12 @@ class FleetAutoscaler:
                 return "out", "kv_pressure"
             if 0 < self.cfg.scale_out_p99_latency_s < sig["p99_latency_s"]:
                 return "out", "latency"
+            slo = getattr(self.router, "slo", None)
+            if slo is not None and slo.wants_scale_out():
+                # opt-in (slo.autoscale_on_burn): a firing burn alert on
+                # a latency/availability objective is the leading signal
+                # the lagging queue/kv thresholds confirm too late
+                return "out", "slo_burn"
         if n > self.cfg.autoscale_min_replicas \
                 and sig["queue_depth"] < self.cfg.scale_in_queue_depth:
             return "in", "idle"

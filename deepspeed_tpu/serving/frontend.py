@@ -70,6 +70,7 @@ from deepspeed_tpu.serving.circuit import (
 )
 from deepspeed_tpu.serving.health import HealthSurface
 from deepspeed_tpu.serving.tenancy import TenantRegistry
+from deepspeed_tpu.telemetry import exposition
 from deepspeed_tpu.telemetry import tracing as _tracing
 from deepspeed_tpu.testing.chaos import chaos_point
 from deepspeed_tpu.utils.logging import logger
@@ -186,6 +187,10 @@ class ServingFrontend:
         # in place) — cache the handle; every call is a no-op while
         # tracing is disabled
         self._tracer = _tracing.get_tracer()
+        # fleet observatory back-reference (serving/observatory): the
+        # owning FleetRouter installs one; every hook below is
+        # None-guarded so a standalone frontend pays nothing
+        self.observatory = None
         self._setup_telemetry()
         self.health: Optional[HealthSurface] = None
         if register_health:
@@ -216,6 +221,9 @@ class ServingFrontend:
             self.tenancy.release(req.tenant, req.quota_blocks)
             registry.transfer_inflight(req.tenant, req.quota_blocks)
         self.tenancy = registry
+        # keep ?tenant= exposition filtering addressable exactly as far
+        # as the tenancy label-cardinality guard records labels
+        exposition.set_tenant_filter_cap(registry.cfg.max_tenant_labels)
 
     # ------------------------------------------------------------------ #
     def _setup_telemetry(self) -> None:
@@ -256,10 +264,16 @@ class ServingFrontend:
         self._tm_t_resolved = telemetry.counter(
             "serving_tenant_resolved_total",
             "terminal request states by tenant and outcome")
+        # long sliding window (10 s × 60 intervals) so per-tenant SLO
+        # objectives can read windowed bad-fractions over the burn-rate
+        # engine's slow window; window shape binds at FIRST creation
+        # process-wide, clock rebinding is per-call (fleet replicas all
+        # share their router's clock, so last-wins is also all-win)
         self._tm_t_ttft = telemetry.histogram(
             "serving_tenant_ttft_seconds",
             "submit() to first prefill progress, by tenant (per-tenant "
-            "p99 TTFT source)")
+            "p99 TTFT source)", window_s=600.0, window_intervals=60)
+        self._tm_t_ttft.set_window_clock(self.clock)
         self._tm_t_quar = telemetry.counter(
             "serving_tenant_quarantines_total",
             "per-tenant poison quarantines tripped, by tenant")
@@ -470,9 +484,15 @@ class ServingFrontend:
 
         # 4) capacity — queue cap and KV high watermark, shed per policy
         # (victim selection is tier-aware: batch pays before standard
-        # pays before realtime, deadline slack breaking ties in-tier)
+        # pays before realtime, deadline slack breaking ties in-tier).
+        # A firing SLO burn alert may tighten the queue bound — but ONLY
+        # when the operator opted in (slo.shed_on_burn); the default
+        # observe-only engine always answers 0.0 here
+        obs = self.observatory
+        tighten = obs.slo.shed_tighten() \
+            if obs is not None and obs.slo is not None else 0.0
         reason = self.ctrl.overload_reason(
-            len(self._reqs), self._kv_util(blocks_needed))
+            len(self._reqs), self._kv_util(blocks_needed), tighten=tighten)
         if reason is not None:
             incoming = _Candidate(
                 uid=uid, age_order=self._order_counter,
@@ -496,7 +516,8 @@ class ServingFrontend:
                 self._shed(victim, reason)
                 # one victim per admission: recheck, reject if still over
                 reason = self.ctrl.overload_reason(
-                    len(self._reqs), self._kv_util(blocks_needed))
+                    len(self._reqs), self._kv_util(blocks_needed),
+                    tighten=tighten)
             if reason is not None:
                 retry = retry_after_from_backlog(
                     self._outstanding_tokens(), tok_s)
@@ -605,6 +626,9 @@ class ServingFrontend:
                                  tokens=len(tokens), tenant=tenant)
 
     def _shed(self, uid: int, reason: str) -> None:
+        # waste attribution happens at the FLEET layer (the router may
+        # carry this victim's tokens forward — only it knows whether
+        # they were truly discarded), not here
         tokens = self._tokens_of(uid)
         self._tm_shed.inc(policy=self.ctrl.shed_policy)
         logger.warning(f"serving: shedding request {uid} "
@@ -723,6 +747,10 @@ class ServingFrontend:
                 self._tm_wait.observe(wait_s)
                 self._tm_t_ttft.observe(
                     wait_s, tenant=self.tenancy.label(req.tenant))
+                if self.observatory is not None:
+                    # fleet TTFT: first service on ANY replica counts
+                    # once (the observatory dedups hedge/failover copies)
+                    self.observatory.note_first_service(uid, wait_s)
                 self._tracer.request_event(uid, "first_service",
                                            queue_wait_s=round(wait_s, 6))
             if seq.expired:

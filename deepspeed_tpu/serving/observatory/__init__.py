@@ -1,0 +1,46 @@
+"""Fleet observatory: the serving-side sibling of the execution
+observatory (``profiling/observatory``).
+
+Four lenses over a running fleet (README "Fleet observatory"):
+
+* :mod:`ledger` — per-request lifecycle records (queue-wait, admission
+  verdict, hops, terminal state) in a bounded ring, plus goodput
+  accounting: ``fleet_goodput_tokens_total`` vs
+  ``fleet_wasted_tokens_total{reason}`` — tokens the fleet computed but
+  never delivered, the honest denominator for every phase-2 win.
+* :mod:`slo` — declarative objectives (TTFT p99, per-token decode
+  latency, availability) evaluated with SRE-workbook multi-window
+  burn-rate alerting over sliding-window quantiles; observe-only by
+  default, optionally a scale-out reason and a shed hint.
+* :mod:`prefix` — block-granularity prompt-prefix hashing measuring the
+  would-be prefix-hit rate, block-sharing potential and KV-pool
+  fragmentation (prices ROADMAP item 3a before any routing code), plus
+  the decode-tick collective-ledger fold (wire bytes for item 3d).
+* :mod:`report` — the ``fleet-report`` CLI's renderer: SLO compliance,
+  burn rates, per-tenant p99s, goodput breakdown and prefix opportunity
+  from a live fleet or a bench row.
+"""
+from deepspeed_tpu.serving.observatory.ledger import (
+    WASTE_REASONS,
+    FleetObservatory,
+    RequestLifecycle,
+)
+from deepspeed_tpu.serving.observatory.prefix import (
+    PrefixMeter,
+    decode_wire_stats,
+    pool_stats,
+)
+from deepspeed_tpu.serving.observatory.report import (
+    build_report,
+    render_report,
+    report_exit_code,
+    slo_bench_block,
+)
+from deepspeed_tpu.serving.observatory.slo import SloAlert, SloEngine
+
+__all__ = [
+    "FleetObservatory", "RequestLifecycle", "WASTE_REASONS",
+    "SloAlert", "SloEngine",
+    "PrefixMeter", "pool_stats", "decode_wire_stats",
+    "build_report", "render_report", "report_exit_code", "slo_bench_block",
+]

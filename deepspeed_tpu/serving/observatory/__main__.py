@@ -1,0 +1,150 @@
+"""``fleet-report`` — render the fleet observatory's verdict.
+
+Sources, in order of preference:
+
+* ``--url http://host:port`` — fetch a live fleet's ``/slo`` endpoint
+  and render its body (the SLO engine's ``state()``).
+* ``PATH`` — a bench results JSON (detected by its ``schema_version``
+  key; pick an entry with ``--entry``, default: first entry carrying an
+  ``slo`` block) or a previously dumped report/``/slo`` body.
+
+Exit codes are dslint-shaped: 0 clean, 1 findings (a firing burn-rate
+alert or a goodput reconciliation the fleet cannot prove), 2 usage or
+malformed input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.serving.observatory.report import (
+    render_report,
+    report_exit_code,
+)
+
+
+def _report_from_slo_state(state: Dict[str, Any],
+                           source: str) -> Dict[str, Any]:
+    """Shape a live ``/slo`` body (SloEngine.state()) into the report
+    dict the renderer expects."""
+    goodput = state.get("goodput", {})
+    report: Dict[str, Any] = {
+        "source": source,
+        "slo": {
+            "objectives": state.get("objectives", []),
+            "alerts": state.get("alerts", []),
+            "any_firing": state.get("any_firing", False),
+            "worst_burn_rate": state.get("worst_burn_rate", 0.0),
+        },
+        "tenants": {},
+        "goodput": goodput,
+        "reconciliation": {
+            "tokens_ok": goodput.get("reconciles", True),
+            "terminals_ok": True,
+        },
+        "prefix": state.get("prefix", {}),
+    }
+    if "ttft_p99_s" in state:
+        report["ttft_p99_s"] = state["ttft_p99_s"]
+    return report
+
+
+def _pick_bench_entry(result: Dict[str, Any], wanted: str):
+    entries = result.get("entries")
+    if not isinstance(entries, dict) or not entries:
+        raise ValueError("bench results carry no entries")
+    if wanted:
+        if wanted not in entries:
+            raise ValueError(
+                f"no bench entry named {wanted!r} "
+                f"(have: {', '.join(sorted(entries))})")
+        return wanted, entries[wanted]
+    for name, entry in entries.items():
+        if isinstance(entry, dict) and isinstance(entry.get("slo"), dict):
+            return name, entry
+    raise ValueError(
+        "no bench entry carries an 'slo' block — run a fleet lane "
+        "without BENCH_SLO=0, or name an entry with --entry")
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if not isinstance(payload, dict):
+        raise ValueError("expected a JSON object at the top level")
+    return payload
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fleet-report",
+        description="Render the fleet observatory's verdict: SLO "
+                    "compliance, burn rates, per-tenant TTFT p99s, "
+                    "goodput/wasted breakdown, prefix opportunity.")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="bench results JSON (schema_version file) or "
+                             "a dumped report / /slo body")
+    parser.add_argument("--url", default=None,
+                        help="base URL of a live exposition server; "
+                             "fetches <url>/slo")
+    parser.add_argument("--entry", default="",
+                        help="bench entry name to report on (default: "
+                             "first entry with an slo block)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the report as JSON instead of text")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:        # argparse exits 2 on usage errors
+        return int(exc.code or 0)
+    if (args.path is None) == (args.url is None):
+        parser.print_usage(sys.stderr)
+        print("fleet-report: need exactly one of PATH or --url",
+              file=sys.stderr)
+        return 2
+
+    try:
+        if args.url is not None:
+            import urllib.request
+
+            url = args.url.rstrip("/") + "/slo"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                state = json.loads(resp.read().decode("utf-8"))
+            report = _report_from_slo_state(state, source=url)
+        else:
+            payload = _load(args.path)
+            if "schema_version" in payload:
+                from deepspeed_tpu.bench.schema import validate_result
+
+                errs = validate_result(payload)
+                if errs:
+                    for e in errs:
+                        print(f"fleet-report: schema: {e}", file=sys.stderr)
+                    return 2
+                from deepspeed_tpu.serving.observatory.report import (
+                    build_report,
+                )
+
+                name, entry = _pick_bench_entry(payload, args.entry)
+                report = build_report(bench_entry=entry, entry_name=name)
+            elif "alerts" in payload.get("slo", {}) \
+                    or "reconciliation" in payload:
+                report = payload           # an already-built report dump
+            elif "objectives" in payload:  # a dumped /slo body
+                report = _report_from_slo_state(
+                    payload, source=f"file:{args.path}")
+            else:
+                raise ValueError(
+                    "unrecognized input: neither bench results, a "
+                    "report dump, nor an /slo body")
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"fleet-report: {exc}", file=sys.stderr)
+        return 2
+
+    print(render_report(report, as_json=args.as_json))
+    return report_exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

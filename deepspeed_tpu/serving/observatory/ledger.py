@@ -1,0 +1,256 @@
+"""Request-lifecycle ledger + goodput accounting for the serving fleet.
+
+Every request that crosses the fleet door gets ONE
+:class:`RequestLifecycle` record tracking what the per-uid trace
+(telemetry/tracing) narrates, but structured: queue-wait, admission
+verdict, prefill/decode token counts, every failover/hedge/migration
+hop, tenant, terminal state. Terminal records land in a bounded ring
+(``slo.ledger_size``) the SLO engine's availability objectives and the
+``fleet-report`` CLI read.
+
+Goodput accounting is the second half: the fleet computes tokens it
+never delivers — a hedge loser's stream, a failover's prefill replay of
+carried tokens, a shed or poison-evicted request's partial output. Each
+computation quantum is counted exactly once, at the moment its fate is
+known, into ``fleet_goodput_tokens_total`` (delivered) or
+``fleet_wasted_tokens_total{reason}`` (discarded), and every count also
+lands in ``fleet_computed_tokens_total`` — so
+
+    goodput + wasted == computed
+
+holds by construction, and the reconciliation is an invariant the bench
+validator and the chaos tests can pin rather than a report-time hope.
+One LOGICAL token may contribute several quanta (decoded on a lost
+replica, then prefill-replayed on the next): that is precisely the
+waste this ledger exists to make visible.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu import telemetry
+
+#: the closed set of waste attributions — the bench validator and the
+#: metric catalog enumerate exactly these
+WASTE_REASONS = ("hedge_lost", "failover_replay", "evicted", "shed")
+
+#: sliding-window shape for the fleet TTFT histogram: 10 s intervals
+#: over 10 min, so the SLO engine's slow window (default 300 s) always
+#: fits inside what the ring retains
+TTFT_WINDOW_S = 600.0
+TTFT_WINDOW_INTERVALS = 60
+
+
+@dataclasses.dataclass
+class RequestLifecycle:
+    """One request's structured lifecycle, fleet-door to terminal."""
+    uid: int
+    tenant: str = ""
+    submit_t: float = 0.0
+    verdict: str = ""              # admitted | the rejection reason
+    queue_wait_s: Optional[float] = None   # submit to first service
+    prefill_tokens: int = 0        # prompt length at the fleet door
+    decode_tokens: int = 0         # tokens actually delivered
+    hops: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    state: str = "active"
+    reason: str = ""
+    end_t: Optional[float] = None
+
+    @property
+    def hedged(self) -> bool:
+        return any(h["kind"] == "hedge" for h in self.hops)
+
+    @property
+    def failovers(self) -> int:
+        return sum(1 for h in self.hops if h["kind"] == "failover")
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["hedged"] = self.hedged
+        d["failovers"] = self.failovers
+        return d
+
+
+class FleetObservatory:
+    """The fleet's lifecycle ledger + goodput accountant.
+
+    Owned by a ``FleetRouter`` (one per fleet); frontends the router
+    installs get a back-reference and call the ``note_*`` hooks. Every
+    hook is cheap (dict update / counter inc) and None-tolerant at the
+    call sites, so a standalone frontend without a fleet pays nothing.
+    As single-threaded as the router that owns it. ``slo`` is the
+    optionally attached :class:`~.slo.SloEngine` (the frontend's shed
+    hint and the autoscaler's burn reason read it through here).
+    """
+
+    def __init__(self, clock=time.monotonic, ledger_size: int = 2048):
+        self.clock = clock
+        self._open: Dict[int, RequestLifecycle] = {}
+        self._closed: collections.deque = collections.deque(
+            maxlen=max(1, int(ledger_size)))
+        self.slo = None
+        # internal integers are the reconciliation source of truth (the
+        # process-global counters below mirror them but can be shared
+        # with another fleet in the same process or reset by tests)
+        self.goodput_tokens = 0
+        self.computed_tokens = 0
+        self.wasted_tokens: Dict[str, int] = {r: 0 for r in WASTE_REASONS}
+        self.terminal_counts: collections.Counter = collections.Counter()
+        self._tm_goodput = telemetry.counter(
+            "fleet_goodput_tokens_total",
+            "tokens computed AND delivered to callers in a terminal "
+            "record — the honest numerator for serving-efficiency wins")
+        self._tm_wasted = telemetry.counter(
+            "fleet_wasted_tokens_total",
+            "tokens the fleet computed but never delivered, by reason "
+            "(hedge_lost / failover_replay / evicted / shed)")
+        self._tm_computed = telemetry.counter(
+            "fleet_computed_tokens_total",
+            "every token-computation quantum the fleet paid for; equals "
+            "goodput + wasted by construction (the reconciliation "
+            "invariant the bench validator pins)")
+        self._tm_ttft = telemetry.histogram(
+            "fleet_ttft_seconds",
+            "fleet submit to first prefill progress on any replica "
+            "(fleet-wide TTFT; sliding-window source for SLO burn rates)",
+            window_s=TTFT_WINDOW_S, window_intervals=TTFT_WINDOW_INTERVALS)
+        self._tm_ttft.set_window_clock(clock)
+
+    # ------------------------------------------------------------ hooks
+    def note_submit(self, uid: int, tenant: str, prompt_len: int,
+                    t: float) -> None:
+        self._open[uid] = RequestLifecycle(
+            uid=uid, tenant=tenant, submit_t=t, prefill_tokens=prompt_len)
+
+    def note_verdict(self, uid: int, verdict: str) -> None:
+        rec = self._open.get(uid)
+        if rec is not None:
+            rec.verdict = verdict
+
+    def note_hop(self, uid: int, kind: str, replica: str,
+                 reason: str = "") -> None:
+        """One placement event: kind ∈ dispatch | retry | hedge |
+        failover | migration."""
+        rec = self._open.get(uid)
+        if rec is not None:
+            rec.hops.append({"kind": kind, "replica": replica,
+                             "reason": reason,
+                             "t": round(self.clock(), 6)})
+
+    def note_first_service(self, uid: int, wait_s: float) -> None:
+        """First prefill progress on ANY replica: the fleet TTFT. Only
+        the first copy to serve counts — a hedge or failover copy
+        reaching prefill later is not a second first-token. The wait is
+        measured from the FLEET door (this ledger's submit stamp), so
+        retry backoff and re-dispatch queuing are inside it — ``wait_s``
+        is the replica-relative wait, kept in the signature for callers
+        that have it, and a request never ledgered at submit observes
+        nothing (there is no fleet door to measure from)."""
+        rec = self._open.get(uid)
+        if rec is not None and rec.queue_wait_s is None:
+            fleet_wait = max(0.0, self.clock() - rec.submit_t)
+            rec.queue_wait_s = round(fleet_wait, 6)
+            self._tm_ttft.observe(fleet_wait)
+
+    def note_goodput(self, tokens: int) -> None:
+        if tokens <= 0:
+            return
+        self.goodput_tokens += tokens
+        self.computed_tokens += tokens
+        self._tm_goodput.inc(tokens)
+        self._tm_computed.inc(tokens)
+
+    def note_waste(self, reason: str, tokens: int) -> None:
+        if tokens <= 0:
+            return
+        if reason not in self.wasted_tokens:
+            raise ValueError(f"unknown waste reason {reason!r} "
+                             f"(expected one of {WASTE_REASONS})")
+        self.wasted_tokens[reason] += tokens
+        self.computed_tokens += tokens
+        self._tm_wasted.inc(tokens, reason=reason)
+        self._tm_computed.inc(tokens)
+
+    def note_terminal(self, uid: int, state: str, reason: str,
+                      delivered_tokens: int) -> None:
+        rec = self._open.pop(uid, None)
+        if rec is None:
+            # terminal without a submit record (router built mid-flight,
+            # or a test drove _record_result directly): still ledger it
+            rec = RequestLifecycle(uid=uid, submit_t=self.clock())
+        rec.state = state
+        rec.reason = reason
+        rec.decode_tokens = delivered_tokens
+        rec.end_t = self.clock()
+        self.terminal_counts[state] += 1
+        self._closed.append(rec)
+
+    # ------------------------------------------------------------ reads
+    def record(self, uid: int) -> Optional[RequestLifecycle]:
+        if uid in self._open:
+            return self._open[uid]
+        for rec in reversed(self._closed):
+            if rec.uid == uid:
+                return rec
+        return None
+
+    def records(self, window_s: Optional[float] = None
+                ) -> List[RequestLifecycle]:
+        """Terminal records, oldest first; ``window_s`` keeps only those
+        that ended inside the last that-many seconds."""
+        if window_s is None:
+            return list(self._closed)
+        cutoff = self.clock() - window_s
+        return [r for r in self._closed
+                if r.end_t is not None and r.end_t >= cutoff]
+
+    def availability(self, window_s: float, tenant: Optional[str] = None
+                     ) -> Optional[float]:
+        """Fraction of terminal requests inside the window that
+        completed (rejections and failures both spend error budget —
+        the caller was turned away or hurt either way). None when the
+        window holds no terminal record: no traffic is not an outage."""
+        recs = self.records(window_s)
+        if tenant is not None:
+            recs = [r for r in recs if r.tenant == tenant]
+        if not recs:
+            return None
+        ok = sum(1 for r in recs if r.state == "completed")
+        return ok / len(recs)
+
+    def ttft_quantile(self, q: float, window_s: Optional[float] = None
+                      ) -> Optional[float]:
+        return self._tm_ttft.windowed_quantile(q, window_s=window_s)
+
+    def ttft_bad_fraction(self, threshold_s: float,
+                          window_s: Optional[float] = None):
+        return self._tm_ttft.windowed_bad_fraction(
+            threshold_s, window_s=window_s)
+
+    def goodput_fraction(self) -> Optional[float]:
+        """goodput / computed, or None before any token was computed."""
+        if self.computed_tokens == 0:
+            return None
+        return self.goodput_tokens / self.computed_tokens
+
+    def reconciles(self) -> bool:
+        """The ledger's own invariant — exact, not approximate."""
+        return (self.goodput_tokens + sum(self.wasted_tokens.values())
+                == self.computed_tokens)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready state for ``/slo``, bench rows and fleet-report."""
+        frac = self.goodput_fraction()
+        return {
+            "goodput_tokens": self.goodput_tokens,
+            "wasted_tokens": dict(self.wasted_tokens),
+            "computed_tokens": self.computed_tokens,
+            "goodput_fraction": round(frac, 6) if frac is not None else None,
+            "reconciles": self.reconciles(),
+            "terminal_counts": dict(self.terminal_counts),
+            "open_requests": len(self._open),
+            "ledger_records": len(self._closed),
+        }

@@ -1,0 +1,209 @@
+"""KV/prefix opportunity metering — pricing prefix reuse BEFORE it's built.
+
+ROADMAP item 3a (prefix-cache-aware routing) only pays if real traffic
+actually shares prompt prefixes at block granularity. This module
+measures that opportunity on today's fleet, with no routing changes:
+
+* :class:`PrefixMeter` — hashes every submitted prompt block-by-block
+  (chained, so a block only matches when its whole prefix matched) and
+  counts how many blocks a block-granular prefix cache WOULD have
+  served from cache (``fleet_prefix_blocks_total{outcome}``).
+* :func:`pool_stats` — over the live paged KV pools: how many allocated
+  blocks hold identical chained prefixes (block-sharing potential if
+  blocks were refcounted, vLLM-style) and how much of the allocated
+  pool is tail fragmentation (partially-filled last blocks).
+* :func:`decode_wire_stats` — folds ``FastGenEngine.collective_ledger``
+  into fleet terms: decode-tick wire bytes, the denominator EQuARX-style
+  wire compression (item 3d) must shrink.
+"""
+from __future__ import annotations
+
+import collections
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.utils.logging import logger
+
+
+def _chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Chained per-block hashes of ``tokens``: hash[i] covers blocks
+    0..i, so equal hash[i] means the ENTIRE prefix up to block i is
+    equal — the lookup a block-granular prefix cache would perform.
+    Only full blocks count (a partial tail block can't be shared)."""
+    out: List[int] = []
+    h = 0
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        block = tokens[start:start + block_size]
+        h = zlib.crc32(repr(tuple(block)).encode(), h)
+        out.append(h)
+    return out
+
+
+class PrefixMeter:
+    """Would-be prefix-hit accounting over submitted prompts.
+
+    ``observe_prompt`` is called once per fleet submission (failover and
+    hedge re-dispatches are the SAME offered prompt, so the fleet hooks
+    it at its front door only). A seen-set of chained block hashes,
+    bounded LRU at ``max_tracked`` entries, stands in for the cache a
+    real implementation would keep; ``hit_rate`` is then the fraction
+    of offered full blocks that cache would have served."""
+
+    def __init__(self, max_tracked: int = 65536):
+        self.max_tracked = max(1, int(max_tracked))
+        self._seen: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.hit_blocks = 0
+        self.total_blocks = 0
+        self.prompts = 0
+        self._tm_blocks = telemetry.counter(
+            "fleet_prefix_blocks_total",
+            "full prompt blocks offered to the fleet, by whether a "
+            "block-granular prefix cache would have served them "
+            "(outcome=hit / miss) — the measured prefix-reuse "
+            "opportunity that prices prefix-aware routing")
+        self._tm_rate = telemetry.gauge(
+            "fleet_prefix_hit_rate",
+            "cumulative would-be prefix-cache hit rate over offered "
+            "full prompt blocks")
+
+    def observe_prompt(self, prompt: Sequence[int],
+                       block_size: int) -> int:
+        """Meter one offered prompt; returns the would-be hit count."""
+        if block_size <= 0:
+            return 0
+        self.prompts += 1
+        hits = 0
+        for h in _chain_hashes(prompt, block_size):
+            self.total_blocks += 1
+            if h in self._seen:
+                self._seen.move_to_end(h)
+                hits += 1
+                self._tm_blocks.inc(outcome="hit")
+            else:
+                self._seen[h] = None
+                while len(self._seen) > self.max_tracked:
+                    self._seen.popitem(last=False)
+                self._tm_blocks.inc(outcome="miss")
+        self.hit_blocks += hits
+        if self.total_blocks:
+            self._tm_rate.set(self.hit_blocks / self.total_blocks)
+        return hits
+
+    def hit_rate(self) -> Optional[float]:
+        if self.total_blocks == 0:
+            return None
+        return self.hit_blocks / self.total_blocks
+
+    def snapshot(self) -> Dict[str, Any]:
+        rate = self.hit_rate()
+        return {
+            "prompts": self.prompts,
+            "total_blocks": self.total_blocks,
+            "hit_blocks": self.hit_blocks,
+            "hit_rate": round(rate, 6) if rate is not None else None,
+            "tracked_prefixes": len(self._seen),
+        }
+
+
+def pool_stats(engines: Iterable) -> Dict[str, Any]:
+    """Sharing potential + fragmentation over the LIVE paged KV pools.
+
+    * ``sharing_potential``: of the full prompt blocks currently held
+      by live sequences, the fraction that duplicates another live
+      sequence's chained prefix block — blocks a refcounted
+      block-sharing pool would free today.
+    * ``fragmentation``: of the token capacity in allocated blocks, the
+      fraction sitting empty in partially-filled tail blocks.
+
+    Publishes ``fleet_prefix_sharing_potential`` and
+    ``fleet_kv_fragmentation`` gauges and returns the numbers."""
+    seen: Dict[int, int] = {}
+    total_full = 0
+    dup_full = 0
+    alloc_blocks = 0
+    used_tokens = 0
+    free_blocks = 0
+    n_blocks = 0
+    for eng in engines:
+        bs = eng.block_size
+        alloc = getattr(eng, "allocator", None)
+        if alloc is not None:
+            free_blocks += alloc.free_blocks
+            n_blocks += max(0, alloc.n_blocks - 1)   # block 0 = trash
+        for seq in eng.seqs.values():
+            if seq.done:
+                continue
+            tokens = list(seq.prompt) + list(seq.generated)
+            alloc_blocks += len(seq.blocks)
+            used_tokens += len(tokens)
+            for h in _chain_hashes(tokens, bs):
+                total_full += 1
+                count = seen.get(h, 0)
+                if count:
+                    dup_full += 1
+                seen[h] = count + 1
+    capacity_tokens = 0
+    for eng in engines:
+        # re-walk for capacity so a heterogeneous fleet (mixed block
+        # sizes) prices each sequence against ITS engine's block size
+        for seq in eng.seqs.values():
+            if not seq.done:
+                capacity_tokens += len(seq.blocks) * eng.block_size
+    sharing = dup_full / total_full if total_full else 0.0
+    frag = (1.0 - used_tokens / capacity_tokens) if capacity_tokens else 0.0
+    telemetry.gauge(
+        "fleet_prefix_sharing_potential",
+        "fraction of live full prompt blocks duplicating another live "
+        "sequence's chained prefix — blocks a refcounted sharing pool "
+        "would free right now").set(sharing)
+    telemetry.gauge(
+        "fleet_kv_fragmentation",
+        "fraction of allocated KV token capacity sitting empty in "
+        "partially-filled tail blocks").set(frag)
+    return {
+        "live_full_blocks": total_full,
+        "duplicate_blocks": dup_full,
+        "sharing_potential": round(sharing, 6),
+        "allocated_blocks": alloc_blocks,
+        "fragmentation": round(frag, 6),
+        "pool_blocks": n_blocks,
+        "free_blocks": free_blocks,
+    }
+
+
+def decode_wire_stats(engines: Iterable) -> Dict[str, Any]:
+    """Fold each engine's decode-tick collective ledger into fleet
+    rows: total wire bytes one tick moves, by collective kind. Engines
+    whose ledger can't lower (no compiled program on this backend)
+    contribute zero rather than failing the report — single-replica
+    serving legitimately ledgers empty."""
+    total_bytes = 0
+    by_kind: Dict[str, int] = {}
+    ledgered = 0
+    unledgered = 0
+    for eng in engines:
+        try:
+            ledger = eng.collective_ledger()
+        except Exception as exc:
+            # a backend that can't lower the decode tick contributes
+            # zero wire bytes — counted + logged, never fatal
+            unledgered += 1
+            logger.debug(f"decode-wire ledger unavailable: {exc}")
+            continue
+        ledgered += 1
+        total_bytes += ledger.total_bytes()
+        for kind, row in ledger.totals_by_kind().items():
+            by_kind[kind] = by_kind.get(kind, 0) + int(row["bytes"])
+    telemetry.gauge(
+        "fleet_decode_wire_bytes_per_tick",
+        "bytes the fleet's compiled decode-tick collectives move per "
+        "tick, summed across replicas — the denominator decode-wire "
+        "compression must shrink").set(total_bytes)
+    return {
+        "engines_ledgered": ledgered,
+        "engines_unledgered": unledgered,
+        "wire_bytes_per_tick": total_bytes,
+        "by_kind": by_kind,
+    }

@@ -1,0 +1,247 @@
+"""fleet-report: one rendered verdict over the fleet observatory.
+
+Builds a JSON-ready report — SLO compliance + burn rates, per-tenant
+TTFT p99s, the goodput/wasted breakdown (with its exact reconciliation
+check), prefix-reuse opportunity, decode wire bytes — from either a
+LIVE fleet (router + engine objects) or a BENCH result row (a v2.6
+``slo`` block embedded by the fleet lanes). The CLI in ``__main__``
+renders it dslint-shaped: exit 0 clean, 1 findings (a firing alert or a
+reconciliation failure), 2 usage/malformed input.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu import telemetry
+
+_TENANT_TTFT = "serving_tenant_ttft_seconds"
+
+
+def _verdict(alert: Dict[str, Any], fired: float, cleared: float) -> str:
+    if alert.get("firing"):
+        return "firing"
+    if fired > 0 and cleared > 0:
+        return "fired_and_cleared"
+    if alert.get("has_data"):
+        return "ok"
+    return "no_data"
+
+
+def _tenant_ttft_p99s() -> Dict[str, Optional[float]]:
+    """Per-tenant TTFT p99 from the live registry: the sliding-window
+    view when the window holds data, the lifetime view otherwise (a
+    drained fleet's report should still name its tenants)."""
+    hist = telemetry.get_registry().get(_TENANT_TTFT)
+    if hist is None:
+        return {}
+    out: Dict[str, Optional[float]] = {}
+    for key, _child in hist.labels_items():
+        labels = dict(key)
+        tenant = labels.get("tenant")
+        if tenant is None:
+            continue
+        p99 = hist.windowed_quantile(0.99, tenant=tenant)
+        if p99 is None:
+            p99 = hist.quantile(0.99, tenant=tenant)
+        out[tenant] = round(p99, 6) if p99 is not None else None
+    return out
+
+
+def _alert_verdicts(slo_engine) -> Dict[str, str]:
+    trans = telemetry.get_registry().get("fleet_slo_alert_transitions_total")
+    verdicts: Dict[str, str] = {}
+    for alert in slo_engine.alerts():
+        d = alert.as_dict()
+        fired = cleared = 0.0
+        if trans is not None:
+            fired = trans.value(objective=alert.name, to="firing")
+            cleared = trans.value(objective=alert.name, to="clear")
+        verdicts[alert.name] = _verdict(d, fired, cleared)
+    return verdicts
+
+
+def slo_bench_block(router) -> Dict[str, Any]:
+    """The v2.6 ``slo`` bench-entry block, from a live router: compact
+    objective verdicts + the goodput reconciliation triple the schema
+    validator re-checks on every validate."""
+    obs = router.observatory
+    engine = router.slo
+    block: Dict[str, Any] = {
+        "objectives": [
+            {"name": o.name, "metric": o.metric, "tenant": o.tenant,
+             "target": o.target, "threshold_s": o.threshold_s}
+            for o in (engine.objectives if engine is not None else [])],
+        "verdicts": _alert_verdicts(engine) if engine is not None else {},
+        "worst_burn_rate": round(engine.worst_burn_rate(), 6)
+        if engine is not None else 0.0,
+        "goodput_tokens": obs.goodput_tokens,
+        "wasted_tokens": dict(obs.wasted_tokens),
+        "computed_tokens": obs.computed_tokens,
+        "goodput_fraction": obs.goodput_fraction(),
+    }
+    rate = router.prefix.hit_rate() if router.prefix is not None else None
+    block["prefix_hit_rate"] = round(rate, 6) if rate is not None else None
+    return block
+
+
+def build_report(router=None, bench_entry: Optional[Dict[str, Any]] = None,
+                 entry_name: str = "", wire: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Assemble the canonical report dict from a live ``FleetRouter``
+    OR a bench entry carrying a v2.6 ``slo`` block (exactly one)."""
+    if (router is None) == (bench_entry is None):
+        raise ValueError("build_report needs exactly one of router / "
+                         "bench_entry")
+    if router is not None:
+        obs = router.observatory
+        engine = router.slo
+        alerts = [a.as_dict() for a in engine.alerts()] \
+            if engine is not None else []
+        verdicts = _alert_verdicts(engine) if engine is not None else {}
+        for a in alerts:
+            a["verdict"] = verdicts.get(a["name"], "no_data")
+        goodput = obs.snapshot()
+        resolved = telemetry.get_registry().get("fleet_resolved_total")
+        ledger_terminals = sum(obs.terminal_counts.values())
+        counter_terminals = int(resolved.total()) if resolved is not None \
+            else ledger_terminals
+        report = {
+            "source": "live",
+            "slo": {
+                "objectives": [
+                    {"name": o.name, "metric": o.metric, "tenant": o.tenant,
+                     "target": o.target, "threshold_s": o.threshold_s}
+                    for o in (engine.objectives
+                              if engine is not None else [])],
+                "alerts": alerts,
+                "any_firing": engine.any_firing()
+                if engine is not None else False,
+                "worst_burn_rate": round(engine.worst_burn_rate(), 6)
+                if engine is not None else 0.0,
+            },
+            "tenants": {t: {"ttft_p99_s": p}
+                        for t, p in _tenant_ttft_p99s().items()},
+            "goodput": goodput,
+            "reconciliation": {
+                # two independent checks: the ledger's own token
+                # invariant, and the lifecycle ring vs the fleet's
+                # terminal-outcome counter (every terminal counted once)
+                "tokens_ok": obs.reconciles(),
+                "terminals_ok": ledger_terminals == counter_terminals,
+                "ledger_terminals": ledger_terminals,
+                "counter_terminals": counter_terminals,
+            },
+            "prefix": router.prefix.snapshot()
+            if router.prefix is not None else {},
+        }
+        if wire is not None:
+            report["wire"] = wire
+        return report
+    # ---- bench-row mode -------------------------------------------- #
+    slo = bench_entry.get("slo")
+    if not isinstance(slo, dict):
+        raise ValueError(
+            f"bench entry {entry_name or '<unnamed>'} carries no 'slo' "
+            "block (fleet lanes embed one unless BENCH_SLO=0)")
+    wasted = slo.get("wasted_tokens", {})
+    goodput_tokens = slo.get("goodput_tokens", 0)
+    computed = slo.get("computed_tokens", 0)
+    alerts = [{"name": name, "verdict": verdict, "firing":
+               verdict == "firing"}
+              for name, verdict in sorted(slo.get("verdicts", {}).items())]
+    tenants = {}
+    for t, row in (bench_entry.get("tenants") or {}).items():
+        if isinstance(row, dict) and "ttft_p99_s" in row:
+            tenants[t] = {"ttft_p99_s": row["ttft_p99_s"]}
+    report = {
+        "source": f"bench:{entry_name}" if entry_name else "bench",
+        "slo": {
+            "objectives": slo.get("objectives", []),
+            "alerts": alerts,
+            "any_firing": any(a["firing"] for a in alerts),
+            "worst_burn_rate": slo.get("worst_burn_rate", 0.0),
+        },
+        "tenants": tenants,
+        "goodput": {
+            "goodput_tokens": goodput_tokens,
+            "wasted_tokens": dict(wasted),
+            "computed_tokens": computed,
+            "goodput_fraction": slo.get("goodput_fraction"),
+        },
+        "reconciliation": {
+            "tokens_ok": goodput_tokens + sum(wasted.values()) == computed,
+            "terminals_ok": True,   # the schema validator pinned it at
+                                    # embed time (tenants block)
+        },
+        "prefix": {"hit_rate": slo.get("prefix_hit_rate")},
+    }
+    if "wire_bytes_per_tick" in slo:
+        report["wire"] = {"wire_bytes_per_tick": slo["wire_bytes_per_tick"]}
+    return report
+
+
+def report_exit_code(report: Dict[str, Any]) -> int:
+    """dslint-shaped: 1 when the report carries findings (a firing
+    alert, or a reconciliation the fleet cannot prove), else 0."""
+    rec = report.get("reconciliation", {})
+    if not rec.get("tokens_ok", True) or not rec.get("terminals_ok", True):
+        return 1
+    if report.get("slo", {}).get("any_firing"):
+        return 1
+    return 0
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_report(report: Dict[str, Any], as_json: bool = False) -> str:
+    if as_json:
+        import json
+
+        return json.dumps(report, indent=2, sort_keys=True)
+    lines: List[str] = []
+    lines.append(f"fleet-report ({report.get('source', '?')})")
+    slo = report.get("slo", {})
+    lines.append(f"  slo: {len(slo.get('objectives', []))} objective(s), "
+                 f"worst burn rate {_fmt(slo.get('worst_burn_rate'))}, "
+                 f"{'FIRING' if slo.get('any_firing') else 'not firing'}")
+    for a in slo.get("alerts", []):
+        burns = ""
+        if "fast_burn" in a:
+            burns = (f" fast={_fmt(a['fast_burn'])} "
+                     f"slow={_fmt(a['slow_burn'])}")
+        lines.append(f"    [{a.get('verdict', '?'):>17}] {a['name']}"
+                     f"{burns}")
+    tenants = report.get("tenants", {})
+    if tenants:
+        lines.append("  per-tenant TTFT p99:")
+        for t in sorted(tenants):
+            lines.append(f"    {t}: {_fmt(tenants[t].get('ttft_p99_s'))} s")
+    g = report.get("goodput", {})
+    lines.append(f"  goodput: {_fmt(g.get('goodput_tokens'))} tokens "
+                 f"delivered of {_fmt(g.get('computed_tokens'))} computed "
+                 f"(fraction {_fmt(g.get('goodput_fraction'))})")
+    wasted = g.get("wasted_tokens", {})
+    if wasted:
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(wasted.items()))
+        lines.append(f"  wasted: {parts}")
+    rec = report.get("reconciliation", {})
+    lines.append(f"  reconciliation: tokens "
+                 f"{'ok' if rec.get('tokens_ok') else 'BROKEN'}, terminals "
+                 f"{'ok' if rec.get('terminals_ok') else 'BROKEN'}")
+    prefix = report.get("prefix", {})
+    if prefix:
+        lines.append(f"  prefix opportunity: hit rate "
+                     f"{_fmt(prefix.get('hit_rate'))}"
+                     + (f" over {prefix['total_blocks']} blocks"
+                        if prefix.get("total_blocks") else ""))
+    wire = report.get("wire")
+    if wire:
+        lines.append(f"  decode wire: "
+                     f"{_fmt(wire.get('wire_bytes_per_tick'))} bytes/tick")
+    return "\n".join(lines)

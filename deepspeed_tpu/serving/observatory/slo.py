@@ -1,0 +1,253 @@
+"""SLO burn-rate engine over the serving fleet.
+
+Declarative objectives (validated ``"slo"`` config section) evaluated
+SRE-workbook style: each objective burns its error budget at
+
+    burn = bad_fraction / (1 - target)
+
+and an alert FIRES only while BOTH a fast and a slow sliding window
+burn faster than ``burn_rate_threshold`` — the fast window makes the
+alert responsive, the slow window keeps one bad tick from paging — and
+CLEARS as soon as either window recovers. All quantile sources are the
+telemetry histograms' sliding-window views (never process-lifetime
+state), so a slow startup burst ages out of the verdict instead of
+tainting it forever.
+
+Observe-only by default: alert state is exported as gauges and the
+``/slo`` endpoint, and only becomes a ``FleetAutoscaler`` scale-out
+reason (``autoscale_on_burn``) or an admission-ladder shed hint
+(``shed_on_burn``) when the operator opts in — the chaos acceptance
+test pins that the default changes no decision.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu import telemetry
+
+#: histogram each objective metric reads (fleet-wide scope); per-tenant
+#: TTFT reads the per-tenant histogram the frontends already export
+_FLEET_TTFT = "fleet_ttft_seconds"
+_TENANT_TTFT = "serving_tenant_ttft_seconds"
+_DECODE_TOK = "fastgen_decode_token_seconds"
+
+
+@dataclasses.dataclass
+class SloAlert:
+    """One objective's evaluated state at an instant."""
+    name: str
+    metric: str
+    tenant: str
+    target: float
+    threshold_s: float
+    firing: bool
+    fast_burn: float
+    slow_burn: float
+    fast_window_s: float
+    slow_window_s: float
+    has_data: bool            # any observation inside the slow window
+    since: Optional[float] = None   # clock stamp of the current firing
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class SloEngine:
+    """Evaluates the configured objectives against a
+    :class:`~.ledger.FleetObservatory` (TTFT + availability sources) and
+    the process registry (decode-latency + per-tenant TTFT sources).
+
+    ``tenancy`` maps objective tenant names through the cardinality
+    guard so an objective on an over-cap tenant reads the same
+    ``"other"`` series the frontends recorded. Single-threaded, driven
+    by ``FleetRouter.run_tick``.
+    """
+
+    def __init__(self, config=None, observatory=None, tenancy=None,
+                 clock=time.monotonic):
+        from deepspeed_tpu.runtime.config import SloSectionConfig
+        from deepspeed_tpu.runtime.config_utils import config_from_dict
+
+        if config is None:
+            config = SloSectionConfig()
+        elif isinstance(config, dict):
+            config = config_from_dict(SloSectionConfig, config, path="slo.")
+        else:
+            config.validate()
+        self.cfg = config
+        self.objectives = config.parsed_objectives()
+        self.observatory = observatory
+        self.tenancy = tenancy
+        self.clock = clock
+        self._alerts: Dict[str, SloAlert] = {}
+        # the exact callable handed to the exposition layer — unregister
+        # matches by identity, and each ``self.state`` access binds a
+        # fresh method object, so the registered one must be kept
+        self._registered_provider = None
+        self._tm_burn = telemetry.gauge(
+            "fleet_slo_burn_rate",
+            "error-budget burn rate per objective and window (1.0 = "
+            "burning exactly the budget; the alert threshold is "
+            "slo.burn_rate_threshold)")
+        self._tm_firing = telemetry.gauge(
+            "fleet_slo_alert_firing",
+            "1 while an objective's burn-rate alert fires (both windows "
+            "over threshold), 0 otherwise")
+        self._tm_transitions = telemetry.counter(
+            "fleet_slo_alert_transitions_total",
+            "burn-rate alert edges per objective (to=firing / to=clear) "
+            "— a fired-and-cleared episode is exactly one of each")
+
+    # ------------------------------------------------------------ burn
+    def _tenant_label(self, tenant: str) -> str:
+        if self.tenancy is not None:
+            return self.tenancy.label(self.tenancy.resolve(tenant))
+        return tenant
+
+    def _bad_fraction(self, ocfg, window_s: float):
+        """``(bad_fraction, has_data)`` for one objective over one
+        window. No data burns nothing: an idle fleet is not an outage."""
+        if ocfg.metric == "availability":
+            if self.observatory is None:
+                return 0.0, False
+            avail = self.observatory.availability(
+                window_s, tenant=ocfg.tenant or None)
+            if avail is None:
+                return 0.0, False
+            return 1.0 - avail, True
+        if ocfg.metric == "ttft_p99_s" and ocfg.tenant:
+            hist = telemetry.get_registry().get(_TENANT_TTFT)
+            if hist is None:
+                return 0.0, False
+            bad = hist.windowed_bad_fraction(
+                ocfg.threshold_s, window_s=window_s,
+                tenant=self._tenant_label(ocfg.tenant))
+        elif ocfg.metric == "ttft_p99_s":
+            if self.observatory is None:
+                return 0.0, False
+            bad = self.observatory.ttft_bad_fraction(
+                ocfg.threshold_s, window_s=window_s)
+        else:   # decode_token_p99_s
+            hist = telemetry.get_registry().get(_DECODE_TOK)
+            if hist is None:
+                return 0.0, False
+            bad = hist.windowed_bad_fraction(
+                ocfg.threshold_s, window_s=window_s)
+        if bad is None:
+            return 0.0, False
+        return bad[0], True
+
+    def _burn(self, ocfg, window_s: float):
+        bad, has_data = self._bad_fraction(ocfg, window_s)
+        return bad / (1.0 - ocfg.target), has_data
+
+    # ------------------------------------------------------------ drive
+    def evaluate(self) -> List[SloAlert]:
+        """One evaluation pass over every objective; exports gauges and
+        counts firing/clear transitions. Cheap enough for every fleet
+        tick (a handful of window merges per objective)."""
+        if not self.cfg.enabled:
+            return []
+        out: List[SloAlert] = []
+        for ocfg in self.objectives:
+            fast, fast_data = self._burn(ocfg, self.cfg.fast_window_s)
+            slow, slow_data = self._burn(ocfg, self.cfg.slow_window_s)
+            firing = (fast > self.cfg.burn_rate_threshold
+                      and slow > self.cfg.burn_rate_threshold)
+            prev = self._alerts.get(ocfg.name)
+            since = prev.since if prev is not None else None
+            if firing and (prev is None or not prev.firing):
+                since = self.clock()
+                self._tm_transitions.inc(objective=ocfg.name, to="firing")
+            elif not firing:
+                if prev is not None and prev.firing:
+                    self._tm_transitions.inc(objective=ocfg.name, to="clear")
+                since = None
+            alert = SloAlert(
+                name=ocfg.name, metric=ocfg.metric, tenant=ocfg.tenant,
+                target=ocfg.target, threshold_s=ocfg.threshold_s,
+                firing=firing, fast_burn=round(fast, 6),
+                slow_burn=round(slow, 6),
+                fast_window_s=self.cfg.fast_window_s,
+                slow_window_s=self.cfg.slow_window_s,
+                has_data=fast_data or slow_data, since=since)
+            self._alerts[ocfg.name] = alert
+            self._tm_burn.set(alert.fast_burn, objective=ocfg.name,
+                              window="fast")
+            self._tm_burn.set(alert.slow_burn, objective=ocfg.name,
+                              window="slow")
+            self._tm_firing.set(1.0 if firing else 0.0, objective=ocfg.name)
+            out.append(alert)
+        return out
+
+    # ------------------------------------------------------------ reads
+    def alerts(self) -> List[SloAlert]:
+        return [self._alerts[o.name] for o in self.objectives
+                if o.name in self._alerts]
+
+    def any_firing(self) -> bool:
+        return any(a.firing for a in self._alerts.values())
+
+    def worst_burn_rate(self) -> float:
+        worst = 0.0
+        for a in self._alerts.values():
+            worst = max(worst, a.fast_burn, a.slow_burn)
+        return worst
+
+    # the two config-gated actions — both inert by default
+    def wants_scale_out(self) -> bool:
+        """True when a firing objective should become the autoscaler's
+        ``slo_burn`` scale-out reason (requires ``autoscale_on_burn``)."""
+        return self.cfg.autoscale_on_burn and self.any_firing()
+
+    def shed_tighten(self) -> float:
+        """Fractional tightening of the admission queue bound while any
+        objective fires (0.0 unless ``shed_on_burn``)."""
+        if self.cfg.shed_on_burn and self.any_firing():
+            return self.cfg.shed_tighten_frac
+        return 0.0
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready engine state: the ``/slo`` endpoint's body and the
+        fleet-report CLI's live source."""
+        body: Dict[str, Any] = {
+            "enabled": self.cfg.enabled,
+            "objectives_configured": len(self.cfg.objectives),
+            "burn_rate_threshold": self.cfg.burn_rate_threshold,
+            "fast_window_s": self.cfg.fast_window_s,
+            "slow_window_s": self.cfg.slow_window_s,
+            "objectives": [dataclasses.asdict(o) for o in self.objectives],
+            "alerts": [a.as_dict() for a in self.alerts()],
+            "any_firing": self.any_firing(),
+            "worst_burn_rate": round(self.worst_burn_rate(), 6),
+            "actions": {
+                "autoscale_on_burn": self.cfg.autoscale_on_burn,
+                "shed_on_burn": self.cfg.shed_on_burn,
+                "shed_tighten": self.shed_tighten(),
+            },
+        }
+        if self.observatory is not None:
+            body["goodput"] = self.observatory.snapshot()
+            p99 = self.observatory.ttft_quantile(0.99)
+            if p99 is not None:
+                body["ttft_p99_s"] = round(p99, 6)
+        return body
+
+    # ------------------------------------------------------------ expose
+    def register_endpoint(self) -> None:
+        """Serve :meth:`state` at ``/slo`` on the exposition server
+        (idempotent; last registrant wins process-wide, matching the
+        one-exposition-server-per-process model)."""
+        from deepspeed_tpu.telemetry import exposition
+
+        self._registered_provider = self.state
+        exposition.register_slo_provider(self._registered_provider)
+
+    def close(self) -> None:
+        if self._registered_provider is not None:
+            from deepspeed_tpu.telemetry import exposition
+
+            exposition.unregister_slo_provider(self._registered_provider)
+            self._registered_provider = None

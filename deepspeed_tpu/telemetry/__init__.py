@@ -29,6 +29,7 @@ from deepspeed_tpu.telemetry.bridge import MonitorBridge
 from deepspeed_tpu.telemetry.exposition import (
     MetricsServer,
     clear_health_probes,
+    clear_slo_provider,
     health_probe_names,
     health_report,
     register_health_probe,
@@ -40,6 +41,8 @@ from deepspeed_tpu.telemetry.exposition import (
     unregister_health_probe,
 )
 from deepspeed_tpu.telemetry.registry import (
+    DEFAULT_WINDOW_INTERVALS,
+    DEFAULT_WINDOW_S,
     Counter,
     Gauge,
     Histogram,
@@ -79,8 +82,12 @@ def gauge(name: str, description: str = "") -> Gauge:
 
 
 def histogram(name: str, description: str = "",
-              buckets: Optional[Sequence[float]] = None) -> Histogram:
-    return _default_registry.histogram(name, description, buckets=buckets)
+              buckets: Optional[Sequence[float]] = None,
+              window_s: float = DEFAULT_WINDOW_S,
+              window_intervals: int = DEFAULT_WINDOW_INTERVALS) -> Histogram:
+    return _default_registry.histogram(
+        name, description, buckets=buckets,
+        window_s=window_s, window_intervals=window_intervals)
 
 
 def span(name: str, **labels):
@@ -109,8 +116,10 @@ def stop_metrics_server() -> None:
 
 def reset() -> None:
     """Tests only: stop the server, clear the default registry, drop any
-    registered health probes, and disable/clear the default tracer."""
+    registered health probes and /slo provider, and disable/clear the
+    default tracer."""
     _stop_server()
     clear_health_probes()
+    clear_slo_provider()
     tracing.reset()
     _default_registry.reset()

@@ -59,7 +59,53 @@ def _format_value(v: float) -> str:
     return repr(float(v))
 
 
-def render_prometheus(registry: MetricsRegistry) -> str:
+# --------------------------------------------------------------------- #
+# per-tenant filtered views (?tenant= on /metrics and /snapshot)
+# --------------------------------------------------------------------- #
+#: addressable tenant-label cardinality for ?tenant= filtering — kept in
+#: lockstep with ``tenancy.max_tenant_labels`` by whoever adopts a
+#: tenancy config (overflow tenants fold into "other" there, so serving
+#: filtered views past the cap would only ever show empty series)
+_tenant_filter_cap = 32
+_tenant_filter_lock = make_lock("exposition._tenant_filter_lock")
+
+
+def set_tenant_filter_cap(n: int) -> None:
+    global _tenant_filter_cap
+    with _tenant_filter_lock:
+        _tenant_filter_cap = max(1, int(n))
+
+
+def tenant_filter_cap() -> int:
+    with _tenant_filter_lock:
+        return _tenant_filter_cap
+
+
+def _addressable_tenants(registry: MetricsRegistry) -> list:
+    """Distinct ``tenant`` label values across the registry, sorted,
+    truncated at the filter cap — the only values ``?tenant=`` serves."""
+    values = set()
+    for metric in registry.metrics():
+        for key, _ in metric.labels_items():
+            for k, v in key:
+                if k == "tenant":
+                    values.add(v)
+    return sorted(values)[:tenant_filter_cap()]
+
+
+def _keep(key, tenant: Optional[str]) -> bool:
+    """With no filter keep everything; with one, keep label-less and
+    non-tenant series (fleet-wide context) plus the matching tenant's."""
+    if tenant is None:
+        return True
+    for k, v in key:
+        if k == "tenant":
+            return v == tenant
+    return True
+
+
+def render_prometheus(registry: MetricsRegistry,
+                      tenant: Optional[str] = None) -> str:
     registry.collect()
     lines = []
     for metric in registry.metrics():
@@ -67,6 +113,8 @@ def render_prometheus(registry: MetricsRegistry) -> str:
         lines.append(f"# TYPE {metric.name} {metric.kind}")
         if isinstance(metric, Histogram):
             for key, child in metric.labels_items():
+                if not _keep(key, tenant):
+                    continue
                 cum = 0
                 for i, edge in enumerate(metric.buckets):
                     cum += child.bucket_counts[i]
@@ -82,25 +130,36 @@ def render_prometheus(registry: MetricsRegistry) -> str:
                     f"{metric.name}_count{format_labels(key)} {child.count}")
         elif isinstance(metric, (Counter, Gauge)):
             for key, value in metric.labels_items():
+                if not _keep(key, tenant):
+                    continue
                 lines.append(f"{metric.name}{format_labels(key)} "
                              f"{_format_value(value)}")
     return "\n".join(lines) + "\n"
 
 
-def snapshot(registry: MetricsRegistry) -> Dict[str, Any]:
+def snapshot(registry: MetricsRegistry,
+             tenant: Optional[str] = None) -> Dict[str, Any]:
     registry.collect()
     out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
     for metric in registry.metrics():
         if isinstance(metric, Histogram):
             for key, _child in metric.labels_items():
+                if not _keep(key, tenant):
+                    continue
                 out["histograms"][metric.name + format_labels(key)] = \
                     metric.summary(**dict(key))
         elif isinstance(metric, Counter):
             for key, value in metric.labels_items():
+                if not _keep(key, tenant):
+                    continue
                 out["counters"][metric.name + format_labels(key)] = value
         elif isinstance(metric, Gauge):
             for key, value in metric.labels_items():
+                if not _keep(key, tenant):
+                    continue
                 out["gauges"][metric.name + format_labels(key)] = value
+    if tenant is not None:
+        out["tenant_filter"] = tenant
     return out
 
 
@@ -179,18 +238,84 @@ def health_report(kind: str) -> Tuple[bool, Dict[str, Any]]:
     return ok, {"status": "ok" if ok else "unavailable", "checks": checks}
 
 
+# --------------------------------------------------------------------- #
+# /slo provider
+# --------------------------------------------------------------------- #
+#: one provider per process (matching the one-exposition-server model):
+#: a zero-arg callable returning the JSON-ready /slo body — the fleet's
+#: ``SloEngine.state``. Last registrant wins.
+_slo_provider: Optional[Callable[[], Dict[str, Any]]] = None
+_slo_lock = make_lock("exposition._slo_lock")
+
+
+def register_slo_provider(fn: Callable[[], Dict[str, Any]]) -> None:
+    global _slo_provider
+    with _slo_lock:
+        _slo_provider = fn
+
+
+def unregister_slo_provider(fn: Callable[[], Dict[str, Any]]) -> None:
+    """Unregister ``fn`` if it is still the current provider (a closing
+    fleet must not tear down a successor's registration)."""
+    global _slo_provider
+    with _slo_lock:
+        if _slo_provider is fn:
+            _slo_provider = None
+
+
+def clear_slo_provider() -> None:
+    """Tests only (telemetry.reset): drop the provider unconditionally."""
+    global _slo_provider
+    with _slo_lock:
+        _slo_provider = None
+
+
+def slo_report() -> Dict[str, Any]:
+    """The /slo body: the provider's state, or an explicit 'no engine'
+    document (the endpoint always answers — absence is a finding, not a
+    404, so dashboards don't conflate 'no SLOs' with 'server gone')."""
+    with _slo_lock:
+        provider = _slo_provider
+    if provider is None:
+        return {"enabled": False, "objectives": [], "alerts": [],
+                "any_firing": False,
+                "detail": "no SLO engine registered in this process"}
+    return provider()
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None  # set by MetricsServer
+
+    def _query_tenant(self) -> Optional[str]:
+        """The validated ?tenant= filter value, or None. Only values
+        inside the addressable set (distinct tenant labels, capped at
+        ``set_tenant_filter_cap``) select series; anything else filters
+        everything tenant-labeled out — the same fold-don't-explode
+        stance the tenancy cardinality guard takes on the write path."""
+        from urllib.parse import parse_qs, urlsplit
+
+        query = parse_qs(urlsplit(self.path).query)
+        wanted = query.get("tenant", [None])[0]
+        if wanted is None:
+            return None
+        if wanted in _addressable_tenants(self.registry):
+            return wanted
+        return "\x00unaddressable"   # matches no real label value
 
     def do_GET(self):  # noqa: N802 (http.server API)
         status = 200
         try:
             path = self.path.split("?")[0]
             if path in ("/metrics", "/"):
-                body = render_prometheus(self.registry).encode()
+                body = render_prometheus(
+                    self.registry, tenant=self._query_tenant()).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/snapshot":
-                body = json.dumps(snapshot(self.registry)).encode()
+                body = json.dumps(snapshot(
+                    self.registry, tenant=self._query_tenant())).encode()
+                ctype = "application/json"
+            elif path == "/slo":
+                body = json.dumps(slo_report()).encode()
                 ctype = "application/json"
             elif path in ("/trace", "/flight"):
                 from deepspeed_tpu.telemetry import tracing

@@ -25,6 +25,7 @@ breaking the scrape.
 """
 from __future__ import annotations
 
+import collections
 import threading
 
 from deepspeed_tpu.analysis.racelint.sanitizer import make_lock
@@ -37,6 +38,14 @@ LabelKey = Tuple[Tuple[str, str], ...]
 # CPU tick and a multi-second fused train window through a remote tunnel.
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+# Sliding-window defaults: every histogram keeps a ring of per-interval
+# snapshots alongside its lifetime state, so windowed quantiles reflect
+# the last ``window_s`` seconds instead of the whole process lifetime
+# (one slow startup tick must not skew a p99 gauge — or a hedge
+# threshold — forever). Granularity is ``window_s / window_intervals``.
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_WINDOW_INTERVALS = 6
 
 _process_zero: Optional[bool] = None
 
@@ -104,6 +113,11 @@ class Counter(_Metric):
         with self._lock:
             return self._children.get(_label_key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label combination."""
+        with self._lock:
+            return sum(self._children.values())
+
 
 class Gauge(_Metric):
     kind = "gauge"
@@ -145,19 +159,49 @@ class _HistogramChild:
         self.min = float("inf")
         self.max = float("-inf")
 
+    def merge(self, other: "_HistogramChild") -> None:
+        for i, n in enumerate(other.bucket_counts):
+            self.bucket_counts[i] += n
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics). ``observe`` takes
     an optional ``n`` weight so a fused window can credit its per-item mean
-    once per item without a Python loop."""
+    once per item without a Python loop.
+
+    Alongside the lifetime state every child keeps a bounded ring of
+    per-interval snapshots (``window_s`` seconds in ``window_intervals``
+    slices): ``windowed_summary`` / ``windowed_quantile`` answer over
+    the last N seconds only, while ``summary`` keeps its process-lifetime
+    semantics for bench back-compat. ``set_window_clock`` injects a
+    deterministic clock (the serving fleet points it at its own, so the
+    chaos tests' seeded clocks drive window expiry too)."""
 
     kind = "histogram"
 
     def __init__(self, name: str, description: str, registry: "MetricsRegistry",
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 window_intervals: int = DEFAULT_WINDOW_INTERVALS):
         super().__init__(name, description, registry)
         self.buckets = tuple(sorted(buckets if buckets is not None
                                     else DEFAULT_BUCKETS))
+        self.window_s = float(window_s)
+        self.window_intervals = max(1, int(window_intervals))
+        self._interval_s = self.window_s / self.window_intervals
+        self._clock = time.monotonic
+        # per-label ring of (interval_index, interval child), newest last
+        self._win: Dict[LabelKey, collections.deque] = {}
+
+    def set_window_clock(self, clock: Callable[[], float]) -> None:
+        """Point the sliding window at an injectable clock (tests, the
+        fleet's deterministic clock). Lifetime state is clock-free."""
+        with self._lock:
+            self._clock = clock
 
     def labels_items(self):
         """Consistent SNAPSHOTS of each child, copied under the registry
@@ -193,6 +237,94 @@ class Histogram(_Metric):
             child.sum += value * n
             child.min = min(child.min, value)
             child.max = max(child.max, value)
+            # the windowed twin: same observation lands in the current
+            # interval's snapshot; expired intervals fall off the ring
+            wchild = self._win_child(key)
+            wchild.bucket_counts[idx] += n
+            wchild.count += n
+            wchild.sum += value * n
+            wchild.min = min(wchild.min, value)
+            wchild.max = max(wchild.max, value)
+
+    def _win_child(self, key: LabelKey) -> _HistogramChild:
+        """Current interval's child for ``key`` (caller holds the lock)."""
+        now_idx = int(self._clock() // self._interval_s)
+        ring = self._win.get(key)
+        if ring is None:
+            ring = self._win[key] = collections.deque()
+        if not ring or ring[-1][0] != now_idx:
+            ring.append((now_idx, _HistogramChild(len(self.buckets))))
+        while ring and ring[0][0] <= now_idx - self.window_intervals:
+            ring.popleft()
+        return ring[-1][1]
+
+    def windowed_child(self, window_s: Optional[float] = None,
+                       **labels) -> Optional[_HistogramChild]:
+        """Merged snapshot of the intervals inside the last ``window_s``
+        seconds (default: the full configured window; longer requests are
+        clamped to what the ring retains). None when no observation
+        landed inside the window."""
+        if window_s is None:
+            window_s = self.window_s
+        span = max(1, int(round(window_s / self._interval_s)))
+        span = min(span, self.window_intervals)
+        with self._lock:
+            ring = self._win.get(_label_key(labels))
+            if not ring:
+                return None
+            now_idx = int(self._clock() // self._interval_s)
+            merged = _HistogramChild(len(self.buckets))
+            for idx, child in ring:
+                if now_idx - span < idx <= now_idx:
+                    merged.merge(child)
+        return merged if merged.count else None
+
+    def windowed_quantile(self, q: float,
+                          window_s: Optional[float] = None,
+                          **labels) -> Optional[float]:
+        """Bucket-interpolated quantile over the sliding window, or None
+        when the window is empty — callers fall back to their floor (the
+        hedge threshold) or the lifetime view."""
+        child = self.windowed_child(window_s=window_s, **labels)
+        if child is None:
+            return None
+        return self._quantile(self.buckets, child, q)
+
+    def windowed_summary(self, window_s: Optional[float] = None,
+                         **labels) -> Dict[str, float]:
+        """Like :meth:`summary` but over the sliding window only, with a
+        p99 column (the SLO engine's quantile source)."""
+        child = self.windowed_child(window_s=window_s, **labels)
+        if child is None:
+            return {"count": 0, "sum": 0.0}
+        return {
+            "count": child.count,
+            "sum": round(child.sum, 9),
+            "mean": round(child.sum / child.count, 9),
+            "min": round(child.min, 9),
+            "max": round(child.max, 9),
+            "p50": round(self._quantile(self.buckets, child, 0.5), 9),
+            "p95": round(self._quantile(self.buckets, child, 0.95), 9),
+            "p99": round(self._quantile(self.buckets, child, 0.99), 9),
+        }
+
+    def windowed_bad_fraction(self, threshold: float,
+                              window_s: Optional[float] = None,
+                              **labels) -> Optional[Tuple[float, int]]:
+        """``(bad_fraction, total)`` over the window, where *bad* means an
+        observation above ``threshold`` — counted at bucket granularity
+        (the smallest bucket edge >= threshold bounds the good side), so
+        the verdict is deterministic and scrape-consistent. None when the
+        window is empty."""
+        child = self.windowed_child(window_s=window_s, **labels)
+        if child is None or child.count == 0:
+            return None
+        good = 0
+        for i, edge in enumerate(self.buckets):
+            if edge > threshold:
+                break
+            good += child.bucket_counts[i]
+        return (child.count - good) / child.count, child.count
 
     def child(self, **labels) -> Optional[_HistogramChild]:
         with self._lock:
@@ -216,6 +348,19 @@ class Histogram(_Metric):
             seen += n
             lo = edge
         return child.max
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Lifetime-view quantile estimate (``windowed_quantile`` is the
+        recency-bounded sibling); None before any observation."""
+        with self._lock:
+            live = self._children.get(_label_key(labels))
+            if live is None or live.count == 0:
+                return None
+            child = _HistogramChild.__new__(_HistogramChild)
+            child.bucket_counts = list(live.bucket_counts)
+            child.count, child.sum = live.count, live.sum
+            child.min, child.max = live.min, live.max
+        return self._quantile(self.buckets, child, q)
 
     def summary(self, **labels) -> Dict[str, float]:
         with self._lock:   # copy, not live — same torn-read hazard as
@@ -278,8 +423,13 @@ class MetricsRegistry:
         return self._get_or_make(Gauge, name, description)
 
     def histogram(self, name: str, description: str = "",
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
-        return self._get_or_make(Histogram, name, description, buckets=buckets)
+                  buckets: Optional[Sequence[float]] = None,
+                  window_s: float = DEFAULT_WINDOW_S,
+                  window_intervals: int = DEFAULT_WINDOW_INTERVALS,
+                  ) -> Histogram:
+        return self._get_or_make(Histogram, name, description,
+                                 buckets=buckets, window_s=window_s,
+                                 window_intervals=window_intervals)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
@@ -338,5 +488,8 @@ class MetricsRegistry:
         with self._lock:
             for m in self._metrics.values():
                 m._children.clear()
+                win = getattr(m, "_win", None)
+                if win is not None:
+                    win.clear()
             self._collectors.clear()
             self.last_span = None

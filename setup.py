@@ -37,6 +37,7 @@ setup(
             "trace-dump=deepspeed_tpu.telemetry.tracing:main",
             "bench-diff=deepspeed_tpu.bench.cli:main",
             "step-report=deepspeed_tpu.profiling.observatory.__main__:main",
+            "fleet-report=deepspeed_tpu.serving.observatory.__main__:main",
             "plan=deepspeed_tpu.autotuning.__main__:main",
             "reshard=deepspeed_tpu.checkpoint.reshard_cli:main",
         ],

@@ -153,6 +153,16 @@ def pytest_configure(config):
         "deterministically under the sync_point interleaving fuzzer — "
         "AST + threads only, tier-1-eligible under JAX_PLATFORMS=cpu)")
     config.addinivalue_line(
+        "markers", "slo: fleet-observatory tests (request-lifecycle "
+        "ledger + goodput/waste reconciliation, multi-window SLO "
+        "burn-rate alerting, KV/prefix opportunity metering, tenant-"
+        "filtered exposition, bench schema-v2.6 slo blocks, the "
+        "fleet-report CLI exit-code matrix — CPU backend, tier-1-"
+        "eligible under JAX_PLATFORMS=cpu; the chaos acceptance pins a "
+        "fast-window burn alert FIRING during a replica-kill burst and "
+        "CLEARING after quorum recovery under an injected clock, with "
+        "zero lost uids and observe-only decision equality)")
+    config.addinivalue_line(
         "markers", "autotune: observatory-driven plan-engine tests "
         "(plan schema + canary enforcement, analytic OOM refusal, "
         "plan-key purity, engine plan-cache hit/stale/fail_on_stale, "

@@ -336,6 +336,37 @@ class TestConfigKeys:
             f"tenancy keys no longer consumed: "
             f"{tenancy_keys - consumed}")
 
+    def test_slo_section_keys_stay_consumed_and_undeclared(self):
+        # self-enforcement for the fleet observatory (ISSUE 20): the
+        # "slo" section is a validated DeepSpeedTPUConfig field and
+        # every key must stay actually consumed — the SloEngine reads
+        # the windows/threshold/action gates, the FleetRouter reads
+        # ledger_size, the per-objective keys drive burn evaluation; a
+        # dropped read would leave an operator's SLO decorative while
+        # the config still promises alerting
+        from deepspeed_tpu.analysis.rules.config_keys import (
+            DEAD_KEYS,
+            EXTRA_KEYS,
+            consumed_attr_keys,
+        )
+
+        slo_keys = {"slo", "enabled", "objectives", "fast_window_s",
+                    "slow_window_s", "burn_rate_threshold", "ledger_size",
+                    "autoscale_on_burn", "shed_on_burn",
+                    "shed_tighten_frac",
+                    # per-objective keys (SloObjectiveConfig)
+                    "name", "metric", "threshold_s", "target", "tenant"}
+        assert "slo" not in EXTRA_KEYS, (
+            "slo must stay a declared schema section "
+            "(DeepSpeedTPUConfig.slo), not an EXTRA_KEYS escape")
+        assert not slo_keys & set(DEAD_KEYS), (
+            "slo section keys declared dead — "
+            "serving/observatory/slo.py consumes them")
+        proj, _ = dsl_core.load_project([PKG])
+        consumed = consumed_attr_keys(proj, slo_keys)
+        assert consumed == slo_keys, (
+            f"slo keys no longer consumed: {slo_keys - consumed}")
+
     def test_fleet_autoscale_keys_stay_consumed_and_undeclared(self):
         # the autoscaler half of ISSUE 17: the fleet section's autoscale
         # keys drive serving/fleet.FleetAutoscaler — a dropped read
